@@ -1,0 +1,59 @@
+"""deepspeed_tpu — a TPU-native large-model training & inference framework.
+
+Capability surface of DeepSpeed v0.9.2 (reference: /root/reference), designed
+idiomatically for JAX/XLA/Pallas: named-mesh sharding instead of NCCL process
+groups, SPMD ZeRO instead of hook-driven partitioning, Pallas kernels instead
+of CUDA. Public entry points mirror the reference (``deepspeed/__init__.py``):
+
+  initialize()       -> (engine, optimizer, dataloader, lr_scheduler)
+  init_inference()   -> InferenceEngine
+  comm               -> named-axis collective API
+"""
+
+__version__ = "0.1.0"
+version = __version__
+
+from . import comm  # noqa: F401
+from .config import Config, ConfigError, load_config  # noqa: F401
+from .parallel import topology  # noqa: F401
+from .utils.logging import log_dist, logger  # noqa: F401
+
+
+def initialize(args=None, model=None, optimizer=None, model_parameters=None,
+               training_data=None, lr_scheduler=None, mesh=None, config=None,
+               config_params=None, rng=None, collate_fn=None, dist_init_required=None):
+    """Create a training engine — analog of ``deepspeed.initialize`` (reference
+    deepspeed/__init__.py:58). Imported lazily to keep ``import deepspeed_tpu``
+    cheap."""
+    from .runtime.engine import initialize as _initialize
+
+    return _initialize(args=args, model=model, optimizer=optimizer,
+                       model_parameters=model_parameters, training_data=training_data,
+                       lr_scheduler=lr_scheduler, mesh=mesh,
+                       config=config if config is not None else config_params,
+                       rng=rng, collate_fn=collate_fn)
+
+
+def init_inference(model=None, config=None, **kwargs):
+    """Analog of ``deepspeed.init_inference`` (reference deepspeed/__init__.py:260)."""
+    from .inference.engine import init_inference as _init_inference
+
+    return _init_inference(model=model, config=config, **kwargs)
+
+
+def add_config_arguments(parser):
+    """Analog of reference deepspeed/__init__.py:237 — attach --deepspeed args."""
+    group = parser.add_argument_group("DeepSpeed-TPU", "DeepSpeed-TPU configurations")
+    group.add_argument("--deepspeed", default=False, action="store_true",
+                       help="Enable DeepSpeed-TPU (helper flag for compatibility)")
+    group.add_argument("--deepspeed_config", default=None, type=str,
+                       help="Path to the framework JSON config file")
+    group.add_argument("--deepscale", default=False, action="store_true",
+                       help=argparse_suppress())
+    return parser
+
+
+def argparse_suppress():
+    import argparse
+
+    return argparse.SUPPRESS

@@ -1,0 +1,18 @@
+from .base import ConfigError, ConfigModel
+from .config import (AIOConfig, ActivationCheckpointingConfig, BF16Config,
+                     CheckpointConfig, CommsLoggerConfig, CompressionConfig,
+                     Config, CurriculumConfig, DataEfficiencyConfig,
+                     ElasticityConfig, FlopsProfilerConfig, FP16Config,
+                     MonitorConfig, OffloadOptimizerConfig, OffloadParamConfig,
+                     OptimizerConfig, ParallelConfig, SchedulerConfig,
+                     ZeroConfig, load_config)
+
+__all__ = [
+    "ConfigError", "ConfigModel", "Config", "load_config",
+    "FP16Config", "BF16Config", "OptimizerConfig", "SchedulerConfig",
+    "ZeroConfig", "OffloadParamConfig", "OffloadOptimizerConfig",
+    "ParallelConfig", "ActivationCheckpointingConfig", "CommsLoggerConfig",
+    "FlopsProfilerConfig", "MonitorConfig", "ElasticityConfig",
+    "CurriculumConfig", "DataEfficiencyConfig", "CompressionConfig",
+    "AIOConfig", "CheckpointConfig",
+]

@@ -1,0 +1,144 @@
+"""Typed config-tree machinery.
+
+TPU-native analog of the reference's pydantic ``DeepSpeedConfigModel``
+(``runtime/config_utils.py:16``): every feature config is a dataclass that can be
+built from an (untyped) JSON dict with
+
+  * unknown-key detection,
+  * type coercion/validation,
+  * deprecated-key auto-migration (old key -> new key with a warning), and
+  * nested sub-config instantiation.
+
+Implemented over stdlib dataclasses so the framework has zero dependency on a
+specific pydantic major version.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field, fields
+from typing import Any, Dict, Mapping, Optional, Tuple, Type, TypeVar, Union, get_args, get_origin
+
+from ..utils.logging import logger
+
+T = TypeVar("T", bound="ConfigModel")
+
+
+class ConfigError(ValueError):
+    """Raised for malformed framework configs."""
+
+
+def _is_config_model(tp: Any) -> bool:
+    return isinstance(tp, type) and issubclass(tp, ConfigModel)
+
+
+def _unwrap_optional(tp: Any) -> Any:
+    if get_origin(tp) is Union:
+        args = [a for a in get_args(tp) if a is not type(None)]
+        if len(args) == 1:
+            return args[0]
+    return tp
+
+
+def _coerce(name: str, value: Any, tp: Any) -> Any:
+    """Best-effort typed coercion of a JSON value into the annotated type."""
+    tp = _unwrap_optional(tp)
+    if value is None:
+        return None
+    if _is_config_model(tp):
+        if isinstance(value, tp):
+            return value
+        if isinstance(value, Mapping):
+            return tp.from_dict(value)
+        raise ConfigError(f"field '{name}' expects a mapping for {tp.__name__}, got {type(value).__name__}")
+    origin = get_origin(tp)
+    if origin in (list, tuple):
+        (elem_tp,) = get_args(tp)[:1] or (Any,)
+        seq = [_coerce(f"{name}[{i}]", v, elem_tp) for i, v in enumerate(value)]
+        return tuple(seq) if origin is tuple else seq
+    if origin is dict:
+        return dict(value)
+    if tp is bool:
+        if isinstance(value, bool):
+            return value
+        if isinstance(value, str) and value.lower() in ("true", "false"):
+            return value.lower() == "true"
+        raise ConfigError(f"field '{name}' expects bool, got {value!r}")
+    if tp is int:
+        if isinstance(value, bool) or not isinstance(value, (int, float)):
+            raise ConfigError(f"field '{name}' expects int, got {value!r}")
+        if isinstance(value, float):
+            if not value.is_integer():
+                raise ConfigError(f"field '{name}' expects int, got {value!r}")
+            value = int(value)
+        return value
+    if tp is float:
+        if isinstance(value, bool) or not isinstance(value, (int, float)):
+            raise ConfigError(f"field '{name}' expects float, got {value!r}")
+        return float(value)
+    if tp is str:
+        if not isinstance(value, str):
+            raise ConfigError(f"field '{name}' expects str, got {value!r}")
+        return value
+    return value
+
+
+@dataclass
+class ConfigModel:
+    """Base class for all config nodes. Subclasses may define a ``DEPRECATED``
+    class attribute: map of deprecated key -> (new key or None, message)."""
+
+    @classmethod
+    def deprecated_keys(cls) -> Dict[str, Tuple[Optional[str], str]]:
+        return getattr(cls, "DEPRECATED", {})
+
+    @classmethod
+    def _type_hints(cls) -> Dict[str, Any]:
+        cached = cls.__dict__.get("_type_hints_cache")
+        if cached is None:
+            import typing
+
+            cached = typing.get_type_hints(cls)
+            cls._type_hints_cache = cached
+        return cached
+
+    @classmethod
+    def from_dict(cls: Type[T], data: Optional[Mapping[str, Any]] = None) -> T:
+        data = dict(data or {})
+        # deprecated-key migration (reference: config_utils.py:19-50)
+        for old_key, (new_key, msg) in cls.deprecated_keys().items():
+            if old_key in data:
+                logger.warning(f"Config key '{old_key}' is deprecated: {msg}")
+                value = data.pop(old_key)
+                if new_key is not None and new_key not in data:
+                    data[new_key] = value
+        known = {f.name: f for f in fields(cls)}
+        hints = cls._type_hints()
+        kwargs: Dict[str, Any] = {}
+        for key, value in data.items():
+            if key not in known:
+                raise ConfigError(
+                    f"{cls.__name__}: unknown config key '{key}' "
+                    f"(known: {sorted(known)})")
+            kwargs[key] = _coerce(key, value, hints.get(key, Any))
+        obj = cls(**kwargs)
+        obj.validate()
+        return obj
+
+    def validate(self) -> None:
+        """Subclasses override for cross-field checks."""
+
+    def to_dict(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        for f in fields(self):
+            value = getattr(self, f.name)
+            if isinstance(value, ConfigModel):
+                out[f.name] = value.to_dict()
+            elif dataclasses.is_dataclass(value) and not isinstance(value, type):
+                out[f.name] = dataclasses.asdict(value)
+            else:
+                out[f.name] = value
+        return out
+
+    def replace(self: T, **changes: Any) -> T:
+        return dataclasses.replace(self, **changes)

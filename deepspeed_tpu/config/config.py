@@ -1,0 +1,464 @@
+"""The framework config tree.
+
+TPU-native analog of ``deepspeed/runtime/config.py`` (``DeepSpeedConfig``,
+reference :674) plus the per-feature pydantic models scattered through the
+reference (``runtime/zero/config.py``, ``inference/config.py``,
+``monitor/config.py``, ...). One JSON file / dict drives everything; the batch
+triad ``train_batch_size = micro_batch * grad_accum * dp_world`` is resolved
+exactly like ``_set_batch_related_parameters`` (reference runtime/config.py:888).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Mapping, Optional, Union
+
+from .base import ConfigError, ConfigModel
+
+# ---------------------------------------------------------------------------
+# precision
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class FP16Config(ConfigModel):
+    """Reference: ``runtime/fp16`` config section (runtime/config.py FP16 keys)."""
+
+    enabled: bool = False
+    auto_cast: bool = False
+    loss_scale: float = 0.0  # 0 => dynamic
+    initial_scale_power: int = 16
+    loss_scale_window: int = 1000
+    hysteresis: int = 2
+    min_loss_scale: float = 1.0
+
+    @property
+    def dynamic_loss_scale(self) -> bool:
+        return self.loss_scale == 0.0
+
+
+@dataclass
+class BF16Config(ConfigModel):
+    """bf16 is the natural TPU dtype; mirrors the reference ``bf16`` section."""
+
+    enabled: bool = False
+
+
+# ---------------------------------------------------------------------------
+# optimizer / scheduler
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class OptimizerConfig(ConfigModel):
+    """Reference: ``optimizer`` JSON section (runtime/config.py get_optimizer_params)."""
+
+    type: str = "adamw"
+    params: Dict[str, Any] = field(default_factory=dict)
+
+    def validate(self) -> None:
+        known = {"adam", "adamw", "lamb", "adagrad", "sgd", "lion",
+                 "onebitadam", "onebitlamb", "zerooneadam", "fusedadam", "cpuadam"}
+        if self.type.lower() not in known:
+            raise ConfigError(f"unknown optimizer type '{self.type}' (known: {sorted(known)})")
+
+
+@dataclass
+class SchedulerConfig(ConfigModel):
+    """Reference: ``scheduler`` JSON section → runtime/lr_schedules.py."""
+
+    type: str = "WarmupLR"
+    params: Dict[str, Any] = field(default_factory=dict)
+
+
+# ---------------------------------------------------------------------------
+# ZeRO
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class OffloadParamConfig(ConfigModel):
+    """Reference: runtime/zero/offload_config.py (DeepSpeedZeroOffloadParamConfig)."""
+
+    device: str = "none"  # none | cpu | nvme
+    nvme_path: str = "/local_nvme"
+    buffer_count: int = 5
+    buffer_size: int = 100_000_000
+    max_in_cpu: int = 1_000_000_000
+    pin_memory: bool = False
+
+    def validate(self) -> None:
+        if self.device not in ("none", "cpu", "nvme"):
+            raise ConfigError(f"offload_param.device must be none|cpu|nvme, got {self.device}")
+
+
+@dataclass
+class OffloadOptimizerConfig(ConfigModel):
+    """Reference: runtime/zero/offload_config.py (DeepSpeedZeroOffloadOptimizerConfig)."""
+
+    device: str = "none"
+    nvme_path: str = "/local_nvme"
+    buffer_count: int = 4
+    pin_memory: bool = False
+    pipeline_read: bool = False
+    pipeline_write: bool = False
+    fast_init: bool = False
+    ratio: float = 1.0
+
+    def validate(self) -> None:
+        if self.device not in ("none", "cpu", "nvme"):
+            raise ConfigError(f"offload_optimizer.device must be none|cpu|nvme, got {self.device}")
+
+
+@dataclass
+class ZeroConfig(ConfigModel):
+    """Reference: runtime/zero/config.py:76 (DeepSpeedZeroConfig).
+
+    On TPU, the stages are sharding policies over the ``data`` mesh axis:
+      stage 0 — replicated params/grads/opt-state (pure DP, grads psum'd)
+      stage 1 — optimizer state sharded
+      stage 2 — optimizer state + gradients sharded (grad reduce-scatter)
+      stage 3 — parameters sharded too (FSDP; XLA inserts per-layer allgather)
+    Bucket/overlap knobs from the reference are accepted for config
+    compatibility but are no-ops: XLA schedules collective overlap itself.
+    """
+
+    stage: int = 0
+    contiguous_gradients: bool = True
+    reduce_scatter: bool = True
+    reduce_bucket_size: int = 500_000_000
+    allgather_partitions: bool = True
+    allgather_bucket_size: int = 500_000_000
+    overlap_comm: bool = False
+    load_from_fp32_weights: bool = True
+    elastic_checkpoint: bool = False
+    offload_param: OffloadParamConfig = field(default_factory=OffloadParamConfig)
+    offload_optimizer: OffloadOptimizerConfig = field(default_factory=OffloadOptimizerConfig)
+    sub_group_size: int = 1_000_000_000
+    stage3_max_live_parameters: int = 1_000_000_000
+    stage3_max_reuse_distance: int = 1_000_000_000
+    stage3_prefetch_bucket_size: int = 50_000_000
+    stage3_param_persistence_threshold: int = 100_000
+    stage3_gather_16bit_weights_on_model_save: bool = False
+    ignore_unused_parameters: bool = True
+    round_robin_gradients: bool = False
+    zero_hpz_partition_size: int = 1
+    zero_quantized_weights: bool = False
+
+    DEPRECATED = {
+        "stage3_gather_fp16_weights_on_model_save": (
+            "stage3_gather_16bit_weights_on_model_save", "renamed in reference v0.6"),
+        "cpu_offload": (None, "use offload_optimizer.device=cpu"),
+        "cpu_offload_params": (None, "use offload_param.device=cpu"),
+    }
+
+    def validate(self) -> None:
+        if not 0 <= self.stage <= 3:
+            raise ConfigError(f"zero_optimization.stage must be in [0,3], got {self.stage}")
+
+
+# ---------------------------------------------------------------------------
+# parallel topology
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class ParallelConfig(ConfigModel):
+    """Mesh-axis degrees. The reference scatters these (mpu for TP, PipelineModule
+    for PP, MoE kwargs for EP); here they are first-class config so the engine
+    can build one ``jax.sharding.Mesh`` with axes (pipe, data, seq, model).
+    ``data`` is the ZeRO/FSDP axis. 0 means "infer from world size"."""
+
+    data_parallel_size: int = 0
+    tensor_parallel_size: int = 1
+    pipeline_parallel_size: int = 1
+    sequence_parallel_size: int = 1
+    expert_parallel_size: int = 1
+
+    def validate(self) -> None:
+        for name in ("tensor_parallel_size", "pipeline_parallel_size",
+                     "sequence_parallel_size", "expert_parallel_size"):
+            if getattr(self, name) < 1:
+                raise ConfigError(f"{name} must be >= 1")
+
+
+# ---------------------------------------------------------------------------
+# aux feature configs
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class ActivationCheckpointingConfig(ConfigModel):
+    """Reference: runtime/activation_checkpointing/config.py:27-43. On TPU this
+    maps to ``jax.checkpoint`` policies over the layer scan."""
+
+    partition_activations: bool = False
+    cpu_checkpointing: bool = False
+    contiguous_memory_optimization: bool = False
+    number_checkpoints: Optional[int] = None
+    synchronize_checkpoint_boundary: bool = False
+    profile: bool = False
+    # TPU-specific: jax.checkpoint policy name
+    policy: str = "nothing_saveable"  # nothing_saveable | dots_saveable | dots_with_no_batch_dims_saveable
+
+
+@dataclass
+class CommsLoggerConfig(ConfigModel):
+    """Reference: deepspeed/comm/config.py."""
+
+    enabled: bool = False
+    verbose: bool = False
+    prof_all: bool = True
+    debug: bool = False
+    prof_ops: List[str] = field(default_factory=list)
+
+
+@dataclass
+class FlopsProfilerConfig(ConfigModel):
+    """Reference: profiling/config.py."""
+
+    enabled: bool = False
+    profile_step: int = 1
+    module_depth: int = -1
+    top_modules: int = 1
+    detailed: bool = True
+    output_file: Optional[str] = None
+
+
+@dataclass
+class TensorboardConfig(ConfigModel):
+    enabled: bool = False
+    output_path: str = ""
+    job_name: str = "DeepSpeedTPUJob"
+
+
+@dataclass
+class WandbConfig(ConfigModel):
+    enabled: bool = False
+    group: Optional[str] = None
+    team: Optional[str] = None
+    project: str = "deepspeed_tpu"
+
+
+@dataclass
+class CSVConfig(ConfigModel):
+    enabled: bool = False
+    output_path: str = ""
+    job_name: str = "DeepSpeedTPUJob"
+
+
+@dataclass
+class MonitorConfig(ConfigModel):
+    """Reference: monitor/config.py → MonitorMaster fan-out writers."""
+
+    tensorboard: TensorboardConfig = field(default_factory=TensorboardConfig)
+    wandb: WandbConfig = field(default_factory=WandbConfig)
+    csv_monitor: CSVConfig = field(default_factory=CSVConfig)
+
+
+@dataclass
+class ElasticityConfig(ConfigModel):
+    """Reference: elasticity/config.py — pure batch/world-size math."""
+
+    enabled: bool = False
+    max_train_batch_size: int = 2000
+    micro_batch_sizes: List[int] = field(default_factory=lambda: [2, 4, 6])
+    min_gpus: int = 1
+    max_gpus: int = 10000
+    min_time: int = 0
+    version: float = 0.1
+    ignore_non_elastic_batch_info: bool = False
+    prefer_larger_batch: bool = True
+
+
+@dataclass
+class CurriculumConfig(ConfigModel):
+    """Reference: curriculum_learning section (legacy) / data_efficiency."""
+
+    enabled: bool = False
+    curriculum_type: str = "seqlen"
+    min_difficulty: int = 1
+    max_difficulty: int = 10
+    schedule_type: str = "fixed_linear"
+    schedule_config: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class AIOConfig(ConfigModel):
+    """Reference: the ``aio`` section (runtime/config.py) driving csrc/aio knobs."""
+
+    block_size: int = 1048576
+    queue_depth: int = 8
+    thread_count: int = 1
+    single_submit: bool = False
+    overlap_events: bool = True
+
+
+@dataclass
+class CheckpointConfig(ConfigModel):
+    """Reference: checkpoint section keys (tag_validation etc.)."""
+
+    tag_validation: str = "Warn"  # Ignore | Warn | Fail
+    load_universal: bool = False
+    use_node_local_storage: bool = False
+    parallel_write_pipeline: bool = False
+    async_save: bool = False
+
+    def validate(self) -> None:
+        if self.tag_validation.lower() not in ("ignore", "warn", "fail"):
+            raise ConfigError("checkpoint.tag_validation must be Ignore|Warn|Fail")
+
+
+@dataclass
+class DataEfficiencyConfig(ConfigModel):
+    enabled: bool = False
+    seed: int = 1234
+    data_sampling: Dict[str, Any] = field(default_factory=dict)
+    data_routing: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class CompressionConfig(ConfigModel):
+    """Reference: compression/config.py — accepted wholesale; consumed by
+    deepspeed_tpu.compression."""
+
+    weight_quantization: Dict[str, Any] = field(default_factory=dict)
+    activation_quantization: Dict[str, Any] = field(default_factory=dict)
+    sparse_pruning: Dict[str, Any] = field(default_factory=dict)
+    row_pruning: Dict[str, Any] = field(default_factory=dict)
+    head_pruning: Dict[str, Any] = field(default_factory=dict)
+    channel_pruning: Dict[str, Any] = field(default_factory=dict)
+    layer_reduction: Dict[str, Any] = field(default_factory=dict)
+
+
+# ---------------------------------------------------------------------------
+# root config
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class Config(ConfigModel):
+    """Root config — analog of ``DeepSpeedConfig`` (runtime/config.py:674)."""
+
+    train_batch_size: int = 0
+    train_micro_batch_size_per_gpu: int = 0
+    gradient_accumulation_steps: int = 0
+
+    steps_per_print: int = 10
+    wall_clock_breakdown: bool = False
+    dump_state: bool = False
+    prescale_gradients: bool = False
+    gradient_predivide_factor: float = 1.0
+    gradient_clipping: float = 0.0
+    disable_allgather: bool = False
+    communication_data_type: Optional[str] = None
+    seed: int = 1234
+
+    optimizer: OptimizerConfig = field(default_factory=OptimizerConfig)
+    scheduler: Optional[SchedulerConfig] = None
+    fp16: FP16Config = field(default_factory=FP16Config)
+    bf16: BF16Config = field(default_factory=BF16Config)
+    zero_optimization: ZeroConfig = field(default_factory=ZeroConfig)
+    parallel: ParallelConfig = field(default_factory=ParallelConfig)
+    activation_checkpointing: ActivationCheckpointingConfig = field(
+        default_factory=ActivationCheckpointingConfig)
+    comms_logger: CommsLoggerConfig = field(default_factory=CommsLoggerConfig)
+    flops_profiler: FlopsProfilerConfig = field(default_factory=FlopsProfilerConfig)
+    monitor: MonitorConfig = field(default_factory=MonitorConfig)
+    elasticity: ElasticityConfig = field(default_factory=ElasticityConfig)
+    curriculum_learning: CurriculumConfig = field(default_factory=CurriculumConfig)
+    data_efficiency: DataEfficiencyConfig = field(default_factory=DataEfficiencyConfig)
+    compression_training: CompressionConfig = field(default_factory=CompressionConfig)
+    aio: AIOConfig = field(default_factory=AIOConfig)
+    checkpoint: CheckpointConfig = field(default_factory=CheckpointConfig)
+
+    # monitor sections may also appear at top level (reference accepts both)
+    tensorboard: Optional[TensorboardConfig] = None
+    wandb: Optional[WandbConfig] = None
+    csv_monitor: Optional[CSVConfig] = None
+
+    DEPRECATED = {
+        "train_micro_batch_size": ("train_micro_batch_size_per_gpu", "renamed"),
+        "gradient_accumulation_dtype": (None, "grad accumulation is fp32 on TPU"),
+    }
+
+    def __post_init__(self):
+        # lift top-level monitor sections into .monitor (reference behavior)
+        if self.tensorboard is not None:
+            self.monitor = self.monitor.replace(tensorboard=self.tensorboard)
+        if self.wandb is not None:
+            self.monitor = self.monitor.replace(wandb=self.wandb)
+        if self.csv_monitor is not None:
+            self.monitor = self.monitor.replace(csv_monitor=self.csv_monitor)
+
+    # -- batch triad ------------------------------------------------------
+    def resolve_batch_sizes(self, dp_world_size: int) -> "Config":
+        """Resolve (train_batch_size, micro_batch, grad_accum) given the data-
+        parallel world size. Mirrors reference runtime/config.py:888
+        ``_set_batch_related_parameters``: any two determine the third; one
+        given infers the rest with grad_accum=1; none → error."""
+        tb, mb, ga = self.train_batch_size, self.train_micro_batch_size_per_gpu, self.gradient_accumulation_steps
+        if tb and mb and ga:
+            if tb != mb * ga * dp_world_size:
+                raise ConfigError(
+                    f"train_batch_size ({tb}) != micro_batch ({mb}) * grad_accum ({ga}) "
+                    f"* dp_world ({dp_world_size})")
+        elif tb and mb:
+            ga, rem = divmod(tb, mb * dp_world_size)
+            if rem or ga < 1:
+                raise ConfigError(
+                    f"train_batch_size {tb} not divisible by micro_batch {mb} * dp {dp_world_size}")
+        elif tb and ga:
+            mb, rem = divmod(tb, ga * dp_world_size)
+            if rem or mb < 1:
+                raise ConfigError(
+                    f"train_batch_size {tb} not divisible by grad_accum {ga} * dp {dp_world_size}")
+        elif mb and ga:
+            tb = mb * ga * dp_world_size
+        elif tb:
+            mb, rem = divmod(tb, dp_world_size)
+            if rem:
+                raise ConfigError(f"train_batch_size {tb} not divisible by dp world {dp_world_size}")
+            ga = 1
+        elif mb:
+            ga = 1
+            tb = mb * dp_world_size
+        else:
+            raise ConfigError(
+                "one of train_batch_size / train_micro_batch_size_per_gpu must be set")
+        return self.replace(train_batch_size=tb, train_micro_batch_size_per_gpu=mb,
+                            gradient_accumulation_steps=ga)
+
+    def validate(self) -> None:
+        if self.fp16.enabled and self.bf16.enabled:
+            raise ConfigError("fp16 and bf16 cannot both be enabled")
+        if self.gradient_clipping < 0:
+            raise ConfigError("gradient_clipping must be >= 0")
+
+    # -- convenience ------------------------------------------------------
+    @property
+    def precision_dtype(self) -> str:
+        if self.bf16.enabled:
+            return "bfloat16"
+        if self.fp16.enabled:
+            return "float16"
+        return "float32"
+
+    @property
+    def zero_stage(self) -> int:
+        return self.zero_optimization.stage
+
+
+def load_config(config: Union[str, Mapping[str, Any], Config, None]) -> Config:
+    """Accept a path, a dict, an existing Config, or None (defaults)."""
+    if config is None:
+        return Config()
+    if isinstance(config, Config):
+        return config
+    if isinstance(config, str):
+        with open(config) as fh:
+            config = json.load(fh)
+    if not isinstance(config, Mapping):
+        raise ConfigError(f"config must be a path, dict, or Config — got {type(config)}")
+    return Config.from_dict(config)

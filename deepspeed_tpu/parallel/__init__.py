@@ -1,0 +1,19 @@
+from .mesh import (DATA_AXIS, EXPERT_AXIS, MESH_AXES, MODEL_AXIS, PIPE_AXIS,
+                   SEQ_AXIS, build_mesh, get_data_parallel_world_size,
+                   get_expert_parallel_world_size, get_mesh,
+                   get_model_parallel_world_size, get_pipe_parallel_world_size,
+                   get_sequence_parallel_world_size, get_world_size,
+                   mesh_context, replicated, reset_mesh, set_mesh, sharding)
+from .topology import (PipeDataParallelTopology, PipelineParallelGrid,
+                       PipeModelDataParallelTopology, ProcessTopology)
+
+__all__ = [
+    "DATA_AXIS", "EXPERT_AXIS", "MESH_AXES", "MODEL_AXIS", "PIPE_AXIS",
+    "SEQ_AXIS", "build_mesh", "get_mesh", "set_mesh", "reset_mesh",
+    "mesh_context", "replicated", "sharding", "get_world_size",
+    "get_data_parallel_world_size", "get_model_parallel_world_size",
+    "get_pipe_parallel_world_size", "get_sequence_parallel_world_size",
+    "get_expert_parallel_world_size", "ProcessTopology",
+    "PipeDataParallelTopology", "PipeModelDataParallelTopology",
+    "PipelineParallelGrid",
+]

@@ -1,0 +1,173 @@
+"""Device-mesh construction — the TPU analog of ``deepspeed/utils/groups.py``.
+
+The reference creates and caches NCCL process groups per parallel dimension
+(``_create_model_parallel`` groups.py:59, expert groups :108-258, accessors
+:319-392). On TPU all of that collapses into ONE ``jax.sharding.Mesh`` whose
+named axes are the parallel dimensions; collectives are addressed by axis name
+and XLA routes them over ICI/DCN. This module owns:
+
+  * axis-name constants (data/fsdp, model, pipe, seq, expert),
+  * mesh construction from a ``ParallelConfig`` + device list,
+  * the groups-accessor API surface of the reference (sizes/ranks), and
+  * a process-global default mesh (mirror of the reference's module globals).
+
+Axis layout convention (outermost → innermost): ("pipe", "data", "seq", "model").
+Innermost axes change fastest across physically adjacent devices, so "model"
+(highest-bandwidth collectives: TP allreduce every layer) rides the shortest ICI
+hops, matching the scaling-book recipe. The expert axis is folded over
+("data",) or a sub-axis of it at MoE layer level via shard_map, mirroring the
+reference where ep_size must divide the dp world (groups.py:108).
+"""
+
+from __future__ import annotations
+
+import os
+from contextlib import contextmanager
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..config.config import ParallelConfig
+from ..utils.logging import logger
+
+# canonical axis names
+PIPE_AXIS = "pipe"
+DATA_AXIS = "data"      # DP *and* ZeRO/FSDP shard axis
+SEQ_AXIS = "seq"        # sequence/context parallelism (Ulysses / ring)
+MODEL_AXIS = "model"    # tensor parallelism
+EXPERT_AXIS = "expert"  # expert parallelism (folded over data at MoE layers)
+
+MESH_AXES = (PIPE_AXIS, DATA_AXIS, SEQ_AXIS, MODEL_AXIS)
+
+_GLOBAL_MESH: Optional[Mesh] = None
+_GLOBAL_EP_SIZE: int = 1
+
+
+def build_mesh(parallel: Optional[ParallelConfig] = None,
+               devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Create the framework mesh.
+
+    ``data`` size is inferred as world/(pp*sp*tp) when left 0. Device order uses
+    ``jax.experimental.mesh_utils`` when available so the innermost axes land on
+    physically adjacent chips (ICI-contiguous), falling back to a plain reshape
+    for CPU test meshes.
+    """
+    parallel = parallel or ParallelConfig()
+    devices = list(devices if devices is not None else jax.devices())
+    world = len(devices)
+    pp, tp, sp = (parallel.pipeline_parallel_size, parallel.tensor_parallel_size,
+                  parallel.sequence_parallel_size)
+    denom = pp * tp * sp
+    if world % denom != 0:
+        raise ValueError(f"world size {world} not divisible by pipe*seq*model = {denom}")
+    dp = parallel.data_parallel_size or world // denom
+    if pp * dp * sp * tp != world:
+        raise ValueError(
+            f"mesh {pp}x{dp}x{sp}x{tp} (pipe,data,seq,model) != world size {world}")
+    if (dp * sp) % parallel.expert_parallel_size != 0:
+        raise ValueError(
+            f"expert_parallel_size {parallel.expert_parallel_size} must divide "
+            f"data*seq = {dp * sp} (reference: groups.py:108 ep<=dp constraint)")
+
+    shape = (pp, dp, sp, tp)
+    try:
+        from jax.experimental import mesh_utils
+
+        device_array = mesh_utils.create_device_mesh(shape, devices=devices)
+    except Exception:
+        device_array = np.asarray(devices).reshape(shape)
+    mesh = Mesh(device_array, MESH_AXES)
+    logger.info(f"Built mesh pipe={pp} data={dp} seq={sp} model={tp} over {world} devices")
+    return mesh
+
+
+def set_mesh(mesh: Mesh, expert_parallel_size: int = 1) -> None:
+    global _GLOBAL_MESH, _GLOBAL_EP_SIZE
+    _GLOBAL_MESH = mesh
+    _GLOBAL_EP_SIZE = expert_parallel_size
+
+
+def get_mesh() -> Mesh:
+    global _GLOBAL_MESH
+    if _GLOBAL_MESH is None:
+        _GLOBAL_MESH = build_mesh()
+    return _GLOBAL_MESH
+
+
+def reset_mesh() -> None:
+    global _GLOBAL_MESH, _GLOBAL_EP_SIZE
+    _GLOBAL_MESH = None
+    _GLOBAL_EP_SIZE = 1
+
+
+@contextmanager
+def mesh_context(mesh: Mesh, expert_parallel_size: int = 1):
+    global _GLOBAL_MESH, _GLOBAL_EP_SIZE
+    prev, prev_ep = _GLOBAL_MESH, _GLOBAL_EP_SIZE
+    _GLOBAL_MESH, _GLOBAL_EP_SIZE = mesh, expert_parallel_size
+    try:
+        with mesh:
+            yield mesh
+    finally:
+        _GLOBAL_MESH, _GLOBAL_EP_SIZE = prev, prev_ep
+
+
+# ---------------------------------------------------------------------------
+# groups-style accessors (reference utils/groups.py:319-392 API surface)
+# ---------------------------------------------------------------------------
+
+def _axis_size(axis: str, mesh: Optional[Mesh] = None) -> int:
+    mesh = mesh or get_mesh()
+    return int(mesh.shape.get(axis, 1))
+
+
+def get_data_parallel_world_size(mesh: Optional[Mesh] = None) -> int:
+    return _axis_size(DATA_AXIS, mesh)
+
+
+def get_model_parallel_world_size(mesh: Optional[Mesh] = None) -> int:
+    return _axis_size(MODEL_AXIS, mesh)
+
+
+def get_pipe_parallel_world_size(mesh: Optional[Mesh] = None) -> int:
+    return _axis_size(PIPE_AXIS, mesh)
+
+
+def get_sequence_parallel_world_size(mesh: Optional[Mesh] = None) -> int:
+    return _axis_size(SEQ_AXIS, mesh)
+
+
+def get_expert_parallel_world_size(mesh: Optional[Mesh] = None) -> int:
+    return _GLOBAL_EP_SIZE
+
+
+def get_world_size(mesh: Optional[Mesh] = None) -> int:
+    mesh = mesh or get_mesh()
+    return int(np.prod(list(mesh.shape.values())))
+
+
+def replicated(mesh: Optional[Mesh] = None) -> NamedSharding:
+    return NamedSharding(mesh or get_mesh(), P())
+
+
+def sharding(spec: P, mesh: Optional[Mesh] = None) -> NamedSharding:
+    return NamedSharding(mesh or get_mesh(), spec)
+
+
+def batch_spec() -> P:
+    """Input-batch sharding: batch dim split over (pipe?, data); tokens over seq."""
+    return P(DATA_AXIS, SEQ_AXIS)
+
+
+def local_device_count() -> int:
+    return jax.local_device_count()
+
+
+def process_index() -> int:
+    return jax.process_index()
+
+
+def process_count() -> int:
+    return jax.process_count()

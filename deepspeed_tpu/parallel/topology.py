@@ -1,0 +1,183 @@
+"""Cartesian process topology — pure rank math, no devices required.
+
+TPU-native analog of ``deepspeed/runtime/pipe/topology.py`` (``ProcessTopology``
+:12, ``PipeDataParallelTopology`` :232, ``PipeModelDataParallelTopology`` :244).
+The named-axis coordinate system maps 1:1 onto ``jax.sharding.Mesh`` axis names;
+``ProcessTopology.to_mesh_shape()`` bridges the two worlds.
+"""
+
+from __future__ import annotations
+
+import itertools
+from collections import namedtuple
+from typing import Dict, List, Sequence, Tuple
+
+
+class ProcessTopology:
+    """Maps n-dimensional Cartesian coordinates to linear ranks (row-major,
+    first axis slowest-varying — same convention as the reference)."""
+
+    def __init__(self, axes: Sequence[str], dims: Sequence[int]):
+        if len(axes) != len(dims):
+            raise ValueError("axes and dims must have equal length")
+        self.axes: List[str] = list(axes)
+        self.dims: List[int] = list(dims)
+        self.ProcessCoord = namedtuple("ProcessCoord", self.axes)
+        self.mapping: Dict[Tuple[int, ...], int] = {}
+        for rank, coord in enumerate(itertools.product(*[range(d) for d in self.dims])):
+            self.mapping[coord] = rank
+
+    def get_rank(self, **coord_kwargs: int) -> int:
+        if len(coord_kwargs) != len(self.axes):
+            raise ValueError(f"get_rank() requires all axes {self.axes}")
+        key = tuple(coord_kwargs[axis] for axis in self.axes)
+        if key not in self.mapping:
+            raise ValueError(f"coordinate {coord_kwargs} out of range for dims {self.dims}")
+        return self.mapping[key]
+
+    def get_axis_names(self) -> List[str]:
+        return self.axes
+
+    def get_rank_repr(self, rank: int, omit_axes: Sequence[str] = ("data",),
+                      inner_sep: str = "_", outer_sep: str = "-") -> str:
+        omit = set(omit_axes)
+        coord = self.get_coord(rank)
+        parts = [f"{axis}{inner_sep}{getattr(coord, axis):02d}"
+                 for axis in self.axes if axis not in omit]
+        return outer_sep.join(parts)
+
+    def get_dim(self, axis: str) -> int:
+        return self.dims[self.axes.index(axis)] if axis in self.axes else 0
+
+    def get_coord(self, rank: int):
+        for coord, r in self.mapping.items():
+            if r == rank:
+                return self.ProcessCoord(*coord)
+        raise ValueError(f"rank {rank} not in topology")
+
+    def get_axis_comm_lists(self, axis: str) -> List[List[int]]:
+        """All groups of ranks that differ only along ``axis`` (the reference's
+        comm-group construction, topology.py:127). On TPU these become mesh-axis
+        collectives; kept for launcher/diagnostics parity."""
+        if axis not in self.axes:
+            return []
+        other_axes = [a for a in self.axes if a != axis]
+        lists = []
+        for other_coords in itertools.product(*[range(self.get_dim(a)) for a in other_axes]):
+            fixed = dict(zip(other_axes, other_coords))
+            ranks = [self.get_rank(**{axis: i, **fixed}) for i in range(self.get_dim(axis))]
+            lists.append(ranks)
+        return lists
+
+    def filter_match(self, **filter_kwargs: int) -> List[int]:
+        def matches(coord):
+            return all(getattr(coord, k) == v for k, v in filter_kwargs.items())
+
+        return sorted(rank for coord_key, rank in self.mapping.items()
+                      if matches(self.ProcessCoord(*coord_key)))
+
+    def get_axis_list(self, axis: str, idx: int) -> List[int]:
+        return self.filter_match(**{axis: idx})
+
+    def world_size(self) -> int:
+        size = 1
+        for d in self.dims:
+            size *= d
+        return size
+
+    def to_mesh_shape(self) -> Dict[str, int]:
+        """Axis-name → size dict, feedable to ``jax.sharding.Mesh`` creation."""
+        return dict(zip(self.axes, self.dims))
+
+    def __str__(self) -> str:
+        return f"ProcessTopology(axes={self.axes}, dims={self.dims})"
+
+
+class PipeDataParallelTopology(ProcessTopology):
+    """pipe × data — reference topology.py:232. ZeRO-DP shards over 'data'."""
+
+    def __init__(self, num_pp: int, num_dp: int):
+        super().__init__(axes=["pipe", "data"], dims=[num_pp, num_dp])
+
+
+class PipeModelDataParallelTopology(ProcessTopology):
+    """pipe × data × model (3D) — reference topology.py:244."""
+
+    def __init__(self, num_pp: int, num_mp: int, num_dp: int):
+        super().__init__(axes=["pipe", "data", "model"], dims=[num_pp, num_dp, num_mp])
+
+
+class PipelineParallelGrid:
+    """Rank bookkeeping for a pipeline run — reference topology.py:251.
+
+    In the reference this builds NCCL process groups; on TPU the groups are
+    implicit in the mesh, so this class is pure coordinate accounting consumed
+    by the pipeline engine and checkpoint layer naming.
+    """
+
+    def __init__(self, topology: ProcessTopology, global_rank: int = 0):
+        self._topo = topology
+        self.global_rank = global_rank
+        self.world_size = topology.world_size()
+        self.data_parallel_size = max(topology.get_dim("data"), 1)
+        self.pipe_parallel_size = max(topology.get_dim("pipe"), 1)
+        self.model_parallel_size = max(topology.get_dim("model"), 1)
+        self.slice_parallel_size = self.model_parallel_size
+        assert self.world_size == (
+            self.data_parallel_size * self.pipe_parallel_size * self.model_parallel_size)
+        coord = topology.get_coord(global_rank)
+        self.stage_id = getattr(coord, "pipe", 0)
+        self.data_parallel_id = getattr(coord, "data", 0)
+        self.model_parallel_id = getattr(coord, "model", 0) if "model" in topology.axes else 0
+
+    @property
+    def topology(self) -> ProcessTopology:
+        return self._topo
+
+    def get_stage_id(self) -> int:
+        return self.stage_id
+
+    def get_data_parallel_id(self) -> int:
+        return self.data_parallel_id
+
+    def get_pipe_parallel_rank(self) -> int:
+        return self.stage_id
+
+    def get_pipe_parallel_world_size(self) -> int:
+        return self.pipe_parallel_size
+
+    def get_data_parallel_rank(self) -> int:
+        return self.data_parallel_id
+
+    def get_data_parallel_world_size(self) -> int:
+        return self.data_parallel_size
+
+    def get_model_parallel_rank(self) -> int:
+        return self.model_parallel_id
+
+    def get_model_parallel_world_size(self) -> int:
+        return self.model_parallel_size
+
+    def get_global_rank(self) -> int:
+        return self.global_rank
+
+    def stage_to_global(self, stage_id: int, **kwargs) -> int:
+        coord = self._topo.get_coord(self.global_rank)
+        overrides = dict(coord._asdict())
+        overrides["pipe"] = stage_id
+        overrides.update(kwargs)
+        return self._topo.get_rank(**overrides)
+
+    def is_first_stage(self) -> bool:
+        return self.stage_id == 0
+
+    def is_last_stage(self) -> bool:
+        return self.stage_id == self.pipe_parallel_size - 1
+
+    def p2p_pairs(self) -> List[Tuple[int, int]]:
+        """(src, dst) global-rank pairs for adjacent-stage activation traffic."""
+        pairs = []
+        for lists in self._topo.get_axis_comm_lists("pipe"):
+            for a, b in zip(lists[:-1], lists[1:]):
+                pairs.append((a, b))
+        return pairs

@@ -1,0 +1,5 @@
+from .logging import log_dist, logger, print_json_dist
+from .timer import SynchronizedWallClockTimer, ThroughputTimer
+
+__all__ = ["logger", "log_dist", "print_json_dist",
+           "SynchronizedWallClockTimer", "ThroughputTimer"]

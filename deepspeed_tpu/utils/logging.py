@@ -1,0 +1,74 @@
+"""Rank-aware logging.
+
+TPU-native analog of the reference's ``deepspeed/utils/logging.py`` (``logger``,
+``log_dist``): a single framework logger plus helpers that gate output on the
+JAX process index instead of a torch.distributed rank.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+from typing import Iterable, Optional
+
+LOG_FORMAT = "[%(asctime)s] [%(levelname)s] [%(name)s:%(lineno)d] %(message)s"
+
+
+def _create_logger(name: str = "deepspeed_tpu", level: int = logging.INFO) -> logging.Logger:
+    lg = logging.getLogger(name)
+    lg.setLevel(level)
+    lg.propagate = False
+    if not lg.handlers:
+        handler = logging.StreamHandler(stream=sys.stdout)
+        handler.setFormatter(logging.Formatter(LOG_FORMAT, datefmt="%Y-%m-%d %H:%M:%S"))
+        lg.addHandler(handler)
+    env_level = os.environ.get("DSTPU_LOG_LEVEL")
+    if env_level:
+        lg.setLevel(getattr(logging, env_level.upper(), logging.INFO))
+    return lg
+
+
+logger = _create_logger()
+
+
+def _process_index() -> int:
+    try:
+        import jax
+
+        return jax.process_index()
+    except Exception:  # jax not initialised yet
+        return 0
+
+
+def log_dist(message: str, ranks: Optional[Iterable[int]] = None, level: int = logging.INFO) -> None:
+    """Log ``message`` only on the given process indices (default: process 0).
+
+    Mirrors the reference ``log_dist`` contract: ``ranks=[-1]`` logs everywhere.
+    """
+    my_rank = _process_index()
+    ranks = list(ranks) if ranks is not None else [0]
+    if -1 in ranks or my_rank in ranks:
+        logger.log(level, f"[Rank {my_rank}] {message}")
+
+
+def warning_once(message: str, _seen=set()) -> None:  # noqa: B006 - intentional cache
+    if message not in _seen:
+        _seen.add(message)
+        logger.warning(message)
+
+
+def print_json_dist(message: dict, ranks: Optional[Iterable[int]] = None, path: Optional[str] = None) -> None:
+    """Write a JSON metrics blob from selected ranks (autotuner report format)."""
+    import json
+
+    my_rank = _process_index()
+    ranks = list(ranks) if ranks is not None else [0]
+    if -1 in ranks or my_rank in ranks:
+        message["rank"] = my_rank
+        if path is None:
+            print(json.dumps(message, sort_keys=True))
+        else:
+            with open(path, "w") as fh:
+                json.dump(message, fh, sort_keys=True)
+                fh.write("\n")

@@ -1,0 +1,171 @@
+"""Wall-clock and throughput timers.
+
+TPU-native analog of the reference ``deepspeed/utils/timer.py``
+(``SynchronizedWallClockTimer`` / ``ThroughputTimer``). On TPU there are no
+CUDA events; synchronization is a ``jax.block_until_ready`` on a token array,
+which drains the dispatched XLA computation the same way ``cudaEventSynchronize``
+drains a stream.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import OrderedDict
+from typing import Dict, List, Optional
+
+from .logging import log_dist
+
+FORWARD_MICRO_TIMER = "fwd_microstep"
+FORWARD_GLOBAL_TIMER = "fwd"
+BACKWARD_MICRO_TIMER = "bwd_microstep"
+BACKWARD_GLOBAL_TIMER = "bwd"
+STEP_MICRO_TIMER = "step_microstep"
+STEP_GLOBAL_TIMER = "step"
+TRAIN_BATCH_TIMER = "train_batch"
+
+
+def _device_synchronize() -> None:
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        # Enqueue a trivial computation on the default device and drain it.
+        # XLA executes per-device computations in dispatch order, so this
+        # completes only after all previously dispatched work on that device.
+        (jnp.zeros(()) + 0).block_until_ready()
+    except Exception:
+        pass
+
+
+class _Timer:
+    def __init__(self, name: str):
+        self.name = name
+        self.started = False
+        self.start_time = 0.0
+        self.elapsed_ = 0.0
+        self.records: List[float] = []
+
+    def start(self, synchronize: bool = False) -> None:
+        if synchronize:
+            _device_synchronize()
+        self.start_time = time.time()
+        self.started = True
+
+    def stop(self, record: bool = True, synchronize: bool = True) -> None:
+        if not self.started:
+            return
+        if synchronize:
+            _device_synchronize()
+        elapsed = time.time() - self.start_time
+        self.elapsed_ += elapsed
+        if record:
+            self.records.append(elapsed)
+        self.started = False
+
+    def elapsed(self, reset: bool = True) -> float:
+        value = self.elapsed_
+        if reset:
+            self.elapsed_ = 0.0
+        return value
+
+    def mean(self) -> float:
+        return sum(self.records) / len(self.records) if self.records else 0.0
+
+    def reset(self) -> None:
+        self.started = False
+        self.elapsed_ = 0.0
+        self.records = []
+
+
+class SynchronizedWallClockTimer:
+    """Named timer registry; ``log()`` prints ms per timer like the reference."""
+
+    def __init__(self):
+        self.timers: "OrderedDict[str, _Timer]" = OrderedDict()
+
+    def __call__(self, name: str) -> _Timer:
+        if name not in self.timers:
+            self.timers[name] = _Timer(name)
+        return self.timers[name]
+
+    def has_timer(self, name: str) -> bool:
+        return name in self.timers
+
+    def log(self, names: Optional[List[str]] = None, normalizer: float = 1.0, reset: bool = True,
+            memory_breakdown: bool = False, ranks: Optional[List[int]] = None) -> None:
+        assert normalizer > 0.0
+        names = names if names is not None else list(self.timers)
+        parts = []
+        for name in names:
+            if name in self.timers:
+                ms = self.timers[name].elapsed(reset=reset) * 1000.0 / normalizer
+                parts.append(f"{name}: {ms:.2f}")
+        if parts:
+            log_dist("time (ms) | " + " | ".join(parts), ranks=ranks)
+
+    def get_mean(self, names: List[str], normalizer: float = 1.0) -> Dict[str, float]:
+        return {n: self.timers[n].mean() * 1000.0 / normalizer for n in names if n in self.timers}
+
+
+class ThroughputTimer:
+    """Samples/sec + tokens/sec tracker over train steps."""
+
+    def __init__(self, batch_size: int, start_step: int = 2, steps_per_output: int = 50,
+                 monitor_memory: bool = False, logging_fn=None):
+        self.batch_size = max(batch_size, 1)
+        self.start_step = start_step
+        self.steps_per_output = steps_per_output
+        self.logging = logging_fn or (lambda msg: log_dist(msg))
+        self.initialized = False
+        self.global_step_count = 0
+        self.local_step_count = 0
+        self.total_elapsed_time = 0.0
+        self.step_elapsed_time = 0.0
+        self.start_time = 0.0
+        self.started = False
+
+    def update_epoch_count(self) -> None:
+        self.local_step_count = 0
+
+    def start(self) -> None:
+        self.started = True
+        if self.global_step_count >= self.start_step:
+            _device_synchronize()
+            self.start_time = time.time()
+
+    def stop(self, global_step: bool = True, report_speed: bool = True) -> None:
+        if not self.started:
+            return
+        self.started = False
+        if global_step:
+            self.global_step_count += 1
+            self.local_step_count += 1
+        if self.global_step_count > self.start_step and self.start_time:
+            _device_synchronize()
+            duration = time.time() - self.start_time
+            self.total_elapsed_time += duration
+            self.step_elapsed_time += duration
+            if global_step and report_speed and self.global_step_count % self.steps_per_output == 0:
+                self.logging(
+                    f"step={self.global_step_count}, "
+                    f"samples/sec={self.avg_samples_per_sec():.2f}, "
+                    f"batch/step latency={duration * 1000:.2f} ms")
+                self.step_elapsed_time = 0.0
+
+    def avg_samples_per_sec(self) -> float:
+        if self.global_step_count > self.start_step and self.total_elapsed_time > 0:
+            steps = self.global_step_count - self.start_step
+            return self.batch_size / (self.total_elapsed_time / steps)
+        return 0.0
+
+
+def trim_mean(data: List[float], trim_percent: float) -> float:
+    """Trimmed mean (used by bench harness to discard warmup jitter)."""
+    assert 0.0 <= trim_percent <= 1.0
+    n = len(data)
+    if n == 0:
+        return 0.0
+    data = sorted(data)
+    k = int(round(n * trim_percent))
+    trimmed = data[k: max(n - k, k + 1)]
+    return sum(trimmed) / len(trimmed)

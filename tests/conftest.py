@@ -1,0 +1,40 @@
+"""Test harness configuration.
+
+The reference tests multi-rank behavior by forking N local processes with a
+fake NCCL rendezvous (tests/unit/common.py:86 DistributedExec). On TPU the
+equivalent — and much faster — trick is a single process with N virtual CPU
+devices: every "distributed" test becomes a single-process mesh test
+(SURVEY.md §4 lesson). These env vars must be set before jax initializes.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+# The axon TPU plugin (container sitecustomize) registers itself before
+# conftest runs and pins jax_platforms; override via the config API, which
+# takes precedence over anything set at interpreter start.
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset_global_mesh():
+    yield
+    from deepspeed_tpu.parallel import mesh
+
+    mesh.reset_mesh()
+
+
+@pytest.fixture
+def devices8():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual devices, got {len(devs)}"
+    return devs

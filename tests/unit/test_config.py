@@ -1,0 +1,101 @@
+"""Config-system tests — analog of reference tests/unit/runtime/test_ds_config_dict.py
+and test_ds_config_model.py."""
+
+import json
+
+import pytest
+
+from deepspeed_tpu.config import Config, ConfigError, load_config
+from deepspeed_tpu.config.config import ZeroConfig
+
+
+def test_defaults():
+    cfg = Config()
+    assert cfg.zero_optimization.stage == 0
+    assert not cfg.fp16.enabled
+    assert cfg.precision_dtype == "float32"
+
+
+def test_from_dict_nested():
+    cfg = load_config({
+        "train_batch_size": 16,
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 3, "sub_group_size": 1000},
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+    })
+    assert cfg.train_batch_size == 16
+    assert cfg.bf16.enabled
+    assert cfg.precision_dtype == "bfloat16"
+    assert cfg.zero_optimization.stage == 3
+    assert cfg.zero_optimization.sub_group_size == 1000
+    assert cfg.optimizer.params["lr"] == 1e-3
+
+
+def test_unknown_key_rejected():
+    with pytest.raises(ConfigError, match="unknown config key"):
+        load_config({"zero_optimization": {"stagee": 2}})
+
+
+def test_type_validation():
+    with pytest.raises(ConfigError):
+        load_config({"train_batch_size": "four"})
+    with pytest.raises(ConfigError):
+        load_config({"fp16": {"enabled": "maybe"}})
+
+
+def test_deprecated_key_migration():
+    cfg = ZeroConfig.from_dict({"stage3_gather_fp16_weights_on_model_save": True})
+    assert cfg.stage3_gather_16bit_weights_on_model_save is True
+
+
+def test_fp16_bf16_exclusive():
+    with pytest.raises(ConfigError):
+        load_config({"fp16": {"enabled": True}, "bf16": {"enabled": True}})
+
+
+def test_invalid_zero_stage():
+    with pytest.raises(ConfigError):
+        load_config({"zero_optimization": {"stage": 5}})
+
+
+def test_config_from_file(tmp_path):
+    path = tmp_path / "ds_config.json"
+    path.write_text(json.dumps({"train_micro_batch_size_per_gpu": 4,
+                                "gradient_accumulation_steps": 2}))
+    cfg = load_config(str(path))
+    assert cfg.train_micro_batch_size_per_gpu == 4
+
+
+# batch triad resolution — mirrors reference runtime/config.py:888 semantics
+@pytest.mark.parametrize("given,dp,expect", [
+    ({"train_batch_size": 32}, 4, (32, 8, 1)),
+    ({"train_micro_batch_size_per_gpu": 2}, 4, (8, 2, 1)),
+    ({"train_micro_batch_size_per_gpu": 2, "gradient_accumulation_steps": 4}, 2, (16, 2, 4)),
+    ({"train_batch_size": 32, "train_micro_batch_size_per_gpu": 4}, 4, (32, 4, 2)),
+    ({"train_batch_size": 32, "gradient_accumulation_steps": 2}, 4, (32, 4, 2)),
+    ({"train_batch_size": 64, "train_micro_batch_size_per_gpu": 4,
+      "gradient_accumulation_steps": 4}, 4, (64, 4, 4)),
+])
+def test_batch_triad(given, dp, expect):
+    cfg = load_config(given).resolve_batch_sizes(dp)
+    assert (cfg.train_batch_size, cfg.train_micro_batch_size_per_gpu,
+            cfg.gradient_accumulation_steps) == expect
+
+
+def test_batch_triad_inconsistent():
+    with pytest.raises(ConfigError):
+        load_config({"train_batch_size": 32, "train_micro_batch_size_per_gpu": 4,
+                     "gradient_accumulation_steps": 4}).resolve_batch_sizes(4)
+
+
+def test_batch_triad_missing():
+    with pytest.raises(ConfigError):
+        load_config({}).resolve_batch_sizes(4)
+
+
+def test_roundtrip_to_dict():
+    cfg = load_config({"bf16": {"enabled": True}, "gradient_clipping": 1.0})
+    d = cfg.to_dict()
+    assert d["bf16"]["enabled"] is True
+    cfg2 = load_config({k: v for k, v in d.items() if v is not None})
+    assert cfg2.gradient_clipping == 1.0

@@ -1,0 +1,78 @@
+"""ProcessTopology rank-math tests — analog of reference
+tests/unit/runtime/pipe/test_topology.py (pure math, no devices)."""
+
+import pytest
+
+from deepspeed_tpu.parallel.topology import (PipeDataParallelTopology,
+                                             PipelineParallelGrid,
+                                             PipeModelDataParallelTopology,
+                                             ProcessTopology)
+
+
+def test_topology_2d():
+    topo = ProcessTopology(axes=["row", "col"], dims=[2, 2])
+    assert topo.get_rank(row=0, col=0) == 0
+    assert topo.get_rank(row=0, col=1) == 1
+    assert topo.get_rank(row=1, col=0) == 2
+    assert topo.get_rank(row=1, col=1) == 3
+    assert topo.world_size() == 4
+
+
+def test_topology_coord_roundtrip():
+    topo = PipeModelDataParallelTopology(num_pp=2, num_mp=2, num_dp=2)
+    for rank in range(topo.world_size()):
+        coord = topo.get_coord(rank)
+        assert topo.get_rank(pipe=coord.pipe, data=coord.data, model=coord.model) == rank
+
+
+def test_axis_comm_lists():
+    topo = PipeDataParallelTopology(num_pp=2, num_dp=4)
+    pipe_lists = topo.get_axis_comm_lists("pipe")
+    assert len(pipe_lists) == 4
+    for lst in pipe_lists:
+        assert len(lst) == 2
+    data_lists = topo.get_axis_comm_lists("data")
+    assert len(data_lists) == 2
+    assert data_lists[0] == [0, 1, 2, 3]
+
+
+def test_filter_match():
+    topo = PipeModelDataParallelTopology(num_pp=2, num_mp=2, num_dp=2)
+    ranks = topo.filter_match(pipe=0)
+    assert ranks == [0, 1, 2, 3]
+    assert topo.filter_match(pipe=1, model=1) == [5, 7]
+
+
+def test_get_rank_repr():
+    topo = PipeModelDataParallelTopology(num_pp=2, num_mp=2, num_dp=2)
+    assert topo.get_rank_repr(0) == "pipe_00-model_00"
+
+
+def test_bad_coords():
+    topo = ProcessTopology(axes=["a"], dims=[2])
+    with pytest.raises(ValueError):
+        topo.get_rank(a=5)
+    with pytest.raises(ValueError):
+        topo.get_rank()  # missing axis
+
+
+def test_grid():
+    topo = PipeDataParallelTopology(num_pp=4, num_dp=2)
+    grid = PipelineParallelGrid(topo, global_rank=3)
+    assert grid.pipe_parallel_size == 4
+    assert grid.data_parallel_size == 2
+    assert grid.get_stage_id() == 1
+    assert grid.get_data_parallel_id() == 1
+    assert not grid.is_first_stage() and not grid.is_last_stage()
+    assert grid.stage_to_global(2) == 5
+
+
+def test_grid_p2p_pairs():
+    topo = PipeDataParallelTopology(num_pp=3, num_dp=1)
+    grid = PipelineParallelGrid(topo, global_rank=0)
+    assert grid.p2p_pairs() == [(0, 1), (1, 2)]
+
+
+def test_mesh_shape_bridge():
+    topo = PipeModelDataParallelTopology(num_pp=2, num_mp=2, num_dp=2)
+    assert topo.to_mesh_shape() == {"pipe": 2, "data": 2, "model": 2}
