@@ -1,0 +1,89 @@
+#!/usr/bin/env python
+"""Benchmark harness — run by the driver on real TPU hardware.
+
+Prints exactly ONE JSON line to stdout:
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Benchmark: GPT-2 125M causal-LM training throughput on one chip, bf16,
+tokens/sec (BASELINE.json tracked config #1). ``vs_baseline`` reports
+MFU / 0.5 — the fraction of the driver's north-star (≥50% MFU) achieved,
+so 1.0 == target reached.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def peak_flops_per_chip() -> float:
+    """bf16 peak for the attached chip generation."""
+    kind = jax.devices()[0].device_kind.lower()
+    table = {
+        "v5 lite": 197e12, "v5litepod": 197e12, "v5e": 197e12,
+        "v5p": 459e12, "v5": 459e12,
+        "v4": 275e12,
+        "v6 lite": 918e12, "v6e": 918e12,
+    }
+    for key, val in table.items():
+        if key in kind:
+            return val
+    return 197e12
+
+
+def main() -> None:
+    import deepspeed_tpu
+    from deepspeed_tpu.models import create_model
+
+    batch, seq = int(os.environ.get("BENCH_BATCH", 8)), int(os.environ.get("BENCH_SEQ", 1024))
+    model = create_model("gpt2-125m", dtype=jnp.bfloat16, remat=False,
+                         max_seq_len=seq)
+    cfg = {
+        "train_micro_batch_size_per_gpu": batch,
+        "gradient_accumulation_steps": 1,
+        "steps_per_print": 1000,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-4, "weight_decay": 0.01}},
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 0},
+    }
+    engine, *_ = deepspeed_tpu.initialize(model=model, config=cfg)
+
+    rng = jax.random.PRNGKey(0)
+    ids = jax.random.randint(rng, (1, batch, seq), 0, model.config.vocab_size)
+    batch_tree = {"input_ids": ids}
+
+    # warmup (compile)
+    for _ in range(2):
+        loss = engine.train_batch(batch=batch_tree)
+    jax.block_until_ready(loss)
+
+    steps = int(os.environ.get("BENCH_STEPS", 10))
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = engine.train_batch(batch=batch_tree)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = batch * seq * steps / dt
+    n_params = sum(int(p.size) for p in jax.tree.leaves(engine.params))
+    cfg_m = model.config
+    # training flops/token: 6*N for matmul params + attention 12*L*H*S per token
+    flops_per_token = 6 * n_params + 12 * cfg_m.num_layers * cfg_m.hidden_size * seq
+    mfu = tokens_per_sec * flops_per_token / peak_flops_per_chip()
+
+    print(json.dumps({
+        "metric": "gpt2_125m_bf16_train_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(mfu / 0.5, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

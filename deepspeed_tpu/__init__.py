@@ -43,17 +43,13 @@ def init_inference(model=None, config=None, **kwargs):
 
 def add_config_arguments(parser):
     """Analog of reference deepspeed/__init__.py:237 — attach --deepspeed args."""
+    import argparse
+
     group = parser.add_argument_group("DeepSpeed-TPU", "DeepSpeed-TPU configurations")
     group.add_argument("--deepspeed", default=False, action="store_true",
                        help="Enable DeepSpeed-TPU (helper flag for compatibility)")
     group.add_argument("--deepspeed_config", default=None, type=str,
                        help="Path to the framework JSON config file")
     group.add_argument("--deepscale", default=False, action="store_true",
-                       help=argparse_suppress())
+                       help=argparse.SUPPRESS)
     return parser
-
-
-def argparse_suppress():
-    import argparse
-
-    return argparse.SUPPRESS

@@ -28,7 +28,6 @@ from __future__ import annotations
 
 import functools
 import os
-import time
 from enum import Enum
 from typing import Any, Callable, List, Optional, Sequence
 
@@ -105,10 +104,19 @@ def init_distributed(dist_backend: str = "xla",
 
 
 def get_rank() -> int:
+    """Host-process index (rank of this *process*, not of a chip). In SPMD the
+    per-chip 'rank' is a mesh coordinate — use axis_rank() inside shard_map."""
     return jax.process_index()
 
 
 def get_world_size() -> int:
+    """Total accelerator count — matches the reference semantics where
+    world_size == number of GPUs (one rank per GPU). For the host-process
+    count use get_process_count()."""
+    return jax.device_count()
+
+
+def get_process_count() -> int:
     return jax.process_count()
 
 
@@ -135,17 +143,19 @@ def _tensor_bytes(t: Any) -> int:
 
 
 def timed_op(fn: Callable) -> Callable:
+    """Comms-logger seam. Collectives only execute for real inside a traced
+    (shard_map/jit) program, where per-op host timing is meaningless — so under
+    tracing we record a *census* event (op + message bytes, once per compile)
+    and leave latency to the jax profiler. Eager calls are identity fallbacks
+    and are never recorded."""
+
     @functools.wraps(fn)
     def wrapper(*args, **kwargs):
         clog = get_comms_logger()
-        if clog is None or not clog.enabled or _in_trace(args):
-            return fn(*args, **kwargs)
-        t0 = time.time()
-        result = fn(*args, **kwargs)
-        jax.block_until_ready(result)
-        clog.append(fn.__name__, kwargs.get("log_name", fn.__name__),
-                    time.time() - t0, _tensor_bytes(args[0]) if args else 0)
-        return result
+        if clog is not None and clog.enabled and _in_trace(args):
+            clog.append_traced(fn.__name__, kwargs.get("log_name", fn.__name__),
+                               _tensor_bytes(args[0]) if args else 0)
+        return fn(*args, **kwargs)
 
     return wrapper
 
@@ -259,11 +269,17 @@ def send_prev(tensor: jax.Array, axis: str = "pipe") -> jax.Array:
     return lax.ppermute(tensor, axis, [(i, (i - 1) % n) for i in range(n)])
 
 
-def axis_rank(axis: str) -> jax.Array:
+def axis_rank(axis: str):
+    """Index along a mesh axis; 0 outside a mapped context (single participant)."""
+    if not _axis_in_scope(axis):
+        return 0
     return lax.axis_index(axis)
 
 
-def axis_size(axis: str) -> int:
+def axis_size(axis: str):
+    """Size of a mesh axis; 1 outside a mapped context (single participant)."""
+    if not _axis_in_scope(axis):
+        return 1
     return lax.psum(1, axis)
 
 

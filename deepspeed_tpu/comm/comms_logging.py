@@ -43,6 +43,7 @@ class CommsLogger:
         self.prof_ops = prof_ops or []
         self.world_size = max(world_size, 1)
         self.comms_dict: Dict[str, Dict[int, List[float]]] = defaultdict(lambda: defaultdict(list))
+        self.traced_dict: Dict[str, Dict[int, int]] = defaultdict(lambda: defaultdict(int))
 
     def configure(self, config) -> None:
         self.enabled = config.enabled
@@ -58,6 +59,8 @@ class CommsLogger:
         self.prof_all = False
 
     def append(self, raw_name: str, record_name: str, latency_s: float, msg_size: int) -> None:
+        """Record a host-timed op (explicit instrumentation, e.g. engine-level
+        checkpoint transfers)."""
         if not self.prof_all and record_name not in self.prof_ops:
             return
         size, algbw, busbw = calc_bw_log(raw_name, msg_size, latency_s, self.world_size)
@@ -67,16 +70,34 @@ class CommsLogger:
                      f"msg size: {_fmt_size(size)} | algbw (Gbps): {algbw:.2f} | "
                      f"busbw (Gbps): {busbw:.2f}")
 
+    def append_traced(self, raw_name: str, record_name: str, msg_size: int) -> None:
+        """Record a collective encountered during jit/shard_map tracing —
+        a *census* of the compiled program (one event per trace, not per step).
+        Latency of traced collectives comes from the jax profiler."""
+        if not self.prof_all and record_name not in self.prof_ops:
+            return
+        self.traced_dict[record_name][msg_size] += 1
+        if self.verbose:
+            log_dist(f"traced comm op: {record_name} | msg size: {_fmt_size(msg_size)}")
+
     def log_summary(self) -> None:
-        lines = [f"{'Comm. Op':<20}{'Message Size':<20}{'Count':<10}"
-                 f"{'Total Latency(ms)':<20}{'Avg Latency(ms)':<20}"]
-        for record_name, sizes in self.comms_dict.items():
-            lines.append(record_name)
-            for size, lats in sorted(sizes.items()):
-                total = sum(lats)
-                lines.append(f"{'':<20}{_fmt_size(size):<20}{len(lats):<10}"
-                             f"{total:<20.2f}{total / len(lats):<20.2f}")
-        log_dist("\n".join(lines))
+        lines = []
+        if self.comms_dict:
+            lines.append(f"{'Comm. Op':<20}{'Message Size':<20}{'Count':<10}"
+                         f"{'Total Latency(ms)':<20}{'Avg Latency(ms)':<20}")
+            for record_name, sizes in self.comms_dict.items():
+                lines.append(record_name)
+                for size, lats in sorted(sizes.items()):
+                    total = sum(lats)
+                    lines.append(f"{'':<20}{_fmt_size(size):<20}{len(lats):<10}"
+                                 f"{total:<20.2f}{total / len(lats):<20.2f}")
+        if self.traced_dict:
+            lines.append("Traced collectives (per compiled program; latency via jax profiler):")
+            lines.append(f"{'Comm. Op':<20}{'Message Size':<20}{'Occurrences':<12}")
+            for record_name, sizes in self.traced_dict.items():
+                for size, n in sorted(sizes.items()):
+                    lines.append(f"{record_name:<20}{_fmt_size(size):<20}{n:<12}")
+        log_dist("\n".join(lines) if lines else "comms logger: no events recorded")
 
 
 def _fmt_size(num_bytes: int) -> str:

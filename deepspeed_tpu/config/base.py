@@ -32,18 +32,20 @@ def _is_config_model(tp: Any) -> bool:
     return isinstance(tp, type) and issubclass(tp, ConfigModel)
 
 
-def _unwrap_optional(tp: Any) -> Any:
+def _unwrap_optional(tp: Any) -> Tuple[Any, bool]:
     if get_origin(tp) is Union:
         args = [a for a in get_args(tp) if a is not type(None)]
         if len(args) == 1:
-            return args[0]
-    return tp
+            return args[0], True
+    return tp, tp is Any
 
 
 def _coerce(name: str, value: Any, tp: Any) -> Any:
     """Best-effort typed coercion of a JSON value into the annotated type."""
-    tp = _unwrap_optional(tp)
+    tp, optional = _unwrap_optional(tp)
     if value is None:
+        if not optional:
+            raise ConfigError(f"field '{name}' may not be null")
         return None
     if _is_config_model(tp):
         if isinstance(value, tp):

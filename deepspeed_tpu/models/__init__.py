@@ -1,0 +1,13 @@
+from .core import Model, cast_floating, param_count, resolve_param_specs
+from .presets import available_presets, create_model, transformer_config
+from .simple import random_batches, random_token_batches, simple_model
+from .transformer import (TransformerConfig, build_model, cross_entropy_loss,
+                          forward, init_params, param_axes)
+
+__all__ = [
+    "Model", "cast_floating", "param_count", "resolve_param_specs",
+    "available_presets", "create_model", "transformer_config",
+    "random_batches", "random_token_batches", "simple_model",
+    "TransformerConfig", "build_model", "cross_entropy_loss", "forward",
+    "init_params", "param_axes",
+]

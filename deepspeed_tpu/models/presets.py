@@ -1,0 +1,78 @@
+"""Named model presets — the families the reference targets with injection
+policies (module_inject/containers/{gpt2,opt,bloom,gptj,gptneo,gptneox,llama}
+and the BASELINE configs: GPT-2 125M, OPT-1.3B, Llama-7B, BLOOM-7B)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax.numpy as jnp
+
+from .core import Model
+from .transformer import TransformerConfig, build_model
+
+# family defaults: (norm, position, activation, tie)
+_FAMILIES: Dict[str, Dict[str, Any]] = {
+    "gpt2": dict(norm="layernorm", position="learned", activation="gelu",
+                 tie_embeddings=True),
+    "opt": dict(norm="layernorm", position="learned", activation="gelu",
+                tie_embeddings=True),
+    "bloom": dict(norm="layernorm", position="learned", activation="gelu",
+                  tie_embeddings=True),
+    "gptj": dict(norm="layernorm", position="rope", activation="gelu",
+                 tie_embeddings=False),
+    "gptneox": dict(norm="layernorm", position="rope", activation="gelu",
+                    tie_embeddings=False),
+    "llama": dict(norm="rmsnorm", position="rope", activation="swiglu",
+                  tie_embeddings=False),
+    "mistral": dict(norm="rmsnorm", position="rope", activation="swiglu",
+                    tie_embeddings=False),
+}
+
+# size presets: hidden, layers, heads, kv_heads, vocab, max_seq
+_SIZES: Dict[str, Dict[str, Any]] = {
+    "gpt2-125m": dict(family="gpt2", hidden_size=768, num_layers=12, num_heads=12,
+                      vocab_size=50257, max_seq_len=1024),
+    "gpt2-350m": dict(family="gpt2", hidden_size=1024, num_layers=24, num_heads=16,
+                      vocab_size=50257, max_seq_len=1024),
+    "gpt2-1.3b": dict(family="gpt2", hidden_size=2048, num_layers=24, num_heads=32,
+                      vocab_size=50257, max_seq_len=2048),
+    "opt-125m": dict(family="opt", hidden_size=768, num_layers=12, num_heads=12,
+                     vocab_size=50272, max_seq_len=2048),
+    "opt-1.3b": dict(family="opt", hidden_size=2048, num_layers=24, num_heads=32,
+                     vocab_size=50272, max_seq_len=2048),
+    "opt-6.7b": dict(family="opt", hidden_size=4096, num_layers=32, num_heads=32,
+                     vocab_size=50272, max_seq_len=2048),
+    "llama-7b": dict(family="llama", hidden_size=4096, num_layers=32, num_heads=32,
+                     vocab_size=32000, max_seq_len=4096, ffn_hidden_size=11008),
+    "llama-13b": dict(family="llama", hidden_size=5120, num_layers=40, num_heads=40,
+                      vocab_size=32000, max_seq_len=4096, ffn_hidden_size=13824),
+    "bloom-7b": dict(family="bloom", hidden_size=4096, num_layers=30, num_heads=32,
+                     vocab_size=250880, max_seq_len=2048),
+    # tiny debug models (reference tests/unit/simple_model.py scale)
+    "tiny": dict(family="gpt2", hidden_size=64, num_layers=2, num_heads=4,
+                 vocab_size=256, max_seq_len=128),
+    "tiny-llama": dict(family="llama", hidden_size=64, num_layers=2, num_heads=4,
+                       num_kv_heads=2, vocab_size=256, max_seq_len=128,
+                       ffn_hidden_size=128),
+}
+
+
+def transformer_config(preset: str, dtype=jnp.float32, **overrides) -> TransformerConfig:
+    if preset not in _SIZES:
+        raise ValueError(f"unknown preset '{preset}' (known: {sorted(_SIZES)})")
+    spec = dict(_SIZES[preset])
+    family = spec.pop("family")
+    kwargs = dict(_FAMILIES[family])
+    kwargs.update(spec)
+    kwargs.update(overrides)
+    return TransformerConfig(dtype=dtype, **kwargs)
+
+
+def create_model(preset: str, dtype=jnp.float32, **overrides) -> Model:
+    cfg = transformer_config(preset, dtype=dtype, **overrides)
+    return build_model(cfg, name=preset)
+
+
+def available_presets():
+    return sorted(_SIZES)

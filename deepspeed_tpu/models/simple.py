@@ -1,0 +1,61 @@
+"""Tiny fixture models for tests — analog of reference
+tests/unit/simple_model.py (SimpleModel :18, SimpleMoEModel :70, ...)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+from .core import EMBED, MLP, Model
+
+
+def simple_model(hidden_dim: int = 10, nlayers: int = 2) -> Model:
+    """Linear stack + MSE head; batch = {"x": (B,H), "y": (B,1)}."""
+
+    def init(rng):
+        keys = jax.random.split(rng, nlayers + 1)
+        params = {f"linear_{i}": {
+            "w": jax.random.normal(keys[i], (hidden_dim, hidden_dim)) * 0.1,
+            "b": jnp.zeros((hidden_dim,))} for i in range(nlayers)}
+        params["head"] = {"w": jax.random.normal(keys[-1], (hidden_dim, 1)) * 0.1,
+                          "b": jnp.zeros((1,))}
+        return params
+
+    def apply(params, batch):
+        h = batch["x"]
+        for i in range(nlayers):
+            h = jax.nn.relu(h @ params[f"linear_{i}"]["w"] + params[f"linear_{i}"]["b"])
+        return h @ params["head"]["w"] + params["head"]["b"]
+
+    def loss_fn(params, batch):
+        pred = apply(params, batch)
+        return jnp.mean(jnp.square(pred - batch["y"]))
+
+    axes: Dict[str, Any] = {f"linear_{i}": {"w": (EMBED, MLP), "b": (MLP,)}
+                            for i in range(nlayers)}
+    axes["head"] = {"w": (EMBED, None), "b": (None,)}
+    return Model(init=init, apply=apply, loss_fn=loss_fn, axes=axes, name="simple")
+
+
+def random_batches(rng: jax.Array, n: int, batch_size: int, hidden_dim: int = 10):
+    """Deterministic synthetic regression data (reference random_dataloader)."""
+    batches = []
+    for i in range(n):
+        k1, k2, rng = jax.random.split(rng, 3)
+        x = jax.random.normal(k1, (batch_size, hidden_dim))
+        w_true = jnp.arange(hidden_dim, dtype=jnp.float32)[:, None] / hidden_dim
+        y = x @ w_true + 0.01 * jax.random.normal(k2, (batch_size, 1))
+        batches.append({"x": x, "y": y})
+    return batches
+
+
+def random_token_batches(rng: jax.Array, n: int, batch_size: int, seq_len: int,
+                         vocab_size: int):
+    batches = []
+    for i in range(n):
+        k, rng = jax.random.split(rng)
+        ids = jax.random.randint(k, (batch_size, seq_len), 0, vocab_size)
+        batches.append({"input_ids": ids})
+    return batches

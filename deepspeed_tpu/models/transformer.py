@@ -1,0 +1,373 @@
+"""Unified decoder-only transformer (GPT-2 / Llama families).
+
+The reference implements transformer compute three times (fused training kernel
+``csrc/transformer/``, inference kernels ``csrc/transformer/inference/``, and
+per-architecture injected modules). Here ONE functional decoder covers both
+families through config switches:
+
+  GPT-2 family : LayerNorm(+bias), learned positions, GELU MLP, tied embeddings
+  Llama family : RMSNorm, RoPE, SwiGLU MLP, GQA (n_kv_heads < n_heads)
+
+Layers are **stacked and scanned** (`lax.scan` over a leading layer dim) so XLA
+compiles one layer program regardless of depth — the TPU-idiomatic equivalent
+of the reference's per-layer kernel launch loop — with `jax.checkpoint` for
+activation rematerialisation (reference: activation_checkpointing/).
+
+Attention is pluggable: the engine can swap in the Pallas flash-attention
+kernel (ops/flash_attention.py) via ``attention_impl``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .core import (EMBED, HEADS, KV_HEADS, LAYERS, MLP, Model, SEQ, VOCAB,
+                   cast_floating)
+
+
+@dataclasses.dataclass
+class TransformerConfig:
+    vocab_size: int = 50257
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    num_kv_heads: Optional[int] = None      # None => MHA
+    ffn_hidden_size: Optional[int] = None   # None => 4*hidden (gelu) / llama rule (swiglu)
+    max_seq_len: int = 1024
+    norm: str = "layernorm"                 # layernorm | rmsnorm
+    position: str = "learned"               # learned | rope
+    activation: str = "gelu"                # gelu | swiglu
+    tie_embeddings: bool = True
+    norm_eps: float = 1e-5
+    rope_theta: float = 10000.0
+    dropout: float = 0.0
+    dtype: Any = jnp.float32                # compute/param dtype
+    remat: bool = False                     # activation checkpointing over layers
+    attention_impl: Optional[Callable] = None  # pluggable (pallas flash attention)
+
+    def __post_init__(self):
+        if self.num_kv_heads is None:
+            self.num_kv_heads = self.num_heads
+        if self.ffn_hidden_size is None:
+            if self.activation == "swiglu":
+                self.ffn_hidden_size = int(8 * self.hidden_size / 3 / 64 + 0.999) * 64
+            else:
+                self.ffn_hidden_size = 4 * self.hidden_size
+        assert self.hidden_size % self.num_heads == 0
+        assert self.num_heads % self.num_kv_heads == 0
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+
+# ---------------------------------------------------------------------------
+# init
+# ---------------------------------------------------------------------------
+
+
+def init_params(rng: jax.Array, cfg: TransformerConfig) -> Dict[str, Any]:
+    H, L = cfg.hidden_size, cfg.num_layers
+    N, K, D, F, V = (cfg.num_heads, cfg.num_kv_heads, cfg.head_dim,
+                     cfg.ffn_hidden_size, cfg.vocab_size)
+    keys = iter(jax.random.split(rng, 16))
+    std = 0.02
+    # GPT-2-style scaled init on residual-writing projections
+    resid_std = std / (2 * L) ** 0.5
+
+    def normal(key, shape, s=std):
+        return (jax.random.normal(key, shape, jnp.float32) * s).astype(cfg.dtype)
+
+    params: Dict[str, Any] = {
+        "embed": {"tokens": normal(next(keys), (V, H))},
+    }
+    if cfg.position == "learned":
+        params["pos"] = normal(next(keys), (cfg.max_seq_len, H), 0.01)
+
+    layers: Dict[str, Any] = {
+        "ln1": {"scale": jnp.ones((L, H), cfg.dtype)},
+        "ln2": {"scale": jnp.ones((L, H), cfg.dtype)},
+        "attn": {
+            "wq": normal(next(keys), (L, H, N * D)),
+            "wk": normal(next(keys), (L, H, K * D)),
+            "wv": normal(next(keys), (L, H, K * D)),
+            "wo": normal(next(keys), (L, N * D, H), resid_std),
+        },
+    }
+    if cfg.activation == "swiglu":
+        layers["mlp"] = {
+            "w_gate": normal(next(keys), (L, H, F)),
+            "w_up": normal(next(keys), (L, H, F)),
+            "w_down": normal(next(keys), (L, F, H), resid_std),
+        }
+    else:
+        layers["mlp"] = {
+            "w_up": normal(next(keys), (L, H, F)),
+            "b_up": jnp.zeros((L, F), cfg.dtype),
+            "w_down": normal(next(keys), (L, F, H), resid_std),
+            "b_down": jnp.zeros((L, H), cfg.dtype),
+        }
+    if cfg.norm == "layernorm":
+        layers["ln1"]["bias"] = jnp.zeros((L, H), cfg.dtype)
+        layers["ln2"]["bias"] = jnp.zeros((L, H), cfg.dtype)
+        layers["attn"]["bq"] = jnp.zeros((L, N * D), cfg.dtype)
+        layers["attn"]["bk"] = jnp.zeros((L, K * D), cfg.dtype)
+        layers["attn"]["bv"] = jnp.zeros((L, K * D), cfg.dtype)
+        layers["attn"]["bo"] = jnp.zeros((L, H), cfg.dtype)
+    params["layers"] = layers
+
+    params["final_norm"] = {"scale": jnp.ones((H,), cfg.dtype)}
+    if cfg.norm == "layernorm":
+        params["final_norm"]["bias"] = jnp.zeros((H,), cfg.dtype)
+    if not cfg.tie_embeddings:
+        params["lm_head"] = normal(next(keys), (H, V))
+    return params
+
+
+def param_axes(cfg: TransformerConfig) -> Dict[str, Any]:
+    """Logical-axis tree mirroring init_params — drives TP/ZeRO sharding."""
+    attn = {"wq": (LAYERS, EMBED, HEADS), "wk": (LAYERS, EMBED, KV_HEADS),
+            "wv": (LAYERS, EMBED, KV_HEADS), "wo": (LAYERS, HEADS, EMBED)}
+    if cfg.norm == "layernorm":
+        attn.update({"bq": (LAYERS, HEADS), "bk": (LAYERS, KV_HEADS),
+                     "bv": (LAYERS, KV_HEADS), "bo": (LAYERS, EMBED)})
+    if cfg.activation == "swiglu":
+        mlp = {"w_gate": (LAYERS, EMBED, MLP), "w_up": (LAYERS, EMBED, MLP),
+               "w_down": (LAYERS, MLP, EMBED)}
+    else:
+        mlp = {"w_up": (LAYERS, EMBED, MLP), "b_up": (LAYERS, MLP),
+               "w_down": (LAYERS, MLP, EMBED), "b_down": (LAYERS, EMBED)}
+    ln = {"scale": (LAYERS, EMBED)}
+    if cfg.norm == "layernorm":
+        ln = {"scale": (LAYERS, EMBED), "bias": (LAYERS, EMBED)}
+    axes: Dict[str, Any] = {
+        "embed": {"tokens": (VOCAB, EMBED)},
+        "layers": {"ln1": dict(ln), "ln2": dict(ln), "attn": attn, "mlp": mlp},
+        "final_norm": ({"scale": (EMBED,), "bias": (EMBED,)}
+                       if cfg.norm == "layernorm" else {"scale": (EMBED,)}),
+    }
+    if cfg.position == "learned":
+        axes["pos"] = (SEQ, EMBED)
+    if not cfg.tie_embeddings:
+        axes["lm_head"] = (EMBED, VOCAB)
+    return axes
+
+
+# ---------------------------------------------------------------------------
+# building blocks
+# ---------------------------------------------------------------------------
+
+
+def _norm(x: jax.Array, scale: jax.Array, bias: Optional[jax.Array],
+          kind: str, eps: float) -> jax.Array:
+    x32 = x.astype(jnp.float32)
+    if kind == "rmsnorm":
+        var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
+        out = x32 * lax.rsqrt(var + eps) * scale.astype(jnp.float32)
+    else:
+        mean = jnp.mean(x32, axis=-1, keepdims=True)
+        var = jnp.var(x32, axis=-1, keepdims=True)
+        out = (x32 - mean) * lax.rsqrt(var + eps) * scale.astype(jnp.float32)
+        if bias is not None:
+            out = out + bias.astype(jnp.float32)
+    return out.astype(x.dtype)
+
+
+def rope_table(positions: jax.Array, head_dim: int, theta: float) -> Tuple[jax.Array, jax.Array]:
+    """cos/sin tables for the given absolute positions, shape (..., head_dim/2)."""
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    angles = positions[..., None].astype(jnp.float32) * inv_freq  # (..., D/2)
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
+    """x: (B, S, n, D); cos/sin: (S, D/2) or broadcastable."""
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    c = cos[None, :, None, :]
+    s = sin[None, :, None, :]
+    return jnp.concatenate([x1 * c - x2 * s, x1 * s + x2 * c], axis=-1).astype(x.dtype)
+
+
+def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                          mask: Optional[jax.Array], causal: bool = True) -> jax.Array:
+    """Plain-XLA reference attention. q: (B,S,N,D); k,v: (B,T,K,D) with GQA
+    broadcast. Softmax in fp32 (reference softmax kernels are fp32-accum)."""
+    B, S, N, D = q.shape
+    T, K = k.shape[1], k.shape[2]
+    if K != N:
+        k = jnp.repeat(k, N // K, axis=2)
+        v = jnp.repeat(v, N // K, axis=2)
+    scores = jnp.einsum("bsnd,btnd->bnst", q, k).astype(jnp.float32) / (D ** 0.5)
+    neg = jnp.finfo(jnp.float32).min
+    if causal:
+        # query at absolute position (T - S + s) attends to keys <= that position
+        q_pos = jnp.arange(S)[:, None] + (T - S)
+        k_pos = jnp.arange(T)[None, :]
+        scores = jnp.where((k_pos <= q_pos)[None, None], scores, neg)
+    if mask is not None:
+        # (B,T) key-padding mask or (B,S,T) full attention mask
+        if mask.ndim == 2:
+            scores = jnp.where(mask[:, None, None, :].astype(bool), scores, neg)
+        else:
+            scores = jnp.where(mask[:, None, :, :].astype(bool), scores, neg)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bnst,btnd->bsnd", probs, v)
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+
+def _layer_forward(cfg: TransformerConfig, x: jax.Array, layer: Dict[str, Any],
+                   mask: Optional[jax.Array],
+                   positions: jax.Array,
+                   cache: Optional[Dict[str, jax.Array]] = None
+                   ) -> Tuple[jax.Array, Optional[Dict[str, jax.Array]]]:
+    """One decoder block. ``layer`` holds this layer's (unstacked) params.
+    ``cache`` (decode): dict with k/v of shape (B, T_max, K, D) and scalar
+    ``index`` — returns the updated cache."""
+    B, S, H = x.shape
+    N, K, D = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+
+    h = _norm(x, layer["ln1"]["scale"], layer["ln1"].get("bias"), cfg.norm, cfg.norm_eps)
+    q = jnp.einsum("bsh,hd->bsd", h, layer["attn"]["wq"])
+    k = jnp.einsum("bsh,hd->bsd", h, layer["attn"]["wk"])
+    v = jnp.einsum("bsh,hd->bsd", h, layer["attn"]["wv"])
+    if "bq" in layer["attn"]:
+        q = q + layer["attn"]["bq"]
+        k = k + layer["attn"]["bk"]
+        v = v + layer["attn"]["bv"]
+    q = q.reshape(B, S, N, D)
+    k = k.reshape(B, S, K, D)
+    v = v.reshape(B, S, K, D)
+
+    if cfg.position == "rope":
+        cos, sin = rope_table(positions, D, cfg.rope_theta)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+
+    attn_fn = cfg.attention_impl or dot_product_attention
+    new_cache = None
+    if cache is not None:
+        idx = cache["index"]
+        ck = lax.dynamic_update_slice(cache["k"], k, (0, idx, 0, 0))
+        cv = lax.dynamic_update_slice(cache["v"], v, (0, idx, 0, 0))
+        new_cache = {"k": ck, "v": cv, "index": idx + S}
+        k, v = ck, cv
+        T = ck.shape[1]
+        # causal over absolute positions: query s sits at idx+s, keys valid <= that
+        q_pos = idx + jnp.arange(S)
+        k_pos = jnp.arange(T)
+        causal_mask = (k_pos[None, :] <= q_pos[:, None]).astype(jnp.int32)  # (S,T)
+        full = jnp.broadcast_to(causal_mask[None], (B, S, T))
+        if mask is not None:  # (B, T_prompt) padding mask padded to T by caller
+            full = full * mask[:, None, :]
+        attn = attn_fn(q, k, v, full, causal=False)
+    else:
+        attn = attn_fn(q, k, v, mask, causal=True)
+
+    attn = attn.reshape(B, S, N * D)
+    attn_out = jnp.einsum("bsd,dh->bsh", attn, layer["attn"]["wo"])
+    if "bo" in layer["attn"]:
+        attn_out = attn_out + layer["attn"]["bo"]
+    x = x + attn_out
+
+    h = _norm(x, layer["ln2"]["scale"], layer["ln2"].get("bias"), cfg.norm, cfg.norm_eps)
+    if cfg.activation == "swiglu":
+        gate = jnp.einsum("bsh,hf->bsf", h, layer["mlp"]["w_gate"])
+        up = jnp.einsum("bsh,hf->bsf", h, layer["mlp"]["w_up"])
+        inner = jax.nn.silu(gate) * up
+        mlp_out = jnp.einsum("bsf,fh->bsh", inner, layer["mlp"]["w_down"])
+    else:
+        inner = jnp.einsum("bsh,hf->bsf", h, layer["mlp"]["w_up"]) + layer["mlp"]["b_up"]
+        inner = jax.nn.gelu(inner, approximate=True)
+        mlp_out = jnp.einsum("bsf,fh->bsh", inner, layer["mlp"]["w_down"]) + layer["mlp"]["b_down"]
+    x = x + mlp_out
+    return x, new_cache
+
+
+def forward(params: Dict[str, Any], input_ids: jax.Array,
+            cfg: TransformerConfig,
+            attention_mask: Optional[jax.Array] = None,
+            cache: Optional[Dict[str, Any]] = None,
+            start_pos: Any = 0) -> Tuple[jax.Array, Optional[Dict[str, Any]]]:
+    """Token ids (B,S) → logits (B,S,V). With ``cache``, runs in decode mode
+    (cache is a per-layer stacked pytree; see inference/kv_cache.py)."""
+    B, S = input_ids.shape
+    x = params["embed"]["tokens"][input_ids].astype(cfg.dtype)
+    positions = jnp.arange(S) + start_pos
+    if cfg.position == "learned":
+        x = x + params["pos"][positions].astype(cfg.dtype)
+
+    def block(carry, layer_and_cache):
+        h = carry
+        layer, layer_cache = layer_and_cache
+        h, new_cache = _layer_forward(cfg, h, layer, attention_mask, positions, layer_cache)
+        return h, new_cache
+
+    block_fn = block
+    if cfg.remat and cache is None:
+        block_fn = jax.checkpoint(block, prevent_cse=False)
+
+    if cache is None:
+        x, _ = lax.scan(lambda c, layer: block_fn(c, (layer, None)),
+                        x, params["layers"])
+        new_cache = None
+    else:
+        x, new_cache = lax.scan(block_fn, x, (params["layers"], cache))
+
+    x = _norm(x, params["final_norm"]["scale"], params["final_norm"].get("bias"),
+              cfg.norm, cfg.norm_eps)
+    if cfg.tie_embeddings:
+        logits = jnp.einsum("bsh,vh->bsv", x, params["embed"]["tokens"])
+    else:
+        logits = jnp.einsum("bsh,hv->bsv", x, params["lm_head"])
+    return logits, new_cache
+
+
+def cross_entropy_loss(logits: jax.Array, labels: jax.Array,
+                       mask: Optional[jax.Array] = None) -> jax.Array:
+    """Next-token cross entropy in fp32; labels == -100 are ignored (HF
+    convention used throughout the reference tests)."""
+    logits = logits.astype(jnp.float32)
+    valid = labels != -100
+    if mask is not None:
+        valid = valid & mask.astype(bool)
+    safe_labels = jnp.where(valid, labels, 0)
+    logps = jax.nn.log_softmax(logits, axis=-1)
+    token_loss = -jnp.take_along_axis(logps, safe_labels[..., None], axis=-1)[..., 0]
+    token_loss = jnp.where(valid, token_loss, 0.0)
+    return token_loss.sum() / jnp.maximum(valid.sum(), 1)
+
+
+def build_model(cfg: TransformerConfig, name: str = "transformer") -> Model:
+    """Bundle init/apply/loss/axes for the engine."""
+
+    def init(rng):
+        return init_params(rng, cfg)
+
+    def apply(params, batch, cache=None, start_pos=0):
+        return forward(params, batch["input_ids"], cfg,
+                       attention_mask=batch.get("attention_mask"),
+                       cache=cache, start_pos=start_pos)
+
+    def loss_fn(params, batch):
+        logits, _ = forward(params, batch["input_ids"], cfg,
+                            attention_mask=batch.get("attention_mask"))
+        labels = batch.get("labels")
+        if labels is None:
+            labels = jnp.concatenate(
+                [batch["input_ids"][:, 1:],
+                 jnp.full((batch["input_ids"].shape[0], 1), -100, batch["input_ids"].dtype)],
+                axis=1)
+        return cross_entropy_loss(logits, labels, batch.get("attention_mask"))
+
+    return Model(init=init, apply=apply, loss_fn=loss_fn, axes=param_axes(cfg),
+                 config=cfg, name=name)

@@ -1,0 +1,118 @@
+"""Metrics monitoring — analog of ``deepspeed/monitor/`` (``MonitorMaster``
+monitor.py:29 fanning (name, value, step) events out to TensorBoard / WandB /
+CSV writers, rank-0 gated)."""
+
+from __future__ import annotations
+
+import csv
+import os
+from typing import List, Optional, Tuple
+
+import jax
+
+from ..config.config import MonitorConfig
+from ..utils.logging import logger
+
+Event = Tuple[str, float, int]
+
+
+class BaseWriter:
+    enabled = False
+
+    def write_events(self, events: List[Event]) -> None:
+        raise NotImplementedError
+
+    def flush(self) -> None:
+        pass
+
+
+class CSVMonitor(BaseWriter):
+    """Reference monitor/csv_monitor.py: one csv file per metric name."""
+
+    def __init__(self, config) -> None:
+        self.enabled = config.enabled and jax.process_index() == 0
+        self.output_path = config.output_path or "./csv_monitor"
+        self.job_name = config.job_name
+        self._files = {}
+        if self.enabled:
+            os.makedirs(os.path.join(self.output_path, self.job_name), exist_ok=True)
+
+    def write_events(self, events: List[Event]) -> None:
+        if not self.enabled:
+            return
+        for name, value, step in events:
+            fname = os.path.join(self.output_path, self.job_name,
+                                 name.replace("/", "_") + ".csv")
+            new = not os.path.exists(fname)
+            with open(fname, "a", newline="") as fh:
+                w = csv.writer(fh)
+                if new:
+                    w.writerow(["step", name])
+                w.writerow([step, value])
+
+
+class TensorBoardMonitor(BaseWriter):
+    def __init__(self, config) -> None:
+        self.enabled = False
+        self.summary_writer = None
+        if config.enabled and jax.process_index() == 0:
+            try:
+                from torch.utils.tensorboard import SummaryWriter
+
+                path = os.path.join(config.output_path or "./runs", config.job_name)
+                self.summary_writer = SummaryWriter(log_dir=path)
+                self.enabled = True
+            except Exception as e:  # tensorboard not installed
+                logger.warning(f"tensorboard unavailable ({e}); disabling writer")
+
+    def write_events(self, events: List[Event]) -> None:
+        if not self.enabled:
+            return
+        for name, value, step in events:
+            self.summary_writer.add_scalar(name, value, step)
+
+    def flush(self) -> None:
+        if self.enabled:
+            self.summary_writer.flush()
+
+
+class WandbMonitor(BaseWriter):
+    def __init__(self, config) -> None:
+        self.enabled = False
+        if config.enabled and jax.process_index() == 0:
+            try:
+                import wandb
+
+                wandb.init(project=config.project, group=config.group,
+                           entity=config.team)
+                self._wandb = wandb
+                self.enabled = True
+            except Exception as e:
+                logger.warning(f"wandb unavailable ({e}); disabling writer")
+
+    def write_events(self, events: List[Event]) -> None:
+        if not self.enabled:
+            return
+        for name, value, step in events:
+            self._wandb.log({name: value}, step=step)
+
+
+class MonitorMaster(BaseWriter):
+    """Fan-out to all enabled writers (reference monitor/monitor.py:29)."""
+
+    def __init__(self, config: Optional[MonitorConfig] = None):
+        config = config or MonitorConfig()
+        self.writers: List[BaseWriter] = [
+            TensorBoardMonitor(config.tensorboard),
+            WandbMonitor(config.wandb),
+            CSVMonitor(config.csv_monitor),
+        ]
+        self.enabled = any(w.enabled for w in self.writers)
+
+    def write_events(self, events: List[Event]) -> None:
+        for w in self.writers:
+            w.write_events(events)
+
+    def flush(self) -> None:
+        for w in self.writers:
+            w.flush()

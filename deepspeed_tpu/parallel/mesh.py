@@ -139,7 +139,10 @@ def get_sequence_parallel_world_size(mesh: Optional[Mesh] = None) -> int:
     return _axis_size(SEQ_AXIS, mesh)
 
 
-def get_expert_parallel_world_size(mesh: Optional[Mesh] = None) -> int:
+def get_expert_parallel_world_size() -> int:
+    """EP degree of the active configuration (set via set_mesh/mesh_context).
+    EP is not a mesh axis — the expert dim folds over 'data' at MoE layers —
+    so unlike the sibling accessors there is no per-mesh variant."""
     return _GLOBAL_EP_SIZE
 
 

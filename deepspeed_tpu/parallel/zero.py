@@ -1,0 +1,113 @@
+"""ZeRO as sharding policy.
+
+The reference implements ZeRO with ~5k LoC of hook-driven tensor surgery
+(``runtime/zero/stage_1_and_2.py``, ``stage3.py``, ``partition_parameters.py``):
+flattening, bucketing, per-param backward hooks, trace-based prefetch. On TPU
+the *entire mechanism* reduces to WHERE each tensor lives on the mesh — XLA's
+SPMD partitioner then emits exactly the collectives the reference hand-codes:
+
+  stage 0: params/grads/opt replicated; grads all-reduced          (DDP)
+  stage 1: optimizer state (master + moments) sharded over 'data'  — update
+           computed shardwise, updated params all-gathered         (= step_1&2 step())
+  stage 2: + gradients sharded over 'data' — XLA lowers the grad
+           psum to reduce-scatter feeding the sharded update       (= reduce_ipg_grads)
+  stage 3: + parameters sharded over 'data' — XLA inserts per-layer
+           all-gather before use and discards after                (= fetch_sub_module)
+
+The prefetch/overlap machinery (ZeRoTraceMode, __prefetch_nvme...) disappears:
+XLA's latency-hiding scheduler overlaps the gathers with compute.
+
+This module computes the three PartitionSpec trees (params / grads / optimizer
+state) from a model's logical axes + the ZeRO stage + TP rules.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, NamedTuple, Optional
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..config.config import ZeroConfig
+from ..models.core import DEFAULT_TP_RULES, resolve_param_specs
+from ..utils.logging import logger
+from .mesh import DATA_AXIS, MODEL_AXIS
+
+
+class ZeroShardingPlan(NamedTuple):
+    param_specs: Any      # pytree of PartitionSpec aligned with params
+    grad_specs: Any       # same tree — sharding to constrain grads to
+    master_specs: Any     # sharding for fp32 master + optimizer moments
+    stage: int
+
+
+def build_sharding_plan(stage: int, params_or_shapes: Any, axes: Any,
+                        tp_rules: Optional[Dict[str, Optional[str]]] = None,
+                        fsdp_min_size: int = 2 ** 11) -> ZeroShardingPlan:
+    """Compute the ZeRO sharding plan.
+
+    ``fsdp_min_size`` mirrors the reference's stage3_param_persistence_threshold
+    (partition_parameters.py: small params stay dense); tiny tensors are
+    replicated at every stage.
+    """
+    if not 0 <= stage <= 3:
+        raise ValueError(f"ZeRO stage must be 0..3, got {stage}")
+    rules = dict(DEFAULT_TP_RULES if tp_rules is None else tp_rules)
+
+    tp_only = resolve_param_specs(params_or_shapes, axes, rules, fsdp_axis=None)
+    fsdp = resolve_param_specs(params_or_shapes, axes, rules, fsdp_axis=DATA_AXIS,
+                               fsdp_min_size=fsdp_min_size)
+
+    param_specs = fsdp if stage >= 3 else tp_only
+    grad_specs = fsdp if stage >= 2 else tp_only
+    master_specs = fsdp if stage >= 1 else tp_only
+    return ZeroShardingPlan(param_specs=param_specs, grad_specs=grad_specs,
+                            master_specs=master_specs, stage=stage)
+
+
+def optimizer_state_specs(state_shapes: Any, params: Any, param_like_specs: Any) -> Any:
+    """Map a sharding-spec tree onto an optimizer state whose inner nodes
+    contain params-structured subtrees (optax moments, our fp32 master).
+    Scalars and anything not params-shaped stay replicated.
+
+    This is the TPU analog of the reference's ZeRO rule "optimizer state is
+    partitioned exactly like its param" (stage_1_and_2.py
+    get_data_parallel_partitions / stage3 sub-groups).
+    """
+    params_treedef = jax.tree.structure(params)
+
+    def is_node_leaf(n):
+        return hasattr(n, "shape") or n is None
+
+    def rec(node):
+        if node is None:
+            return None
+        if not is_node_leaf(node):
+            try:
+                if jax.tree.structure(node) == params_treedef:
+                    return param_like_specs
+            except Exception:
+                pass
+        if is_node_leaf(node):
+            return P()
+        # descend one pytree level
+        children, treedef = jax.tree_util.tree_flatten(
+            node, is_leaf=lambda x: x is not node)
+        return jax.tree_util.tree_unflatten(treedef, [rec(c) for c in children])
+
+    return rec(state_shapes)
+
+
+def as_named(specs: Any, mesh: Mesh) -> Any:
+    """PartitionSpec tree → NamedSharding tree (jit in_shardings form)."""
+    return jax.tree.map(lambda s: NamedSharding(mesh, s),
+                        specs, is_leaf=lambda x: isinstance(x, P))
+
+
+def describe_plan(plan: ZeroShardingPlan, params: Any) -> str:
+    total = sum(int(p.size) for p in jax.tree.leaves(params))
+    sharded = sum(int(p.size) for p, s in zip(jax.tree.leaves(params),
+                                              jax.tree.leaves(plan.param_specs))
+                  if any(a is not None for a in (s or ())))
+    return (f"ZeRO stage {plan.stage}: {total / 1e6:.1f}M params, "
+            f"{sharded / max(total, 1) * 100:.0f}% sharded")

@@ -1,0 +1,188 @@
+"""Checkpoint save/load.
+
+Analog of the reference checkpoint layer (``engine.save_checkpoint``
+runtime/engine.py:2792, ``CheckpointEngine`` runtime/checkpoint_engine/,
+``latest`` tag file :2979, tag-validation :2775) with one deliberate design
+change: checkpoints are stored as **full (unsharded) per-param arrays**, one
+file per leaf. That makes every checkpoint a *universal checkpoint* by
+construction — loadable under any dp/tp/pp topology, which the reference needs
+a separate offline reshape pipeline for (``deepspeed/checkpoint/``,
+``universal_checkpoint.py``): on load, each array is simply ``device_put``
+onto the new sharding.
+
+Layout:
+    <dir>/<tag>/metadata.json         paths, shapes, dtypes, client state
+    <dir>/<tag>/arrays/<flat_key>.npy one file per pytree leaf
+    <dir>/latest                      text file with the newest tag
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils.logging import logger
+
+_SEP = "##"
+
+
+def _flatten_with_keys(tree: Any) -> Dict[str, Any]:
+    flat = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        key = _SEP.join(_path_element_str(p) for p in path)
+        flat[key] = leaf
+    return flat
+
+
+def _path_element_str(p) -> str:
+    if hasattr(p, "key"):
+        return str(p.key)
+    if hasattr(p, "idx"):
+        return str(p.idx)
+    if hasattr(p, "name"):
+        return str(p.name)
+    return str(p)
+
+
+def _to_numpy(x: jax.Array) -> np.ndarray:
+    arr = np.asarray(jax.device_get(x))
+    if arr.dtype == jnp.bfloat16:
+        # store bf16 as its raw uint16 bits; dtype recorded in metadata
+        arr = arr.view(np.uint16)
+    return arr
+
+
+def _from_numpy(arr: np.ndarray, dtype_str: str) -> np.ndarray:
+    if dtype_str == "bfloat16":
+        return arr.view(jnp.bfloat16)
+    return arr
+
+
+def save_checkpoint(save_dir: str, tag: str, params: Any, opt_state: Any = None,
+                    client_state: Optional[Dict] = None, save_latest: bool = True,
+                    tag_validation: str = "Warn") -> str:
+    _validate_tag(tag, tag_validation)
+    ckpt_dir = os.path.join(save_dir, tag)
+    arrays_dir = os.path.join(ckpt_dir, "arrays")
+    os.makedirs(arrays_dir, exist_ok=True)
+
+    meta: Dict[str, Any] = {"tag": tag, "client_state": client_state or {},
+                            "arrays": {}}
+    trees = {"params": params}
+    if opt_state is not None:
+        trees["opt"] = opt_state
+    only_rank0 = jax.process_index() == 0
+    for prefix, tree in trees.items():
+        for key, leaf in _flatten_with_keys(tree).items():
+            if leaf is None:
+                continue
+            full_key = f"{prefix}{_SEP}{key}"
+            fname = re.sub(r"[^A-Za-z0-9_.#-]", "_", full_key) + ".npy"
+            meta["arrays"][full_key] = {
+                "file": fname,
+                "shape": list(np.shape(leaf)),
+                "dtype": str(leaf.dtype),
+            }
+            if only_rank0:
+                np.save(os.path.join(arrays_dir, fname), _to_numpy(leaf),
+                        allow_pickle=False)
+    if only_rank0:
+        with open(os.path.join(ckpt_dir, "metadata.json"), "w") as fh:
+            json.dump(meta, fh, indent=1)
+        if save_latest:
+            with open(os.path.join(save_dir, "latest"), "w") as fh:
+                fh.write(tag)
+    return ckpt_dir
+
+
+def read_latest_tag(load_dir: str) -> Optional[str]:
+    latest = os.path.join(load_dir, "latest")
+    if os.path.exists(latest):
+        with open(latest) as fh:
+            return fh.read().strip()
+    return None
+
+
+def load_checkpoint(load_dir: str, tag: Optional[str] = None,
+                    params_template: Optional[Tuple[Any, Any]] = None,
+                    opt_template: Optional[Tuple[Any, Any]] = None
+                    ) -> Optional[Tuple[Any, Any, Dict]]:
+    """Restore (params, opt_state, client_state). Templates are
+    (current_tree, shardings_tree) — arrays are device_put straight onto the
+    target sharding, which is what makes any topology change 'just work'."""
+    tag = tag or read_latest_tag(load_dir)
+    if tag is None:
+        logger.warning(f"no 'latest' file in {load_dir}; nothing restored")
+        return None
+    ckpt_dir = os.path.join(load_dir, tag)
+    meta_path = os.path.join(ckpt_dir, "metadata.json")
+    if not os.path.exists(meta_path):
+        raise FileNotFoundError(f"checkpoint metadata not found: {meta_path}")
+    with open(meta_path) as fh:
+        meta = json.load(fh)
+    arrays_dir = os.path.join(ckpt_dir, "arrays")
+
+    def restore(prefix: str, template: Tuple[Any, Any]) -> Any:
+        tree, shardings = template
+        flat_t = _flatten_with_keys(tree)
+        flat_s = _flatten_with_keys(shardings) if shardings is not None else {}
+        out = {}
+        for key, leaf in flat_t.items():
+            full_key = f"{prefix}{_SEP}{key}"
+            info = meta["arrays"].get(full_key)
+            if info is None:
+                raise KeyError(f"checkpoint missing array '{full_key}' "
+                               f"(topology/model mismatch?)")
+            arr = _from_numpy(np.load(os.path.join(arrays_dir, info["file"])),
+                              info["dtype"])
+            if list(arr.shape) != list(np.shape(leaf)):
+                raise ValueError(f"shape mismatch for '{full_key}': checkpoint "
+                                 f"{arr.shape} vs model {np.shape(leaf)}")
+            arr = arr.astype(leaf.dtype) if hasattr(leaf, "dtype") else arr
+            sh = flat_s.get(key)
+            out[key] = jax.device_put(arr, sh) if sh is not None else jnp.asarray(arr)
+        # rebuild original structure
+        treedef = jax.tree.structure(tree)
+        leaves = [out[k] for k in _flatten_with_keys(tree)]
+        return jax.tree.unflatten(treedef, leaves)
+
+    params = restore("params", params_template) if params_template else None
+    opt_state = restore("opt", opt_template) if opt_template else None
+    return params, opt_state, meta.get("client_state", {})
+
+
+def save_flat_weights(params: Any, path: str) -> None:
+    """Consolidated single-file export (reference save_16bit_model /
+    zero_to_fp32 output shape)."""
+    flat = {k: _to_numpy(v) for k, v in _flatten_with_keys(params).items()}
+    dtypes = {k: str(v.dtype) for k, v in _flatten_with_keys(params).items()}
+    np.savez(path, __dtypes__=json.dumps(dtypes), **flat)
+
+
+def load_flat_weights(path: str) -> Dict[str, np.ndarray]:
+    data = np.load(path, allow_pickle=False)
+    dtypes = json.loads(str(data["__dtypes__"]))
+    return {k: _from_numpy(data[k], dtypes[k]) for k in data.files
+            if k != "__dtypes__"}
+
+
+def _validate_tag(tag: str, mode: str) -> None:
+    """Reference _checkpoint_tag_validation (engine.py:2775): in multi-process
+    runs every process must use the same tag."""
+    if jax.process_count() == 1 or mode.lower() == "ignore":
+        return
+    from jax.experimental import multihost_utils
+
+    h = np.frombuffer(tag.encode()[:8].ljust(8, b"\0"), np.int64)[0]
+    gathered = multihost_utils.process_allgather(jnp.asarray(h))
+    if not bool((np.asarray(gathered) == h).all()):
+        msg = f"checkpoint tag '{tag}' differs across processes"
+        if mode.lower() == "fail":
+            raise RuntimeError(msg)
+        logger.warning(msg)

@@ -1,0 +1,100 @@
+"""Data loading.
+
+Analog of ``deepspeed/runtime/dataloader.py`` (``DeepSpeedDataLoader``, 162 LoC,
+DistributedSampler over dp ranks) and ``RepeatingLoader`` (runtime/utils.py).
+On TPU the common case is single-process-per-host with a global mesh, so the
+loader yields **global** batches (batch dim = micro_batch * dp_world) and the
+engine shards them onto the mesh; in multi-host mode each process loads its
+``process_index`` slice of every batch (same sample order on every host — the
+contract torch's DistributedSampler provides per rank).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, Iterator, Optional, Sequence
+
+import jax
+import numpy as np
+
+
+def default_collate(samples: Sequence[Any]) -> Any:
+    """Stack a list of samples (dicts/tuples/arrays) into one batch pytree."""
+    first = samples[0]
+    if isinstance(first, dict):
+        return {k: default_collate([s[k] for s in samples]) for k in first}
+    if isinstance(first, (tuple, list)):
+        return type(first)(default_collate([s[i] for s in samples])
+                           for i in range(len(first)))
+    return np.stack([np.asarray(s) for s in samples])
+
+
+class DeepSpeedDataLoader:
+    """Shuffling, epoch-aware batch loader over a map-style dataset."""
+
+    def __init__(self, dataset: Any, batch_size: int,
+                 collate_fn: Optional[Callable] = None, shuffle: bool = True,
+                 drop_last: bool = True, seed: int = 0,
+                 num_local_io_workers: int = 0, data_sampler=None):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.collate_fn = collate_fn or default_collate
+        self.shuffle = shuffle
+        self.drop_last = drop_last
+        self.seed = seed
+        self.epoch = 0
+        self.data_sampler = data_sampler
+        self.num_processes = jax.process_count()
+        self.process_index = jax.process_index()
+        if batch_size % self.num_processes != 0:
+            raise ValueError(f"batch_size {batch_size} not divisible by "
+                             f"process count {self.num_processes}")
+        self.len = len(dataset) // batch_size if drop_last else (
+            (len(dataset) + batch_size - 1) // batch_size)
+
+    def __len__(self) -> int:
+        return self.len
+
+    def set_epoch(self, epoch: int) -> None:
+        self.epoch = epoch
+
+    def _indices(self) -> np.ndarray:
+        n = len(self.dataset)
+        if self.data_sampler is not None:
+            return np.asarray(list(self.data_sampler))
+        if self.shuffle:
+            rng = np.random.RandomState(self.seed + self.epoch)
+            return rng.permutation(n)
+        return np.arange(n)
+
+    def __iter__(self) -> Iterator[Any]:
+        idx = self._indices()
+        nb = self.len
+        per_proc = self.batch_size // self.num_processes
+        for b in range(nb):
+            batch_idx = idx[b * self.batch_size:(b + 1) * self.batch_size]
+            if len(batch_idx) < self.batch_size and self.drop_last:
+                break
+            # multi-host: this process materializes only its slice
+            lo = self.process_index * per_proc
+            my = batch_idx[lo:lo + per_proc] if self.num_processes > 1 else batch_idx
+            yield self.collate_fn([self.dataset[int(i)] for i in my])
+        self.epoch += 1
+
+
+class RepeatingLoader:
+    """Reference runtime/dataloader.py RepeatingLoader: wraps an iterator and
+    restarts it on StopIteration (infinite stream for step-driven loops)."""
+
+    def __init__(self, loader: Iterable):
+        self.loader = loader
+        self.data_iter = iter(self.loader)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        try:
+            return next(self.data_iter)
+        except StopIteration:
+            self.data_iter = iter(self.loader)
+            return next(self.data_iter)

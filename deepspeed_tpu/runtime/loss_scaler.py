@@ -1,0 +1,129 @@
+"""Static and dynamic loss scaling for fp16 training.
+
+TPU-native analog of ``deepspeed/runtime/fp16/loss_scaler.py`` (``LossScaler``,
+``DynamicLossScaler``, 265 LoC). The reference mutates Python attributes per
+step; here the scaler is a pure pytree state threaded through the jitted train
+step so scale updates happen on-device with no host sync:
+
+    state = DynamicLossScaler(...).init()
+    ...
+    scaled_loss = loss * state.scale
+    has_overflow = overflow_check(grads)          # inf/nan anywhere
+    state = scaler.update(state, has_overflow)    # pure
+
+Semantics match the reference: on overflow, scale /= 2 (respecting hysteresis
+``delayed_shift``); after ``scale_window`` consecutive overflow-free steps,
+scale *= 2; never below ``min_scale``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class LossScaleState(NamedTuple):
+    scale: jax.Array          # f32 scalar
+    good_steps: jax.Array     # i32 — consecutive non-overflow steps
+    hysteresis: jax.Array     # i32 — remaining tolerated overflows before backoff
+
+
+class LossScalerBase:
+    def init(self) -> LossScaleState:
+        raise NotImplementedError
+
+    def update(self, state: LossScaleState, has_overflow: jax.Array) -> LossScaleState:
+        raise NotImplementedError
+
+    def scale_loss(self, loss: jax.Array, state: LossScaleState) -> jax.Array:
+        return loss * state.scale
+
+    def unscale_grads(self, grads: Any, state: LossScaleState) -> Any:
+        inv = 1.0 / state.scale
+        return jax.tree.map(lambda g: (g * inv).astype(g.dtype), grads)
+
+
+class LossScaler(LossScalerBase):
+    """Static scaling (reference LossScaler): scale never changes."""
+
+    def __init__(self, scale: float = 1.0):
+        self.cur_scale = scale
+
+    def init(self) -> LossScaleState:
+        return LossScaleState(scale=jnp.float32(self.cur_scale),
+                              good_steps=jnp.int32(0), hysteresis=jnp.int32(1))
+
+    def update(self, state: LossScaleState, has_overflow: jax.Array) -> LossScaleState:
+        return state._replace(good_steps=state.good_steps + 1)
+
+
+class DynamicLossScaler(LossScalerBase):
+    """Dynamic scaling (reference DynamicLossScaler): backoff on overflow with
+    hysteresis, growth after ``scale_window`` clean steps."""
+
+    def __init__(self, init_scale: float = 2.0 ** 16, scale_factor: float = 2.0,
+                 scale_window: int = 1000, min_scale: float = 1.0,
+                 delayed_shift: int = 1, consecutive_hysteresis: bool = False):
+        self.init_scale = init_scale
+        self.scale_factor = scale_factor
+        self.scale_window = scale_window
+        self.min_scale = min_scale
+        self.delayed_shift = max(delayed_shift, 1)
+        self.consecutive_hysteresis = consecutive_hysteresis
+
+    def init(self) -> LossScaleState:
+        return LossScaleState(scale=jnp.float32(self.init_scale),
+                              good_steps=jnp.int32(0),
+                              hysteresis=jnp.int32(self.delayed_shift))
+
+    def update(self, state: LossScaleState, has_overflow: jax.Array) -> LossScaleState:
+        has_overflow = jnp.asarray(has_overflow)
+        hysteresis_spent = state.hysteresis <= 1
+        # overflow & hysteresis exhausted -> back off
+        backoff_scale = jnp.maximum(state.scale / self.scale_factor, self.min_scale)
+        # clean window completed -> grow
+        window_done = (state.good_steps + 1) % self.scale_window == 0
+        grow_scale = state.scale * self.scale_factor
+
+        new_scale = jnp.where(
+            has_overflow & hysteresis_spent, backoff_scale,
+            jnp.where(~has_overflow & window_done, grow_scale, state.scale))
+        new_good = jnp.where(has_overflow, 0, state.good_steps + 1)
+        if self.consecutive_hysteresis:
+            # only consecutive overflows consume hysteresis; a clean step resets it
+            new_hyst = jnp.where(
+                has_overflow, jnp.maximum(state.hysteresis - 1, 1),
+                jnp.int32(self.delayed_shift))
+        else:
+            new_hyst = jnp.where(has_overflow & ~hysteresis_spent,
+                                 state.hysteresis - 1, state.hysteresis)
+            new_hyst = jnp.where(has_overflow & hysteresis_spent,
+                                 jnp.int32(self.delayed_shift), new_hyst)
+        return LossScaleState(scale=new_scale, good_steps=new_good, hysteresis=new_hyst)
+
+
+def has_overflow(grads: Any) -> jax.Array:
+    """True if any grad entry is inf/nan — the reference's CheckOverflow
+    (runtime/utils.py:176) as a pure reduction; under ZeRO the caller psums the
+    flag over the data axis (reference allreduces a byte tensor)."""
+    leaves = jax.tree.leaves(grads)
+    if not leaves:
+        return jnp.asarray(False)
+    flags = [~jnp.isfinite(g).all() for g in leaves]
+    return jnp.stack(flags).any()
+
+
+def create_loss_scaler(fp16_enabled: bool, dynamic: bool = True,
+                       static_scale: float = 1.0, initial_scale_power: int = 16,
+                       scale_window: int = 1000, min_scale: float = 1.0,
+                       hysteresis: int = 2) -> LossScalerBase:
+    """Build from the fp16 config section (reference fp16 config keys)."""
+    if not fp16_enabled:
+        return LossScaler(1.0)
+    if dynamic:
+        return DynamicLossScaler(init_scale=2.0 ** initial_scale_power,
+                                 scale_window=scale_window, min_scale=min_scale,
+                                 delayed_shift=hysteresis)
+    return LossScaler(static_scale)

@@ -1,0 +1,229 @@
+"""Learning-rate schedules.
+
+TPU-native analog of ``deepspeed/runtime/lr_schedules.py`` (763 LoC): the same
+four schedule families (LRRangeTest, OneCycle, WarmupLR, WarmupDecayLR) with the
+same parameter names and shapes, implemented as pure ``step -> lr`` callables so
+they can be traced into a jitted train step (the reference mutates
+``optimizer.param_groups``; here the lr is just an input to the optimizer
+transform).
+
+Each class also keeps the reference's stateful interface (``step()``,
+``get_lr()``, ``state_dict()``/``load_state_dict()``) for API parity.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Dict, List, Optional, Union
+
+import jax.numpy as jnp
+
+LR_RANGE_TEST = "LRRangeTest"
+ONE_CYCLE = "OneCycle"
+WARMUP_LR = "WarmupLR"
+WARMUP_DECAY_LR = "WarmupDecayLR"
+WARMUP_COSINE_LR = "WarmupCosineLR"
+
+VALID_LR_SCHEDULES = [LR_RANGE_TEST, ONE_CYCLE, WARMUP_LR, WARMUP_DECAY_LR, WARMUP_COSINE_LR]
+
+
+class _Schedule:
+    """Base: stateful step counter over a pure ``lr_at(step)`` function."""
+
+    def __init__(self, last_batch_iteration: int = -1):
+        self.last_batch_iteration = last_batch_iteration
+
+    # pure — traceable inside jit
+    def lr_at(self, step) -> Any:
+        raise NotImplementedError
+
+    # stateful reference-parity surface
+    def step(self, last_batch_iteration: Optional[int] = None) -> None:
+        if last_batch_iteration is None:
+            last_batch_iteration = self.last_batch_iteration + 1
+        self.last_batch_iteration = last_batch_iteration
+
+    def get_lr(self) -> List[float]:
+        return [float(self.lr_at(max(self.last_batch_iteration, 0)))]
+
+    def get_last_lr(self) -> List[float]:
+        return self.get_lr()
+
+    def state_dict(self) -> Dict[str, Any]:
+        return {"last_batch_iteration": self.last_batch_iteration}
+
+    def load_state_dict(self, sd: Dict[str, Any]) -> None:
+        self.last_batch_iteration = sd["last_batch_iteration"]
+
+    def __call__(self, step):
+        return self.lr_at(step)
+
+
+class LRRangeTest(_Schedule):
+    """Reference lr_schedules.py LRRangeTest: linearly (or staircase) growing lr
+    for range tests (Smith 2017)."""
+
+    def __init__(self, lr_range_test_min_lr: float = 1e-3,
+                 lr_range_test_step_size: int = 2000,
+                 lr_range_test_step_rate: float = 1.0,
+                 lr_range_test_staircase: bool = False,
+                 last_batch_iteration: int = -1, **_ignored):
+        super().__init__(last_batch_iteration)
+        self.min_lr = lr_range_test_min_lr
+        self.step_size = lr_range_test_step_size
+        self.step_rate = lr_range_test_step_rate
+        self.staircase = lr_range_test_staircase
+
+    def lr_at(self, step):
+        if self.staircase:
+            interval = jnp.floor(step / self.step_size)
+        else:
+            interval = step / self.step_size
+        return self.min_lr * (1.0 + interval * self.step_rate)
+
+
+class OneCycle(_Schedule):
+    """Reference lr_schedules.py OneCycle: two-phase cycle then decay."""
+
+    def __init__(self, cycle_min_lr: float, cycle_max_lr: float,
+                 decay_lr_rate: float = 0.0, cycle_first_step_size: int = 2000,
+                 cycle_second_step_size: Optional[int] = None,
+                 cycle_first_stair_count: int = 0, cycle_second_stair_count: Optional[int] = None,
+                 decay_step_size: int = 0, cycle_momentum: bool = True,
+                 cycle_min_mom: float = 0.8, cycle_max_mom: float = 0.9,
+                 decay_mom_rate: float = 0.0, last_batch_iteration: int = -1, **_ignored):
+        super().__init__(last_batch_iteration)
+        self.cycle_min_lr = cycle_min_lr
+        self.cycle_max_lr = cycle_max_lr
+        self.decay_lr_rate = decay_lr_rate
+        self.first_size = cycle_first_step_size
+        self.second_size = cycle_second_step_size if cycle_second_step_size is not None else cycle_first_step_size
+        self.decay_step_size = max(decay_step_size, 1)
+        self.cycle_momentum = cycle_momentum
+        self.cycle_min_mom = cycle_min_mom
+        self.cycle_max_mom = cycle_max_mom
+        self.decay_mom_rate = decay_mom_rate
+
+    def lr_at(self, step):
+        step = jnp.asarray(step, jnp.float32)
+        total = self.first_size + self.second_size
+        up = jnp.minimum(step, self.first_size) / self.first_size
+        down = jnp.clip((step - self.first_size) / self.second_size, 0.0, 1.0)
+        in_cycle_lr = self.cycle_min_lr + (self.cycle_max_lr - self.cycle_min_lr) * (up - down)
+        decay_steps = jnp.maximum(step - total, 0.0)
+        decayed = self.cycle_min_lr / (1.0 + self.decay_lr_rate * decay_steps / self.decay_step_size)
+        return jnp.where(step <= total, in_cycle_lr, decayed)
+
+    def mom_at(self, step):
+        step = jnp.asarray(step, jnp.float32)
+        total = self.first_size + self.second_size
+        up = jnp.minimum(step, self.first_size) / self.first_size
+        down = jnp.clip((step - self.first_size) / self.second_size, 0.0, 1.0)
+        in_cycle = self.cycle_max_mom - (self.cycle_max_mom - self.cycle_min_mom) * (up - down)
+        decay_steps = jnp.maximum(step - total, 0.0)
+        decayed = self.cycle_max_mom * (1.0 + self.decay_mom_rate * decay_steps / self.decay_step_size)
+        return jnp.where(step <= total, in_cycle, decayed)
+
+
+class WarmupLR(_Schedule):
+    """Reference lr_schedules.py WarmupLR: warmup_min_lr → warmup_max_lr over
+    warmup_num_steps (log or linear), then constant."""
+
+    def __init__(self, warmup_min_lr: float = 0.0, warmup_max_lr: float = 0.001,
+                 warmup_num_steps: int = 1000, warmup_type: str = "log",
+                 last_batch_iteration: int = -1, **_ignored):
+        super().__init__(last_batch_iteration)
+        self.warmup_min_lr = warmup_min_lr
+        self.warmup_max_lr = warmup_max_lr
+        self.warmup_num_steps = max(warmup_num_steps, 2)
+        if warmup_type not in ("log", "linear"):
+            raise ValueError(f"warmup_type must be 'log' or 'linear', got {warmup_type}")
+        self.warmup_type = warmup_type
+        self.inverse_log_warm_up = 1.0 / math.log(self.warmup_num_steps)
+
+    def _gamma(self, step):
+        step = jnp.asarray(step, jnp.float32)
+        if self.warmup_type == "log":
+            g = self.inverse_log_warm_up * jnp.log(jnp.maximum(step, 1.0) + 1.0)
+        else:
+            g = step / self.warmup_num_steps
+        return jnp.minimum(g, 1.0)
+
+    def lr_at(self, step):
+        return self.warmup_min_lr + (self.warmup_max_lr - self.warmup_min_lr) * self._gamma(step)
+
+
+class WarmupDecayLR(WarmupLR):
+    """Reference lr_schedules.py WarmupDecayLR: WarmupLR then linear decay to 0
+    at total_num_steps."""
+
+    def __init__(self, total_num_steps: int, warmup_min_lr: float = 0.0,
+                 warmup_max_lr: float = 0.001, warmup_num_steps: int = 1000,
+                 warmup_type: str = "log", last_batch_iteration: int = -1, **_ignored):
+        super().__init__(warmup_min_lr, warmup_max_lr, warmup_num_steps, warmup_type,
+                         last_batch_iteration)
+        self.total_num_steps = total_num_steps
+
+    def lr_at(self, step):
+        step = jnp.asarray(step, jnp.float32)
+        warm = super().lr_at(step)
+        decay = jnp.clip(
+            (self.total_num_steps - step) / jnp.maximum(self.total_num_steps - self.warmup_num_steps, 1.0),
+            0.0, 1.0)
+        return jnp.where(step < self.warmup_num_steps, warm, self.warmup_max_lr * decay)
+
+
+class WarmupCosineLR(WarmupLR):
+    """Linear warmup then cosine decay to cos_min_ratio * warmup_max_lr — the
+    schedule every modern LLM pretrain uses (added to DeepSpeed post-0.9.2;
+    included here as a first-class citizen)."""
+
+    def __init__(self, total_num_steps: int, warmup_min_lr: float = 0.0,
+                 warmup_max_lr: float = 0.001, warmup_num_steps: int = 1000,
+                 cos_min_ratio: float = 0.0001, warmup_type: str = "linear",
+                 last_batch_iteration: int = -1, **_ignored):
+        super().__init__(warmup_min_lr, warmup_max_lr, warmup_num_steps, warmup_type,
+                         last_batch_iteration)
+        self.total_num_steps = total_num_steps
+        self.cos_min_ratio = cos_min_ratio
+
+    def lr_at(self, step):
+        step = jnp.asarray(step, jnp.float32)
+        warm = WarmupLR.lr_at(self, step)
+        progress = jnp.clip(
+            (step - self.warmup_num_steps) / jnp.maximum(self.total_num_steps - self.warmup_num_steps, 1.0),
+            0.0, 1.0)
+        cos = 0.5 * (1.0 + jnp.cos(jnp.pi * progress))
+        min_lr = self.cos_min_ratio * self.warmup_max_lr
+        return jnp.where(step < self.warmup_num_steps, warm,
+                         min_lr + (self.warmup_max_lr - min_lr) * cos)
+
+
+_SCHEDULES = {
+    LR_RANGE_TEST: LRRangeTest,
+    ONE_CYCLE: OneCycle,
+    WARMUP_LR: WarmupLR,
+    WARMUP_DECAY_LR: WarmupDecayLR,
+    WARMUP_COSINE_LR: WarmupCosineLR,
+}
+
+
+def build_lr_schedule(type_name: str, params: Dict[str, Any]) -> _Schedule:
+    """Build from the config ``scheduler`` section (reference config surface)."""
+    if type_name not in _SCHEDULES:
+        raise ValueError(f"unknown scheduler '{type_name}' (valid: {VALID_LR_SCHEDULES})")
+    return _SCHEDULES[type_name](**params)
+
+
+def constant_schedule(lr: float) -> Callable:
+    return lambda step: jnp.asarray(lr, jnp.float32)
+
+
+ScheduleLike = Union[_Schedule, Callable, float]
+
+
+def as_schedule_fn(schedule: ScheduleLike) -> Callable:
+    """Normalize a schedule/callable/float to a ``step -> lr`` function."""
+    if isinstance(schedule, (int, float)):
+        return constant_schedule(float(schedule))
+    return schedule

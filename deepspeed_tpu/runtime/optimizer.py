@@ -1,0 +1,180 @@
+"""Optimizer construction and the mixed-precision update step.
+
+TPU-native analog of the reference's optimizer stack:
+  * ``_configure_basic_optimizer`` (runtime/engine.py:1187) — config type →
+    optimizer instance (Adam/AdamW/FusedAdam/Lamb/Adagrad/SGD/...),
+  * ``FP16_Optimizer``/``BF16_Optimizer`` (runtime/fp16/fused_optimizer.py:22,
+    runtime/bf16_optimizer.py:30) — fp32 master weights + (dynamic) loss
+    scaling + overflow skip + global-norm clipping.
+
+Design: params live in the compute dtype (bf16/fp16) so ZeRO-3 allgathers move
+half the bytes; the fp32 master copy lives inside ``OptimizerState`` and is
+sharded with the rest of the optimizer state (ZeRO-1 semantics fall out of the
+state sharding spec). The whole update is a pure function traced into the
+jitted train step — "fused Adam" on TPU is simply this update jitted, which XLA
+fuses into a handful of kernels (the reference needs multi_tensor_adam.cu for
+the same effect; a Pallas variant lives in ops/fused_adam.py for the bench).
+
+No torch; the inner math is optax gradient transforms, which are themselves
+pure-jnp.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ..config.config import Config, OptimizerConfig
+from ..utils.logging import logger
+from .lr_schedules import as_schedule_fn
+
+
+class OptimizerState(NamedTuple):
+    inner: Any                    # optax state (moments etc.), fp32
+    master: Any                   # fp32 master params (None leaves if params fp32)
+    count: jax.Array              # i64/i32 step count
+
+
+class StepStats(NamedTuple):
+    grad_norm: jax.Array
+    skipped: jax.Array            # bool — update skipped (fp16 overflow)
+    lr: jax.Array
+
+
+def _global_norm(tree: Any) -> jax.Array:
+    """Global L2 norm over a pytree (reference runtime/utils.py:849
+    get_global_norm_of_tensors). Computed in fp32."""
+    leaves = [jnp.sum(jnp.square(g.astype(jnp.float32))) for g in jax.tree.leaves(tree)]
+    if not leaves:
+        return jnp.float32(0.0)
+    return jnp.sqrt(jnp.sum(jnp.stack(leaves)))
+
+
+def clip_by_global_norm(grads: Any, max_norm: float,
+                        total_norm: Optional[jax.Array] = None) -> Tuple[Any, jax.Array]:
+    """Reference clip_grad_norm_ (runtime/utils.py:310): scale by
+    max_norm / (norm + 1e-6) when norm exceeds max_norm."""
+    if total_norm is None:
+        total_norm = _global_norm(grads)
+    clip_coef = jnp.minimum(max_norm / (total_norm + 1e-6), 1.0)
+    clipped = jax.tree.map(lambda g: (g.astype(jnp.float32) * clip_coef).astype(g.dtype), grads)
+    return clipped, total_norm
+
+
+def build_optax_transform(opt_config: OptimizerConfig,
+                          lr_schedule: Optional[Callable] = None) -> optax.GradientTransformation:
+    """Config ``optimizer`` section → optax transform. Parameter names follow
+    the reference's torch-style params dict (lr, betas, eps, weight_decay...)."""
+    params = dict(opt_config.params)
+    name = opt_config.type.lower()
+    lr = lr_schedule if lr_schedule is not None else params.get("lr", 1e-3)
+    lr = as_schedule_fn(lr)
+    betas = params.get("betas", (0.9, 0.999))
+    eps = params.get("eps", 1e-8)
+    wd = params.get("weight_decay", 0.0)
+
+    if name in ("adam", "fusedadam", "cpuadam", "onebitadam", "zerooneadam"):
+        # reference FusedAdam has adam_w_mode=True by default (ops/adam/fused_adam.py:18)
+        adam_w_mode = params.get("adam_w_mode", name != "adam")
+        if wd and adam_w_mode:
+            return optax.adamw(lr, b1=betas[0], b2=betas[1], eps=eps, weight_decay=wd)
+        tx = optax.adam(lr, b1=betas[0], b2=betas[1], eps=eps)
+        if wd:
+            tx = optax.chain(optax.add_decayed_weights(wd), tx)
+        return tx
+    if name == "adamw":
+        return optax.adamw(lr, b1=betas[0], b2=betas[1], eps=eps, weight_decay=wd)
+    if name in ("lamb", "onebitlamb"):
+        return optax.lamb(lr, b1=betas[0], b2=betas[1], eps=eps, weight_decay=wd)
+    if name == "adagrad":
+        # initial accumulator 0 matches torch/DeepSpeedCPUAdagrad (csrc/adagrad)
+        return optax.adagrad(lr, initial_accumulator_value=params.get(
+            "initial_accumulator_value", 0.0), eps=params.get("eps", 1e-10))
+    if name == "sgd":
+        return optax.sgd(lr, momentum=params.get("momentum", 0.0),
+                         nesterov=params.get("nesterov", False))
+    if name == "lion":
+        return optax.lion(lr, b1=params.get("betas", (0.9, 0.99))[0],
+                          b2=params.get("betas", (0.9, 0.99))[1], weight_decay=wd)
+    raise ValueError(f"unknown optimizer type '{opt_config.type}'")
+
+
+class MixedPrecisionOptimizer:
+    """The fp16/bf16-aware optimizer wrapper. Pure-functional: ``init`` builds
+    state, ``apply`` is traced into the train step."""
+
+    def __init__(self, tx: optax.GradientTransformation,
+                 lr_schedule: Optional[Callable] = None,
+                 grad_clip: float = 0.0,
+                 keep_master_weights: bool = True):
+        self.tx = tx
+        self.lr_schedule = as_schedule_fn(lr_schedule if lr_schedule is not None else 0.0)
+        self.grad_clip = grad_clip
+        self.keep_master_weights = keep_master_weights
+
+    def init(self, params: Any) -> OptimizerState:
+        needs_master = self.keep_master_weights and any(
+            p.dtype in (jnp.bfloat16, jnp.float16) for p in jax.tree.leaves(params))
+        master = (jax.tree.map(lambda p: p.astype(jnp.float32), params)
+                  if needs_master else None)
+        inner = self.tx.init(master if master is not None else params)
+        return OptimizerState(inner=inner, master=master, count=jnp.int32(0))
+
+    def apply(self, params: Any, grads: Any, state: OptimizerState,
+              skip_update: Optional[jax.Array] = None) -> Tuple[Any, OptimizerState, StepStats]:
+        """One optimizer step. ``grads`` are the (already averaged) raw grads in
+        any dtype; math runs in fp32 against the master copy. ``skip_update``
+        True (fp16 overflow) keeps params+state unchanged but still counts the
+        attempt (reference FP16_Optimizer.step overflow path)."""
+        grads32 = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
+        if self.grad_clip and self.grad_clip > 0:
+            grads32, grad_norm = clip_by_global_norm(grads32, self.grad_clip)
+        else:
+            grad_norm = _global_norm(grads32)
+
+        reference_params = state.master if state.master is not None else params
+        updates, new_inner = self.tx.update(grads32, state.inner, reference_params)
+        new_reference = optax.apply_updates(reference_params, updates)
+
+        if state.master is not None:
+            new_master = new_reference
+            new_params = jax.tree.map(lambda m, p: m.astype(p.dtype), new_master, params)
+        else:
+            new_master = None
+            new_params = new_reference
+
+        if skip_update is None:
+            skip_update = jnp.asarray(False)
+
+        def select(old, new):
+            if old is None:
+                return None
+            return jax.tree.map(lambda a, b: jnp.where(skip_update, a, b), old, new)
+
+        final_params = select(params, new_params)
+        final_state = OptimizerState(
+            inner=select(state.inner, new_inner),
+            master=select(state.master, new_master),
+            count=state.count + 1)
+        lr_val = jnp.asarray(self.lr_schedule(state.count), jnp.float32)
+        return final_params, final_state, StepStats(
+            grad_norm=grad_norm, skipped=skip_update, lr=lr_val)
+
+
+def build_optimizer(config: Config, lr_schedule: Optional[Callable] = None) -> MixedPrecisionOptimizer:
+    """Engine entry: config → MixedPrecisionOptimizer (reference
+    _configure_optimizer runtime/engine.py:1137)."""
+    from .lr_schedules import build_lr_schedule
+
+    if lr_schedule is None and config.scheduler is not None:
+        lr_schedule = build_lr_schedule(config.scheduler.type, config.scheduler.params)
+    if lr_schedule is None:
+        lr_schedule = float(config.optimizer.params.get("lr", 1e-3))
+    tx = build_optax_transform(config.optimizer, lr_schedule)
+    logger.info(f"Built optimizer '{config.optimizer.type}' "
+                f"(grad_clip={config.gradient_clipping})")
+    return MixedPrecisionOptimizer(
+        tx, lr_schedule=lr_schedule, grad_clip=config.gradient_clipping)

@@ -20,7 +20,7 @@ def _create_logger(name: str = "deepspeed_tpu", level: int = logging.INFO) -> lo
     lg.setLevel(level)
     lg.propagate = False
     if not lg.handlers:
-        handler = logging.StreamHandler(stream=sys.stdout)
+        handler = logging.StreamHandler(stream=sys.stderr)
         handler.setFormatter(logging.Formatter(LOG_FORMAT, datefmt="%Y-%m-%d %H:%M:%S"))
         lg.addHandler(handler)
     env_level = os.environ.get("DSTPU_LOG_LEVEL")

@@ -1,0 +1,211 @@
+"""Engine correctness — analog of reference tests/unit/runtime/zero/test_zero.py
+(ZeRO vs DDP equivalence), test_ds_initialize.py, and checkpoint tests.
+
+The gold standard: every ZeRO stage must produce the SAME training trajectory
+as plain single-replica training (the sharding plan changes where tensors live,
+never the math)."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models import create_model, random_token_batches, simple_model
+from deepspeed_tpu.models.simple import random_batches
+
+
+def _make_engine(zero_stage=0, dtype_cfg=None, gas=1, model=None, clip=0.0,
+                 extra=None):
+    cfg = {"train_micro_batch_size_per_gpu": 2,
+           "gradient_accumulation_steps": gas,
+           "steps_per_print": 100,
+           "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+           "zero_optimization": {"stage": zero_stage},
+           "gradient_clipping": clip}
+    if dtype_cfg:
+        cfg.update(dtype_cfg)
+    if extra:
+        cfg.update(extra)
+    model = model or simple_model(hidden_dim=10)
+    engine, *_ = deepspeed_tpu.initialize(model=model, config=cfg)
+    return engine
+
+
+def _fixed_batches(engine, n=5):
+    rng = jax.random.PRNGKey(42)
+    return random_batches(rng, n, engine.train_batch_size() //
+                          engine.gradient_accumulation_steps())
+
+
+def _trajectory(zero_stage, gas=1, clip=0.0, steps=5):
+    engine = _make_engine(zero_stage=zero_stage, gas=gas, clip=clip)
+    batches = _fixed_batches(engine, steps * gas)
+    losses = []
+    it = iter(batches)
+    for _ in range(steps):
+        losses.append(float(engine.train_batch(data_iter=it)))
+    final = jax.tree.map(lambda p: np.asarray(jax.device_get(p)), engine.params)
+    return losses, final
+
+
+@pytest.mark.parametrize("stage", [1, 2, 3])
+def test_zero_stage_matches_stage0(stage):
+    l0, p0 = _trajectory(0)
+    ls, ps = _trajectory(stage)
+    np.testing.assert_allclose(l0, ls, rtol=1e-5)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(a, b, atol=1e-5), p0, ps)
+
+
+def test_gradient_accumulation_equivalence():
+    """gas=2 with micro_batch b must equal gas=1 with batch 2b (same samples) —
+    the reference's GAS contract."""
+    l1, p1 = _trajectory(0, gas=1, steps=4)
+    # same data split into twice as many microbatches
+    engine = _make_engine(zero_stage=0, gas=2)
+    batches = _fixed_batches(engine, 100)
+    # gas=1 trajectory consumed batches of size train_batch; rebuild identical
+    # global batches: interleave halves
+    eng1 = _make_engine(zero_stage=0, gas=1)
+    big = _fixed_batches(eng1, 4)
+    losses2 = []
+    for b in big:
+        half = b["x"].shape[0] // 2
+        micro = [{k: v[:half] for k, v in b.items()},
+                 {k: v[half:] for k, v in b.items()}]
+        losses2.append(float(engine.train_batch(data_iter=iter(micro))))
+    np.testing.assert_allclose(l1, losses2, rtol=1e-5)
+
+
+def test_gradient_clipping_changes_updates():
+    l_unclipped, p_unclipped = _trajectory(0, clip=0.0)
+    l_clipped, p_clipped = _trajectory(0, clip=1e-3)
+    diffs = jax.tree.map(lambda a, b: float(np.abs(a - b).max()),
+                         p_unclipped, p_clipped)
+    assert max(jax.tree.leaves(diffs)) > 1e-6
+
+
+def test_bf16_training_runs():
+    engine = _make_engine(zero_stage=2, dtype_cfg={"bf16": {"enabled": True}})
+    assert engine.compute_dtype == jnp.bfloat16
+    assert engine.opt_state.master is not None
+    batches = _fixed_batches(engine, 6)
+    it = iter(batches)
+    losses = [float(engine.train_batch(data_iter=it)) for _ in range(6)]
+    assert all(np.isfinite(losses))
+
+
+def test_fp16_overflow_skips_step():
+    engine = _make_engine(zero_stage=0, dtype_cfg={"fp16": {"enabled": True,
+                                                            "initial_scale_power": 4,
+                                                            "hysteresis": 1}})
+    params_before = jax.tree.map(np.asarray, jax.device_get(engine.params))
+    # poison batch -> inf loss -> overflow -> skipped update, halved scale
+    gb = engine.train_batch_size()
+    bad = {"x": jnp.full((gb, 10), 1e30), "y": jnp.zeros((gb, 1))}
+    scale0 = engine.cur_scale
+    engine.train_batch(batch=jax.tree.map(lambda x: x[None], bad))
+    assert engine.skipped_steps == 1
+    assert engine.cur_scale == scale0 / 2
+    params_after = jax.tree.map(np.asarray, jax.device_get(engine.params))
+    jax.tree.map(lambda a, b: np.testing.assert_array_equal(a, b),
+                 params_before, params_after)
+
+
+def test_forward_backward_step_api_matches_train_batch():
+    """The reference three-call protocol must produce the same params as the
+    fused train_batch path."""
+    e1 = _make_engine(zero_stage=0, gas=2)
+    e2 = _make_engine(zero_stage=0, gas=2)
+    batches = _fixed_batches(e1, 2)  # 2 microbatches = 1 global step
+    e1.train_batch(data_iter=iter(batches))
+
+    for mb in batches:
+        loss = e2.forward(mb)
+        e2.backward(loss)
+    assert e2.is_gradient_accumulation_boundary()
+    e2.step()
+    assert e2.global_steps == 1
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        np.asarray(a), np.asarray(b), atol=1e-6),
+        jax.device_get(e1.params), jax.device_get(e2.params))
+
+
+def test_transformer_zero3_trains():
+    model = create_model("tiny")
+    engine = _make_engine(zero_stage=3, model=model,
+                          dtype_cfg={"bf16": {"enabled": True}})
+    batches = random_token_batches(jax.random.PRNGKey(0), 8,
+                                   engine.train_batch_size(), 16,
+                                   model.config.vocab_size)
+    # train on one repeated batch: loss must fall
+    fixed = batches[0]
+    losses = [float(engine.train_batch(batch=jax.tree.map(lambda x: x[None], fixed)))
+              for _ in range(8)]
+    assert losses[-1] < losses[0]
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    engine = _make_engine(zero_stage=2)
+    batches = _fixed_batches(engine, 4)
+    it = iter(batches)
+    for _ in range(2):
+        engine.train_batch(data_iter=it)
+    engine.save_checkpoint(str(tmp_path), tag="step2")
+    assert (tmp_path / "latest").read_text() == "step2"
+
+    loss_next = float(engine.train_batch(data_iter=it))
+    params_after3 = jax.tree.map(np.asarray, jax.device_get(engine.params))
+
+    # fresh engine restores and replays the same step
+    e2 = _make_engine(zero_stage=2)
+    e2.load_checkpoint(str(tmp_path))
+    assert e2.global_steps == 2
+    it2 = iter(batches)
+    next(it2), next(it2)  # skip consumed
+    loss_next2 = float(e2.train_batch(data_iter=it2))
+    assert loss_next2 == pytest.approx(loss_next, rel=1e-5)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(a, np.asarray(b), atol=1e-6),
+                 params_after3, jax.device_get(e2.params))
+
+
+def test_checkpoint_reshard_across_zero_stages(tmp_path):
+    """Universal-checkpoint property: save under ZeRO-3, load under ZeRO-0."""
+    e3 = _make_engine(zero_stage=3)
+    batches = _fixed_batches(e3, 2)
+    e3.train_batch(data_iter=iter(batches))
+    e3.save_checkpoint(str(tmp_path), tag="x")
+    e0 = _make_engine(zero_stage=0)
+    e0.load_checkpoint(str(tmp_path))
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        np.asarray(jax.device_get(a)), np.asarray(jax.device_get(b)), atol=1e-7),
+        e3.params, e0.params)
+
+
+def test_save_16bit_model(tmp_path):
+    from deepspeed_tpu.runtime.checkpoint import load_flat_weights
+
+    engine = _make_engine(zero_stage=3, dtype_cfg={"bf16": {"enabled": True}})
+    path = engine.save_16bit_model(str(tmp_path))
+    flat = load_flat_weights(path)
+    assert len(flat) == len(jax.tree.leaves(engine.params))
+    key = [k for k in flat if "head" in k and "w" in k][0]
+    assert flat[key].dtype == jnp.bfloat16
+
+
+def test_dataloader():
+    from deepspeed_tpu.runtime.dataloader import DeepSpeedDataLoader
+
+    data = [{"x": np.full((3,), i, np.float32)} for i in range(10)]
+    dl = DeepSpeedDataLoader(data, batch_size=4, shuffle=False)
+    batches = list(dl)
+    assert len(batches) == 2
+    assert batches[0]["x"].shape == (4, 3)
+    np.testing.assert_array_equal(batches[0]["x"][:, 0], [0, 1, 2, 3])
+    # shuffled epochs differ
+    dl2 = DeepSpeedDataLoader(data, batch_size=4, shuffle=True, seed=1)
+    e1 = [b["x"][:, 0].tolist() for b in dl2]
+    e2 = [b["x"][:, 0].tolist() for b in dl2]
+    assert e1 != e2

@@ -1,0 +1,76 @@
+"""Loss-scaler tests — analog of reference
+tests/unit/runtime/half_precision/test_dynamic_loss_scale.py."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime.loss_scaler import (DynamicLossScaler, LossScaler,
+                                               create_loss_scaler, has_overflow)
+
+
+def test_static_scaler():
+    s = LossScaler(128.0)
+    st = s.init()
+    assert float(st.scale) == 128.0
+    st = s.update(st, jnp.asarray(True))
+    assert float(st.scale) == 128.0  # static never changes
+
+
+def test_overflow_detection():
+    assert not bool(has_overflow({"a": jnp.ones(3)}))
+    assert bool(has_overflow({"a": jnp.array([1.0, jnp.inf])}))
+    assert bool(has_overflow({"a": jnp.ones(2), "b": jnp.array([jnp.nan])}))
+
+
+def test_dynamic_backoff_and_growth():
+    s = DynamicLossScaler(init_scale=2.0 ** 8, scale_factor=2.0, scale_window=3,
+                          min_scale=1.0, delayed_shift=1)
+    st = s.init()
+    # overflow → halve
+    st = s.update(st, jnp.asarray(True))
+    assert float(st.scale) == 2.0 ** 7
+    assert int(st.good_steps) == 0
+    # 3 clean steps → double
+    for _ in range(3):
+        st = s.update(st, jnp.asarray(False))
+    assert float(st.scale) == 2.0 ** 8
+
+
+def test_dynamic_min_scale():
+    s = DynamicLossScaler(init_scale=2.0, scale_factor=2.0, min_scale=1.0)
+    st = s.init()
+    for _ in range(5):
+        st = s.update(st, jnp.asarray(True))
+    assert float(st.scale) == 1.0
+
+
+def test_hysteresis():
+    """delayed_shift=2: first overflow consumes hysteresis, second backs off
+    (reference DynamicLossScaler delayed_shift semantics)."""
+    s = DynamicLossScaler(init_scale=2.0 ** 8, delayed_shift=2)
+    st = s.init()
+    st = s.update(st, jnp.asarray(True))
+    assert float(st.scale) == 2.0 ** 8  # tolerated
+    st = s.update(st, jnp.asarray(True))
+    assert float(st.scale) == 2.0 ** 7  # now backs off
+
+
+def test_scale_unscale_roundtrip():
+    s = DynamicLossScaler(init_scale=1024.0)
+    st = s.init()
+    loss = jnp.asarray(2.0)
+    assert float(s.scale_loss(loss, st)) == 2048.0
+    grads = {"w": jnp.full((3,), 1024.0)}
+    un = s.unscale_grads(grads, st)
+    np.testing.assert_allclose(np.asarray(un["w"]), 1.0)
+
+
+def test_create_from_config():
+    s = create_loss_scaler(fp16_enabled=False)
+    assert isinstance(s, LossScaler) and s.cur_scale == 1.0
+    s = create_loss_scaler(fp16_enabled=True, dynamic=True, initial_scale_power=10)
+    assert isinstance(s, DynamicLossScaler)
+    assert s.init_scale == 1024.0
+    s = create_loss_scaler(fp16_enabled=True, dynamic=False, static_scale=64.0)
+    assert float(s.init().scale) == 64.0

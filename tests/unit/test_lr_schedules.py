@@ -1,0 +1,83 @@
+"""LR-schedule tests — analog of reference tests/unit/runtime/test_lr_schedulers.py."""
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime.lr_schedules import (LRRangeTest, OneCycle, WarmupLR,
+                                                WarmupCosineLR, WarmupDecayLR,
+                                                build_lr_schedule)
+
+
+def test_warmup_lr_linear():
+    s = WarmupLR(warmup_min_lr=0.0, warmup_max_lr=0.1, warmup_num_steps=10,
+                 warmup_type="linear")
+    assert float(s.lr_at(0)) == pytest.approx(0.0)
+    assert float(s.lr_at(5)) == pytest.approx(0.05)
+    assert float(s.lr_at(10)) == pytest.approx(0.1)
+    assert float(s.lr_at(100)) == pytest.approx(0.1)  # constant after warmup
+
+
+def test_warmup_lr_log():
+    s = WarmupLR(warmup_min_lr=0.0, warmup_max_lr=0.1, warmup_num_steps=100,
+                 warmup_type="log")
+    vals = [float(s.lr_at(t)) for t in [0, 10, 50, 99, 200]]
+    assert vals == sorted(vals)
+    assert vals[-1] == pytest.approx(0.1, rel=1e-2)
+
+
+def test_warmup_decay():
+    s = WarmupDecayLR(total_num_steps=100, warmup_min_lr=0.0, warmup_max_lr=0.1,
+                      warmup_num_steps=10, warmup_type="linear")
+    assert float(s.lr_at(10)) == pytest.approx(0.1)
+    assert float(s.lr_at(55)) == pytest.approx(0.05)
+    assert float(s.lr_at(100)) == pytest.approx(0.0)
+    assert float(s.lr_at(150)) == pytest.approx(0.0)  # clamped
+
+
+def test_warmup_cosine():
+    s = WarmupCosineLR(total_num_steps=100, warmup_max_lr=0.1, warmup_num_steps=10,
+                       cos_min_ratio=0.1)
+    assert float(s.lr_at(10)) == pytest.approx(0.1, rel=1e-5)
+    assert float(s.lr_at(100)) == pytest.approx(0.01, rel=1e-4)
+    mid = float(s.lr_at(55))
+    assert 0.01 < mid < 0.1
+
+
+def test_one_cycle():
+    s = OneCycle(cycle_min_lr=0.01, cycle_max_lr=0.1, cycle_first_step_size=10,
+                 decay_lr_rate=0.5, decay_step_size=10)
+    assert float(s.lr_at(0)) == pytest.approx(0.01)
+    assert float(s.lr_at(10)) == pytest.approx(0.1)
+    assert float(s.lr_at(20)) == pytest.approx(0.01)
+    assert float(s.lr_at(40)) < 0.01  # decay phase
+
+
+def test_lr_range_test():
+    s = LRRangeTest(lr_range_test_min_lr=0.001, lr_range_test_step_size=10,
+                    lr_range_test_step_rate=1.0)
+    assert float(s.lr_at(0)) == pytest.approx(0.001)
+    assert float(s.lr_at(10)) == pytest.approx(0.002)
+    st = LRRangeTest(lr_range_test_min_lr=0.001, lr_range_test_step_size=10,
+                     lr_range_test_step_rate=1.0, lr_range_test_staircase=True)
+    assert float(st.lr_at(9)) == pytest.approx(0.001)
+    assert float(st.lr_at(10)) == pytest.approx(0.002)
+
+
+def test_stateful_interface():
+    s = WarmupLR(warmup_max_lr=0.1, warmup_num_steps=10, warmup_type="linear")
+    s.step()
+    s.step()
+    assert s.last_batch_iteration == 1
+    sd = s.state_dict()
+    s2 = WarmupLR(warmup_max_lr=0.1, warmup_num_steps=10, warmup_type="linear")
+    s2.load_state_dict(sd)
+    assert s2.get_lr() == s.get_lr()
+
+
+def test_build_by_name():
+    s = build_lr_schedule("WarmupDecayLR", {"total_num_steps": 100,
+                                            "warmup_num_steps": 10,
+                                            "warmup_max_lr": 0.01})
+    assert float(s.lr_at(10)) == pytest.approx(0.01)
+    with pytest.raises(ValueError):
+        build_lr_schedule("Nope", {})
