@@ -41,8 +41,8 @@ def main() -> None:
     import deepspeed_tpu
     from deepspeed_tpu.models import create_model
 
-    batch, seq = int(os.environ.get("BENCH_BATCH", 8)), int(os.environ.get("BENCH_SEQ", 1024))
-    model = create_model("gpt2-125m", dtype=jnp.bfloat16, remat=False,
+    batch, seq = int(os.environ.get("BENCH_BATCH", 32)), int(os.environ.get("BENCH_SEQ", 1024))
+    model = create_model("gpt2-125m", dtype=jnp.bfloat16, remat=True,
                          max_seq_len=seq)
     cfg = {
         "train_micro_batch_size_per_gpu": batch,
@@ -58,16 +58,17 @@ def main() -> None:
     ids = jax.random.randint(rng, (1, batch, seq), 0, model.config.vocab_size)
     batch_tree = {"input_ids": ids}
 
-    # warmup (compile)
+    # warmup (compile); float() forces materialisation — block_until_ready is
+    # not a reliable fence over remote-tunnel backends
     for _ in range(2):
         loss = engine.train_batch(batch=batch_tree)
-    jax.block_until_ready(loss)
+    float(loss)
 
     steps = int(os.environ.get("BENCH_STEPS", 10))
     t0 = time.perf_counter()
     for _ in range(steps):
         loss = engine.train_batch(batch=batch_tree)
-    jax.block_until_ready(loss)
+    float(loss)
     dt = time.perf_counter() - t0
 
     tokens_per_sec = batch * seq * steps / dt

@@ -1,0 +1,35 @@
+"""deepspeed_tpu.ops — Pallas kernels + registry (reference: deepspeed/ops,
+op_builder/, csrc/)."""
+
+from .flash_attention import flash_attention, make_attention_impl
+from .fused_adam import fused_adam_flat, reference_adam_flat
+from .normalization import fused_layer_norm, reference_layer_norm
+from .quantization import (dequantize_symmetric, fake_quantize,
+                           quantize_symmetric, reference_quantize_symmetric)
+from .registry import available_ops, get_op, is_compatible, op_report, register_op
+
+register_op("flash_attention", flash_attention,
+            reference=lambda *a, **k: _ref_attn(*a, **k),
+            description="FA2-style fused attention fwd+bwd")
+register_op("fused_adam", fused_adam_flat, reference=reference_adam_flat,
+            description="flat-buffer Adam/AdamW update")
+register_op("fused_layer_norm", fused_layer_norm, reference=reference_layer_norm,
+            description="fused LayerNorm/RMSNorm")
+register_op("quantize_symmetric", quantize_symmetric,
+            reference=reference_quantize_symmetric,
+            description="int8/int4 group quantization")
+
+
+def _ref_attn(q, k, v, mask=None, causal=True, **_):
+    from ..models.transformer import dot_product_attention
+
+    return dot_product_attention(q, k, v, mask, causal=causal)
+
+
+__all__ = [
+    "flash_attention", "make_attention_impl", "fused_adam_flat",
+    "reference_adam_flat", "fused_layer_norm", "reference_layer_norm",
+    "quantize_symmetric", "dequantize_symmetric", "fake_quantize",
+    "reference_quantize_symmetric", "available_ops", "get_op",
+    "is_compatible", "op_report", "register_op",
+]

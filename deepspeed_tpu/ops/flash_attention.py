@@ -1,0 +1,357 @@
+"""Pallas flash attention (forward + backward) for TPU.
+
+TPU-native replacement for the reference's attention kernels: the fused
+training softmax/attention path in ``csrc/transformer/softmax_kernels.cu`` +
+``ds_transformer_cuda.cpp`` and the inference ``softmax_context`` op
+(csrc/transformer/inference/csrc/softmax.cu). Online-softmax tiling (Flash
+Attention 2 schedule): the KV loop is the innermost sequential grid dimension,
+with running max/denominator kept in VMEM scratch; causal blocks above the
+diagonal are skipped entirely.
+
+Layouts: q (B, N, S, D); k, v (B, N, T, D) — callers with GQA expand KV heads
+before the call (wrapper does it). All matmuls accumulate in fp32 on the MXU.
+
+The backward pass is two Pallas kernels (dq, and dkv) following the standard
+FA2 recomputation scheme with the forward's logsumexp as residual.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+LANES = 128
+
+
+def _block_sizes(s: int, t: int) -> Tuple[int, int]:
+    return min(128, s), min(128, t)
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                acc, m_scr, l_scr, *, scale: float, causal: bool,
+                bq: int, bk: int, kv_len: int):
+    i = pl.program_id(2)   # q block
+    j = pl.program_id(3)   # kv block
+    nj = pl.num_programs(3)
+
+    @pl.when(j == 0)
+    def _init():
+        acc[:] = jnp.zeros_like(acc)
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+
+    # causal: skip blocks strictly above the diagonal
+    run = True
+    if causal:
+        run = j * bk <= i * bq + (bq - 1)
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32) * scale          # (bq, D)
+        k = k_ref[0, 0].astype(jnp.float32)                  # (bk, D)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)  # (bq, bk)
+        col = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + j * bk
+        mask = col < kv_len
+        if causal:
+            row = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + i * bq
+            mask = mask & (col <= row)
+        s = jnp.where(mask, s, NEG_INF)
+
+        m_prev = m_scr[:, :1]                                # (bq, 1)
+        m_cur = jnp.max(s, axis=1, keepdims=True)            # (bq, 1)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)                               # (bq, bk)
+        correction = jnp.exp(m_prev - m_new)                 # (bq, 1)
+        l_new = correction * l_scr[:, :1] + jnp.sum(p, axis=1, keepdims=True)
+        acc[:] = acc[:] * correction + jax.lax.dot_general(
+            p, v_ref[0, 0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(j == nj - 1)
+    def _finalize():
+        l = l_scr[:, :1]
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc[:] / safe_l).astype(o_ref.dtype)
+        lse = m_scr[:, :1] + jnp.log(safe_l)
+        lse_ref[0, 0] = jnp.broadcast_to(lse, lse_ref[0, 0].shape)
+
+
+def _fwd(q: jax.Array, k: jax.Array, v: jax.Array, *, causal: bool,
+         scale: float, kv_len: int, interpret: bool = False):
+    B, N, S, D = q.shape
+    T = k.shape[2]
+    bq, bk = _block_sizes(S, T)
+    grid = (B, N, S // bq, T // bk)
+
+    kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                               bq=bq, bk=bk, kv_len=kv_len)
+    out_shape = [
+        jax.ShapeDtypeStruct((B, N, S, D), q.dtype),
+        jax.ShapeDtypeStruct((B, N, S, LANES), jnp.float32),  # lse (lane-padded)
+    ]
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, D), lambda b, n, i, j: (b, n, i, 0)),
+            pl.BlockSpec((1, 1, bk, D), lambda b, n, i, j: (b, n, j, 0)),
+            pl.BlockSpec((1, 1, bk, D), lambda b, n, i, j: (b, n, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, bq, D), lambda b, n, i, j: (b, n, i, 0)),
+            pl.BlockSpec((1, 1, bq, LANES), lambda b, n, i, j: (b, n, i, 0)),
+        ],
+        out_shape=out_shape,
+        scratch_shapes=[
+            pltpu.VMEM((bq, D), jnp.float32),
+            pltpu.VMEM((bq, LANES), jnp.float32),
+            pltpu.VMEM((bq, LANES), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(q, k, v)
+    return o, lse[..., 0]
+
+
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   acc, *, scale: float, causal: bool, bq: int, bk: int,
+                   kv_len: int):
+    i = pl.program_id(2)
+    j = pl.program_id(3)
+    nj = pl.num_programs(3)
+
+    @pl.when(j == 0)
+    def _init():
+        acc[:] = jnp.zeros_like(acc)
+
+    run = True
+    if causal:
+        run = j * bk <= i * bq + (bq - 1)
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32) * scale
+        k = k_ref[0, 0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        col = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + j * bk
+        mask = col < kv_len
+        if causal:
+            row = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + i * bq
+            mask = mask & (col <= row)
+        s = jnp.where(mask, s, NEG_INF)
+        p = jnp.exp(s - lse_ref[0, 0][:, :1])                 # (bq, bk)
+        do = do_ref[0, 0].astype(jnp.float32)                 # (bq, D)
+        dp = jax.lax.dot_general(do, v_ref[0, 0].astype(jnp.float32),
+                                 (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0, 0][:, :1])                # (bq, bk)
+        acc[:] += jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+
+    @pl.when(j == nj - 1)
+    def _finalize():
+        dq_ref[0, 0] = (acc[:] * scale).astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_acc, dv_acc, *, scale: float,
+                    causal: bool, bq: int, bk: int, kv_len: int):
+    j = pl.program_id(2)   # kv block (outer)
+    i = pl.program_id(3)   # q block (inner, sequential)
+    ni = pl.num_programs(3)
+
+    @pl.when(i == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    run = True
+    if causal:
+        run = j * bk <= i * bq + (bq - 1)
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32) * scale           # (bq, D)
+        k = k_ref[0, 0].astype(jnp.float32)                   # (bk, D)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        col = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + j * bk
+        mask = col < kv_len
+        if causal:
+            row = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + i * bq
+            mask = mask & (col <= row)
+        s = jnp.where(mask, s, NEG_INF)
+        p = jnp.exp(s - lse_ref[0, 0][:, :1])                 # (bq, bk)
+        do = do_ref[0, 0].astype(jnp.float32)
+        dv_acc[:] += jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+                                         preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v_ref[0, 0].astype(jnp.float32),
+                                 (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0, 0][:, :1])
+        dk_acc[:] += jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+                                         preferred_element_type=jnp.float32)
+
+    @pl.when(i == ni - 1)
+    def _finalize():
+        dk_ref[0, 0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _bwd(causal: bool, scale: float, kv_len: int, interpret: bool,
+         residuals, grads):
+    q, k, v, o, lse = residuals
+    do = grads[0]
+    B, N, S, D = q.shape
+    T = k.shape[2]
+    bq, bk = _block_sizes(S, T)
+
+    delta = jnp.sum(o.astype(jnp.float32) * do.astype(jnp.float32), axis=-1)
+    delta = jnp.broadcast_to(delta[..., None], (B, N, S, LANES))
+    lse_pad = jnp.broadcast_to(lse[..., None], (B, N, S, LANES))
+
+    common_specs = [
+        pl.BlockSpec((1, 1, bq, D), lambda b, n, x, y: (b, n, x, 0)),      # q
+        pl.BlockSpec((1, 1, bk, D), lambda b, n, x, y: (b, n, y, 0)),      # k
+        pl.BlockSpec((1, 1, bk, D), lambda b, n, x, y: (b, n, y, 0)),      # v
+        pl.BlockSpec((1, 1, bq, D), lambda b, n, x, y: (b, n, x, 0)),      # do
+        pl.BlockSpec((1, 1, bq, LANES), lambda b, n, x, y: (b, n, x, 0)),  # lse
+        pl.BlockSpec((1, 1, bq, LANES), lambda b, n, x, y: (b, n, x, 0)),  # delta
+    ]
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          bq=bq, bk=bk, kv_len=kv_len),
+        grid=(B, N, S // bq, T // bk),
+        in_specs=common_specs,
+        out_specs=[pl.BlockSpec((1, 1, bq, D), lambda b, n, x, y: (b, n, x, 0))],
+        out_shape=[jax.ShapeDtypeStruct((B, N, S, D), q.dtype)],
+        scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(q, k, v, do, lse_pad, delta)[0]
+
+    # dkv: swap loop order — kv block outer (parallel), q block inner (sequential)
+    swapped_specs = [
+        pl.BlockSpec((1, 1, bq, D), lambda b, n, y, x: (b, n, x, 0)),
+        pl.BlockSpec((1, 1, bk, D), lambda b, n, y, x: (b, n, y, 0)),
+        pl.BlockSpec((1, 1, bk, D), lambda b, n, y, x: (b, n, y, 0)),
+        pl.BlockSpec((1, 1, bq, D), lambda b, n, y, x: (b, n, x, 0)),
+        pl.BlockSpec((1, 1, bq, LANES), lambda b, n, y, x: (b, n, x, 0)),
+        pl.BlockSpec((1, 1, bq, LANES), lambda b, n, y, x: (b, n, x, 0)),
+    ]
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                          bq=bq, bk=bk, kv_len=kv_len),
+        grid=(B, N, T // bk, S // bq),
+        in_specs=swapped_specs,
+        out_specs=[
+            pl.BlockSpec((1, 1, bk, D), lambda b, n, y, x: (b, n, y, 0)),
+            pl.BlockSpec((1, 1, bk, D), lambda b, n, y, x: (b, n, y, 0)),
+        ],
+        out_shape=[jax.ShapeDtypeStruct((B, N, T, D), k.dtype),
+                   jax.ShapeDtypeStruct((B, N, T, D), v.dtype)],
+        scratch_shapes=[pltpu.VMEM((bk, D), jnp.float32),
+                        pltpu.VMEM((bk, D), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(q, k, v, do, lse_pad, delta)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# public entry
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_core(q, k, v, causal: bool, scale: float, kv_len: int,
+                interpret: bool):
+    o, _ = _fwd(q, k, v, causal=causal, scale=scale, kv_len=kv_len,
+                interpret=interpret)
+    return o
+
+
+def _flash_core_fwd(q, k, v, causal, scale, kv_len, interpret):
+    o, lse = _fwd(q, k, v, causal=causal, scale=scale, kv_len=kv_len,
+                  interpret=interpret)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_core_bwd(causal, scale, kv_len, interpret, residuals, g):
+    return _bwd(causal, scale, kv_len, interpret, residuals, (g,))
+
+
+_flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
+
+
+def _pad_to(x: jax.Array, axis: int, mult: int) -> jax.Array:
+    size = x.shape[axis]
+    pad = (-size) % mult
+    if pad == 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths)
+
+
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                    mask=None, causal: bool = True,
+                    scale: Optional[float] = None,
+                    interpret: bool = False) -> jax.Array:
+    """Drop-in replacement for models.transformer.dot_product_attention:
+    q (B,S,N,D), k/v (B,T,Kh,D); returns (B,S,N,D). Padding masks are not
+    kernel-supported yet — callers with masks fall back to the jnp path
+    (models pass mask=None for full-sequence pretraining, the hot case)."""
+    if mask is not None:
+        from ..models.transformer import dot_product_attention
+
+        return dot_product_attention(q, k, v, mask, causal=causal)
+    B, S, N, D = q.shape
+    T, K = k.shape[1], k.shape[2]
+    if K != N:  # GQA: expand KV heads (wrapper-level; kernel sees MHA)
+        k = jnp.repeat(k, N // K, axis=2)
+        v = jnp.repeat(v, N // K, axis=2)
+    scale = scale if scale is not None else D ** -0.5
+    # (B,S,N,D) -> (B,N,S,D)
+    qt, kt, vt = (x.swapaxes(1, 2) for x in (q, k, v))
+    bq, bk = _block_sizes(S, T)
+    qt = _pad_to(qt, 2, bq)
+    kt = _pad_to(kt, 2, bk)
+    vt = _pad_to(vt, 2, bk)
+    o = _flash_core(qt, kt, vt, causal, scale, T, interpret)
+    return o[:, :, :S].swapaxes(1, 2)
+
+
+def make_attention_impl(interpret: bool = False):
+    """attention_impl hook for TransformerConfig."""
+
+    def impl(q, k, v, mask, causal=True):
+        return flash_attention(q, k, v, mask=mask, causal=causal,
+                               interpret=interpret)
+
+    return impl

@@ -1,0 +1,102 @@
+"""Group quantization kernels (int8/int4, symmetric & asymmetric).
+
+TPU-native analog of the reference quantizer ops (``csrc/quantization/``:
+quantize.cu, dequantize.cu, fake_quantizer.cu; python surface
+``ops/quantizer``). Used by: MoQ-style quant-aware training (fake quant),
+inference int8 weight storage, and the 1-bit optimizer family's error-feedback
+compression.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _quant_sym_kernel(x_ref, q_ref, scale_ref, *, bits: int):
+    x = x_ref[:].astype(jnp.float32)
+    qmax = float(2 ** (bits - 1) - 1)
+    absmax = jnp.max(jnp.abs(x), axis=-1, keepdims=True)
+    scale = jnp.where(absmax == 0.0, 1.0, absmax / qmax)
+    q = jnp.clip(jnp.round(x / scale), -qmax - 1, qmax)
+    q_ref[:] = q.astype(jnp.int8)
+    scale_ref[:] = jnp.broadcast_to(scale, scale_ref.shape)
+
+
+def quantize_symmetric(x: jax.Array, bits: int = 8, group_size: int = 128,
+                       interpret: bool = False) -> Tuple[jax.Array, jax.Array]:
+    """Per-group symmetric quantization. x flat (n,) with n % group_size == 0.
+    Returns (int8 values, fp32 per-group scales). int4 packs into int8 range."""
+    assert bits in (4, 8)
+    n = x.shape[-1]
+    assert n % group_size == 0, f"{n} % {group_size} != 0"
+    groups = n // group_size
+    x2 = x.reshape(groups, group_size)
+    GB = 8  # group rows per kernel block
+    pad = (-groups) % GB
+    if pad:
+        x2 = jnp.pad(x2, ((0, pad), (0, 0)))
+    q, scales = pl.pallas_call(
+        functools.partial(_quant_sym_kernel, bits=bits),
+        grid=(x2.shape[0] // GB,),
+        in_specs=[pl.BlockSpec((GB, group_size), lambda i: (i, 0))],
+        out_specs=[pl.BlockSpec((GB, group_size), lambda i: (i, 0)),
+                   pl.BlockSpec((GB, group_size), lambda i: (i, 0))],
+        out_shape=[jax.ShapeDtypeStruct(x2.shape, jnp.int8),
+                   jax.ShapeDtypeStruct(x2.shape, jnp.float32)],
+        interpret=interpret,
+    )(x2)
+    if pad:
+        q, scales = q[:groups], scales[:groups]
+    return q.reshape(n), scales[:, 0]
+
+
+def dequantize_symmetric(q: jax.Array, scales: jax.Array,
+                         group_size: int = 128) -> jax.Array:
+    groups = q.shape[-1] // group_size
+    return (q.reshape(groups, group_size).astype(jnp.float32)
+            * scales[:, None]).reshape(-1)
+
+
+def reference_quantize_symmetric(x, bits=8, group_size=128):
+    qmax = float(2 ** (bits - 1) - 1)
+    groups = x.shape[-1] // group_size
+    x2 = x.reshape(groups, group_size).astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(x2), axis=-1, keepdims=True)
+    scale = jnp.where(absmax == 0.0, 1.0, absmax / qmax)
+    q = jnp.clip(jnp.round(x2 / scale), -qmax - 1, qmax).astype(jnp.int8)
+    return q.reshape(-1), scale[:, 0]
+
+
+def fake_quantize(x: jax.Array, bits: int = 8, group_size: int = 128,
+                  interpret: bool = False) -> jax.Array:
+    """Quantize-dequantize roundtrip (MoQ fake_quantizer.cu) with a
+    straight-through gradient estimator."""
+
+    @jax.custom_vjp
+    def _fq(x):
+        shape = x.shape
+        flat = x.reshape(-1)
+        pad = (-flat.shape[0]) % group_size
+        if pad:
+            flat = jnp.pad(flat, (0, pad))
+        q, s = quantize_symmetric(flat, bits=bits, group_size=group_size,
+                                  interpret=interpret)
+        deq = dequantize_symmetric(q, s, group_size=group_size)
+        if pad:
+            deq = deq[:x.size]
+        return deq.reshape(shape).astype(x.dtype)
+
+    def fwd(x):
+        return _fq(x), None
+
+    def bwd(_, g):
+        return (g,)  # straight-through
+
+    _fq.defvjp(fwd, bwd)
+    return _fq(x)

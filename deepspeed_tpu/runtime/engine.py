@@ -118,10 +118,14 @@ class TrainEngine:
         # bookkeeping
         self.global_steps = 0
         self.micro_steps = 0
-        self.skipped_steps = 0
+        self._skipped_steps = 0
         self.timers = SynchronizedWallClockTimer()
         self.tput_timer = ThroughputTimer(
-            batch_size=self.train_batch_size(), steps_per_output=self.steps_per_print())
+            batch_size=self.train_batch_size(), start_step=0,
+            steps_per_output=self.steps_per_print())
+        self._skipped_accum = None
+        self._steps_since_sync = 0
+        self._tput_window_start = None
         self._staged_grads = None
         self._staged_count = 0
         self._compiled_step = None
@@ -164,7 +168,15 @@ class TrainEngine:
         return self.config.wall_clock_breakdown
 
     def get_lr(self):
-        return [self._last_lr]
+        """Current learning rate. Host-side when a scheduler exists; otherwise
+        evaluates the optimizer's schedule at the current step (a tiny device
+        computation — fine at user-call cadence)."""
+        if self.lr_scheduler is not None and hasattr(self.lr_scheduler, "get_last_lr"):
+            return self.lr_scheduler.get_last_lr()
+        try:
+            return [float(self.optimizer.lr_schedule(self.global_steps))]
+        except Exception:
+            return [self._last_lr]
 
     def get_global_step(self) -> int:
         return self.global_steps
@@ -172,6 +184,20 @@ class TrainEngine:
     @property
     def cur_scale(self) -> float:
         return float(self.scaler_state.scale)
+
+    @property
+    def skipped_steps(self) -> int:
+        """Total overflow-skipped steps. Reading drains the pending device
+        counter (a sync) — steady-state code paths never read it."""
+        if self._skipped_accum is not None:
+            self._skipped_steps += int(self._skipped_accum)
+            self._skipped_accum = None
+        return self._skipped_steps
+
+    @skipped_steps.setter
+    def skipped_steps(self, value: int) -> None:
+        self._skipped_steps = value
+        self._skipped_accum = None
 
     # -- sharding helpers -------------------------------------------------
     def _opt_state_shardings(self):
@@ -277,8 +303,13 @@ class TrainEngine:
         if self._compiled_step is None:
             self._compiled_step = self._build_train_step()
 
-        self.timers(TRAIN_BATCH_TIMER).start()
-        self.tput_timer.start()
+        # Steady-state path is SYNC-FREE: no host<->device scalar fetches per
+        # step (each one drains the TPU queue — ruinous over remote tunnels).
+        # Device-side counters accumulate lazily; materialised only at
+        # steps_per_print boundaries (reference logs at the same cadence).
+        breakdown = self.wall_clock_breakdown()
+        if breakdown:
+            self.timers(TRAIN_BATCH_TIMER).start(synchronize=True)
         with self.mesh:
             batch = jax.device_put(batch, self._batch_sharding(batch, leading_gas=True))
             (self.params, self.opt_state, self.scaler_state, loss,
@@ -286,22 +317,44 @@ class TrainEngine:
                                           self.scaler_state, batch)
         self.global_steps += 1
         self.micro_steps += gas
-        self._last_lr = float(stats.lr)
-        if bool(stats.skipped):
-            self.skipped_steps += 1
-            log_dist(f"step {self.global_steps}: fp16 overflow, skipping update "
-                     f"(scale -> {float(self.scaler_state.scale)})")
-        self.tput_timer.stop()
-        self.timers(TRAIN_BATCH_TIMER).stop()
+        self._skipped_accum = (stats.skipped.astype(jnp.int32)
+                               if self._skipped_accum is None
+                               else self._skipped_accum + stats.skipped)
+        if breakdown:
+            self.timers(TRAIN_BATCH_TIMER).stop(synchronize=True)
+            self.timers.log([TRAIN_BATCH_TIMER])
         if self.lr_scheduler is not None and hasattr(self.lr_scheduler, "step"):
             self.lr_scheduler.step()
         if self.global_steps % self.steps_per_print() == 0:
+            self._sync_step_stats(stats)
             log_dist(f"step={self.global_steps} loss={float(loss):.4f} "
-                     f"lr={self._last_lr:.3e} grad_norm={float(stats.grad_norm):.3f}")
-        self._write_monitor(float(loss), float(stats.grad_norm))
-        if self.wall_clock_breakdown():
-            self.timers.log([TRAIN_BATCH_TIMER])
+                     f"lr={self._last_lr:.3e} grad_norm={float(stats.grad_norm):.3f} "
+                     f"skipped={self.skipped_steps} "
+                     f"throughput={self.tput_timer.avg_samples_per_sec():.1f} samples/s")
+            self._write_monitor(float(loss), float(stats.grad_norm))
+        self._steps_since_sync += 1
+        self._tput_window_start = self._tput_window_start or time.time()
         return loss
+
+    def _sync_step_stats(self, stats: StepStats) -> None:
+        """Materialise lazily-accumulated device counters (one queue drain)."""
+        _ = self.skipped_steps  # property drains _skipped_accum
+        self._last_lr = float(stats.lr)
+        if self._tput_window_start is not None and self._steps_since_sync > 0:
+            self.tput_timer.add_window(time.time() - self._tput_window_start,
+                                       self._steps_since_sync)
+        self._tput_window_start = time.time()
+        self._steps_since_sync = 0
+
+    def mark_step_boundary(self) -> None:
+        """Exclude upcoming host work (eval, checkpointing, data stalls) from
+        the throughput window. Called automatically by eval_loss and
+        save_checkpoint."""
+        if self._tput_window_start is not None and self._steps_since_sync > 0:
+            self.tput_timer.add_window(time.time() - self._tput_window_start,
+                                       self._steps_since_sync)
+            self._steps_since_sync = 0
+        self._tput_window_start = None
 
     # -- forward/backward/step staged emulation (reference API parity) ----
     def forward(self, batch: Any) -> jax.Array:
@@ -359,7 +412,7 @@ class TrainEngine:
                 self.params, grads, self.opt_state, skip_update=overflow)
         self.scaler_state = self.loss_scaler.update(self.scaler_state, overflow)
         if bool(stats.skipped):
-            self.skipped_steps += 1
+            self._skipped_steps += 1
         self._staged_grads = None
         self._staged_count = 0
         self.global_steps += 1
@@ -368,6 +421,7 @@ class TrainEngine:
             self.lr_scheduler.step()
 
     def eval_loss(self, batch: Any) -> jax.Array:
+        self.mark_step_boundary()
         with self.mesh:
             return jax.jit(self.model.loss_fn)(self.params, batch)
 
@@ -389,6 +443,7 @@ class TrainEngine:
                         save_latest: bool = True) -> str:
         from .checkpoint import save_checkpoint as _save
 
+        self.mark_step_boundary()
         tag = tag or f"global_step{self.global_steps}"
         client_state = dict(client_state or {})
         client_state.update({

@@ -152,6 +152,12 @@ class ThroughputTimer:
                     f"batch/step latency={duration * 1000:.2f} ms")
                 self.step_elapsed_time = 0.0
 
+    def add_window(self, elapsed_s: float, steps: int) -> None:
+        """Account a window of ``steps`` steps taking ``elapsed_s`` seconds —
+        used by sync-free engines that cannot bracket individual steps."""
+        self.total_elapsed_time += elapsed_s
+        self.global_step_count += steps
+
     def avg_samples_per_sec(self) -> float:
         if self.global_step_count > self.start_step and self.total_elapsed_time > 0:
             steps = self.global_step_count - self.start_step

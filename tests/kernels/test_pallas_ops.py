@@ -1,0 +1,196 @@
+"""Pallas kernel parity tests (interpret mode on CPU) — analog of reference
+tests/unit/ops/* which check each CUDA kernel against a torch oracle on small
+shapes. Every kernel is compared against its pure-jnp reference."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.models.transformer import dot_product_attention
+from deepspeed_tpu.ops import (dequantize_symmetric, fake_quantize,
+                               flash_attention, fused_adam_flat,
+                               fused_layer_norm, op_report,
+                               quantize_symmetric, reference_adam_flat,
+                               reference_layer_norm,
+                               reference_quantize_symmetric)
+
+INTERPRET = True  # CPU mesh — run kernels through the pallas interpreter
+
+
+def _qkv(b=2, s=128, n=2, d=64, t=None, kv_heads=None, seed=0, dtype=jnp.float32):
+    t = t or s
+    kv_heads = kv_heads or n
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    q = jax.random.normal(ks[0], (b, s, n, d), dtype)
+    k = jax.random.normal(ks[1], (b, t, kv_heads, d), dtype)
+    v = jax.random.normal(ks[2], (b, t, kv_heads, d), dtype)
+    return q, k, v
+
+
+class TestFlashAttention:
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_forward_matches_reference(self, causal):
+        q, k, v = _qkv(s=256)
+        out = flash_attention(q, k, v, causal=causal, interpret=INTERPRET)
+        ref = dot_product_attention(q, k, v, None, causal=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_forward_unaligned_seq(self):
+        # S=100 not a multiple of the 128 block — exercises padding path
+        q, k, v = _qkv(s=100, t=100)
+        out = flash_attention(q, k, v, causal=True, interpret=INTERPRET)
+        ref = dot_product_attention(q, k, v, None, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_forward_gqa(self):
+        q, k, v = _qkv(n=4, kv_heads=2)
+        out = flash_attention(q, k, v, causal=True, interpret=INTERPRET)
+        ref = dot_product_attention(q, k, v, None, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_cross_attention_shapes(self):
+        q, k, v = _qkv(s=128, t=256)
+        out = flash_attention(q, k, v, causal=False, interpret=INTERPRET)
+        ref = dot_product_attention(q, k, v, None, causal=False)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_gradients_match_reference(self, causal):
+        q, k, v = _qkv(s=128)
+
+        def loss_flash(q, k, v):
+            return jnp.sum(flash_attention(q, k, v, causal=causal,
+                                           interpret=INTERPRET) ** 2)
+
+        def loss_ref(q, k, v):
+            return jnp.sum(dot_product_attention(q, k, v, None, causal=causal) ** 2)
+
+        g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b, name in zip(g1, g2, "qkv"):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=5e-4, rtol=1e-3,
+                                       err_msg=f"d{name} mismatch")
+
+    def test_grad_unaligned(self):
+        q, k, v = _qkv(s=100, t=100)
+
+        def loss_flash(q):
+            return jnp.sum(flash_attention(q, k, v, causal=True,
+                                           interpret=INTERPRET) ** 2)
+
+        def loss_ref(q):
+            return jnp.sum(dot_product_attention(q, k, v, None, causal=True) ** 2)
+
+        np.testing.assert_allclose(np.asarray(jax.grad(loss_flash)(q)),
+                                   np.asarray(jax.grad(loss_ref)(q)),
+                                   atol=5e-4, rtol=1e-3)
+
+    def test_mask_falls_back(self):
+        q, k, v = _qkv(s=64)
+        mask = jnp.ones((2, 64), jnp.int32).at[:, 32:].set(0)
+        out = flash_attention(q, k, v, mask=mask, causal=True, interpret=INTERPRET)
+        ref = dot_product_attention(q, k, v, mask, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-6)
+
+
+class TestFusedAdam:
+    @pytest.mark.parametrize("wd,adam_w", [(0.0, True), (0.01, True), (0.01, False)])
+    def test_matches_reference(self, wd, adam_w):
+        rng = np.random.RandomState(0)
+        n = 10000  # not a block multiple — exercises padding
+        p = jnp.asarray(rng.randn(n), jnp.float32)
+        g = jnp.asarray(rng.randn(n), jnp.float32)
+        m = jnp.zeros(n)
+        v = jnp.zeros(n)
+        p1, m1, v1 = p, m, v
+        p2, m2, v2 = p, m, v
+        for step in range(1, 4):
+            p1, m1, v1 = fused_adam_flat(p1, g, m1, v1, step, lr=1e-2,
+                                         weight_decay=wd, adam_w_mode=adam_w,
+                                         interpret=INTERPRET)
+            p2, m2, v2 = reference_adam_flat(p2, g, m2, v2, step, lr=1e-2,
+                                             weight_decay=wd, adam_w_mode=adam_w)
+        np.testing.assert_allclose(np.asarray(p1), np.asarray(p2), atol=1e-6)
+        np.testing.assert_allclose(np.asarray(m1), np.asarray(m2), atol=1e-6)
+        np.testing.assert_allclose(np.asarray(v1), np.asarray(v2), atol=1e-6)
+
+    def test_matches_torch_adamw(self):
+        import torch
+
+        rng = np.random.RandomState(1)
+        n = 512
+        p0 = rng.randn(n).astype(np.float32)
+        g0 = rng.randn(n).astype(np.float32)
+        p, m, v = jnp.asarray(p0), jnp.zeros(n), jnp.zeros(n)
+        t = torch.tensor(p0, requires_grad=True)
+        opt = torch.optim.AdamW([t], lr=1e-2, weight_decay=0.01)
+        for step in range(1, 5):
+            p, m, v = fused_adam_flat(p, jnp.asarray(g0), m, v, step, lr=1e-2,
+                                      weight_decay=0.01, interpret=INTERPRET)
+            t.grad = torch.tensor(g0)
+            opt.step()
+        np.testing.assert_allclose(np.asarray(p), t.detach().numpy(),
+                                   atol=1e-5, rtol=1e-5)
+
+
+class TestLayerNorm:
+    @pytest.mark.parametrize("rms", [False, True])
+    def test_forward(self, rms):
+        x = jax.random.normal(jax.random.PRNGKey(0), (4, 100, 256))
+        scale = jax.random.normal(jax.random.PRNGKey(1), (256,)) + 1.0
+        bias = None if rms else jax.random.normal(jax.random.PRNGKey(2), (256,))
+        out = fused_layer_norm(x, scale, bias, 1e-5, rms, INTERPRET)
+        ref = reference_layer_norm(x, scale, bias, 1e-5, rms)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-5, rtol=1e-5)
+
+    @pytest.mark.parametrize("rms", [False, True])
+    def test_backward(self, rms):
+        x = jax.random.normal(jax.random.PRNGKey(0), (8, 256))
+        scale = jax.random.normal(jax.random.PRNGKey(1), (256,)) + 1.0
+        bias = None if rms else jnp.zeros((256,))
+
+        def loss_fused(x, scale):
+            return jnp.sum(fused_layer_norm(x, scale, bias, 1e-5, rms,
+                                            INTERPRET) ** 2)
+
+        def loss_ref(x, scale):
+            return jnp.sum(reference_layer_norm(x, scale, bias, 1e-5, rms) ** 2)
+
+        g1 = jax.grad(loss_fused, argnums=(0, 1))(x, scale)
+        g2 = jax.grad(loss_ref, argnums=(0, 1))(x, scale)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-4, rtol=1e-4)
+
+
+class TestQuantization:
+    @pytest.mark.parametrize("bits", [8, 4])
+    def test_roundtrip_error_bounded(self, bits):
+        x = jax.random.normal(jax.random.PRNGKey(0), (4096,))
+        q, s = quantize_symmetric(x, bits=bits, interpret=INTERPRET)
+        qr, sr = reference_quantize_symmetric(x, bits=bits)
+        np.testing.assert_array_equal(np.asarray(q), np.asarray(qr))
+        np.testing.assert_allclose(np.asarray(s), np.asarray(sr), rtol=1e-6)
+        deq = dequantize_symmetric(q, s)
+        max_group_scale = float(jnp.max(s))
+        assert float(jnp.max(jnp.abs(deq - x))) <= max_group_scale * 0.5 + 1e-6
+
+    def test_fake_quantize_straight_through(self):
+        x = jax.random.normal(jax.random.PRNGKey(0), (16, 128))
+        y = fake_quantize(x, interpret=INTERPRET)
+        assert y.shape == x.shape
+        g = jax.grad(lambda x: jnp.sum(fake_quantize(x, interpret=INTERPRET) * 2))(x)
+        np.testing.assert_allclose(np.asarray(g), 2.0)
+
+
+def test_op_report():
+    report = op_report()
+    assert "flash_attention" in report
+    assert "fused_adam" in report
