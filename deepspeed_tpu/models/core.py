@@ -57,6 +57,8 @@ class Model:
     axes: AxesTree
     config: Any = None
     name: str = "model"
+    pipelined: bool = False     # loss_fn consumes a whole (M, mb, ...) stack
+    num_stages: int = 1
 
 
 # ---------------------------------------------------------------------------
@@ -70,7 +72,8 @@ DEFAULT_TP_RULES: Dict[str, Optional[str]] = {
     HEADS: MODEL_AXIS,
     KV_HEADS: MODEL_AXIS,
     MLP: MODEL_AXIS,
-    EXPERT: None,   # expert dim handled by the MoE layer itself
+    EXPERT: None,          # expert dim handled by the MoE layer itself
+    "pipe_stage": "pipe",  # pipelined models: stage dim over the pipe axis
 }
 
 
@@ -93,7 +96,7 @@ def logical_to_spec(axes: Optional[Tuple[str, ...]],
     mesh_axes: list = [rules.get(a) for a in axes]
     # never shard the scan-carried layer dim
     mesh_axes = [None if a == LAYERS else m for a, m in zip(axes, mesh_axes)]
-    if fsdp_axis is not None:
+    if fsdp_axis is not None and fsdp_axis not in mesh_axes:
         size = 1
         for s in shape:
             size *= s

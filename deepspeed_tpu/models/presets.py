@@ -55,6 +55,12 @@ _SIZES: Dict[str, Dict[str, Any]] = {
     "tiny-llama": dict(family="llama", hidden_size=64, num_layers=2, num_heads=4,
                        num_kv_heads=2, vocab_size=256, max_seq_len=128,
                        ffn_hidden_size=128),
+    # GShard/Switch-style 8-expert GPT (BASELINE tracked config #4)
+    "moe-tiny": dict(family="gpt2", hidden_size=64, num_layers=2, num_heads=4,
+                     vocab_size=256, max_seq_len=128, moe_num_experts=8),
+    "moe-gpt-350m-8e": dict(family="gpt2", hidden_size=1024, num_layers=24,
+                            num_heads=16, vocab_size=50257, max_seq_len=1024,
+                            moe_num_experts=8),
 }
 
 

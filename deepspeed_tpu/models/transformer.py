@@ -50,6 +50,13 @@ class TransformerConfig:
     dtype: Any = jnp.float32                # compute/param dtype
     remat: bool = False                     # activation checkpointing over layers
     attention_impl: Optional[Callable] = None  # pluggable (pallas flash attention)
+    # MoE (reference deepspeed/moe): >0 experts turns every layer's FFN into a
+    # gated expert bank with top_k routing + load-balancing aux loss
+    moe_num_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_min_capacity: int = 4
+    moe_aux_loss_coef: float = 0.01
 
     def __post_init__(self):
         if self.num_kv_heads is None:
@@ -100,7 +107,21 @@ def init_params(rng: jax.Array, cfg: TransformerConfig) -> Dict[str, Any]:
             "wo": normal(next(keys), (L, N * D, H), resid_std),
         },
     }
-    if cfg.activation == "swiglu":
+    E = cfg.moe_num_experts
+    if E > 0:
+        layers["router"] = normal(next(keys), (L, H, E))
+        if cfg.activation == "swiglu":
+            layers["mlp"] = {
+                "w_gate": normal(next(keys), (L, E, H, F)),
+                "w_up": normal(next(keys), (L, E, H, F)),
+                "w_down": normal(next(keys), (L, E, F, H), resid_std),
+            }
+        else:
+            layers["mlp"] = {
+                "w_up": normal(next(keys), (L, E, H, F)),
+                "w_down": normal(next(keys), (L, E, F, H), resid_std),
+            }
+    elif cfg.activation == "swiglu":
         layers["mlp"] = {
             "w_gate": normal(next(keys), (L, H, F)),
             "w_up": normal(next(keys), (L, H, F)),
@@ -137,7 +158,17 @@ def param_axes(cfg: TransformerConfig) -> Dict[str, Any]:
     if cfg.norm == "layernorm":
         attn.update({"bq": (LAYERS, HEADS), "bk": (LAYERS, KV_HEADS),
                      "bv": (LAYERS, KV_HEADS), "bo": (LAYERS, EMBED)})
-    if cfg.activation == "swiglu":
+    from .core import EXPERT
+
+    if cfg.moe_num_experts > 0:
+        if cfg.activation == "swiglu":
+            mlp = {"w_gate": (LAYERS, EXPERT, EMBED, MLP),
+                   "w_up": (LAYERS, EXPERT, EMBED, MLP),
+                   "w_down": (LAYERS, EXPERT, MLP, EMBED)}
+        else:
+            mlp = {"w_up": (LAYERS, EXPERT, EMBED, MLP),
+                   "w_down": (LAYERS, EXPERT, MLP, EMBED)}
+    elif cfg.activation == "swiglu":
         mlp = {"w_gate": (LAYERS, EMBED, MLP), "w_up": (LAYERS, EMBED, MLP),
                "w_down": (LAYERS, MLP, EMBED)}
     else:
@@ -146,9 +177,12 @@ def param_axes(cfg: TransformerConfig) -> Dict[str, Any]:
     ln = {"scale": (LAYERS, EMBED)}
     if cfg.norm == "layernorm":
         ln = {"scale": (LAYERS, EMBED), "bias": (LAYERS, EMBED)}
+    layer_axes = {"ln1": dict(ln), "ln2": dict(ln), "attn": attn, "mlp": mlp}
+    if cfg.moe_num_experts > 0:
+        layer_axes["router"] = (LAYERS, EMBED, None)
     axes: Dict[str, Any] = {
         "embed": {"tokens": (VOCAB, EMBED)},
-        "layers": {"ln1": dict(ln), "ln2": dict(ln), "attn": attn, "mlp": mlp},
+        "layers": layer_axes,
         "final_norm": ({"scale": (EMBED,), "bias": (EMBED,)}
                        if cfg.norm == "layernorm" else {"scale": (EMBED,)}),
     }
@@ -248,6 +282,19 @@ def _layer_forward(cfg: TransformerConfig, x: jax.Array, layer: Dict[str, Any],
     k = k.reshape(B, S, K, D)
     v = v.reshape(B, S, K, D)
 
+    # Ulysses SP / TP reshard: sequence gathered, heads scattered over
+    # ('seq','model') — XLA lowers this constraint to the head-scatter
+    # all-to-all (parallel/sequence.py). Training path only (no cache).
+    if cache is None:
+        from ..parallel.sequence import heads_spec, constrain
+
+        qspec = heads_spec(N)
+        kspec = heads_spec(K)
+        if qspec is not None and kspec is not None:
+            q = constrain(q, qspec)
+            k = constrain(k, kspec)
+            v = constrain(v, kspec)
+
     if cfg.position == "rope":
         cos, sin = rope_table(positions, D, cfg.rope_theta)
         q = apply_rope(q, cos, sin)
@@ -277,10 +324,23 @@ def _layer_forward(cfg: TransformerConfig, x: jax.Array, layer: Dict[str, Any],
     attn_out = jnp.einsum("bsd,dh->bsh", attn, layer["attn"]["wo"])
     if "bo" in layer["attn"]:
         attn_out = attn_out + layer["attn"]["bo"]
+    if cache is None:
+        from ..parallel.sequence import constrain, hidden_spec, sequence_parallel_enabled
+
+        if sequence_parallel_enabled():
+            attn_out = constrain(attn_out, hidden_spec())
     x = x + attn_out
 
     h = _norm(x, layer["ln2"]["scale"], layer["ln2"].get("bias"), cfg.norm, cfg.norm_eps)
-    if cfg.activation == "swiglu":
+    aux = jnp.float32(0.0)
+    if cfg.moe_num_experts > 0:
+        from ..parallel.moe import moe_mlp
+
+        mlp_out, aux = moe_mlp(h, layer["router"], layer["mlp"], cfg.activation,
+                               top_k=cfg.moe_top_k,
+                               capacity_factor=cfg.moe_capacity_factor,
+                               min_capacity=cfg.moe_min_capacity)
+    elif cfg.activation == "swiglu":
         gate = jnp.einsum("bsh,hf->bsf", h, layer["mlp"]["w_gate"])
         up = jnp.einsum("bsh,hf->bsf", h, layer["mlp"]["w_up"])
         inner = jax.nn.silu(gate) * up
@@ -290,16 +350,17 @@ def _layer_forward(cfg: TransformerConfig, x: jax.Array, layer: Dict[str, Any],
         inner = jax.nn.gelu(inner, approximate=True)
         mlp_out = jnp.einsum("bsf,fh->bsh", inner, layer["mlp"]["w_down"]) + layer["mlp"]["b_down"]
     x = x + mlp_out
-    return x, new_cache
+    return x, new_cache, aux
 
 
 def forward(params: Dict[str, Any], input_ids: jax.Array,
             cfg: TransformerConfig,
             attention_mask: Optional[jax.Array] = None,
             cache: Optional[Dict[str, Any]] = None,
-            start_pos: Any = 0) -> Tuple[jax.Array, Optional[Dict[str, Any]]]:
-    """Token ids (B,S) → logits (B,S,V). With ``cache``, runs in decode mode
-    (cache is a per-layer stacked pytree; see inference/kv_cache.py)."""
+            start_pos: Any = 0) -> Tuple[jax.Array, Optional[Dict[str, Any]], jax.Array]:
+    """Token ids (B,S) → (logits (B,S,V), new_cache, moe_aux_loss). With
+    ``cache``, runs in decode mode (cache is a per-layer stacked pytree; see
+    inference/kv_cache.py)."""
     B, S = input_ids.shape
     x = params["embed"]["tokens"][input_ids].astype(cfg.dtype)
     positions = jnp.arange(S) + start_pos
@@ -307,21 +368,23 @@ def forward(params: Dict[str, Any], input_ids: jax.Array,
         x = x + params["pos"][positions].astype(cfg.dtype)
 
     def block(carry, layer_and_cache):
-        h = carry
+        h, aux_acc = carry
         layer, layer_cache = layer_and_cache
-        h, new_cache = _layer_forward(cfg, h, layer, attention_mask, positions, layer_cache)
-        return h, new_cache
+        h, new_cache, aux = _layer_forward(cfg, h, layer, attention_mask,
+                                           positions, layer_cache)
+        return (h, aux_acc + aux), new_cache
 
     block_fn = block
     if cfg.remat and cache is None:
         block_fn = jax.checkpoint(block, prevent_cse=False)
 
     if cache is None:
-        x, _ = lax.scan(lambda c, layer: block_fn(c, (layer, None)),
-                        x, params["layers"])
+        (x, aux_total), _ = lax.scan(lambda c, layer: block_fn(c, (layer, None)),
+                                     (x, jnp.float32(0.0)), params["layers"])
         new_cache = None
     else:
-        x, new_cache = lax.scan(block_fn, x, (params["layers"], cache))
+        (x, aux_total), new_cache = lax.scan(block_fn, (x, jnp.float32(0.0)),
+                                             (params["layers"], cache))
 
     x = _norm(x, params["final_norm"]["scale"], params["final_norm"].get("bias"),
               cfg.norm, cfg.norm_eps)
@@ -329,7 +392,7 @@ def forward(params: Dict[str, Any], input_ids: jax.Array,
         logits = jnp.einsum("bsh,vh->bsv", x, params["embed"]["tokens"])
     else:
         logits = jnp.einsum("bsh,hv->bsv", x, params["lm_head"])
-    return logits, new_cache
+    return logits, new_cache, aux_total
 
 
 def cross_entropy_loss(logits: jax.Array, labels: jax.Array,
@@ -354,20 +417,24 @@ def build_model(cfg: TransformerConfig, name: str = "transformer") -> Model:
         return init_params(rng, cfg)
 
     def apply(params, batch, cache=None, start_pos=0):
-        return forward(params, batch["input_ids"], cfg,
-                       attention_mask=batch.get("attention_mask"),
-                       cache=cache, start_pos=start_pos)
+        logits, new_cache, _ = forward(params, batch["input_ids"], cfg,
+                                       attention_mask=batch.get("attention_mask"),
+                                       cache=cache, start_pos=start_pos)
+        return logits, new_cache
 
     def loss_fn(params, batch):
-        logits, _ = forward(params, batch["input_ids"], cfg,
-                            attention_mask=batch.get("attention_mask"))
+        logits, _, aux = forward(params, batch["input_ids"], cfg,
+                                 attention_mask=batch.get("attention_mask"))
         labels = batch.get("labels")
         if labels is None:
             labels = jnp.concatenate(
                 [batch["input_ids"][:, 1:],
                  jnp.full((batch["input_ids"].shape[0], 1), -100, batch["input_ids"].dtype)],
                 axis=1)
-        return cross_entropy_loss(logits, labels, batch.get("attention_mask"))
+        loss = cross_entropy_loss(logits, labels, batch.get("attention_mask"))
+        if cfg.moe_num_experts > 0:
+            loss = loss + cfg.moe_aux_loss_coef * aux / max(cfg.num_layers, 1)
+        return loss
 
     return Model(init=init, apply=apply, loss_fn=loss_fn, axes=param_axes(cfg),
                  config=cfg, name=name)

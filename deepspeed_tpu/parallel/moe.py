@@ -1,0 +1,167 @@
+"""Mixture-of-Experts: gating + dispatch (expert parallelism).
+
+TPU-native analog of ``deepspeed/moe/`` (``MoE`` layer.py:16, ``MOELayer`` +
+``TopKGate`` sharded_moe.py:420/343, ``top1gating`` :179, ``top2gating`` :277,
+``Experts`` experts.py, ``_AllToAll`` :90). Same gating semantics — softmax
+router, capacity factor, load-balancing aux loss (GShard l_aux = E·Σ me·ce),
+optional no-drop jitter — expressed as einsum dispatch/combine (the GShard
+formulation the reference also uses). The explicit NCCL all-to-all becomes a
+sharding constraint on the dispatched (E, C, H) tensor: when the expert dim is
+sharded over 'data' (EP folded over DP, reference groups.py:108 constraint),
+XLA lowers the token exchange to exactly that all-to-all.
+
+Expert gradients: because expert weights are *sharded* (not replicated) over
+'data', SPMD autodiff never averages them across data ranks — the behavior the
+reference implements manually with expert_data_parallel_group
+(runtime/engine.py:2238).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .mesh import DATA_AXIS, get_expert_parallel_world_size, get_mesh
+from .sequence import constrain
+from jax.sharding import PartitionSpec as P
+
+
+class GateOutput(NamedTuple):
+    combine: jax.Array    # (T, E, C) — combine weights
+    dispatch: jax.Array   # (T, E, C) bool — dispatch mask
+    aux_loss: jax.Array   # scalar load-balancing loss
+    # diagnostics
+    expert_counts: jax.Array  # (E,) tokens routed per expert (pre-drop)
+
+
+def _capacity(num_tokens: int, num_experts: int, capacity_factor: float,
+              min_capacity: int = 4) -> int:
+    """Reference sharded_moe.py:157 _capacity."""
+    cap = int(num_tokens / num_experts * capacity_factor)
+    return max(cap, min_capacity)
+
+
+def _one_hot(x: jax.Array, n: int) -> jax.Array:
+    return jax.nn.one_hot(x, n, dtype=jnp.float32)
+
+
+def top1gating(logits: jax.Array, capacity_factor: float = 1.0,
+               min_capacity: int = 4, noisy_gate_policy: Optional[str] = None,
+               rng: Optional[jax.Array] = None) -> GateOutput:
+    """Switch-style top-1 gating (reference sharded_moe.py:179)."""
+    T, E = logits.shape
+    C = _capacity(T, E, capacity_factor, min_capacity)
+    if noisy_gate_policy == "RSample" and rng is not None:
+        logits_for_choice = logits + jax.random.gumbel(rng, logits.shape)
+    else:
+        logits_for_choice = logits
+    gates = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)      # (T, E)
+    expert_idx = jnp.argmax(logits_for_choice, axis=-1)              # (T,)
+    mask = _one_hot(expert_idx, E)                                   # (T, E)
+
+    # aux loss: E * mean_e(frac_tokens_e * mean_gate_e)  (GShard eq.)
+    me = gates.mean(axis=0)
+    ce = mask.mean(axis=0)
+    aux = jnp.sum(me * ce) * E
+
+    # capacity assignment: position of each token within its expert queue
+    pos_in_expert = jnp.cumsum(mask, axis=0) * mask                  # 1-based
+    keep = (pos_in_expert <= C) & (mask > 0)
+    pos = (pos_in_expert - 1.0) * mask                               # 0-based
+    gate_val = (gates * mask).sum(axis=-1, keepdims=True)            # (T,1)
+    dispatch = keep[..., None] & (  # (T,E,C)
+        _one_hot(pos.sum(axis=-1).astype(jnp.int32), C)[:, None, :] > 0)
+    dispatch = dispatch & (mask[..., None] > 0)
+    combine = gate_val[:, :, None] * dispatch.astype(jnp.float32)
+    return GateOutput(combine=combine, dispatch=dispatch, aux_loss=aux,
+                      expert_counts=mask.sum(axis=0))
+
+
+def top2gating(logits: jax.Array, capacity_factor: float = 1.0,
+               min_capacity: int = 4) -> GateOutput:
+    """GShard top-2 gating (reference sharded_moe.py:277): second expert
+    weighted by renormalised gate, both capacity-limited."""
+    T, E = logits.shape
+    C = _capacity(T, E, 2 * capacity_factor, min_capacity)
+    gates = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+
+    idx1 = jnp.argmax(gates, axis=-1)
+    mask1 = _one_hot(idx1, E)
+    gates_wo1 = gates * (1.0 - mask1)
+    idx2 = jnp.argmax(gates_wo1, axis=-1)
+    mask2 = _one_hot(idx2, E)
+
+    me = gates.mean(axis=0)
+    ce = mask1.mean(axis=0)
+    aux = jnp.sum(me * ce) * E
+
+    # queue positions: expert-1 tokens first, then expert-2 tokens
+    pos1 = jnp.cumsum(mask1, axis=0) * mask1
+    pos2 = (jnp.cumsum(mask2, axis=0) + mask1.sum(axis=0)[None, :]) * mask2
+    keep1 = (pos1 <= C) & (mask1 > 0)
+    keep2 = (pos2 <= C) & (mask2 > 0)
+
+    g1 = (gates * mask1).sum(axis=-1)
+    g2 = (gates * mask2).sum(axis=-1)
+    denom = jnp.maximum(g1 + g2, 1e-9)
+    g1, g2 = g1 / denom, g2 / denom
+
+    def slots(pos, keep):
+        return keep[..., None] & (
+            _one_hot((pos.sum(-1) - 1.0).clip(0).astype(jnp.int32), C)[:, None, :] > 0)
+
+    d1 = slots(pos1, keep1) & (mask1[..., None] > 0)
+    d2 = slots(pos2, keep2) & (mask2[..., None] > 0)
+    combine = (g1[:, None, None] * d1.astype(jnp.float32)
+               + g2[:, None, None] * d2.astype(jnp.float32))
+    dispatch = d1 | d2
+    return GateOutput(combine=combine, dispatch=dispatch, aux_loss=aux,
+                      expert_counts=(mask1 + mask2).sum(axis=0))
+
+
+def _ep_active(num_experts: int) -> bool:
+    if get_expert_parallel_world_size() <= 1:
+        return False
+    try:
+        dp = int(get_mesh().shape.get(DATA_AXIS, 1))
+    except Exception:
+        return False
+    return dp > 1 and num_experts % dp == 0
+
+
+def moe_mlp(x: jax.Array, router_w: jax.Array, experts: Dict[str, jax.Array],
+            activation: str, top_k: int = 2, capacity_factor: float = 1.25,
+            min_capacity: int = 4) -> Tuple[jax.Array, jax.Array]:
+    """MoE FFN for one layer. x (B, S, H); router_w (H, E); experts:
+    w_up/w_down (+w_gate for swiglu) with leading expert dim E.
+    Returns (out (B,S,H), aux_loss scalar)."""
+    B, S, H = x.shape
+    E = router_w.shape[-1]
+    T = B * S
+    xt = x.reshape(T, H)
+    logits = xt.astype(jnp.float32) @ router_w.astype(jnp.float32)
+    gate = top2gating(logits, capacity_factor, min_capacity) if top_k == 2 else \
+        top1gating(logits, capacity_factor, min_capacity)
+
+    dispatch = gate.dispatch.astype(x.dtype)                  # (T, E, C)
+    dispatched = jnp.einsum("tec,th->ech", dispatch, xt)      # (E, C, H)
+    if _ep_active(E):
+        # EP: expert dim sharded over 'data' — XLA inserts the all-to-all here
+        dispatched = constrain(dispatched, P(DATA_AXIS, None, None))
+
+    if activation == "swiglu":
+        g = jnp.einsum("ech,ehf->ecf", dispatched, experts["w_gate"])
+        u = jnp.einsum("ech,ehf->ecf", dispatched, experts["w_up"])
+        inner = jax.nn.silu(g) * u
+    else:
+        inner = jax.nn.gelu(
+            jnp.einsum("ech,ehf->ecf", dispatched, experts["w_up"]),
+            approximate=True)
+    expert_out = jnp.einsum("ecf,efh->ech", inner, experts["w_down"])
+    if _ep_active(E):
+        expert_out = constrain(expert_out, P(DATA_AXIS, None, None))
+
+    out = jnp.einsum("tec,ech->th", gate.combine.astype(x.dtype), expert_out)
+    return out.reshape(B, S, H), gate.aux_loss

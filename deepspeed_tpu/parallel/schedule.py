@@ -1,0 +1,224 @@
+"""Pipeline instruction schedules — pure data structures.
+
+Analog of ``deepspeed/runtime/pipe/schedule.py`` (494 LoC): ``TrainSchedule``
+(1F1B, reference :189) and ``InferenceSchedule`` (:135) generate per-step
+instruction lists. On GPU these drive the ``PipelineEngine`` instruction
+interpreter (``_exec_schedule`` pipe/engine.py:1287); on TPU the executed
+program is the SPMD collective loop in ``pipeline.py``, but the schedule
+objects are kept 1:1 because (a) they define the canonical semantics the SPMD
+loop must match, (b) tests and tooling (autotuner memory estimates) consume
+them, mirroring reference tests/unit/runtime/pipe/test_pipe_schedule.py.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List
+
+
+class PipeInstruction:
+    def __init__(self, **kwargs):
+        self.name = self.__class__.__name__
+        self.kwargs = kwargs
+        for key, val in kwargs.items():
+            setattr(self, key, val)
+
+    def __repr__(self):
+        if self.kwargs:
+            args = ", ".join(f"{k}={v}" for k, v in self.kwargs.items())
+            return f"{self.name}({args})"
+        return self.name
+
+    def __eq__(self, other):
+        return (isinstance(other, PipeInstruction) and self.name == other.name
+                and self.kwargs == other.kwargs)
+
+
+class OptimizerStep(PipeInstruction):
+    pass
+
+
+class ReduceGrads(PipeInstruction):
+    pass
+
+
+class ReduceTiedGrads(PipeInstruction):
+    pass
+
+
+class LoadMicroBatch(PipeInstruction):
+    pass
+
+
+class ForwardPass(PipeInstruction):
+    pass
+
+
+class BackwardPass(PipeInstruction):
+    pass
+
+
+class SendActivation(PipeInstruction):
+    pass
+
+
+class RecvActivation(PipeInstruction):
+    pass
+
+
+class SendGrad(PipeInstruction):
+    pass
+
+
+class RecvGrad(PipeInstruction):
+    pass
+
+
+class PipeSchedule:
+    """Base — reference schedule.py PipeSchedule. Yields lists of instructions
+    per step for one (stage, num_stages, micro_batches) coordinate."""
+
+    def __init__(self, micro_batches: int, stages: int, stage_id: int):
+        assert 0 <= stage_id < stages
+        self.micro_batches = micro_batches
+        self.stages = stages
+        self.stage_id = stage_id
+        self.prev_stage = stage_id - 1
+        self.next_stage = stage_id + 1
+
+    def steps(self) -> Iterator[List[PipeInstruction]]:
+        raise NotImplementedError
+
+    def num_pipe_buffers(self) -> int:
+        return self.micro_batches
+
+    @property
+    def is_first_stage(self) -> bool:
+        return self.stage_id == 0
+
+    @property
+    def is_last_stage(self) -> bool:
+        return self.stage_id == self.stages - 1
+
+    def _valid_micro_batch(self, micro_batch_id: int) -> bool:
+        return 0 <= micro_batch_id < self.micro_batches
+
+    def _valid_stage(self, stage_id: int) -> bool:
+        return 0 <= stage_id < self.stages
+
+    def __iter__(self):
+        return self.steps()
+
+    def __len__(self) -> int:
+        return sum(1 for _ in self.steps())
+
+
+class InferenceSchedule(PipeSchedule):
+    """Reference schedule.py:135 — straight pipelined forward."""
+
+    def steps(self):
+        total_steps = self.micro_batches + self.stages - 1
+        for step_id in range(total_steps):
+            micro_batch_id = step_id - self.stage_id
+            cmds: List[PipeInstruction] = []
+            if self._valid_micro_batch(micro_batch_id):
+                if self.is_first_stage:
+                    cmds.append(LoadMicroBatch(buffer_id=micro_batch_id % self.num_pipe_buffers()))
+                else:
+                    cmds.append(RecvActivation(buffer_id=micro_batch_id % self.num_pipe_buffers()))
+                cmds.append(ForwardPass(buffer_id=micro_batch_id % self.num_pipe_buffers()))
+                if not self.is_last_stage:
+                    cmds.append(SendActivation(buffer_id=micro_batch_id % self.num_pipe_buffers()))
+            yield cmds
+
+    def num_pipe_buffers(self) -> int:
+        return 2
+
+
+class TrainSchedule(PipeSchedule):
+    """1F1B (reference schedule.py:189): early stages warm up with forwards,
+    then alternate 1 forward / 1 backward, then drain backwards; grads reduced
+    and optimizer stepped once all microbatches complete."""
+
+    def steps(self):
+        prev_micro_batch_id = -1
+        total_steps = 2 * (self.micro_batches + self.stages - 1)
+        for step_id in range(total_steps):
+            micro_batch_id, is_forward = self._step_to_micro_batch(step_id)
+            cmds: List[PipeInstruction] = []
+
+            # neighbor exchange — mirrors reference schedule.py TrainSchedule:
+            # forward step: send queued grad to prev stage, recv activation
+            # backward step: recv grad from next stage, send queued activation
+            if is_forward:
+                if (self._valid_micro_batch(prev_micro_batch_id)
+                        and self._valid_stage(self.prev_stage)):
+                    cmds.append(SendGrad(buffer_id=self._buffer_idx(prev_micro_batch_id)))
+                if self._valid_micro_batch(micro_batch_id):
+                    if self.is_first_stage:
+                        cmds.append(LoadMicroBatch(buffer_id=self._buffer_idx(micro_batch_id)))
+                    else:
+                        cmds.append(RecvActivation(buffer_id=self._buffer_idx(micro_batch_id)))
+            else:
+                if (self._valid_micro_batch(micro_batch_id)
+                        and self._valid_stage(self.next_stage)):
+                    cmds.append(RecvGrad(buffer_id=self._buffer_idx(micro_batch_id)))
+                if (self._valid_micro_batch(prev_micro_batch_id)
+                        and self._valid_stage(self.next_stage)):
+                    cmds.append(SendActivation(buffer_id=self._buffer_idx(prev_micro_batch_id)))
+
+            # compute
+            if self._valid_micro_batch(micro_batch_id):
+                cmds.append(ForwardPass(buffer_id=self._buffer_idx(micro_batch_id))
+                            if is_forward else
+                            BackwardPass(buffer_id=self._buffer_idx(micro_batch_id)))
+
+            # step boundary
+            if step_id == total_steps - 1:
+                cmds.append(ReduceTiedGrads())
+                cmds.append(ReduceGrads())
+                cmds.append(OptimizerStep())
+
+            prev_micro_batch_id = micro_batch_id
+            yield cmds
+
+    def _step_to_micro_batch(self, step_id: int):
+        """Maps interleaved step ids to (micro_batch, is_forward) — the core
+        1F1B index math (reference schedule.py:255-291)."""
+
+        def _even_step_forward_id(sid):
+            return sid // 2 - self.stage_id // 2
+
+        def _odd_step_forward_id(sid):
+            return (sid - 1) // 2 - self.stage_id // 2
+
+        def _even_step_backward_id(sid):
+            return sid // 2 - self.stages + (self.stage_id + 1) // 2
+
+        def _odd_step_backward_id(sid):
+            return (sid - 1) // 2 - self.stages + 1 + self.stage_id // 2
+
+        if _is_even(step_id) and _is_even(self.stage_id):
+            return _even_step_forward_id(step_id), True
+        if _is_odd(step_id) and _is_odd(self.stage_id):
+            return _odd_step_forward_id(step_id), True
+        if _is_even(step_id) and _is_odd(self.stage_id):
+            return _even_step_backward_id(step_id), False
+        return _odd_step_backward_id(step_id), False
+
+    def _buffer_idx(self, micro_batch_id: int) -> int:
+        assert self._valid_micro_batch(micro_batch_id)
+        return micro_batch_id % self.num_pipe_buffers()
+
+    def num_pipe_buffers(self) -> int:
+        """1F1B in-flight buffer bound (reference schedule.py:243): at most
+        stages - stage_id activations are live on a stage."""
+        buffers = min(self.stages - self.stage_id, self.micro_batches)
+        return max(2, buffers)
+
+
+def _is_even(x: int) -> bool:
+    return x % 2 == 0
+
+
+def _is_odd(x: int) -> bool:
+    return x % 2 != 0

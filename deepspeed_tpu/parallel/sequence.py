@@ -1,0 +1,90 @@
+"""Sequence/context parallelism (Ulysses-style) — first-class on TPU.
+
+Absent in reference v0.9.2 (SURVEY §2.4: no deepspeed/sequence/) but mandated
+as first-class here. The DeepSpeed-Ulysses scheme: tokens are sharded over the
+'seq' axis; around attention, an all-to-all re-shards from token-sharded to
+head-sharded (each device gets the FULL sequence for N/sp heads), attention
+runs locally, and the inverse all-to-all restores token sharding.
+
+In SPMD-jit we express this purely with sharding constraints — XLA lowers the
+reshard to exactly the head-scatter all-to-all Ulysses hand-codes:
+
+  hidden  (B, S, H):    P(data, seq, None)      tokens sharded
+  q/k/v   (B, S, N, D): P(data, None, ('seq','model'), None)
+                        sequence gathered, heads scattered
+  attn out -> back to   P(data, seq, None)
+
+Ring attention (blockwise P2P over 'seq' with ppermute) is the long-term
+long-context path; Ulysses covers seq lengths where one device holds S×H/sp.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .mesh import DATA_AXIS, MODEL_AXIS, SEQ_AXIS, get_mesh
+
+
+def _active_mesh():
+    try:
+        mesh = get_mesh()
+        if not mesh.shape:
+            return None
+        return mesh
+    except Exception:
+        return None
+
+
+def _in_manual_pipe() -> bool:
+    """True when tracing inside the pipeline's manual shard_map — sharding
+    constraints over auto axes there trip an XLA SPMD partitioner check
+    (spmd_partitioner_util.cc subgroup mismatch), so constraints are skipped
+    and layout is left to propagation."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    try:
+        lax.axis_index("pipe")
+        return True
+    except Exception:
+        return False
+
+
+def constrain(x: jax.Array, spec: P) -> jax.Array:
+    """Apply a sharding constraint if a mesh is active; no-op otherwise."""
+    mesh = _active_mesh()
+    if mesh is None or _in_manual_pipe():
+        return x
+    try:
+        return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+    except Exception:
+        return x
+
+
+def hidden_spec() -> P:
+    """(B, S, H) activations: batch over data, tokens over seq."""
+    return P(DATA_AXIS, SEQ_AXIS, None)
+
+
+def heads_spec(num_heads: int) -> Optional[P]:
+    """(B, S, N, D) around attention: full sequence, heads over seq×model.
+    None when the head count doesn't divide the axis product (constraint
+    would be invalid) — callers then skip the reshard."""
+    mesh = _active_mesh()
+    if mesh is None:
+        return None
+    sp = int(mesh.shape.get(SEQ_AXIS, 1))
+    tp = int(mesh.shape.get(MODEL_AXIS, 1))
+    if sp == 1 and tp == 1:
+        return None
+    if num_heads % max(sp * tp, 1) != 0:
+        return None
+    return P(DATA_AXIS, None, (SEQ_AXIS, MODEL_AXIS), None)
+
+
+def sequence_parallel_enabled() -> bool:
+    mesh = _active_mesh()
+    return mesh is not None and int(mesh.shape.get(SEQ_AXIS, 1)) > 1

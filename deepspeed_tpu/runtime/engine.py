@@ -54,11 +54,23 @@ class TrainEngine:
                  optimizer: Optional[MixedPrecisionOptimizer] = None,
                  lr_scheduler=None, training_data=None, collate_fn=None,
                  rng: Optional[jax.Array] = None):
+        pp = config.parallel.pipeline_parallel_size
+        if pp > 1 and config.zero_optimization.stage >= 2:
+            # same constraint as the reference (pipe/engine.py:56): pipeline
+            # composes with ZeRO-1 (sharded optimizer states) but not with
+            # sharded grads/params across the data axis
+            raise ValueError("pipeline parallelism supports ZeRO stage <= 1 "
+                             f"(got stage {config.zero_optimization.stage})")
+        if pp > 1 and not model.pipelined:
+            from ..parallel.pipeline import pipelinize_model
+
+            model = pipelinize_model(model, pp)
         self.model = model
         self.mesh = mesh if mesh is not None else mesh_mod.build_mesh(config.parallel)
         mesh_mod.set_mesh(self.mesh, config.parallel.expert_parallel_size)
-        dp_world = int(self.mesh.shape[mesh_mod.DATA_AXIS]) * int(
-            self.mesh.shape[mesh_mod.SEQ_AXIS])
+        # SP ranks share the batch (tokens are sharded, not samples) — only
+        # the data axis multiplies the batch (reference Ulysses semantics)
+        dp_world = int(self.mesh.shape[mesh_mod.DATA_AXIS])
         self.config = config.resolve_batch_sizes(dp_world)
         self._dp_world = dp_world
         configure_comms_logger(self.config.comms_logger, world_size=dp_world)
@@ -86,8 +98,28 @@ class TrainEngine:
         # ---- sharded state construction (zero.Init equivalent) ----------
         rng = rng if rng is not None else jax.random.PRNGKey(self.config.seed)
         param_shapes = jax.eval_shape(model.init, rng)
+        tp_rules = None
+        ep = self.config.parallel.expert_parallel_size
+        if ep > 1:
+            from ..models.core import DEFAULT_TP_RULES, EXPERT
+
+            # EP v1 constraint: experts shard over the FULL data axis (EP
+            # folded over DP). ep must equal dp and divide the expert count;
+            # sub-axis EP groups (ep < dp, reference groups.py:108) are a
+            # later refinement.
+            if ep != dp_world:
+                raise ValueError(
+                    f"expert_parallel_size={ep} must equal the data-parallel "
+                    f"degree ({dp_world}) in this version (experts shard over "
+                    "the full data axis)")
+            n_experts = getattr(model.config, "moe_num_experts", 0) if model.config else 0
+            if n_experts and n_experts % dp_world != 0:
+                raise ValueError(
+                    f"moe_num_experts={n_experts} must be divisible by the "
+                    f"data-parallel degree {dp_world} for expert parallelism")
+            tp_rules = {**DEFAULT_TP_RULES, EXPERT: mesh_mod.DATA_AXIS}
         self.plan: ZeroShardingPlan = build_sharding_plan(
-            self.config.zero_stage, param_shapes, model.axes,
+            self.config.zero_stage, param_shapes, model.axes, tp_rules=tp_rules,
             fsdp_min_size=self.config.zero_optimization.stage3_param_persistence_threshold
             if self.config.zero_stage >= 3 else 2 ** 11)
         self.param_shardings = as_named(self.plan.param_specs, self.mesh)
@@ -207,12 +239,17 @@ class TrainEngine:
                             is_leaf=lambda x: isinstance(x, P))
 
     def _batch_sharding(self, batch: Any, leading_gas: bool) -> Any:
+        sp = int(self.mesh.shape[mesh_mod.SEQ_AXIS])
+
         def spec(x):
             nd = np.ndim(x)
             axes: list = [None] * nd
             pos = 1 if leading_gas else 0
             if nd > pos:
                 axes[pos] = mesh_mod.DATA_AXIS
+            # token dim sharded over 'seq' when SP is on and divisible
+            if sp > 1 and nd > pos + 1 and np.shape(x)[pos + 1] % sp == 0:
+                axes[pos + 1] = mesh_mod.SEQ_AXIS
             return NamedSharding(self.mesh, P(*axes))
 
         return jax.tree.map(spec, batch)
@@ -228,11 +265,21 @@ class TrainEngine:
         prescale = self.config.prescale_gradients
         predivide = self.config.gradient_predivide_factor
 
+        pipelined = model.pipelined
+
         def micro_loss(params, mb, scale):
             loss = model.loss_fn(params, mb)
             return loss * scale / gas, loss
 
         grad_fn = jax.value_and_grad(micro_loss, has_aux=True)
+
+        def pipe_loss(params, batch, scale):
+            # pipelined loss_fn consumes the whole (M=gas, mb, ...) stack and
+            # averages over microbatches internally — no outer scan
+            loss = model.loss_fn(params, batch)
+            return loss * scale, loss
+
+        pipe_grad_fn = jax.value_and_grad(pipe_loss, has_aux=True)
 
         def train_step(params, opt_state, scaler_state, batch):
             scale = scaler_state.scale if fp16 else jnp.float32(1.0)
@@ -246,7 +293,11 @@ class TrainEngine:
 
             zero_grads = jax.tree.map(
                 lambda p: jnp.zeros(p.shape, jnp.float32), params)
-            if gas == 1:
+            if pipelined:
+                (_, loss), grads = pipe_grad_fn(params, batch, scale)
+                grads = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
+                losses = loss[None]
+            elif gas == 1:
                 squeeze = jax.tree.map(lambda x: x[0], batch)
                 grads, losses = one_micro(zero_grads, squeeze)
                 losses = losses[None]
@@ -360,6 +411,11 @@ class TrainEngine:
     def forward(self, batch: Any) -> jax.Array:
         """Compute microbatch loss; with backward() and step() this emulates
         the reference's three-call protocol. grads are computed at backward."""
+        if self.model.pipelined:
+            raise RuntimeError(
+                "the staged forward/backward/step protocol is not available for "
+                "pipelined models — use train_batch() (the reference has the "
+                "same restriction: PipelineEngine only exposes train_batch)")
         if self._compiled_micro is None:
             model, gas, fp16 = self.model, self.gradient_accumulation_steps(), self.fp16_enabled()
 
@@ -422,6 +478,10 @@ class TrainEngine:
 
     def eval_loss(self, batch: Any) -> jax.Array:
         self.mark_step_boundary()
+        if self.model.pipelined:
+            # the pipelined loss_fn needs an (M, mb, ...) stack; for a plain
+            # eval microbatch wrap it as a single-microbatch stack
+            batch = jax.tree.map(lambda x: x[None], batch)
         with self.mesh:
             return jax.jit(self.model.loss_fn)(self.params, batch)
 

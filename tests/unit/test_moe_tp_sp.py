@@ -1,0 +1,176 @@
+"""MoE / TP / SP tests — analogs of reference tests/unit/moe/test_moe.py,
+moe/test_moe_tp.py, and the (post-reference) Ulysses sequence-parallel tests."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models import create_model
+from deepspeed_tpu.parallel.moe import top1gating, top2gating, _capacity
+
+
+def _engine(preset="tiny", tp=1, sp=1, ep=1, zero=0, gas=1, **model_kw):
+    model = create_model(preset, **model_kw)
+    cfg = {"train_micro_batch_size_per_gpu": 4,
+           "gradient_accumulation_steps": gas,
+           "steps_per_print": 1000,
+           "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+           "zero_optimization": {"stage": zero},
+           "parallel": {"tensor_parallel_size": tp,
+                        "sequence_parallel_size": sp,
+                        "expert_parallel_size": ep}}
+    engine, *_ = deepspeed_tpu.initialize(model=model, config=cfg)
+    return engine
+
+
+def _token_batch(engine, seq=16, seed=0, vocab=256):
+    gas = engine.gradient_accumulation_steps()
+    gb = engine.train_batch_size() // gas
+    ids = jax.random.randint(jax.random.PRNGKey(seed), (gas, gb, seq), 0, vocab)
+    return {"input_ids": ids}
+
+
+class TestGating:
+    def test_capacity(self):
+        assert _capacity(64, 8, 1.0) == 8
+        assert _capacity(64, 8, 1.25) == 10
+        assert _capacity(4, 8, 1.0) == 4  # min_capacity floor
+
+    def test_top1_dispatch_conservation(self):
+        logits = jax.random.normal(jax.random.PRNGKey(0), (64, 8))
+        out = top1gating(logits, capacity_factor=2.0)
+        # each token dispatched to at most one (expert, slot)
+        per_token = out.dispatch.sum(axis=(1, 2))
+        assert (np.asarray(per_token) <= 1).all()
+        # with generous capacity nothing is dropped
+        assert float(per_token.sum()) == 64
+        # each (expert, slot) holds at most one token
+        per_slot = out.dispatch.sum(axis=0)
+        assert (np.asarray(per_slot) <= 1).all()
+
+    def test_top1_capacity_drops(self):
+        # all tokens prefer expert 0 -> capacity limits dispatch
+        logits = jnp.zeros((64, 8)).at[:, 0].set(10.0)
+        out = top1gating(logits, capacity_factor=1.0)
+        cap = _capacity(64, 8, 1.0)
+        assert float(out.dispatch.sum()) == cap
+        # aux loss is high when load is imbalanced
+        balanced = top1gating(jax.random.normal(jax.random.PRNGKey(0), (64, 8)))
+        assert float(out.aux_loss) > float(balanced.aux_loss)
+
+    def test_top2_two_experts_per_token(self):
+        logits = jax.random.normal(jax.random.PRNGKey(1), (64, 8))
+        out = top2gating(logits, capacity_factor=2.0)
+        per_token = out.dispatch.sum(axis=(1, 2))
+        assert (np.asarray(per_token) <= 2).all()
+        assert float(per_token.sum()) > 64  # most tokens get 2 experts
+        # combine weights normalized <= 1
+        tot = out.combine.sum(axis=(1, 2))
+        assert (np.asarray(tot) <= 1.0 + 1e-5).all()
+
+    def test_gate_values_match_softmax(self):
+        logits = jax.random.normal(jax.random.PRNGKey(2), (16, 4))
+        out = top1gating(logits, capacity_factor=4.0)
+        gates = jax.nn.softmax(logits, axis=-1)
+        picked = np.asarray(out.combine.sum(axis=(1, 2)))
+        expect = np.asarray(gates.max(axis=-1))
+        np.testing.assert_allclose(picked, expect, rtol=1e-5)
+
+
+class TestMoETraining:
+    def test_moe_model_trains(self):
+        engine = _engine("moe-tiny")
+        batch = _token_batch(engine)
+        losses = [float(engine.train_batch(batch=batch)) for _ in range(8)]
+        assert all(np.isfinite(losses))
+        assert losses[-1] < losses[0]
+
+    def test_moe_expert_parallel(self):
+        """EP over the 8-device data axis: experts sharded, training works."""
+        engine = _engine("moe-tiny", ep=8)
+        # expert weight leading dim sharded over data
+        spec = engine.plan.param_specs["layers"]["mlp"]["w_up"]
+        assert "data" in str(spec)
+        batch = _token_batch(engine)
+        losses = [float(engine.train_batch(batch=batch)) for _ in range(5)]
+        assert all(np.isfinite(losses))
+        assert losses[-1] < losses[0]
+
+    def test_moe_ep_matches_no_ep(self):
+        """EP is a layout change only: same loss trajectory as replicated."""
+        e1 = _engine("moe-tiny", ep=1)
+        e2 = _engine("moe-tiny", ep=8)
+        batch = _token_batch(e1)
+        l1 = [float(e1.train_batch(batch=batch)) for _ in range(3)]
+        l2 = [float(e2.train_batch(batch=batch)) for _ in range(3)]
+        np.testing.assert_allclose(l1, l2, rtol=1e-4)
+
+    def test_moe_with_zero2(self):
+        engine = _engine("moe-tiny", ep=8, zero=2)
+        batch = _token_batch(engine)
+        losses = [float(engine.train_batch(batch=batch)) for _ in range(3)]
+        assert all(np.isfinite(losses))
+
+
+class TestTensorParallel:
+    def test_tp_matches_single(self):
+        e1 = _engine("tiny", tp=1)
+        e2 = _engine("tiny", tp=2)
+        batch = _token_batch(e1)
+        l1 = [float(e1.train_batch(batch=batch)) for _ in range(3)]
+        l2 = [float(e2.train_batch(batch=batch)) for _ in range(3)]
+        np.testing.assert_allclose(l1, l2, rtol=1e-4)
+
+    def test_tp_param_layout(self):
+        e = _engine("tiny", tp=2)
+        wq_spec = e.plan.param_specs["layers"]["attn"]["wq"]
+        assert "model" in str(wq_spec)
+
+
+class TestSequenceParallel:
+    def test_sp_matches_single(self):
+        e1 = _engine("tiny", sp=1)
+        e2 = _engine("tiny", sp=2)
+        batch = _token_batch(e1)
+        l1 = [float(e1.train_batch(batch=batch)) for _ in range(3)]
+        l2 = [float(e2.train_batch(batch=batch)) for _ in range(3)]
+        np.testing.assert_allclose(l1, l2, rtol=1e-4)
+
+    def test_sp_tp_compose(self):
+        e1 = _engine("tiny")
+        e2 = _engine("tiny", sp=2, tp=2)
+        batch = _token_batch(e1)
+        l1 = [float(e1.train_batch(batch=batch)) for _ in range(3)]
+        l2 = [float(e2.train_batch(batch=batch)) for _ in range(3)]
+        np.testing.assert_allclose(l1, l2, rtol=1e-4)
+
+    def test_3d_zero1_pp_tp(self):
+        """3D composition: ZeRO-1 x PP x TP on the 8-device mesh (the
+        reference's supported combination — PP x ZeRO>=2 is asserted out
+        there too, pipe/engine.py:56)."""
+        model = create_model("tiny", num_layers=4)
+        cfg = {"train_micro_batch_size_per_gpu": 4,
+               "gradient_accumulation_steps": 2,
+               "steps_per_print": 1000,
+               "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+               "zero_optimization": {"stage": 1},
+               "parallel": {"pipeline_parallel_size": 2,
+                            "tensor_parallel_size": 2,
+                            "sequence_parallel_size": 1}}
+        engine, *_ = deepspeed_tpu.initialize(model=model, config=cfg)
+        batch = _token_batch(engine)
+        losses = [float(engine.train_batch(batch=batch)) for _ in range(3)]
+        assert all(np.isfinite(losses))
+        assert losses[-1] < losses[0]
+
+    def test_pp_zero2_rejected(self):
+        model = create_model("tiny", num_layers=4)
+        with pytest.raises(ValueError, match="ZeRO stage <= 1"):
+            deepspeed_tpu.initialize(
+                model=model,
+                config={"train_micro_batch_size_per_gpu": 2,
+                        "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+                        "zero_optimization": {"stage": 2},
+                        "parallel": {"pipeline_parallel_size": 2}})
