@@ -42,8 +42,21 @@ def main() -> None:
     from deepspeed_tpu.models import create_model
 
     batch, seq = int(os.environ.get("BENCH_BATCH", 32)), int(os.environ.get("BENCH_SEQ", 1024))
-    model = create_model("gpt2-125m", dtype=jnp.bfloat16, remat=True,
+    remat = os.environ.get("BENCH_REMAT", "1") == "1"
+    remat_policy = os.environ.get("BENCH_REMAT_POLICY", "dots")
+    unroll = int(os.environ.get("BENCH_UNROLL", 1))
+    model = create_model("gpt2-125m", dtype=jnp.bfloat16, remat=remat,
+                         remat_policy=remat_policy, scan_unroll=unroll,
                          max_seq_len=seq)
+
+    # the Pallas kernels must actually be the hot path on TPU (round-1 miss:
+    # kernels existed but the bench ran plain-jnp attention)
+    from deepspeed_tpu.models.transformer import active_attention_impl
+
+    if jax.default_backend() == "tpu":
+        impl = active_attention_impl(model.config)
+        assert impl == "flash_attention", (
+            f"expected Pallas flash attention on TPU, resolved '{impl}'")
     cfg = {
         "train_micro_batch_size_per_gpu": batch,
         "gradient_accumulation_steps": 1,
