@@ -1,0 +1,93 @@
+#!/usr/bin/env python
+"""Inference benchmark — TTFT + decode throughput (BASELINE tracked config #5,
+the driver's "DS-Inference p50 TTFT" metric).
+
+Prints ONE JSON line:
+    {"metric": ..., "value": <p50 TTFT ms>, "unit": "ms",
+     "decode_tokens_per_sec": ..., "roofline_frac": ..., "vs_baseline": ...}
+
+Decode is HBM-bandwidth-bound: the roofline is
+    BW / (param_bytes + live-KV bytes per token);
+``vs_baseline`` reports achieved/roofline — 1.0 == the chip's memory system
+is saturated (the analog of the reference's kernel-injected decode claim).
+
+Model: largest preset that fits the attached chip (env BENCH_INFER_MODEL to
+override; weights are random — zero-egress environment — which does not
+change the memory-bound timing).
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+HBM_BW = {  # bytes/s
+    "v5 lite": 819e9, "v5e": 819e9, "v5litepod": 819e9,
+    "v5p": 2765e9, "v4": 1228e9, "v6e": 1640e9, "v6 lite": 1640e9,
+}
+
+
+def hbm_bandwidth() -> float:
+    kind = jax.devices()[0].device_kind.lower()
+    for key, val in HBM_BW.items():
+        if key in kind:
+            return val
+    return 819e9
+
+
+def main() -> None:
+    from deepspeed_tpu.inference import init_inference
+
+    model_name = os.environ.get("BENCH_INFER_MODEL", "llama-7b")
+    prompt_len = int(os.environ.get("BENCH_INFER_PROMPT", 512))
+    n_new = int(os.environ.get("BENCH_INFER_NEW", 64))
+    arena = int(os.environ.get("BENCH_INFER_ARENA", 1024))
+
+    engine = init_inference(model_name, dtype=jnp.bfloat16, max_out_tokens=arena)
+    cfg = engine.model.config
+    rng = np.random.RandomState(0)
+    prompt = rng.randint(0, cfg.vocab_size, (1, prompt_len))
+
+    # warmup (compiles prefill + decode)
+    engine.generate(prompt, max_new_tokens=n_new)
+
+    ttfts = []
+    t_all = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        out, ttft = engine.generate(prompt, max_new_tokens=n_new,
+                                    return_ttft=True)
+        np.asarray(out)  # fence
+        t_all.append(time.perf_counter() - t0)
+        ttfts.append(ttft)
+    p50_ttft = sorted(ttfts)[len(ttfts) // 2]
+    p50_all = sorted(t_all)[len(t_all) // 2]
+    decode_tps = (n_new - 1) / (p50_all - p50_ttft)
+
+    param_bytes = sum(int(p.size) * p.dtype.itemsize
+                      for p in jax.tree.leaves(engine.params))
+    # live KV read per decode token (valid region ~ prompt + half the gen)
+    live = prompt_len + n_new // 2
+    kv_bytes = (2 * cfg.num_layers * live * cfg.num_kv_heads * cfg.head_dim
+                * jnp.dtype(jnp.bfloat16).itemsize)
+    roofline_tps = hbm_bandwidth() / (param_bytes + kv_bytes)
+    frac = decode_tps / roofline_tps
+
+    print(json.dumps({
+        "metric": f"{model_name}_bf16_p50_ttft_ms",
+        "value": round(p50_ttft * 1e3, 2),
+        "unit": "ms",
+        "decode_tokens_per_sec": round(decode_tps, 1),
+        "roofline_frac": round(frac, 4),
+        "vs_baseline": round(frac, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,12 @@
+"""Inference stack — TPU-native analog of the reference's
+``deepspeed/inference`` + ``module_inject`` + ``model_implementations``:
+
+  engine.py     InferenceEngine / init_inference (reference inference/engine.py:89)
+  kv_cache.py   preallocated KV-cache arena (reference csrc/transformer/
+                inference/includes/inference_context.h:49)
+  hf_import.py  HF-checkpoint import + TP sharding rules — the policy-free
+                auto-TP analog (reference module_inject/auto_tp.py)
+"""
+
+from .engine import InferenceConfig, InferenceEngine, init_inference  # noqa: F401
+from .kv_cache import cache_memory_bytes, init_cache  # noqa: F401

@@ -1,0 +1,298 @@
+"""InferenceEngine — analog of ``deepspeed.init_inference`` →
+``InferenceEngine`` (reference inference/engine.py:89, deepspeed/__init__.py:260).
+
+The reference engine rewrites an HF torch module in place (injection policies
+→ fused CUDA modules), builds an mp group, and manages a global KV workspace.
+Here the same capabilities are jit programs over a param pytree:
+
+  model rewrite     → family state-dict import (hf_import.py) + the platform
+                      kernel registry (flash/decode Pallas kernels resolve per
+                      backend — the "kernel inject" analog, zero surgery)
+  mp/tp group       → mesh 'model' axis; params sharded by logical-axis rules
+  KV workspace      → kv_cache.py arena pytree threaded through jit steps
+  CUDA-graph        → jit cache discipline: static shapes (prompt buckets,
+                      fixed arena), one compiled prefill + one decode program
+
+``generate`` = jitted prefill (the TTFT path) + ``lax.scan`` decode loop with
+greedy/temperature/top-k sampling, early-EOS masking.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models.core import Model, cast_floating, resolve_param_specs
+from ..models.presets import create_model
+from ..parallel import mesh as mesh_mod
+from ..utils.logging import log_dist, logger
+from . import kv_cache
+from .hf_import import import_hf_model, import_hf_state_dict, load_flat_weights_tree
+
+
+@dataclasses.dataclass
+class InferenceConfig:
+    """Reference DeepSpeedInferenceConfig (inference/config.py) surface,
+    TPU-shaped: bf16 is the native dtype (the reference explicitly rejects
+    bf16 — a CUDA-kernel limitation that does not apply here)."""
+
+    dtype: Any = jnp.bfloat16
+    tensor_parallel: int = 1           # tp_size
+    max_out_tokens: int = 1024         # KV arena length (prompt + generated)
+    replace_with_kernel_inject: bool = True   # platform Pallas kernels
+    checkpoint: Optional[str] = None   # flat-npz path (save_16bit_model output)
+    seed: int = 0
+
+
+def _bucket(n: int, mult: int = 64) -> int:
+    """Prompt-length bucket: bounds the number of distinct compiled prefill
+    programs (the reference's CUDA-graph shape discipline)."""
+    return max(mult, ((n + mult - 1) // mult) * mult)
+
+
+def _sample(logits, rng, temperature: float, top_k: int) -> jax.Array:
+    """Greedy / temperature / top-k sampling — the ONE sampling rule, used
+    for the first token and every decode step alike."""
+    logits = logits.astype(jnp.float32)
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits / temperature
+    if top_k > 0:
+        kth = jnp.sort(logits, axis=-1)[..., -top_k][..., None]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+
+
+class InferenceEngine:
+    """Owns sharded params + the KV arena + compiled prefill/decode programs."""
+
+    def __init__(self, model: Model, config: InferenceConfig,
+                 params: Optional[Any] = None, mesh: Optional[Mesh] = None):
+        self.model = model
+        self.config = config
+        if mesh is None:
+            from ..config.config import ParallelConfig
+
+            tp_req = max(1, config.tensor_parallel)
+            mesh = mesh_mod.build_mesh(
+                ParallelConfig(tensor_parallel_size=tp_req,
+                               data_parallel_size=1),
+                devices=jax.devices()[:tp_req])
+        self.mesh = mesh
+        tp = int(self.mesh.shape[mesh_mod.MODEL_AXIS])
+        cfg = model.config
+        if cfg is None:
+            raise ValueError("model.config is required for inference (the "
+                             "KV-cache arena is sized from it)")
+        if cfg.num_kv_heads % max(tp, 1) != 0:
+            raise ValueError(f"tensor_parallel={tp} must divide "
+                             f"num_kv_heads={cfg.num_kv_heads}")
+
+        # TP-only sharding plan (no fsdp axis — reference inference shards
+        # qkv/mlp across the mp group only, replicating the rest)
+        specs = resolve_param_specs(
+            jax.eval_shape(model.init, jax.random.PRNGKey(0)), model.axes)
+        self.param_shardings = jax.tree.map(
+            lambda s: NamedSharding(self.mesh, s), specs,
+            is_leaf=lambda x: isinstance(x, P))
+
+        if params is None:
+            with self.mesh:
+                params = jax.jit(
+                    lambda key: cast_floating(model.init(key), config.dtype),
+                    out_shardings=self.param_shardings)(
+                        jax.random.PRNGKey(config.seed))
+        else:
+            params = cast_floating(params, config.dtype)
+            params = jax.tree.map(
+                lambda x, s: jax.device_put(np.asarray(x), s),
+                params, self.param_shardings)
+        self.params = params
+
+        self._prefill_cache: Dict[Tuple, Any] = {}
+        self._decode_cache: Dict[Tuple, Any] = {}
+        self._fwd = None
+        n = sum(int(p.size) for p in jax.tree.leaves(self.params))
+        log_dist(f"inference engine ready: {n / 1e6:.1f}M params, tp={tp}, "
+                 f"dtype={jnp.dtype(config.dtype).name}, "
+                 f"arena={config.max_out_tokens} tokens "
+                 f"({kv_cache.cache_memory_bytes(cfg, 1, config.max_out_tokens, config.dtype) / 2**20:.0f}"
+                 f" MiB/seq)")
+
+    # -- plain forward (reference InferenceEngine.forward / module call) -----
+    def forward(self, input_ids, attention_mask=None):
+        """Full-sequence logits, no cache."""
+        if self._fwd is None:
+            self._fwd = jax.jit(lambda p, b: self.model.apply(p, b)[0])
+        batch = {"input_ids": jnp.asarray(input_ids)}
+        if attention_mask is not None:
+            batch["attention_mask"] = jnp.asarray(attention_mask)
+        with self.mesh:
+            return self._fwd(self.params, batch)
+
+    __call__ = forward
+
+    # -- generate ------------------------------------------------------------
+    def _prefill_fn(self, S_pad: int):
+        cfg = self.model.config
+        from ..models.transformer import forward as model_forward
+
+        def prefill(params, ids, mask, cache):
+            logits, cache, _ = model_forward(params, ids, cfg,
+                                             attention_mask=mask,
+                                             cache=cache, start_pos=0)
+            return logits, cache
+
+        return jax.jit(prefill, donate_argnums=(3,))
+
+    def _decode_fn(self, n_new: int, temperature: float, top_k: int,
+                   eos_token_id: Optional[int]):
+        cfg = self.model.config
+        T_max = self.config.max_out_tokens
+        from ..models.transformer import forward as model_forward
+
+        def decode(params, cache, valid, first_tok, rng):
+            def step(carry, rng):
+                cache, valid, tok, done = carry
+                idx = cache["index"][0]
+                # the incoming token becomes a valid key at position idx
+                valid = jax.lax.dynamic_update_slice(
+                    valid, jnp.ones((valid.shape[0], 1), valid.dtype), (0, idx))
+                logits, cache, _ = model_forward(
+                    params, tok[:, None], cfg,
+                    attention_mask=valid, cache=cache, start_pos=idx)
+                nxt = _sample(logits[:, -1], rng, temperature, top_k)
+                if eos_token_id is not None:
+                    nxt = jnp.where(done, eos_token_id, nxt)
+                    done = done | (nxt == eos_token_id)
+                return (cache, valid, nxt, done), nxt
+
+            done = jnp.zeros(first_tok.shape, bool)
+            rngs = jax.random.split(rng, n_new)
+            (cache, valid, _, _), toks = jax.lax.scan(
+                step, (cache, valid, first_tok, done), rngs)
+            return jnp.moveaxis(toks, 0, 1), cache  # (B, n_new)
+
+        return jax.jit(decode, donate_argnums=(1,))
+
+    def generate(self, input_ids, attention_mask=None, max_new_tokens: int = 32,
+                 temperature: float = 0.0, top_k: int = 0,
+                 eos_token_id: Optional[int] = None, seed: int = 0,
+                 return_ttft: bool = False):
+        """Prompt ids (B, S) → generated ids (B, max_new_tokens).
+
+        Ragged prompts: pass ``attention_mask`` (B, S); prompts are treated as
+        right-padded. Decoded tokens take positions S, S+1, ... (S = prompt
+        array width) — exact for full-width prompts; shorter rows in a ragged
+        batch see HF-right-padding position semantics.
+        ``return_ttft``: also return wall seconds to first token (prefill)."""
+        cfg = self.model.config
+        ids = jnp.asarray(np.asarray(input_ids), jnp.int32)
+        B, S = ids.shape
+        S_pad = _bucket(S)
+        T_max = self.config.max_out_tokens
+        if S_pad + max_new_tokens > T_max:
+            raise ValueError(
+                f"prompt ({S_pad} padded) + max_new_tokens ({max_new_tokens}) "
+                f"exceeds max_out_tokens={T_max} — raise InferenceConfig."
+                f"max_out_tokens (the reference raises the same in "
+                f"inference_context.h workspace sizing)")
+        mask = (jnp.ones((B, S), jnp.int32) if attention_mask is None
+                else jnp.asarray(np.asarray(attention_mask), jnp.int32))
+        ids_pad = jnp.pad(ids, ((0, 0), (0, S_pad - S)))
+        # valid-key mask over the whole arena, prompt part filled
+        valid = jnp.zeros((B, T_max), jnp.int32)
+        valid = valid.at[:, :S].set(mask)
+
+        key_p = (B, S_pad)
+        if key_p not in self._prefill_cache:
+            self._prefill_cache[key_p] = self._prefill_fn(S_pad)
+        n_rest = max_new_tokens - 1
+        key_d = (B, n_rest, float(temperature), int(top_k), eos_token_id)
+        if n_rest > 0 and key_d not in self._decode_cache:
+            self._decode_cache[key_d] = self._decode_fn(
+                n_rest, temperature, top_k, eos_token_id)
+
+        with self.mesh:
+            cache = kv_cache.init_cache(cfg, B, T_max, self.config.dtype)
+            t0 = time.perf_counter()
+            logits, cache = self._prefill_cache[key_p](
+                self.params, ids_pad, valid, cache)
+            # rewind the write cursor from the padded to the true prompt
+            # length: decoded tokens must take positions S, S+1, ... — the
+            # junk keys prefill wrote in the padding slots stay masked and
+            # get overwritten as decoding proceeds
+            cache = {**cache, "index": jnp.full_like(cache["index"], S)}
+            lengths = mask.sum(-1)
+            last = jnp.take_along_axis(
+                logits, (lengths - 1)[:, None, None], axis=1)[:, 0]
+            rng, r_first = jax.random.split(jax.random.PRNGKey(seed))
+            first = _sample(last, r_first, temperature, top_k)
+            first = jax.block_until_ready(first)
+            ttft = time.perf_counter() - t0
+            if n_rest == 0:
+                out = first[:, None]
+            else:
+                rest, cache = self._decode_cache[key_d](
+                    self.params, cache, valid, first, rng)
+                out = jnp.concatenate([first[:, None], rest], axis=1)
+        return (out, ttft) if return_ttft else out
+
+
+# ---------------------------------------------------------------------------
+
+
+def init_inference(model=None, config=None, tensor_parallel: Optional[int] = None,
+                   dtype=None, max_out_tokens: Optional[int] = None,
+                   checkpoint: Optional[str] = None, hf_model=None,
+                   hf_state_dict=None, mesh: Optional[Mesh] = None,
+                   replace_with_kernel_inject: bool = True,
+                   **model_overrides) -> InferenceEngine:
+    """Analog of ``deepspeed.init_inference`` (reference __init__.py:260).
+
+    ``model``: a ``Model`` bundle or a preset name (e.g. "bloom-7b",
+    "llama-7b" — the per-architecture injection-policy registry analog).
+    Weights: ``hf_model`` / ``hf_state_dict`` (HF import + TP sharding =
+    auto-TP), ``checkpoint`` (flat npz from save_16bit_model), else random.
+    """
+    if isinstance(config, dict):
+        config = InferenceConfig(**config)
+    cfg = config or InferenceConfig()
+    if tensor_parallel is not None:
+        cfg.tensor_parallel = int(tensor_parallel)
+    if dtype is not None:
+        cfg.dtype = dtype
+    if max_out_tokens is not None:
+        cfg.max_out_tokens = int(max_out_tokens)
+    cfg.replace_with_kernel_inject = replace_with_kernel_inject
+    if checkpoint is not None:
+        cfg.checkpoint = checkpoint
+
+    family = None
+    if isinstance(model, str):
+        from ..models.presets import _SIZES
+
+        family = (_SIZES[model]["family"] if model in _SIZES else model)
+        model = create_model(model, dtype=cfg.dtype,
+                             max_seq_len=max(cfg.max_out_tokens, 128),
+                             **model_overrides)
+    if model is None:
+        raise ValueError("model is required: a Model bundle or preset name")
+
+    params = None
+    if hf_model is not None:
+        params = import_hf_model(hf_model, model.config,
+                                 family or model.name)
+    elif hf_state_dict is not None:
+        params = import_hf_state_dict(hf_state_dict, model.config,
+                                      family or model.name)
+    elif cfg.checkpoint is not None:
+        params = load_flat_weights_tree(cfg.checkpoint)
+    return InferenceEngine(model, cfg, params=params, mesh=mesh)

@@ -1,0 +1,225 @@
+"""HuggingFace checkpoint import — the policy-free auto-TP analog.
+
+The reference maps HF architectures onto its fused inference modules through
+per-architecture injection policies (module_inject/containers/{gpt2,opt,
+bloom,llama...}.py, TransformerPolicy extracting qkv/mlp/LN tensors) and
+shards them with ReplaceWithTensorSlicing (module_inject/replace_module.py:28)
+/ AutoTP (module_inject/auto_tp.py). Here the same knowledge is a pure
+state-dict → param-pytree mapping per family; TP sharding then falls out of
+the logical-axis tree (models/core.py) — no weight surgery, `device_put` with
+a NamedSharding slices each host array straight onto the mesh.
+
+Weight-layout facts encoded below (checked against the reference containers):
+  gpt2   Conv1D stores (in, out); c_attn is fused qkv along out.
+  opt    torch Linear (out, in) → transpose; positions offset by 2.
+  llama  Linear (out, in) → transpose; no biases; SwiGLU gate/up/down.
+  bloom  fused query_key_value rows interleaved (head, [q|k|v], head_dim)
+         (containers/bloom.py qkv ordering); ALiBi + embedding LayerNorm.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+
+def _t(w) -> np.ndarray:
+    return np.ascontiguousarray(np.asarray(w).T)
+
+
+def _a(w) -> np.ndarray:
+    return np.asarray(w)
+
+
+def _stack(layers):
+    """list of per-layer trees → tree of (L, ...) stacked arrays."""
+    import jax
+
+    return jax.tree.map(lambda *xs: np.stack(xs), *layers)
+
+
+def import_hf_state_dict(state_dict: Dict[str, Any], cfg, family: str
+                         ) -> Dict[str, Any]:
+    """HF ``state_dict`` (tensors or numpy) → deepspeed_tpu param pytree
+    (numpy, fp32/fp16 as stored — caller casts/shards)."""
+    sd = {k: np.asarray(getattr(v, "numpy", lambda: v)()
+                        if hasattr(v, "numpy") else v)
+          for k, v in state_dict.items()}
+    fam = family.split("-")[0]
+    mapper = {
+        "gpt2": _import_gpt2,
+        "opt": _import_opt,
+        "llama": _import_llama,
+        "mistral": _import_llama,
+        "bloom": _import_bloom,
+    }.get(fam)
+    if mapper is None:
+        raise ValueError(f"no HF import mapping for family '{family}' "
+                         "(have: gpt2, opt, llama, mistral, bloom)")
+    return mapper(sd, cfg)
+
+
+def _import_gpt2(sd, cfg):
+    H = cfg.hidden_size
+    layers = []
+    for i in range(cfg.num_layers):
+        p = f"transformer.h.{i}."
+        qkv_w = _a(sd[p + "attn.c_attn.weight"])        # (H, 3H) Conv1D
+        qkv_b = _a(sd[p + "attn.c_attn.bias"])          # (3H,)
+        layers.append({
+            "ln1": {"scale": _a(sd[p + "ln_1.weight"]),
+                    "bias": _a(sd[p + "ln_1.bias"])},
+            "ln2": {"scale": _a(sd[p + "ln_2.weight"]),
+                    "bias": _a(sd[p + "ln_2.bias"])},
+            "attn": {
+                "wq": qkv_w[:, :H], "wk": qkv_w[:, H:2 * H], "wv": qkv_w[:, 2 * H:],
+                "bq": qkv_b[:H], "bk": qkv_b[H:2 * H], "bv": qkv_b[2 * H:],
+                "wo": _a(sd[p + "attn.c_proj.weight"]),
+                "bo": _a(sd[p + "attn.c_proj.bias"]),
+            },
+            "mlp": {
+                "w_up": _a(sd[p + "mlp.c_fc.weight"]),
+                "b_up": _a(sd[p + "mlp.c_fc.bias"]),
+                "w_down": _a(sd[p + "mlp.c_proj.weight"]),
+                "b_down": _a(sd[p + "mlp.c_proj.bias"]),
+            },
+        })
+    return {
+        "embed": {"tokens": _a(sd["transformer.wte.weight"])},
+        "pos": _a(sd["transformer.wpe.weight"]),
+        "layers": _stack(layers),
+        "final_norm": {"scale": _a(sd["transformer.ln_f.weight"]),
+                       "bias": _a(sd["transformer.ln_f.bias"])},
+    }
+
+
+def _import_opt(sd, cfg):
+    pre = "model.decoder."
+    layers = []
+    for i in range(cfg.num_layers):
+        p = f"{pre}layers.{i}."
+        layers.append({
+            "ln1": {"scale": _a(sd[p + "self_attn_layer_norm.weight"]),
+                    "bias": _a(sd[p + "self_attn_layer_norm.bias"])},
+            "ln2": {"scale": _a(sd[p + "final_layer_norm.weight"]),
+                    "bias": _a(sd[p + "final_layer_norm.bias"])},
+            "attn": {
+                "wq": _t(sd[p + "self_attn.q_proj.weight"]),
+                "wk": _t(sd[p + "self_attn.k_proj.weight"]),
+                "wv": _t(sd[p + "self_attn.v_proj.weight"]),
+                "bq": _a(sd[p + "self_attn.q_proj.bias"]),
+                "bk": _a(sd[p + "self_attn.k_proj.bias"]),
+                "bv": _a(sd[p + "self_attn.v_proj.bias"]),
+                "wo": _t(sd[p + "self_attn.out_proj.weight"]),
+                "bo": _a(sd[p + "self_attn.out_proj.bias"]),
+            },
+            "mlp": {
+                "w_up": _t(sd[p + "fc1.weight"]),
+                "b_up": _a(sd[p + "fc1.bias"]),
+                "w_down": _t(sd[p + "fc2.weight"]),
+                "b_down": _a(sd[p + "fc2.bias"]),
+            },
+        })
+    return {
+        "embed": {"tokens": _a(sd[pre + "embed_tokens.weight"])},
+        # OPT's learned positions are stored with a +2 offset
+        # (reference containers/opt.py relies on HF applying it)
+        "pos": _a(sd[pre + "embed_positions.weight"])[2:],
+        "layers": _stack(layers),
+        "final_norm": {"scale": _a(sd[pre + "final_layer_norm.weight"]),
+                       "bias": _a(sd[pre + "final_layer_norm.bias"])},
+    }
+
+
+def _import_llama(sd, cfg):
+    layers = []
+    for i in range(cfg.num_layers):
+        p = f"model.layers.{i}."
+        layers.append({
+            "ln1": {"scale": _a(sd[p + "input_layernorm.weight"])},
+            "ln2": {"scale": _a(sd[p + "post_attention_layernorm.weight"])},
+            "attn": {
+                "wq": _t(sd[p + "self_attn.q_proj.weight"]),
+                "wk": _t(sd[p + "self_attn.k_proj.weight"]),
+                "wv": _t(sd[p + "self_attn.v_proj.weight"]),
+                "wo": _t(sd[p + "self_attn.o_proj.weight"]),
+            },
+            "mlp": {
+                "w_gate": _t(sd[p + "mlp.gate_proj.weight"]),
+                "w_up": _t(sd[p + "mlp.up_proj.weight"]),
+                "w_down": _t(sd[p + "mlp.down_proj.weight"]),
+            },
+        })
+    return {
+        "embed": {"tokens": _a(sd["model.embed_tokens.weight"])},
+        "layers": _stack(layers),
+        "final_norm": {"scale": _a(sd["model.norm.weight"])},
+        "lm_head": _t(sd["lm_head.weight"]),
+    }
+
+
+def _import_bloom(sd, cfg):
+    H, N, D = cfg.hidden_size, cfg.num_heads, cfg.head_dim
+    pre = "transformer."
+    layers = []
+    for i in range(cfg.num_layers):
+        p = f"{pre}h.{i}."
+        # fused qkv with (head, 3, head_dim) row interleave
+        # (reference containers/bloom.py / HF BloomAttention layout)
+        qkv_w = _a(sd[p + "self_attention.query_key_value.weight"])  # (3H, H)
+        qkv_b = _a(sd[p + "self_attention.query_key_value.bias"])    # (3H,)
+        w = qkv_w.reshape(N, 3, D, H)
+        b = qkv_b.reshape(N, 3, D)
+        wq = np.ascontiguousarray(w[:, 0].reshape(N * D, H).T)
+        wk = np.ascontiguousarray(w[:, 1].reshape(N * D, H).T)
+        wv = np.ascontiguousarray(w[:, 2].reshape(N * D, H).T)
+        layers.append({
+            "ln1": {"scale": _a(sd[p + "input_layernorm.weight"]),
+                    "bias": _a(sd[p + "input_layernorm.bias"])},
+            "ln2": {"scale": _a(sd[p + "post_attention_layernorm.weight"]),
+                    "bias": _a(sd[p + "post_attention_layernorm.bias"])},
+            "attn": {
+                "wq": wq, "wk": wk, "wv": wv,
+                "bq": b[:, 0].reshape(-1), "bk": b[:, 1].reshape(-1),
+                "bv": b[:, 2].reshape(-1),
+                "wo": _t(sd[p + "self_attention.dense.weight"]),
+                "bo": _a(sd[p + "self_attention.dense.bias"]),
+            },
+            "mlp": {
+                "w_up": _t(sd[p + "mlp.dense_h_to_4h.weight"]),
+                "b_up": _a(sd[p + "mlp.dense_h_to_4h.bias"]),
+                "w_down": _t(sd[p + "mlp.dense_4h_to_h.weight"]),
+                "b_down": _a(sd[p + "mlp.dense_4h_to_h.bias"]),
+            },
+        })
+    return {
+        "embed": {"tokens": _a(sd[pre + "word_embeddings.weight"])},
+        "embed_norm": {"scale": _a(sd[pre + "word_embeddings_layernorm.weight"]),
+                       "bias": _a(sd[pre + "word_embeddings_layernorm.bias"])},
+        "layers": _stack(layers),
+        "final_norm": {"scale": _a(sd[pre + "ln_f.weight"]),
+                       "bias": _a(sd[pre + "ln_f.bias"])},
+    }
+
+
+def import_hf_model(hf_model, cfg, family: str) -> Dict[str, Any]:
+    """Import directly from a live transformers model object."""
+    sd = {k: v.detach().to("cpu").float().numpy()
+          for k, v in hf_model.state_dict().items()}
+    return import_hf_state_dict(sd, cfg, family)
+
+
+def load_flat_weights_tree(path: str) -> Dict[str, Any]:
+    """Load a ``save_flat_weights``/``save_16bit_model`` npz (written by
+    runtime/checkpoint.py) back into a nested param pytree."""
+    from ..runtime.checkpoint import _SEP, load_flat_weights
+
+    tree: Dict[str, Any] = {}
+    for key, arr in load_flat_weights(path).items():
+        parts = key.split(_SEP)
+        node = tree
+        for part in parts[:-1]:
+            node = node.setdefault(part, {})
+        node[parts[-1]] = arr
+    return tree

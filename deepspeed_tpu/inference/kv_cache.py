@@ -1,0 +1,61 @@
+"""KV-cache arena: preallocated per-layer key/value buffers.
+
+TPU-native analog of the reference's ``InferenceContext`` workspace
+(csrc/transformer/inference/includes/inference_context.h:49) which sizes one
+global GPU arena from ``max_out_tokens`` and hands each layer a slice, and of
+the per-layer ``layer_past`` tracking in
+model_implementations/transformers/ds_transformer.py:86.
+
+Here the arena is a pytree of stacked per-layer buffers, shaped to scan with
+the stacked layer params (models/transformer.py forward):
+
+    {"k": (L, B, T_max, KV_HEADS, HEAD_DIM),
+     "v": (L, B, T_max, KV_HEADS, HEAD_DIM),
+     "index": (L,) int32}              # write cursor per layer (all equal)
+
+Static T_max keeps every decode step the same XLA program (the reference's
+CUDA-graph discipline becomes jit-cache discipline); tokens are written with
+``lax.dynamic_update_slice`` at the cursor.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+
+def init_cache(cfg, batch_size: int, max_seq_len: int, dtype=jnp.bfloat16
+               ) -> Dict[str, jax.Array]:
+    """Allocate the arena for ``cfg`` (a TransformerConfig)."""
+    L = cfg.num_layers
+    K = cfg.num_kv_heads
+    D = cfg.head_dim
+    shape = (L, batch_size, max_seq_len, K, D)
+    return {
+        "k": jnp.zeros(shape, dtype),
+        "v": jnp.zeros(shape, dtype),
+        "index": jnp.zeros((L,), jnp.int32),
+    }
+
+
+def cache_memory_bytes(cfg, batch_size: int, max_seq_len: int,
+                       dtype=jnp.bfloat16) -> int:
+    """Arena footprint — the sizing arithmetic the reference does in
+    InferenceContext::GenWorkSpace (inference_context.h:121)."""
+    itemsize = jnp.dtype(dtype).itemsize
+    return (2 * cfg.num_layers * batch_size * max_seq_len
+            * cfg.num_kv_heads * cfg.head_dim * itemsize)
+
+
+def cache_shape_struct(cfg, batch_size: int, max_seq_len: int,
+                       dtype=jnp.bfloat16) -> Dict[str, Any]:
+    """eval_shape-compatible structure (for AOT sharding planning)."""
+    L, K, D = cfg.num_layers, cfg.num_kv_heads, cfg.head_dim
+    shape = (L, batch_size, max_seq_len, K, D)
+    return {
+        "k": jax.ShapeDtypeStruct(shape, dtype),
+        "v": jax.ShapeDtypeStruct(shape, dtype),
+        "index": jax.ShapeDtypeStruct((cfg.num_layers,), jnp.int32),
+    }

@@ -15,18 +15,18 @@ from .transformer import TransformerConfig, build_model
 _FAMILIES: Dict[str, Dict[str, Any]] = {
     "gpt2": dict(norm="layernorm", position="learned", activation="gelu",
                  tie_embeddings=True),
-    "opt": dict(norm="layernorm", position="learned", activation="gelu",
+    "opt": dict(norm="layernorm", position="learned", activation="relu",
                 tie_embeddings=True),
-    "bloom": dict(norm="layernorm", position="learned", activation="gelu",
-                  tie_embeddings=True),
+    "bloom": dict(norm="layernorm", position="alibi", activation="gelu",
+                  tie_embeddings=True, embed_norm=True),
     "gptj": dict(norm="layernorm", position="rope", activation="gelu",
                  tie_embeddings=False),
     "gptneox": dict(norm="layernorm", position="rope", activation="gelu",
                     tie_embeddings=False),
     "llama": dict(norm="rmsnorm", position="rope", activation="swiglu",
-                  tie_embeddings=False),
+                  tie_embeddings=False, norm_eps=1e-6),
     "mistral": dict(norm="rmsnorm", position="rope", activation="swiglu",
-                    tie_embeddings=False),
+                    tie_embeddings=False, norm_eps=1e-6),
 }
 
 # size presets: hidden, layers, heads, kv_heads, vocab, max_seq
@@ -55,6 +55,10 @@ _SIZES: Dict[str, Dict[str, Any]] = {
     "tiny-llama": dict(family="llama", hidden_size=64, num_layers=2, num_heads=4,
                        num_kv_heads=2, vocab_size=256, max_seq_len=128,
                        ffn_hidden_size=128),
+    "tiny-opt": dict(family="opt", hidden_size=64, num_layers=2, num_heads=4,
+                     vocab_size=256, max_seq_len=128),
+    "tiny-bloom": dict(family="bloom", hidden_size=64, num_layers=2, num_heads=4,
+                       vocab_size=256, max_seq_len=128),
     # GShard/Switch-style 8-expert GPT (BASELINE tracked config #4)
     "moe-tiny": dict(family="gpt2", hidden_size=64, num_layers=2, num_heads=4,
                      vocab_size=256, max_seq_len=128, moe_num_experts=8),

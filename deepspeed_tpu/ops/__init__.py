@@ -1,6 +1,7 @@
 """deepspeed_tpu.ops — Pallas kernels + registry (reference: deepspeed/ops,
 op_builder/, csrc/)."""
 
+from .decode_attention import decode_attention, reference_decode_attention
 from .flash_attention import flash_attention, make_attention_impl
 from .fused_adam import fused_adam_flat, reference_adam_flat
 from .normalization import fused_layer_norm, reference_layer_norm
@@ -18,6 +19,9 @@ register_op("fused_layer_norm", fused_layer_norm, reference=reference_layer_norm
 register_op("quantize_symmetric", quantize_symmetric,
             reference=reference_quantize_symmetric,
             description="int8/int4 group quantization")
+register_op("decode_attention", decode_attention,
+            reference=reference_decode_attention,
+            description="single-query KV-cache decode attention (GQA, alibi)")
 
 
 def _ref_attn(q, k, v, mask=None, causal=True, **_):
@@ -27,6 +31,7 @@ def _ref_attn(q, k, v, mask=None, causal=True, **_):
 
 
 __all__ = [
+    "decode_attention", "reference_decode_attention",
     "flash_attention", "make_attention_impl", "fused_adam_flat",
     "reference_adam_flat", "fused_layer_norm", "reference_layer_norm",
     "quantize_symmetric", "dequantize_symmetric", "fake_quantize",

@@ -1,0 +1,156 @@
+"""Pallas decode attention: single-query attention over the KV-cache arena.
+
+TPU-native analog of the reference's inference ``softmax_context`` op
+(csrc/transformer/inference/csrc/pt_binding.cpp attention path + softmax.cu,
+incl. its alibi variant) — the memory-bandwidth-bound op of autoregressive
+decoding: each step streams the whole cache once.
+
+Design points (vs the training flash kernel):
+  * GQA-native — KV heads are NOT expanded; each KV head's block is read once
+    and shared by its G = N/K query heads (the reference expands per-head —
+    on TPU that would multiply the only thing that matters here, HBM reads).
+  * cache layout (B, T, K, D) is consumed directly (no per-step transpose).
+  * per-head matmuls are tiny (G×D @ D×bt); that is fine — the op is
+    bandwidth-bound, the MXU is not the limiter.
+  * key-validity mask (B, T) doubles as the causal mask: the engine marks
+    exactly the written cache slots valid.
+  * optional ALiBi slopes (key-position-linear bias; the query term is
+    softmax-shift-invariant).
+
+jnp reference implementation is below (also GQA-native) — parity oracle and
+CPU fallback.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+LANES = 128
+
+
+def _kernel(q_ref, k_ref, v_ref, valid_ref, alibi_ref, o_ref,
+            acc, m_scr, l_scr, *, scale: float, bt: int,
+            n_heads: int, kv_heads: int, has_alibi: bool):
+    jt = pl.program_id(1)
+    njt = pl.num_programs(1)
+    G = n_heads // kv_heads
+    D = q_ref.shape[-1]
+
+    @pl.when(jt == 0)
+    def _init():
+        acc[:] = jnp.zeros_like(acc)
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+
+    q = q_ref[0].astype(jnp.float32) * scale          # (N, D)
+    k = k_ref[0].astype(jnp.float32)                  # (bt, K, D)
+    v = v_ref[0].astype(jnp.float32)                  # (bt, K, D)
+
+    # s[n, t] per KV-head group: (G, D) @ (D, bt) — statically unrolled over
+    # the (small) KV-head count
+    parts = []
+    for kh in range(kv_heads):
+        qg = q[kh * G:(kh + 1) * G]                    # (G, D) static slice
+        s_kh = jax.lax.dot_general(qg, k[:, kh, :], (((1,), (1,)), ((), ())),
+                                   preferred_element_type=jnp.float32)
+        parts.append(s_kh)                             # (G, bt)
+    s = jnp.concatenate(parts, axis=0)                 # (N, bt)
+
+    col = jax.lax.broadcasted_iota(jnp.int32, (n_heads, bt), 1) + jt * bt
+    if has_alibi:
+        s = s + alibi_ref[0][:, None] * col.astype(jnp.float32)
+    mask = (valid_ref[0, 0] != 0)[None, :]             # (1, bt)
+    s = jnp.where(mask, s, NEG_INF)
+
+    m_prev = m_scr[:, :1]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+    p = jnp.exp(s - m_new)                             # (N, bt)
+    corr = jnp.exp(m_prev - m_new)
+    l_scr[:] = jnp.broadcast_to(corr * l_scr[:, :1]
+                                + jnp.sum(p, axis=1, keepdims=True), l_scr.shape)
+    outs = []
+    for kh in range(kv_heads):
+        pg = p[kh * G:(kh + 1) * G]                    # (G, bt) static slice
+        outs.append(jax.lax.dot_general(pg, v[:, kh, :], (((1,), (0,)), ((), ())),
+                                        preferred_element_type=jnp.float32))
+    acc[:] = acc[:] * corr + jnp.concatenate(outs, axis=0)        # (N, D)
+    m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+
+    @pl.when(jt == njt - 1)
+    def _finalize():
+        l = l_scr[:, :1]
+        safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc[:] / safe).astype(o_ref.dtype)
+
+
+def decode_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
+                     valid: jax.Array, alibi: Optional[jax.Array] = None,
+                     scale: Optional[float] = None,
+                     interpret: bool = False) -> jax.Array:
+    """q (B, N, D) — one new token; k/v_cache (B, T, K, D); valid (B, T)
+    marks live cache slots (causal + padding in one mask). Returns (B, N, D).
+    T must be a multiple of 128 (the arena is sized that way)."""
+    B, N, D = q.shape
+    T, K = k_cache.shape[1], k_cache.shape[2]
+    if T % LANES != 0:
+        raise ValueError(f"cache length {T} must be a multiple of {LANES}")
+    # bt must divide T exactly (grid = T//bt) — largest power-of-two divisor
+    bt = next(b for b in (512, 256, 128) if T % b == 0)
+    scale = scale if scale is not None else D ** -0.5
+    has_alibi = alibi is not None
+    alibi_arr = (alibi.astype(jnp.float32).reshape(1, N) if has_alibi
+                 else jnp.zeros((1, N), jnp.float32))
+    valid3 = valid.astype(jnp.float32)[:, None, :]     # (B, 1, T)
+
+    kernel = functools.partial(_kernel, scale=scale, bt=bt, n_heads=N,
+                               kv_heads=K, has_alibi=has_alibi)
+    out = pl.pallas_call(
+        kernel,
+        grid=(B, T // bt),
+        in_specs=[
+            pl.BlockSpec((1, N, D), lambda b, t: (b, 0, 0)),
+            pl.BlockSpec((1, bt, K, D), lambda b, t: (b, t, 0, 0)),
+            pl.BlockSpec((1, bt, K, D), lambda b, t: (b, t, 0, 0)),
+            pl.BlockSpec((1, 1, bt), lambda b, t: (b, 0, t)),
+            pl.BlockSpec((1, N), lambda b, t: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, N, D), lambda b, t: (b, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, N, D), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((N, D), jnp.float32),
+            pltpu.VMEM((N, LANES), jnp.float32),
+            pltpu.VMEM((N, LANES), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=interpret,
+    )(q, k_cache, v_cache, valid3, alibi_arr)
+    return out
+
+
+def reference_decode_attention(q: jax.Array, k_cache: jax.Array,
+                               v_cache: jax.Array, valid: jax.Array,
+                               alibi: Optional[jax.Array] = None,
+                               scale: Optional[float] = None) -> jax.Array:
+    """GQA-native jnp oracle (no KV expansion: batched over KV heads)."""
+    B, N, D = q.shape
+    T, K = k_cache.shape[1], k_cache.shape[2]
+    G = N // K
+    scale = scale if scale is not None else D ** -0.5
+    q4 = (q * scale).reshape(B, K, G, D)
+    s = jnp.einsum("bkgd,btkd->bkgt", q4.astype(jnp.float32),
+                   k_cache.astype(jnp.float32))        # (B, K, G, T)
+    if alibi is not None:
+        al = alibi.astype(jnp.float32).reshape(K, G)
+        s = s + al[None, :, :, None] * jnp.arange(T, dtype=jnp.float32)
+    s = jnp.where((valid != 0)[:, None, None, :], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bkgt,btkd->bkgd", p, v_cache.astype(jnp.float32))
+    return o.reshape(B, N, D).astype(q.dtype)

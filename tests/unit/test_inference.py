@@ -1,0 +1,330 @@
+"""Inference stack tests — analog of reference tests/unit/inference/
+test_inference.py (HF model × dtype matrix) and the KV-cache/generate
+correctness checks the CUDA kernels get via ds_attention tests.
+
+Key oracles:
+  * generate() greedy == naive no-cache argmax loop (KV-cache correctness)
+  * our forward == HuggingFace torch forward after state-dict import
+    (the injection-policy/auto-TP parity check, per family)
+  * tp=2 == tp=1 generation on the virtual mesh
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.inference import init_inference
+from deepspeed_tpu.models import create_model
+
+
+def naive_greedy(model, params, prompt, n_new):
+    """Oracle: recompute the full forward for every generated token."""
+    ids = jnp.asarray(prompt, jnp.int32)
+    out = []
+    for _ in range(n_new):
+        logits, _ = model.apply(params, {"input_ids": ids})
+        nxt = jnp.argmax(logits[:, -1].astype(jnp.float32), -1).astype(jnp.int32)
+        out.append(nxt)
+        ids = jnp.concatenate([ids, nxt[:, None]], axis=1)
+    return jnp.stack(out, axis=1)
+
+
+@pytest.mark.parametrize("preset", ["tiny", "tiny-llama", "tiny-bloom", "tiny-opt"])
+def test_cache_logits_match_full_forward(preset):
+    """Teacher-forced KV-cache correctness: prefill + per-token decode steps
+    must reproduce the full-forward logits at every position."""
+    from deepspeed_tpu.inference import kv_cache
+    from deepspeed_tpu.models.transformer import forward
+
+    engine = init_inference(preset, dtype=jnp.float32, max_out_tokens=128)
+    cfg = engine.model.config
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(0, 250, size=(2, 20)), jnp.int32)
+    S_prompt = 12
+
+    full, _, _ = forward(engine.params, ids, cfg)
+    cache = kv_cache.init_cache(cfg, 2, 128, jnp.float32)
+    valid = jnp.zeros((2, 128), jnp.int32).at[:, :S_prompt].set(1)
+    lg, cache, _ = forward(engine.params, ids[:, :S_prompt], cfg,
+                           attention_mask=valid, cache=cache, start_pos=0)
+    np.testing.assert_allclose(np.asarray(lg), np.asarray(full[:, :S_prompt]),
+                               atol=1e-4, rtol=1e-4)
+    for pos in range(S_prompt, 20):
+        valid = valid.at[:, pos].set(1)
+        lg, cache, _ = forward(engine.params, ids[:, pos:pos + 1], cfg,
+                               attention_mask=valid, cache=cache,
+                               start_pos=pos)
+        np.testing.assert_allclose(np.asarray(lg[:, 0]),
+                                   np.asarray(full[:, pos]),
+                                   atol=1e-4, rtol=1e-4,
+                                   err_msg=f"decode step at pos {pos}")
+
+
+def test_generate_matches_naive_loop():
+    """Greedy generate == naive full-recompute loop. Token mismatches are
+    accepted only at genuine fp32 near-ties (top-2 gap < 1e-4), after which
+    the prefixes legitimately diverge and comparison stops."""
+    engine = init_inference("tiny", dtype=jnp.float32, max_out_tokens=128)
+    rng = np.random.RandomState(0)
+    prompt = rng.randint(0, 250, size=(2, 12))
+    n_new = 8
+    got = np.asarray(engine.generate(prompt, max_new_tokens=n_new))
+    for b in range(prompt.shape[0]):
+        ids = jnp.asarray(prompt[b:b + 1], jnp.int32)
+        for i in range(n_new):
+            logits, _ = engine.model.apply(engine.params, {"input_ids": ids})
+            row = np.asarray(logits[0, -1], np.float32)
+            best = int(row.argmax())
+            if got[b, i] != best:
+                top2 = np.sort(row)[-2:]
+                assert top2[1] - row[got[b, i]] < 1e-4, (
+                    f"batch {b} step {i}: got {got[b, i]} want {best} "
+                    f"(gap {top2[1] - row[got[b, i]]:.2e} — not a tie)")
+                break
+            ids = jnp.concatenate([ids, jnp.asarray([[best]], jnp.int32)], 1)
+
+
+def test_generate_positions_not_bucket_shifted():
+    """Decoded tokens must take positions from the TRUE prompt length, not
+    the compile bucket (regression: prompt 12 bucketed to 64 gave the first
+    generated token position 64). Amplified position embeddings make any
+    offset flip the argmax."""
+    engine = init_inference("tiny", dtype=jnp.float32, max_out_tokens=128)
+    engine.params = dict(engine.params)
+    engine.params["pos"] = engine.params["pos"] * 50.0
+    prompt = np.random.RandomState(7).randint(0, 250, (1, 12))
+    got = np.asarray(engine.generate(prompt, max_new_tokens=5))
+    ids = jnp.asarray(prompt, jnp.int32)
+    want = []
+    for _ in range(5):
+        logits, _ = engine.model.apply(engine.params, {"input_ids": ids})
+        nxt = jnp.argmax(logits[:, -1].astype(jnp.float32), -1).astype(jnp.int32)
+        want.append(int(nxt[0]))
+        ids = jnp.concatenate([ids, nxt[:, None]], 1)
+    np.testing.assert_array_equal(got[0], want)
+
+
+def test_generate_ragged_prompts_right_padded():
+    engine = init_inference("tiny", dtype=jnp.float32, max_out_tokens=128)
+    rng = np.random.RandomState(1)
+    full = rng.randint(0, 250, size=(2, 10))
+    mask = np.ones((2, 10), np.int32)
+    mask[1, 6:] = 0  # second prompt is 6 tokens long
+    got = engine.generate(full, attention_mask=mask, max_new_tokens=4)
+    # row 1 must match generating from the unpadded 6-token prompt, provided
+    # positions agree: re-run with the short prompt right-padded the same way
+    short = engine.generate(full[1:2, :10] * mask[1:2],
+                            attention_mask=mask[1:2], max_new_tokens=4)
+    np.testing.assert_array_equal(np.asarray(got[1:2]), np.asarray(short))
+
+
+def test_generate_eos_stops():
+    engine = init_inference("tiny", dtype=jnp.float32, max_out_tokens=128)
+    prompt = np.arange(8)[None]
+    toks = engine.generate(prompt, max_new_tokens=12, eos_token_id=None)
+    # pick the first generated token as a fake EOS — regenerate with it
+    eos = int(np.asarray(toks)[0, 0])
+    toks2 = np.asarray(engine.generate(prompt, max_new_tokens=12,
+                                       eos_token_id=eos))
+    hit = np.where(toks2[0] == eos)[0]
+    assert hit.size > 0
+    # after the first EOS everything is EOS
+    assert (toks2[0, hit[0]:] == eos).all()
+
+
+def test_generate_temperature_reproducible():
+    engine = init_inference("tiny", dtype=jnp.float32, max_out_tokens=128)
+    prompt = np.arange(8)[None]
+    a = engine.generate(prompt, max_new_tokens=6, temperature=0.8, top_k=20, seed=3)
+    b = engine.generate(prompt, max_new_tokens=6, temperature=0.8, top_k=20, seed=3)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert np.asarray(a).shape == (1, 6)
+
+
+def test_ttft_reported():
+    engine = init_inference("tiny", dtype=jnp.float32, max_out_tokens=128)
+    out, ttft = engine.generate(np.arange(8)[None], max_new_tokens=2,
+                                return_ttft=True)
+    assert ttft > 0.0
+    assert np.asarray(out).shape == (1, 2)
+
+
+def test_tensor_parallel_generation_matches(devices8):
+    prompt = np.arange(10)[None]
+    e1 = init_inference("tiny-llama", dtype=jnp.float32, max_out_tokens=128)
+    t1 = e1.generate(prompt, max_new_tokens=6)
+    from deepspeed_tpu.parallel import mesh as mesh_mod
+
+    mesh_mod.reset_mesh()
+    e2 = init_inference("tiny-llama", dtype=jnp.float32, max_out_tokens=128,
+                        tensor_parallel=2)
+    # same weights: re-shard e1's params onto e2's mesh
+    e2.params = jax.tree.map(
+        lambda x, s: jax.device_put(np.asarray(x), s), e1.params,
+        e2.param_shardings)
+    t2 = e2.generate(prompt, max_new_tokens=6)
+    np.testing.assert_array_equal(np.asarray(t1), np.asarray(t2))
+
+
+@pytest.mark.parametrize("preset", ["tiny", "tiny-llama"])
+def test_kernel_prefill_decode_branches(preset, monkeypatch):
+    """Drive the Pallas prefill/decode cache branches on CPU via interpret
+    mode (on TPU they are the default; CPU normally takes the jnp path)."""
+    import deepspeed_tpu.models.transformer as T
+    from deepspeed_tpu.inference import kv_cache
+    from deepspeed_tpu.models.transformer import forward
+
+    engine = init_inference(preset, dtype=jnp.float32, max_out_tokens=128)
+    cfg = engine.model.config
+    full_ref, _, _ = forward(engine.params,
+                             jnp.asarray(np.arange(20)[None] % 250, jnp.int32),
+                             cfg)
+
+    import importlib
+
+    fa = importlib.import_module("deepspeed_tpu.ops.flash_attention")
+    da = importlib.import_module("deepspeed_tpu.ops.decode_attention")
+    monkeypatch.setattr(T, "_kernels_active", lambda: True)
+    monkeypatch.setattr(T, "default_attention_impl",
+                        lambda: fa.make_attention_impl(interpret=True))
+    monkeypatch.setattr(da, "decode_attention",
+                        lambda *a, **k: _DA_ORIG(*a, **{**k, "interpret": True}))
+    nrm = importlib.import_module("deepspeed_tpu.ops.normalization")
+    monkeypatch.setattr(nrm, "fused_layer_norm",
+                        lambda x, s, b, eps=1e-5, rms=False: _FLN_ORIG(
+                            x, s, b, eps, rms, True))
+
+    ids = jnp.asarray(np.arange(20)[None] % 250, jnp.int32)
+    cache = kv_cache.init_cache(cfg, 1, 128, jnp.float32)
+    valid = jnp.zeros((1, 128), jnp.int32).at[:, :12].set(1)
+    lg, cache, _ = forward(engine.params, ids[:, :12], cfg,
+                           attention_mask=valid, cache=cache, start_pos=0)
+    np.testing.assert_allclose(np.asarray(lg[:, :12]),
+                               np.asarray(full_ref[:, :12]),
+                               atol=1e-3, rtol=1e-3)
+    for pos in range(12, 16):
+        valid = valid.at[:, pos].set(1)
+        lg, cache, _ = forward(engine.params, ids[:, pos:pos + 1], cfg,
+                               attention_mask=valid, cache=cache,
+                               start_pos=pos)
+        np.testing.assert_allclose(np.asarray(lg[:, 0]),
+                                   np.asarray(full_ref[:, pos]),
+                                   atol=1e-3, rtol=1e-3,
+                                   err_msg=f"kernel decode at pos {pos}")
+
+
+# original kernel entries, captured before any monkeypatching
+from deepspeed_tpu.ops.decode_attention import decode_attention as _DA_ORIG  # noqa: E402
+from deepspeed_tpu.ops.normalization import fused_layer_norm as _FLN_ORIG  # noqa: E402
+
+
+# ---------------------------------------------------------------------------
+# HF parity (the reference's per-architecture container/policy correctness)
+# ---------------------------------------------------------------------------
+
+
+def _hf_logits(hf_model, ids):
+    import torch
+
+    with torch.no_grad():
+        return hf_model(torch.tensor(ids)).logits.float().numpy()
+
+
+def _ours_logits(preset, hf_model, ids):
+    engine = init_inference(preset, dtype=jnp.float32, max_out_tokens=128,
+                            hf_model=hf_model)
+    return np.asarray(engine.forward(ids))
+
+
+def test_hf_import_gpt2():
+    transformers = pytest.importorskip("transformers")
+    __import__("torch").manual_seed(10)
+    cfg = transformers.GPT2Config(
+        vocab_size=256, n_positions=128, n_embd=64, n_layer=2, n_head=4,
+        attn_pdrop=0.0, embd_pdrop=0.0, resid_pdrop=0.0)
+    hf = transformers.GPT2LMHeadModel(cfg).eval()
+    ids = np.random.RandomState(0).randint(0, 256, (2, 16))
+    np.testing.assert_allclose(_ours_logits("tiny", hf, ids),
+                               _hf_logits(hf, ids), atol=2e-3, rtol=2e-3)
+
+
+def test_hf_import_llama():
+    transformers = pytest.importorskip("transformers")
+    __import__("torch").manual_seed(11)
+    cfg = transformers.LlamaConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128, attention_dropout=0.0)
+    hf = transformers.LlamaForCausalLM(cfg).eval()
+    ids = np.random.RandomState(1).randint(0, 256, (2, 16))
+    np.testing.assert_allclose(_ours_logits("tiny-llama", hf, ids),
+                               _hf_logits(hf, ids), atol=2e-3, rtol=2e-3)
+
+
+def test_hf_import_opt():
+    transformers = pytest.importorskip("transformers")
+    __import__("torch").manual_seed(12)
+    cfg = transformers.OPTConfig(
+        vocab_size=256, hidden_size=64, ffn_dim=256, num_hidden_layers=2,
+        num_attention_heads=4, max_position_embeddings=128,
+        word_embed_proj_dim=64, do_layer_norm_before=True, dropout=0.0)
+    hf = transformers.OPTForCausalLM(cfg).eval()
+    ids = np.random.RandomState(2).randint(0, 256, (2, 16))
+    np.testing.assert_allclose(_ours_logits("tiny-opt", hf, ids),
+                               _hf_logits(hf, ids), atol=2e-3, rtol=2e-3)
+
+
+def test_hf_import_bloom():
+    transformers = pytest.importorskip("transformers")
+    __import__("torch").manual_seed(13)
+    cfg = transformers.BloomConfig(
+        vocab_size=256, hidden_size=64, n_layer=2, n_head=4,
+        attention_dropout=0.0, hidden_dropout=0.0)
+    hf = transformers.BloomForCausalLM(cfg).eval()
+    ids = np.random.RandomState(3).randint(0, 256, (2, 16))
+    np.testing.assert_allclose(_ours_logits("tiny-bloom", hf, ids),
+                               _hf_logits(hf, ids), atol=2e-3, rtol=2e-3)
+
+
+def test_hf_import_generate_end_to_end():
+    transformers = pytest.importorskip("transformers")
+    __import__("torch").manual_seed(14)
+    cfg = transformers.GPT2Config(
+        vocab_size=256, n_positions=128, n_embd=64, n_layer=2, n_head=4,
+        attn_pdrop=0.0, embd_pdrop=0.0, resid_pdrop=0.0)
+    hf = transformers.GPT2LMHeadModel(cfg).eval()
+    engine = init_inference("tiny", dtype=jnp.float32, max_out_tokens=128,
+                            hf_model=hf)
+    prompt = np.random.RandomState(4).randint(0, 256, (1, 8))
+    ours = np.asarray(engine.generate(prompt, max_new_tokens=6))
+
+    import torch
+
+    with torch.no_grad():
+        hf_out = hf.generate(torch.tensor(prompt), max_new_tokens=6,
+                             do_sample=False, pad_token_id=0)
+    np.testing.assert_array_equal(ours[0], hf_out[0, 8:].numpy())
+
+
+def test_checkpoint_roundtrip_into_inference(tmp_path):
+    """save_16bit_model output loads into init_inference (reference
+    checkpoint-sharded load path, test_checkpoint_sharding.py analog)."""
+    model = create_model("tiny", dtype=jnp.float32)
+    engine, *_ = deepspeed_tpu.initialize(
+        model=model,
+        config={"train_micro_batch_size_per_gpu": 2,
+                "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+                "zero_optimization": {"stage": 0}})
+    path = engine.save_16bit_model(str(tmp_path), "weights.npz")
+    from deepspeed_tpu.parallel import mesh as mesh_mod
+
+    mesh_mod.reset_mesh()
+    inf = init_inference("tiny", dtype=jnp.float32, max_out_tokens=128,
+                         checkpoint=path)
+    ids = np.arange(8)[None]
+    got = np.asarray(inf.forward(ids))
+    want = np.asarray(jax.jit(lambda p, b: model.apply(p, b)[0])(
+        engine.params, {"input_ids": jnp.asarray(ids)}))
+    np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-5)
