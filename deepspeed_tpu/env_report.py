@@ -1,0 +1,50 @@
+"""`ds-tpu-report` — environment/compatibility report.
+
+Analog of reference ``env_report.py:113`` (`ds_report` CLI): prints the
+op-kernel installed/compatible matrix (here: the Pallas registry's
+platform-probe table) plus platform/device/version info.
+"""
+
+from __future__ import annotations
+
+import sys
+
+
+def get_report() -> str:
+    import jax
+    import jaxlib
+
+    from . import __version__
+    from .ops import op_report
+
+    lines = ["-" * 76,
+             "DeepSpeed-TPU op compatibility report",
+             "-" * 76,
+             op_report(),
+             "-" * 76]
+    try:
+        devices = jax.devices()
+        backend = jax.default_backend()
+        dev_desc = f"{len(devices)} x {devices[0].device_kind}" if devices else "none"
+    except Exception as exc:  # no accelerator / bad env — still report versions
+        backend = f"unavailable ({exc})"
+        dev_desc = "unavailable"
+    lines += [
+        f"{'deepspeed_tpu version':<28}{__version__}",
+        f"{'jax version':<28}{jax.__version__}",
+        f"{'jaxlib version':<28}{jaxlib.__version__}",
+        f"{'python version':<28}{sys.version.split()[0]}",
+        f"{'default backend':<28}{backend}",
+        f"{'devices':<28}{dev_desc}",
+        "-" * 76,
+    ]
+    return "\n".join(lines)
+
+
+def cli_main() -> int:
+    print(get_report())
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(cli_main())

@@ -1,0 +1,15 @@
+"""Launcher/CLI layer — analog of ``deepspeed/launcher`` + ``bin/``.
+
+  runner.py      `deepspeed-tpu` CLI: resource discovery + top-level dispatch
+                 (reference launcher/runner.py:377)
+  launch.py      per-node process spawner with env rendezvous injection
+                 (reference launcher/launch.py:216)
+  multinode.py   PDSH/SSH command builders (reference multinode_runner.py:18)
+
+TPU difference that shapes the design: one JAX process drives ALL local chips,
+so the spawner defaults to one process per host (not per accelerator); the
+``--num_procs`` knob exists for CPU-mesh testing and explicit multi-process
+layouts.
+"""
+
+from .runner import fetch_hostfile, main  # noqa: F401

@@ -1,0 +1,126 @@
+"""Per-node launcher: spawn the user script N times with rendezvous env.
+
+Analog of reference ``launcher/launch.py:216``: decodes ``--world_info``
+(base64 JSON {hostname: num_procs}), computes this node's global ranks,
+spawns one subprocess per local rank with MASTER_ADDR/MASTER_PORT/RANK/
+LOCAL_RANK/WORLD_SIZE injected (the env contract ``comm.init_distributed``
+reads), forwards signals, and propagates the first non-zero exit code
+(terminate_process_tree semantics, reference launch.py:119).
+"""
+
+from __future__ import annotations
+
+import argparse
+import base64
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import Dict, List
+
+from ..utils.logging import logger
+
+
+def decode_world_info(encoded: str) -> Dict[str, int]:
+    return json.loads(base64.urlsafe_b64decode(encoded).decode())
+
+
+def encode_world_info(world_info: Dict[str, int]) -> str:
+    return base64.urlsafe_b64encode(json.dumps(world_info).encode()).decode()
+
+
+def build_rank_env(world_info: Dict[str, int], node_name: str,
+                   master_addr: str, master_port: int) -> List[Dict[str, str]]:
+    """One env dict per local process on ``node_name``."""
+    hosts = list(world_info.keys())
+    if node_name not in world_info:
+        raise ValueError(f"node '{node_name}' not in world_info {hosts}")
+    world_size = sum(world_info.values())
+    rank_offset = 0
+    for h in hosts:
+        if h == node_name:
+            break
+        rank_offset += world_info[h]
+    envs = []
+    for local_rank in range(world_info[node_name]):
+        envs.append({
+            "RANK": str(rank_offset + local_rank),
+            "LOCAL_RANK": str(local_rank),
+            "WORLD_SIZE": str(world_size),
+            "MASTER_ADDR": master_addr,
+            "MASTER_PORT": str(master_port),
+        })
+    return envs
+
+
+def main(args=None) -> int:
+    parser = argparse.ArgumentParser(description="deepspeed-tpu per-node launcher")
+    parser.add_argument("--world_info", required=True,
+                        help="base64 JSON {hostname: num_procs}")
+    parser.add_argument("--node_name", default=None)
+    parser.add_argument("--master_addr", default="127.0.0.1")
+    parser.add_argument("--master_port", type=int, default=29500)
+    parser.add_argument("--cpu_devices_per_proc", type=int, default=0,
+                        help="force N virtual CPU devices per process (testing)")
+    parser.add_argument("training_script")
+    parser.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    opts = parser.parse_args(args)
+
+    world_info = decode_world_info(opts.world_info)
+    node_name = opts.node_name or os.environ.get("DSTPU_NODE_NAME") or \
+        next(iter(world_info))
+    rank_envs = build_rank_env(world_info, node_name,
+                               opts.master_addr, opts.master_port)
+
+    procs: List[subprocess.Popen] = []
+    for env_add in rank_envs:
+        env = dict(os.environ)
+        env.update(env_add)
+        if opts.cpu_devices_per_proc:
+            env["JAX_PLATFORMS"] = "cpu"
+            flags = env.get("XLA_FLAGS", "")
+            env["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_"
+                                f"count={opts.cpu_devices_per_proc}")
+        cmd = [sys.executable, opts.training_script] + opts.training_script_args
+        logger.info(f"launch rank {env_add['RANK']}/{env_add['WORLD_SIZE']}: "
+                    f"{' '.join(cmd)}")
+        procs.append(subprocess.Popen(cmd, env=env))
+
+    def _terminate(signum=None, frame=None):
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        deadline = time.time() + 10
+        for p in procs:
+            try:
+                p.wait(timeout=max(0.1, deadline - time.time()))
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+    signal.signal(signal.SIGTERM, _terminate)
+    signal.signal(signal.SIGINT, _terminate)
+
+    exit_code = 0
+    try:
+        alive = list(procs)
+        while alive:
+            for p in list(alive):
+                rc = p.poll()
+                if rc is None:
+                    continue
+                alive.remove(p)
+                if rc != 0 and exit_code == 0:
+                    exit_code = rc
+                    logger.error(f"rank process {p.pid} exited rc={rc}; "
+                                 "terminating remaining ranks")
+                    _terminate()
+            time.sleep(0.2)
+    finally:
+        _terminate()
+    return exit_code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,157 @@
+"""`deepspeed-tpu` CLI — resource discovery + dispatch.
+
+Analog of reference ``launcher/runner.py:377``:
+
+  * hostfile parsing ("host slots=N", :189) with localhost fallback
+  * TPU-pod env discovery (TPU_WORKER_HOSTNAMES/TPU_WORKER_ID — the GKE/TPU-VM
+    equivalent of the reference's CUDA_VISIBLE_DEVICES slot logic)
+  * single node: exec the per-node spawner in-process
+  * multi node: PDSH/SSH fan-out of `python -m deepspeed_tpu.launcher.launch`
+    with env + world-info injection
+
+Spawned processes rendezvous through comm.init_distributed's env contract
+(MASTER_ADDR/MASTER_PORT/RANK/WORLD_SIZE → jax.distributed.initialize).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+from typing import Dict, List, Optional
+
+from ..utils.logging import logger
+from .launch import encode_world_info
+from .multinode import get_runner
+
+
+def fetch_hostfile(path: Optional[str]) -> Dict[str, int]:
+    """Parse "hostname slots=N" lines (reference runner.py:189). Empty/missing
+    → TPU-pod env, else localhost."""
+    if path and os.path.exists(path):
+        hosts: Dict[str, int] = {}
+        with open(path) as fh:
+            for line in fh:
+                line = line.split("#")[0].strip()
+                if not line:
+                    continue
+                parts = line.split()
+                host = parts[0]
+                slots = 1
+                for p in parts[1:]:
+                    if p.startswith("slots="):
+                        slots = int(p.split("=", 1)[1])
+                if slots < 1:
+                    raise ValueError(f"hostfile {path}: bad slots for {host}")
+                if host in hosts:
+                    raise ValueError(f"hostfile {path}: duplicate host {host}")
+                hosts[host] = slots
+        if not hosts:
+            raise ValueError(f"hostfile {path} is empty")
+        return hosts
+    pod_hosts = os.environ.get("TPU_WORKER_HOSTNAMES")
+    if pod_hosts:
+        return {h.strip(): 1 for h in pod_hosts.split(",") if h.strip()}
+    return {"localhost": 1}
+
+
+def parse_args(argv=None):
+    parser = argparse.ArgumentParser(
+        description="deepspeed-tpu launcher",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    parser.add_argument("-H", "--hostfile", default=None,
+                        help='file of "host slots=N" lines')
+    parser.add_argument("--include", default=None,
+                        help="comma list of hosts to keep")
+    parser.add_argument("--exclude", default=None,
+                        help="comma list of hosts to drop")
+    parser.add_argument("--num_nodes", type=int, default=-1,
+                        help="limit to first N hosts")
+    parser.add_argument("--num_procs", type=int, default=0,
+                        help="processes per node (0 = one per node, the TPU "
+                             "default: one JAX process drives all local chips)")
+    parser.add_argument("--master_addr", default=None)
+    parser.add_argument("--master_port", type=int, default=29500)
+    parser.add_argument("--launcher", default="pdsh", choices=["pdsh", "ssh"])
+    parser.add_argument("--cpu_devices_per_proc", type=int, default=0,
+                        help="virtual CPU devices per process (testing)")
+    parser.add_argument("--force_multi", action="store_true",
+                        help="use the multinode path even for one host")
+    parser.add_argument("training_script")
+    parser.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return parser.parse_args(argv)
+
+
+def filter_hosts(hosts: Dict[str, int], include: Optional[str],
+                 exclude: Optional[str], num_nodes: int) -> Dict[str, int]:
+    out = dict(hosts)
+    if include:
+        keep = {h.strip() for h in include.split(",")}
+        missing = keep - set(out)
+        if missing:
+            raise ValueError(f"--include hosts not in hostfile: {sorted(missing)}")
+        out = {h: s for h, s in out.items() if h in keep}
+    if exclude:
+        drop = {h.strip() for h in exclude.split(",")}
+        out = {h: s for h, s in out.items() if h not in drop}
+    if num_nodes > 0:
+        out = dict(list(out.items())[:num_nodes])
+    if not out:
+        raise ValueError("no hosts left after include/exclude filtering")
+    return out
+
+
+def build_node_cmd(args, world_info: Dict[str, int], master_addr: str) -> List[str]:
+    cmd = [sys.executable, "-m", "deepspeed_tpu.launcher.launch",
+           "--world_info", encode_world_info(world_info),
+           "--master_addr", master_addr,
+           "--master_port", str(args.master_port)]
+    if args.cpu_devices_per_proc:
+        cmd += ["--cpu_devices_per_proc", str(args.cpu_devices_per_proc)]
+    cmd += [args.training_script] + args.training_script_args
+    return cmd
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+    hosts = filter_hosts(fetch_hostfile(args.hostfile), args.include,
+                         args.exclude, args.num_nodes)
+    # hostfile slots are the per-host default; --num_procs overrides globally
+    world_info = {h: (args.num_procs or slots) for h, slots in hosts.items()}
+    master_addr = args.master_addr or next(iter(hosts))
+    if master_addr == "localhost":
+        master_addr = "127.0.0.1"
+
+    multi = args.force_multi or len(hosts) > 1
+    node_cmd = build_node_cmd(args, world_info, master_addr)
+    if not multi:
+        # single node — run the spawner in-process (reference runner.py:476)
+        from . import launch
+
+        node = next(iter(world_info))
+        spawner_args = ["--world_info", encode_world_info(world_info),
+                        "--node_name", node,
+                        "--master_addr", master_addr,
+                        "--master_port", str(args.master_port)]
+        if args.cpu_devices_per_proc:
+            spawner_args += ["--cpu_devices_per_proc",
+                             str(args.cpu_devices_per_proc)]
+        return launch.main(spawner_args + [args.training_script]
+                           + args.training_script_args)
+
+    runner = get_runner(args.launcher)
+    if not runner.backend_exists():
+        raise RuntimeError(f"launcher backend '{args.launcher}' not found on PATH")
+    cmds = runner.get_cmd(list(hosts), {h: node_cmd for h in hosts})
+    logger.info(f"multinode launch over {len(hosts)} hosts via {runner.name}")
+    procs = [subprocess.Popen(c) for c in cmds]
+    rc = 0
+    for p in procs:
+        p.wait()
+        rc = rc or p.returncode
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,153 @@
+"""Launcher tests — mirror of reference tests/unit/launcher/
+(test_ds_arguments.py, test_multinode_runner.py: generated-command
+assertions, no cluster needed) plus a real 2-process local smoke test
+(the DistributedExec pattern driven through the actual CLI)."""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from deepspeed_tpu.launcher.launch import (build_rank_env, decode_world_info,
+                                           encode_world_info)
+from deepspeed_tpu.launcher.multinode import PDSHRunner, SSHRunner
+from deepspeed_tpu.launcher.runner import (build_node_cmd, fetch_hostfile,
+                                           filter_hosts, parse_args)
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+class TestHostfile:
+    def test_parse(self, tmp_path):
+        hf = tmp_path / "hostfile"
+        hf.write_text("worker-0 slots=4\nworker-1 slots=4\n# comment\n\n")
+        assert fetch_hostfile(str(hf)) == {"worker-0": 4, "worker-1": 4}
+
+    def test_duplicate_host_rejected(self, tmp_path):
+        hf = tmp_path / "hostfile"
+        hf.write_text("w0 slots=2\nw0 slots=2\n")
+        with pytest.raises(ValueError, match="duplicate"):
+            fetch_hostfile(str(hf))
+
+    def test_localhost_fallback(self):
+        env = os.environ.pop("TPU_WORKER_HOSTNAMES", None)
+        try:
+            assert fetch_hostfile(None) == {"localhost": 1}
+        finally:
+            if env is not None:
+                os.environ["TPU_WORKER_HOSTNAMES"] = env
+
+    def test_tpu_pod_env(self):
+        os.environ["TPU_WORKER_HOSTNAMES"] = "t0,t1,t2,t3"
+        try:
+            assert fetch_hostfile(None) == {"t0": 1, "t1": 1, "t2": 1, "t3": 1}
+        finally:
+            del os.environ["TPU_WORKER_HOSTNAMES"]
+
+    def test_filters(self):
+        hosts = {"a": 1, "b": 1, "c": 1}
+        assert filter_hosts(hosts, "a,b", None, -1) == {"a": 1, "b": 1}
+        assert filter_hosts(hosts, None, "b", -1) == {"a": 1, "c": 1}
+        assert filter_hosts(hosts, None, None, 2) == {"a": 1, "b": 1}
+        with pytest.raises(ValueError):
+            filter_hosts(hosts, "zzz", None, -1)
+
+
+class TestWorldInfo:
+    def test_roundtrip(self):
+        wi = {"worker-0": 2, "worker-1": 2}
+        assert decode_world_info(encode_world_info(wi)) == wi
+
+    def test_rank_assignment(self):
+        wi = {"w0": 2, "w1": 3}
+        envs = build_rank_env(wi, "w1", "10.0.0.1", 29500)
+        assert [e["RANK"] for e in envs] == ["2", "3", "4"]
+        assert all(e["WORLD_SIZE"] == "5" for e in envs)
+        assert all(e["MASTER_ADDR"] == "10.0.0.1" for e in envs)
+        assert [e["LOCAL_RANK"] for e in envs] == ["0", "1", "2"]
+
+    def test_unknown_node_rejected(self):
+        with pytest.raises(ValueError):
+            build_rank_env({"w0": 1}, "nope", "addr", 1)
+
+
+class TestMultinodeCommands:
+    def _args(self):
+        return parse_args(["--master_port", "29501", "train.py", "--flag"])
+
+    def test_node_cmd(self):
+        args = self._args()
+        cmd = build_node_cmd(args, {"h0": 1, "h1": 1}, "h0")
+        assert cmd[1:3] == ["-m", "deepspeed_tpu.launcher.launch"]
+        assert "--world_info" in cmd
+        i = cmd.index("--world_info")
+        assert decode_world_info(cmd[i + 1]) == {"h0": 1, "h1": 1}
+        assert cmd[-2:] == ["train.py", "--flag"]
+
+    def test_pdsh_cmd(self):
+        runner = PDSHRunner(exports={"PYTHONPATH": "/x"})
+        cmds = runner.get_cmd(["h0", "h1"],
+                              {h: ["python", "-m", "mod"] for h in ["h0", "h1"]})
+        assert len(cmds) == 1
+        cmd = cmds[0]
+        assert cmd[0] == "pdsh"
+        assert cmd[cmd.index("-w") + 1] == "h0,h1"
+        assert "export PYTHONPATH=/x;" in cmd[-1]
+        assert "export DSTPU_NODE_NAME=%h;" in cmd[-1]
+
+    def test_ssh_cmd(self):
+        runner = SSHRunner()
+        cmds = runner.get_cmd(["h0", "h1"],
+                              {h: ["python", "-m", "mod"] for h in ["h0", "h1"]})
+        assert len(cmds) == 2
+        assert cmds[0][0] == "ssh" and cmds[0][-2] == "h0"
+        assert "export DSTPU_NODE_NAME=h0;" in cmds[0][-1]
+
+
+@pytest.mark.slow
+def test_local_two_process_smoke(tmp_path):
+    """End-to-end: the CLI spawns 2 local processes x 4 virtual CPU devices
+    that rendezvous via jax.distributed and psum across the 8-device global
+    mesh (reference DistributedExec, driven through the real launcher)."""
+    script = tmp_path / "worker.py"
+    script.write_text(textwrap.dedent("""
+        import os, sys
+        sys.path.insert(0, %r)
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        from deepspeed_tpu import comm
+        comm.init_distributed()
+        assert jax.process_count() == 2, jax.process_count()
+        assert len(jax.devices()) == 8, len(jax.devices())
+        import numpy as np
+        import jax.numpy as jnp
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+        mesh = Mesh(np.array(jax.devices()).reshape(8), ("data",))
+        ones = jax.jit(
+            lambda: jax.lax.with_sharding_constraint(
+                jnp.ones((8,)), NamedSharding(mesh, P("data"))).sum())()
+        assert float(ones) == 8.0
+        print(f"SMOKE-OK rank={jax.process_index()}", flush=True)
+    """ % REPO))
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env["PYTHONPATH"] = REPO
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bin", "deepspeed-tpu"),
+         "--num_procs", "2", "--cpu_devices_per_proc", "4",
+         "--master_port", "29517", str(script)],
+        capture_output=True, text=True, timeout=300, env=env)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert out.stdout.count("SMOKE-OK") == 2, out.stdout + out.stderr
+
+
+def test_ds_report_cli():
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bin", "ds-tpu-report")],
+        capture_output=True, text=True, timeout=240,
+        env={**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": REPO})
+    assert out.returncode == 0, out.stderr
+    assert "flash_attention" in out.stdout
+    assert "jax version" in out.stdout
