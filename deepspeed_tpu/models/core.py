@@ -59,6 +59,9 @@ class Model:
     name: str = "model"
     pipelined: bool = False     # loss_fn consumes a whole (M, mb, ...) stack
     num_stages: int = 1
+    # custom (loss, grads) producer — set by pipelinize_model to the explicit
+    # 1F1B executor; engines prefer it over jax.value_and_grad(loss_fn)
+    grad_fn: Optional[Callable[..., Any]] = None
 
 
 # ---------------------------------------------------------------------------

@@ -273,13 +273,16 @@ class TrainEngine:
 
         grad_fn = jax.value_and_grad(micro_loss, has_aux=True)
 
-        def pipe_loss(params, batch, scale):
-            # pipelined loss_fn consumes the whole (M=gas, mb, ...) stack and
-            # averages over microbatches internally — no outer scan
-            loss = model.loss_fn(params, batch)
-            return loss * scale, loss
+        # pipelined models provide the explicit 1F1B executor (O(P) activation
+        # residency); fall back to autodiff of the stacked loss otherwise
+        pipe_grad_fn = model.grad_fn
+        if pipelined and pipe_grad_fn is None:
+            def pipe_grad_fn(params, batch, scale):
+                def pipe_loss(p, b):
+                    return model.loss_fn(p, b) * scale
 
-        pipe_grad_fn = jax.value_and_grad(pipe_loss, has_aux=True)
+                loss_scaled, grads = jax.value_and_grad(pipe_loss)(params, batch)
+                return loss_scaled / scale, grads
 
         def train_step(params, opt_state, scaler_state, batch):
             scale = scaler_state.scale if fp16 else jnp.float32(1.0)
@@ -294,7 +297,7 @@ class TrainEngine:
             zero_grads = jax.tree.map(
                 lambda p: jnp.zeros(p.shape, jnp.float32), params)
             if pipelined:
-                (_, loss), grads = pipe_grad_fn(params, batch, scale)
+                loss, grads = pipe_grad_fn(params, batch, scale)
                 grads = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
                 losses = loss[None]
             elif gas == 1:

@@ -192,3 +192,76 @@ class TestPipelinedTraining:
                 config={"train_micro_batch_size_per_gpu": 2,
                         "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
                         "parallel": {"pipeline_parallel_size": 4}})
+
+
+class TestPipelineMemory:
+    def test_activation_residency_is_o_p_not_o_m(self):
+        """1F1B contract (reference schedule.py:212 num_pipe_buffers): live
+        activation storage is bounded by the stage depth P, not the
+        microbatch count M. Compiled temp memory for the grad step must grow
+        sub-linearly when M quadruples at fixed P (the round-1 fill-drain
+        executor stacked every tick: O(M) growth)."""
+        import jax
+        import jax.numpy as jnp
+
+        from deepspeed_tpu.models import create_model
+        from deepspeed_tpu.parallel import mesh as mesh_mod
+        from deepspeed_tpu.parallel.pipeline import (pipelined_grad_fn,
+                                                     pipelinize_model)
+        from deepspeed_tpu.config.config import ParallelConfig
+
+        mesh = mesh_mod.build_mesh(ParallelConfig(pipeline_parallel_size=4,
+                                                  data_parallel_size=2))
+        mesh_mod.set_mesh(mesh)
+        model = create_model("tiny", dtype=jnp.float32, num_layers=4,
+                             max_seq_len=64)
+        pmodel = pipelinize_model(model, 4)
+        params = pmodel.init(jax.random.PRNGKey(0))
+
+        def temp_bytes(M):
+            ids = jnp.zeros((M, 4, 64), jnp.int32)
+            with mesh:
+                lowered = jax.jit(pmodel.grad_fn).lower(
+                    params, {"input_ids": ids}, jnp.float32(1.0))
+                return lowered.compile().memory_analysis().temp_size_in_bytes
+
+        with mesh:
+            t2, t8 = temp_bytes(2), temp_bytes(8)
+        # M x4 => temps must grow far less than proportionally
+        assert t8 < t2 * 2.5, (
+            f"temp memory grew {t8 / t2:.2f}x for 4x microbatches "
+            f"({t2} -> {t8} bytes) — activation residency is not O(P)")
+
+
+class TestPipelineMoE:
+    def test_grad_fn_loss_matches_eval_loss_with_aux(self):
+        """1F1B reported train loss and the eval loss_fn must agree for MoE
+        models — both include CE + router aux (regression: the executor
+        reported CE only while its grads included the aux term)."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from deepspeed_tpu.models import create_model
+        from deepspeed_tpu.parallel import mesh as mesh_mod
+        from deepspeed_tpu.parallel.pipeline import pipelinize_model
+        from deepspeed_tpu.config.config import ParallelConfig
+
+        mesh = mesh_mod.build_mesh(ParallelConfig(pipeline_parallel_size=2,
+                                                  data_parallel_size=4))
+        mesh_mod.set_mesh(mesh)
+        model = create_model("moe-tiny", dtype=jnp.float32, max_seq_len=64)
+        pmodel = pipelinize_model(model, 2)
+        params = pmodel.init(jax.random.PRNGKey(0))
+        ids = jax.random.randint(jax.random.PRNGKey(1), (2, 4, 32), 0, 250)
+        batch = {"input_ids": ids}
+        with mesh:
+            train_loss, grads = jax.jit(pmodel.grad_fn)(
+                params, batch, jnp.float32(1.0))
+            eval_loss = jax.jit(pmodel.loss_fn)(params, batch)
+        np.testing.assert_allclose(float(train_loss), float(eval_loss),
+                                   rtol=1e-5)
+        # and aux really is in there: loss > plain-CE-only would require
+        # recomputing without aux; instead check the router grads are nonzero
+        g_router = np.abs(np.asarray(grads["layers"]["router"])).max()
+        assert g_router > 0.0
