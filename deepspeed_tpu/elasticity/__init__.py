@@ -1,0 +1,14 @@
+"""Elastic training support (reference ``deepspeed/elasticity``).
+
+The portable core is the batch-size arithmetic: given acceptable micro-batch
+sizes and a max global batch, find the global batch size compatible with the
+largest set of chip counts, so the job can be rescheduled onto a different
+slice size without changing effective batch (convergence-preserving rescale).
+The reference's torchelastic agent maps on TPU to pod-slice restart policies +
+``jax.distributed`` re-init + universal checkpoints (runtime/checkpoint.py is
+reshard-on-load by construction).
+"""
+
+from .elasticity import (ElasticityConfig, ElasticityConfigError,
+                         ElasticityError, compute_elastic_config,
+                         elasticity_enabled)  # noqa: F401

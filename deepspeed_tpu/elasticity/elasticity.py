@@ -1,0 +1,242 @@
+"""Elastic batch-size arithmetic.
+
+Reference: ``deepspeed/elasticity/elasticity.py`` (compute_elastic_config
+:233, _get_compatible_gpus_v01 :83, v0.2 node-granular variant :126). The
+math is re-derived here (it is pure arithmetic over divisors and highly
+composite numbers); semantics match the reference:
+
+  v0.1  chip-granular: candidate global batches are micro-batch multiples
+        scaled by highly-composite numbers (maximising divisor count ==
+        maximising valid world sizes); pick the candidate compatible with the
+        most chip counts in [min, max].
+  v0.2  node-granular (TP-aware): world sizes move in whole nodes;
+        ``model_parallel_size`` must divide the per-node chip count and only
+        the data-parallel replicas elasticise.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..utils.logging import logger
+
+
+class ElasticityError(RuntimeError):
+    pass
+
+
+class ElasticityConfigError(ElasticityError):
+    pass
+
+
+# The smallest highly composite numbers — enough to reach ~720K batch sizes
+# (the reference keeps the same table for the same reason: HCNs maximise the
+# number of divisors, i.e. of valid data-parallel world sizes).
+_HCN = [1, 2, 4, 6, 12, 24, 36, 48, 60, 120, 180, 240, 360, 720, 840, 1260,
+        1680, 2520, 5040, 7560, 10080, 15120, 20160, 25200, 27720, 45360,
+        50400, 55440, 83160, 110880, 166320, 221760, 277200, 332640, 498960,
+        554400, 665280, 720720]
+
+LATEST_ELASTICITY_VERSION = 0.2
+
+
+@dataclasses.dataclass
+class ElasticityConfig:
+    """Reference elasticity/config.py schema ('gpus' accepted as alias)."""
+
+    max_train_batch_size: int
+    micro_batch_sizes: Sequence[int]
+    min_chips: int = 1
+    max_chips: int = 10000
+    min_time: int = 0
+    version: float = 0.1
+    prefer_larger_batch: bool = True
+    num_chips_per_node: int = 1
+    model_parallel_size: int = 1
+
+    @classmethod
+    def from_dict(cls, d: Dict) -> "ElasticityConfig":
+        if "max_train_batch_size" not in d:
+            raise ElasticityConfigError("elasticity config requires "
+                                        "'max_train_batch_size'")
+        if "micro_batch_sizes" not in d:
+            raise ElasticityConfigError("elasticity config requires "
+                                        "'micro_batch_sizes'")
+        mbs = list(d["micro_batch_sizes"])
+        if not mbs or any((not isinstance(m, int)) or m <= 0 for m in mbs):
+            raise ElasticityConfigError(
+                f"micro_batch_sizes must be positive ints, got {mbs}")
+        return cls(
+            max_train_batch_size=int(d["max_train_batch_size"]),
+            micro_batch_sizes=mbs,
+            min_chips=int(d.get("min_chips", d.get("min_gpus", 1))),
+            max_chips=int(d.get("max_chips", d.get("max_gpus", 10000))),
+            min_time=int(d.get("min_time", 0)),
+            version=float(d.get("version", 0.1)),
+            prefer_larger_batch=bool(d.get("prefer_larger_batch", True)),
+            num_chips_per_node=int(d.get("num_chips_per_node",
+                                         d.get("num_gpus_per_node", 1))),
+            model_parallel_size=int(d.get("model_parallel_size", 1)),
+        )
+
+
+def _lcm(values: Sequence[int]) -> int:
+    out = 1
+    for v in values:
+        out = out * v // math.gcd(out, v)
+    return out
+
+
+def _candidate_batch_sizes(bases: Sequence[int], max_batch: int) -> List[int]:
+    """For each base, the largest base*HCN <= max_batch (or base itself when
+    the base already exceeds the cap)."""
+    out = set()
+    for base in bases:
+        if base >= max_batch:
+            out.add(base)
+            continue
+        limit = max_batch // base
+        scale = 1
+        for h in _HCN:
+            if h > limit:
+                break
+            scale = h
+        out.add(base * scale)
+    return sorted(out)
+
+
+def _valid_world_sizes(batch_size: int, micro_batches: Sequence[int],
+                       lo: int, hi: int) -> List[int]:
+    """All world sizes w in [lo, hi] such that some micro batch m satisfies
+    batch_size % m == 0 and (batch_size//m) % w == 0 (i.e. gas is integral)."""
+    valid = set()
+    for m in micro_batches:
+        if batch_size % m:
+            continue
+        replicas = batch_size // m
+        for w in range(1, int(math.isqrt(replicas)) + 1):
+            if replicas % w == 0:
+                for cand in (w, replicas // w):
+                    if lo <= cand <= hi:
+                        valid.add(cand)
+    return sorted(valid)
+
+
+def _best_candidate(candidates: Sequence[int], micro_batches: Sequence[int],
+                    lo: int, hi: int, prefer_larger: bool
+                    ) -> Tuple[int, List[int]]:
+    best_batch = min(micro_batches)
+    best_valid: List[int] = []
+    for batch in candidates:
+        valid = _valid_world_sizes(batch, micro_batches, lo, hi)
+        better = len(valid) > len(best_valid) or (
+            len(valid) == len(best_valid)
+            and ((prefer_larger and batch > best_batch)
+                 or (not prefer_larger and batch < best_batch)))
+        if better:
+            best_batch, best_valid = batch, valid
+    return best_batch, best_valid
+
+
+def _compatible_chips_v01(micro_batches: Sequence[int], max_batch: int,
+                          min_chips: int, max_chips: int,
+                          prefer_larger: bool) -> Tuple[int, List[int]]:
+    if any(m > max_batch for m in micro_batches):
+        raise ElasticityError(
+            f"every micro batch must be <= max_train_batch_size={max_batch}, "
+            f"got {list(micro_batches)}")
+    bases = sorted(set(list(micro_batches) + [_lcm(micro_batches)]))
+    candidates = _candidate_batch_sizes(bases, max_batch)
+    return _best_candidate(candidates, micro_batches, min_chips, max_chips,
+                           prefer_larger)
+
+
+def _compatible_chips_v02(cfg: ElasticityConfig, current_chips: int
+                          ) -> Tuple[int, List[int], Optional[int]]:
+    if cfg.num_chips_per_node % cfg.model_parallel_size != 0:
+        raise ElasticityError(
+            f"num_chips_per_node={cfg.num_chips_per_node} must be divisible "
+            f"by model_parallel_size={cfg.model_parallel_size}")
+    dp_per_node = cfg.num_chips_per_node // cfg.model_parallel_size
+
+    def pick_micro(batch: int, dp_world: int) -> Optional[int]:
+        chosen = None
+        for m in cfg.micro_batch_sizes:
+            if dp_world and (batch // dp_world) % m == 0:
+                if chosen is None or (cfg.prefer_larger_batch and m > chosen):
+                    chosen = m
+        return chosen
+
+    node_batch, valid_nodes = _compatible_chips_v01(
+        cfg.micro_batch_sizes, cfg.max_train_batch_size // dp_per_node,
+        max(1, cfg.min_chips // cfg.num_chips_per_node),
+        max(1, cfg.max_chips // cfg.num_chips_per_node),
+        cfg.prefer_larger_batch)
+    batch = node_batch * dp_per_node
+    valid_dp = [n * dp_per_node for n in valid_nodes]
+    current_dp = current_chips // cfg.model_parallel_size
+    if current_dp in valid_dp:
+        return batch, valid_dp, pick_micro(batch, current_dp)
+
+    # current world incompatible with the elastic set: fall back to the
+    # largest batch reachable at the current dp size (reference v0.2 tail)
+    candidates = [m * current_dp * (cfg.max_train_batch_size // (m * current_dp))
+                  for m in cfg.micro_batch_sizes if m * current_dp
+                  and m * current_dp <= cfg.max_train_batch_size]
+    if not candidates:
+        raise ElasticityError(
+            f"current world of {current_chips} chips cannot fit any micro "
+            f"batch under max_train_batch_size={cfg.max_train_batch_size}")
+    batch = (max if cfg.prefer_larger_batch else min)(candidates)
+    return batch, [current_dp], pick_micro(batch, current_dp)
+
+
+def elasticity_enabled(config: Dict) -> bool:
+    return bool(config.get("elasticity", {}).get("enabled", False))
+
+
+def compute_elastic_config(config: Dict, world_size: int = 0,
+                           return_microbatch: bool = False):
+    """Main API (reference elasticity.py:233): given the ``elasticity``
+    section of a framework config, return (global_batch, valid_chip_counts
+    [, micro_batch]) such that training can scale across any count in the
+    list without changing effective batch size."""
+    if "elasticity" not in config:
+        raise ElasticityConfigError("config has no 'elasticity' section")
+    section = config["elasticity"]
+    if not section.get("enabled", False):
+        raise ElasticityConfigError("elasticity is disabled "
+                                    "('enabled': true to use it)")
+    cfg = ElasticityConfig.from_dict(section)
+    if cfg.version > LATEST_ELASTICITY_VERSION:
+        raise ElasticityConfigError(
+            f"elasticity version {cfg.version} > supported "
+            f"{LATEST_ELASTICITY_VERSION}")
+    if cfg.model_parallel_size > 1 and cfg.version < 0.2:
+        raise ElasticityConfigError(
+            "model-parallel elasticity requires version 0.2")
+
+    micro = None
+    if cfg.version >= 0.2:
+        batch, valid, micro = _compatible_chips_v02(cfg, world_size
+                                                    or cfg.num_chips_per_node)
+    else:
+        batch, valid = _compatible_chips_v01(
+            cfg.micro_batch_sizes, cfg.max_train_batch_size,
+            cfg.min_chips, cfg.max_chips, cfg.prefer_larger_batch)
+        if world_size:
+            if world_size not in valid:
+                raise ElasticityError(
+                    f"world size {world_size} is not in the valid elastic "
+                    f"set {valid} for batch {batch}")
+            for m in sorted(cfg.micro_batch_sizes,
+                            reverse=cfg.prefer_larger_batch):
+                if (batch // world_size) % m == 0:
+                    micro = m
+                    break
+    logger.info(f"elasticity: batch={batch} valid_world_sizes={valid}")
+    if return_microbatch:
+        return batch, valid, micro
+    return batch, valid

@@ -1,0 +1,109 @@
+"""Elasticity math tests — mirrors reference tests/unit/elasticity/
+test_elastic.py including its exact numeric oracles (batch 9792 / 23 valid
+world sizes for the canonical 10k config; micro batch 17 at world 64)."""
+
+import pytest
+
+from deepspeed_tpu.elasticity import (ElasticityConfigError, ElasticityError,
+                                      compute_elastic_config,
+                                      elasticity_enabled)
+
+
+@pytest.fixture
+def config():
+    return {
+        "elasticity": {
+            "enabled": True,
+            "max_train_batch_size": 10000,
+            "micro_batch_sizes": [8, 12, 16, 17],
+            "min_chips": 32,
+            "max_chips": 1500,
+            "min_time": 20,
+            "version": 0.1,
+        }
+    }
+
+
+def test_basic_10k(config):
+    batch, valid = compute_elastic_config(config)
+    for w in valid:
+        assert batch % w == 0
+        per = batch // w
+        assert any(per % mb == 0
+                   for mb in config["elasticity"]["micro_batch_sizes"])
+    assert batch == 9792
+    assert len(valid) == 23
+
+
+def test_disabled(config):
+    config["elasticity"]["enabled"] = False
+    assert not elasticity_enabled(config)
+    with pytest.raises(ElasticityConfigError):
+        compute_elastic_config(config)
+
+
+def test_valid_world_size_picks_micro(config):
+    batch, valid, micro = compute_elastic_config(config, world_size=64,
+                                                 return_microbatch=True)
+    assert micro == 17
+
+
+def test_invalid_world_size(config):
+    with pytest.raises(ElasticityError):
+        compute_elastic_config(config, world_size=128)
+
+
+def test_future_version(config):
+    config["elasticity"]["version"] = 0.3
+    with pytest.raises(ElasticityConfigError):
+        compute_elastic_config(config)
+
+
+def test_missing_fields(config):
+    del config["elasticity"]["micro_batch_sizes"]
+    with pytest.raises(ElasticityConfigError):
+        compute_elastic_config(config)
+    with pytest.raises(ElasticityConfigError):
+        compute_elastic_config({"elasticity": {"enabled": True,
+                                               "micro_batch_sizes": [2]}})
+
+
+def test_invalid_micro_batches(config):
+    config["elasticity"]["micro_batch_sizes"] = [2, 0, -1]
+    with pytest.raises(ElasticityConfigError):
+        compute_elastic_config(config)
+
+
+def test_model_parallel_needs_v02(config):
+    config["elasticity"]["model_parallel_size"] = 2
+    with pytest.raises(ElasticityConfigError):
+        compute_elastic_config(config)
+
+
+def test_v02_node_granularity(config):
+    config["elasticity"].update({
+        "version": 0.2,
+        "model_parallel_size": 2,
+        "num_chips_per_node": 4,
+    })
+    batch, valid, micro = compute_elastic_config(config, world_size=64,
+                                                 return_microbatch=True)
+    # dp worlds move in whole nodes: every entry divisible by dp_per_node=2
+    assert all(v % 2 == 0 for v in valid)
+    assert batch % (64 // 2) == 0  # gas integral at current dp world
+    assert micro in config["elasticity"]["micro_batch_sizes"]
+
+
+def test_v02_incompatible_world_falls_back(config):
+    config["elasticity"].update({
+        "version": 0.2,
+        "model_parallel_size": 1,
+        "num_chips_per_node": 7,
+    })
+    # 3 nodes (21 chips) is below min_chips=32 -> off the elastic list ->
+    # v0.2 falls back to the largest batch reachable at the current dp world
+    batch, valid, micro = compute_elastic_config(config, world_size=21,
+                                                 return_microbatch=True)
+    assert valid == [21]
+    assert batch % 21 == 0
+    assert micro is not None and (batch // 21) % micro == 0
