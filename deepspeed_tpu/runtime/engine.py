@@ -54,6 +54,44 @@ class TrainEngine:
                  optimizer: Optional[MixedPrecisionOptimizer] = None,
                  lr_scheduler=None, training_data=None, collate_fn=None,
                  rng: Optional[jax.Array] = None):
+        opt_name = config.optimizer.type.lower()
+        self._onebit = opt_name in ("onebitadam", "onebitlamb", "zerooneadam")
+        if self._onebit:
+            # compressed-gradient comm needs full local grads per dp rank:
+            # incompatible with grad/param sharding and non-data axes
+            # (reference OnebitAdam has the same ZeRO<=1 constraint)
+            if config.zero_optimization.stage > 1:
+                raise ValueError(
+                    f"{config.optimizer.type}: 1-bit compression requires "
+                    f"ZeRO stage <= 1 (got {config.zero_optimization.stage})")
+            par = config.parallel
+            if (par.tensor_parallel_size > 1 or par.sequence_parallel_size > 1
+                    or par.pipeline_parallel_size > 1
+                    or par.expert_parallel_size > 1):
+                raise ValueError(
+                    f"{config.optimizer.type}: compressed allreduce is "
+                    "data-parallel only (tp/sp/pp/ep must be 1)")
+        if opt_name == "cpuadam" and \
+                config.zero_optimization.offload_optimizer.device != "cpu":
+            raise ValueError(
+                "optimizer 'cpuadam' is the host-offloaded Adam — set "
+                "zero_optimization.offload_optimizer.device='cpu' (refusing "
+                "to silently run plain device Adam)")
+        if config.zero_optimization.offload_optimizer.device == "nvme":
+            raise NotImplementedError(
+                "offload_optimizer.device='nvme' is not implemented yet — "
+                "design in docs/offload_design.md tier 2; use 'cpu' for "
+                "host-memory offload")
+        if config.zero_optimization.offload_param.device != "none":
+            raise NotImplementedError(
+                "offload_param is not implemented yet (optimizer-state "
+                "offload via offload_optimizer.device='cpu' is)")
+        if (config.zero_optimization.offload_optimizer.device == "cpu"
+                and jax.default_backend() not in ("tpu", "gpu")):
+            raise ValueError(
+                "offload_optimizer.device='cpu' needs an accelerator backend "
+                "with host memory kinds (XLA CPU cannot lower host-pinned "
+                "jit operands)")
         pp = config.parallel.pipeline_parallel_size
         if pp > 1 and config.zero_optimization.stage >= 2:
             # same constraint as the reference (pipe/engine.py:56): pipeline
@@ -67,10 +105,11 @@ class TrainEngine:
             model = pipelinize_model(model, pp)
         self.model = model
         self.mesh = mesh if mesh is not None else mesh_mod.build_mesh(config.parallel)
-        mesh_mod.set_mesh(self.mesh, config.parallel.expert_parallel_size)
+        mesh_mod.set_mesh(self.mesh)
         # SP ranks share the batch (tokens are sharded, not samples) — only
-        # the data axis multiplies the batch (reference Ulysses semantics)
-        dp_world = int(self.mesh.shape[mesh_mod.DATA_AXIS])
+        # the (expert x data) axes multiply the batch (reference Ulysses
+        # semantics; total dp subdivides into expert groups)
+        dp_world = mesh_mod.get_data_parallel_world_size(self.mesh)
         self.config = config.resolve_batch_sizes(dp_world)
         self._dp_world = dp_world
         configure_comms_logger(self.config.comms_logger, world_size=dp_world)
@@ -103,21 +142,15 @@ class TrainEngine:
         if ep > 1:
             from ..models.core import DEFAULT_TP_RULES, EXPERT
 
-            # EP v1 constraint: experts shard over the FULL data axis (EP
-            # folded over DP). ep must equal dp and divide the expert count;
-            # sub-axis EP groups (ep < dp, reference groups.py:108) are a
-            # later refinement.
-            if ep != dp_world:
-                raise ValueError(
-                    f"expert_parallel_size={ep} must equal the data-parallel "
-                    f"degree ({dp_world}) in this version (experts shard over "
-                    "the full data axis)")
+            # experts shard over the dedicated 'expert' mesh axis; each expert
+            # is replicated across its 'data'-axis ranks — the reference's
+            # expert + expert-data group structure (groups.py:108/156), ep<=dp
             n_experts = getattr(model.config, "moe_num_experts", 0) if model.config else 0
-            if n_experts and n_experts % dp_world != 0:
+            if n_experts and n_experts % ep != 0:
                 raise ValueError(
-                    f"moe_num_experts={n_experts} must be divisible by the "
-                    f"data-parallel degree {dp_world} for expert parallelism")
-            tp_rules = {**DEFAULT_TP_RULES, EXPERT: mesh_mod.DATA_AXIS}
+                    f"moe_num_experts={n_experts} must be divisible by "
+                    f"expert_parallel_size={ep}")
+            tp_rules = {**DEFAULT_TP_RULES, EXPERT: mesh_mod.EXPERT_AXIS}
         self.plan: ZeroShardingPlan = build_sharding_plan(
             self.config.zero_stage, param_shapes, model.axes, tp_rules=tp_rules,
             fsdp_min_size=self.config.zero_optimization.stage3_param_persistence_threshold
@@ -139,12 +172,31 @@ class TrainEngine:
                                      out_shardings=master_shardings_tree)(self.params)
         self.scaler_state: LossScaleState = self.loss_scaler.init()
 
+        # 1-bit compression state: per-rank worker residual + per-chunk
+        # server residual (reference OnebitAdam error-feedback buffers)
+        self._comp_state = None
+        if self._onebit:
+            n_total = sum(int(p.size) for p in jax.tree.leaves(self.params))
+            npad = n_total + ((-n_total) % dp_world)
+            with self.mesh:
+                self._comp_state = {
+                    "worker": jax.device_put(
+                        jnp.zeros((dp_world, npad), jnp.float32),
+                        NamedSharding(self.mesh, P(mesh_mod.DATA_AXIS, None))),
+                    "server": jax.device_put(
+                        jnp.zeros((npad,), jnp.float32),
+                        NamedSharding(self.mesh, P(mesh_mod.DATA_AXIS))),
+                }
+
         # dataloader
         self.training_dataloader = None
         if training_data is not None:
+            # each process loads its share of the global batch; single-host
+            # that is the whole thing (multi-host assembly: _globalize_batch)
+            per_process = (self.train_micro_batch_size_per_gpu() * dp_world
+                           // jax.process_count())
             self.training_dataloader = DeepSpeedDataLoader(
-                training_data,
-                batch_size=self.train_micro_batch_size_per_gpu() * dp_world,
+                training_data, batch_size=per_process,
                 collate_fn=collate_fn, seed=self.config.seed)
 
         # bookkeeping
@@ -235,8 +287,16 @@ class TrainEngine:
     def _opt_state_shardings(self):
         state_shapes = jax.eval_shape(self.optimizer.init, self.params)
         specs = optimizer_state_specs(state_shapes, self.params, self.plan.master_specs)
-        return jax.tree.map(lambda s: NamedSharding(self.mesh, s), specs,
-                            is_leaf=lambda x: isinstance(x, P))
+        shardings = jax.tree.map(lambda s: NamedSharding(self.mesh, s), specs,
+                                 is_leaf=lambda x: isinstance(x, P))
+        if self.config.zero_optimization.offload_optimizer.device == "cpu":
+            # ZeRO-Offload tier 1 (reference stage_1_and_2.py:1021 cpu_offload,
+            # cpu_adam): master weights + moments live in pinned host memory —
+            # the jitted step streams them over PCIe, XLA overlapping the
+            # transfers with compute (docs/offload_design.md)
+            shardings = jax.tree.map(
+                lambda s: s.with_memory_kind("pinned_host"), shardings)
+        return shardings
 
     def _batch_sharding(self, batch: Any, leading_gas: bool) -> Any:
         sp = int(self.mesh.shape[mesh_mod.SEQ_AXIS])
@@ -246,13 +306,121 @@ class TrainEngine:
             axes: list = [None] * nd
             pos = 1 if leading_gas else 0
             if nd > pos:
-                axes[pos] = mesh_mod.DATA_AXIS
+                axes[pos] = mesh_mod.DATA_SHARD
             # token dim sharded over 'seq' when SP is on and divisible
             if sp > 1 and nd > pos + 1 and np.shape(x)[pos + 1] % sp == 0:
                 axes[pos + 1] = mesh_mod.SEQ_AXIS
             return NamedSharding(self.mesh, P(*axes))
 
         return jax.tree.map(spec, batch)
+
+    def _globalize_batch(self, batch: Any, leading_gas: bool) -> Any:
+        """Host-local batch → global sharded arrays. Single-host: plain
+        device_put. Multi-host: every process holds only ITS slice of the
+        global batch (the dataloader yields per-process shares), assembled
+        with make_array_from_process_local_data (round-1 advisory: device_put
+        of a local slice onto a global sharding needs the global array)."""
+        shardings = self._batch_sharding(batch, leading_gas)
+        if jax.process_count() == 1:
+            return jax.device_put(batch, shardings)
+        return jax.tree.map(
+            lambda x, s: jax.make_array_from_process_local_data(
+                s, np.asarray(x)), batch, shardings)
+
+    def _build_onebit_train_step(self) -> Callable:
+        """Train step with compressed-gradient data-parallel comm (reference
+        OnebitAdam/ZeroOneAdam: dense warmup for ``freeze_step`` steps, then
+        error-feedback int8 two-phase allreduce — comm/compressed.py)."""
+        optimizer = self.optimizer
+        loss_scaler = self.loss_scaler
+        model = self.model
+        gas = self.gradient_accumulation_steps()
+        fp16 = self.fp16_enabled()
+        W = self._dp_world
+        freeze = int(self.config.optimizer.params.get("freeze_step", 100))
+        mesh = self.mesh
+        from ..comm.compressed import (compressed_allreduce_flat,
+                                       tree_flatten_pad, tree_unflatten_like)
+
+        def micro_loss(params, mb, scale):
+            loss = model.loss_fn(params, mb)
+            return loss * scale / gas, loss
+
+        grad_fn = jax.value_and_grad(micro_loss, has_aux=True)
+
+        def data_body(params, batch, scale, worker_res, server_res, count):
+            worker = worker_res[0]                  # (npad,) this rank
+
+            def one_micro(carry, mb):
+                (_, loss), grads = grad_fn(params, mb, scale)
+                return jax.tree.map(lambda a, g: a + g.astype(jnp.float32),
+                                    carry, grads), loss
+
+            zero = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32),
+                                params)
+            if gas == 1:
+                grads, losses = one_micro(zero, jax.tree.map(lambda x: x[0],
+                                                             batch))
+                losses = losses[None]
+            else:
+                grads, losses = jax.lax.scan(one_micro, zero, batch)
+
+            flat, _, _ = tree_flatten_pad(grads, W)
+
+            def dense():
+                return (jax.lax.pmean(flat, mesh_mod.DATA_AXIS), worker,
+                        server_res)
+
+            def compressed():
+                return compressed_allreduce_flat(flat, worker, server_res,
+                                                 mesh_mod.DATA_AXIS)
+
+            flat_avg, w2, s2 = jax.lax.cond(count < freeze, dense, compressed)
+            grads_avg = tree_unflatten_like(flat_avg, grads)
+            loss_avg = jax.lax.pmean(jnp.mean(losses.astype(jnp.float32)),
+                                     mesh_mod.DATA_AXIS)
+            return grads_avg, loss_avg, w2[None], s2
+
+        def train_step(params, opt_state, scaler_state, comp_state, batch):
+            scale = scaler_state.scale if fp16 else jnp.float32(1.0)
+            batch_specs = jax.tree.map(
+                lambda x: P(None, mesh_mod.DATA_AXIS), batch)
+            body = jax.shard_map(
+                data_body, mesh=mesh,
+                in_specs=(P(), batch_specs, P(), P(mesh_mod.DATA_AXIS, None),
+                          P(mesh_mod.DATA_AXIS), P()),
+                out_specs=(P(), P(), P(mesh_mod.DATA_AXIS, None),
+                           P(mesh_mod.DATA_AXIS)),
+                check_vma=False, axis_names={mesh_mod.DATA_AXIS})
+            grads, mean_loss, w2, s2 = body(params, batch, scale,
+                                            comp_state["worker"],
+                                            comp_state["server"],
+                                            opt_state.count)
+            if fp16:
+                inv = 1.0 / scale
+                grads = jax.tree.map(lambda g: g * inv, grads)
+                overflow = has_overflow(grads)
+            else:
+                overflow = jnp.asarray(False)
+            new_params, new_opt_state, stats = optimizer.apply(
+                params, grads, opt_state, skip_update=overflow)
+            new_scaler = loss_scaler.update(scaler_state, overflow)
+            new_comp = {"worker": w2, "server": s2}
+            return (new_params, new_opt_state, new_scaler, new_comp,
+                    mean_loss, stats)
+
+        opt_shardings = self._opt_state_shardings()
+        comp_shardings = {
+            "worker": NamedSharding(self.mesh, P(mesh_mod.DATA_AXIS, None)),
+            "server": NamedSharding(self.mesh, P(mesh_mod.DATA_AXIS)),
+        }
+        return jax.jit(
+            train_step,
+            in_shardings=(self.param_shardings, opt_shardings, None,
+                          comp_shardings, None),
+            out_shardings=(self.param_shardings, opt_shardings, None,
+                           comp_shardings, None, None),
+            donate_argnums=(0, 1, 3))
 
     # -- the jitted step --------------------------------------------------
     def _build_train_step(self) -> Callable:
@@ -262,8 +430,42 @@ class TrainEngine:
         gas = self.gradient_accumulation_steps()
         grad_specs = self.plan.grad_specs
         fp16 = self.fp16_enabled()
-        prescale = self.config.prescale_gradients
-        predivide = self.config.gradient_predivide_factor
+
+        offload = self.config.zero_optimization.offload_optimizer.device == "cpu"
+        if offload:
+            # ZeRO-Offload: master+moments stay pinned_host (see
+            # _opt_state_shardings); the update itself runs host-side via
+            # compute_on — grads/params stream D2H, updated params H2D, and
+            # device HBM never holds the fp32 optimizer state (the reference's
+            # cpu_adam path, with XLA scheduling the PCIe transfers)
+            from jax.experimental.compute_on import compute_on
+
+            host = lambda ns: ns.with_memory_kind("pinned_host")
+            grad_host_sh = jax.tree.map(host, as_named(grad_specs, self.mesh))
+            param_host_sh = jax.tree.map(host, self.param_shardings)
+            scalar_host = NamedSharding(self.mesh, P(),
+                                        memory_kind="pinned_host")
+            host_apply = compute_on("device_host")(jax.jit(
+                lambda p, g, st, sk: optimizer.apply(p, g, st, skip_update=sk)))
+
+            def apply_update(params, grads, opt_state, skip):
+                grads_h = jax.tree.map(jax.device_put, grads, grad_host_sh)
+                params_h = jax.tree.map(jax.device_put, params, param_host_sh)
+                skip_h = jax.device_put(skip, scalar_host)
+                new_p_h, new_state, stats = host_apply(params_h, grads_h,
+                                                       opt_state, skip_h)
+                new_params = jax.tree.map(jax.device_put, new_p_h,
+                                          self.param_shardings)
+                # scalars computed host-side come back to device memory so
+                # the step outputs have a uniform layout
+                dev_scalar = NamedSharding(self.mesh, P())
+                stats = jax.tree.map(
+                    lambda x: jax.device_put(x, dev_scalar), stats)
+                return new_params, new_state, stats
+        else:
+            def apply_update(params, grads, opt_state, skip):
+                return optimizer.apply(params, grads, opt_state,
+                                       skip_update=skip)
 
         pipelined = model.pipelined
 
@@ -317,11 +519,17 @@ class TrainEngine:
                 overflow = has_overflow(grads)
             else:
                 overflow = jnp.asarray(False)
-            if prescale and predivide != 1.0:
-                grads = jax.tree.map(lambda g: g / predivide, grads)
+            # gradient_predivide_factor: in the reference's default postscale
+            # path the bucket divides by predivide before the sum and
+            # multiplies by predivide/world after (allreduce_bucket,
+            # engine.py:2152) — net effect on the mean is NONE; under
+            # prescale_gradients the factor is ignored. Our grads are already
+            # exact means, so both modes are no-ops here; the knobs stay for
+            # config compatibility. (Round-1 advisory: we wrongly divided by
+            # predivide under prescale, changing the effective grad scale.)
 
-            new_params, new_opt_state, stats = optimizer.apply(
-                params, grads, opt_state, skip_update=overflow)
+            new_params, new_opt_state, stats = apply_update(
+                params, grads, opt_state, overflow)
             new_scaler = loss_scaler.update(scaler_state, overflow)
             mean_loss = jnp.mean(losses.astype(jnp.float32))
             return new_params, new_opt_state, new_scaler, mean_loss, stats
@@ -355,7 +563,9 @@ class TrainEngine:
                     f"shape must be (gas, micro_batch*dp, ...)")
 
         if self._compiled_step is None:
-            self._compiled_step = self._build_train_step()
+            self._compiled_step = (self._build_onebit_train_step()
+                                   if self._onebit else
+                                   self._build_train_step())
 
         # Steady-state path is SYNC-FREE: no host<->device scalar fetches per
         # step (each one drains the TPU queue — ruinous over remote tunnels).
@@ -365,10 +575,16 @@ class TrainEngine:
         if breakdown:
             self.timers(TRAIN_BATCH_TIMER).start(synchronize=True)
         with self.mesh:
-            batch = jax.device_put(batch, self._batch_sharding(batch, leading_gas=True))
-            (self.params, self.opt_state, self.scaler_state, loss,
-             stats) = self._compiled_step(self.params, self.opt_state,
-                                          self.scaler_state, batch)
+            batch = self._globalize_batch(batch, leading_gas=True)
+            if self._onebit:
+                (self.params, self.opt_state, self.scaler_state,
+                 self._comp_state, loss, stats) = self._compiled_step(
+                    self.params, self.opt_state, self.scaler_state,
+                    self._comp_state, batch)
+            else:
+                (self.params, self.opt_state, self.scaler_state, loss,
+                 stats) = self._compiled_step(self.params, self.opt_state,
+                                              self.scaler_state, batch)
         self.global_steps += 1
         self.micro_steps += gas
         self._skipped_accum = (stats.skipped.astype(jnp.int32)
@@ -427,8 +643,7 @@ class TrainEngine:
                 return loss * scale / gas, loss
 
             self._compiled_micro = jax.jit(jax.value_and_grad(micro, has_aux=True))
-        self._pending_batch = jax.device_put(
-            batch, self._batch_sharding(batch, leading_gas=False))
+        self._pending_batch = self._globalize_batch(batch, leading_gas=False)
         scale = self.scaler_state.scale if self.fp16_enabled() else jnp.float32(1.0)
         with self.mesh:
             (scaled_loss, loss), grads = self._compiled_micro(
@@ -503,7 +718,8 @@ class TrainEngine:
     # -- checkpoint (reference engine.py:2792 save_checkpoint) ------------
     def save_checkpoint(self, save_dir: str, tag: Optional[str] = None,
                         client_state: Optional[Dict] = None,
-                        save_latest: bool = True) -> str:
+                        save_latest: bool = True,
+                        async_save: bool = False) -> str:
         from .checkpoint import save_checkpoint as _save
 
         self.mark_step_boundary()
@@ -520,7 +736,8 @@ class TrainEngine:
         })
         path = _save(save_dir, tag, params=self.params, opt_state=self.opt_state,
                      client_state=client_state, save_latest=save_latest,
-                     tag_validation=self.config.checkpoint.tag_validation)
+                     tag_validation=self.config.checkpoint.tag_validation,
+                     async_save=async_save)
         log_dist(f"saved checkpoint {path}")
         return path
 

@@ -1,0 +1,119 @@
+"""Sharded/async checkpoint tests (format 2) — the reference's
+tests/unit/checkpoint suite concerns (zero shards per rank, reshape across
+topologies, latest-tag semantics) plus async-commit ordering."""
+
+import glob
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deepspeed_tpu.runtime.checkpoint import (load_checkpoint,
+                                              read_latest_tag,
+                                              save_checkpoint, wait_pending)
+
+
+@pytest.fixture
+def mesh8(devices8):
+    return Mesh(np.array(devices8), ("data",))
+
+
+def _sharded(mesh, arr, spec):
+    return jax.device_put(arr, NamedSharding(mesh, spec))
+
+
+def test_sharded_leaves_write_per_shard_files(tmp_path, mesh8):
+    params = {
+        "w": _sharded(mesh8, jnp.arange(64, dtype=jnp.float32).reshape(8, 8),
+                      P("data", None)),
+        "b": _sharded(mesh8, jnp.ones((4,), jnp.float32), P()),
+    }
+    save_checkpoint(str(tmp_path), "t1", params)
+    files = sorted(os.path.basename(f) for f in
+                   glob.glob(str(tmp_path / "t1" / "arrays" / "*.npy")))
+    w_files = [f for f in files if "w" in f and "b" not in f]
+    assert len(w_files) == 8, files           # one file per unique shard
+    # each shard file holds 1/8 of the array, in global coords per metadata
+    meta = json.load(open(tmp_path / "t1" / "metadata.json"))
+    info = meta["arrays"]["params##w"]
+    assert len(info["shards"]) == 8
+    assert info["shards"][0]["bounds"] == [[0, 1], [0, 8]]
+    # replicated leaf collapses to ONE file
+    b_files = [f for f in files if "##b" in f]
+    assert len(b_files) == 1
+
+
+def test_roundtrip_resharded(tmp_path, mesh8):
+    src = jnp.arange(128, dtype=jnp.float32).reshape(16, 8)
+    params = {"w": _sharded(mesh8, src, P("data", None))}
+    save_checkpoint(str(tmp_path), "t1", params)
+    # load under a DIFFERENT sharding (model-dim split) and dtype
+    target = {"w": jnp.zeros((16, 8), jnp.bfloat16)}
+    shardings = {"w": NamedSharding(mesh8, P(None, "data"))}
+    out, _, _ = load_checkpoint(str(tmp_path), "t1",
+                                params_template=(target, shardings))
+    np.testing.assert_allclose(np.asarray(out["w"], np.float32),
+                               np.asarray(src))
+    assert out["w"].sharding.spec == P(None, "data")
+    assert out["w"].dtype == jnp.bfloat16
+
+
+def test_bf16_and_scalar_leaves(tmp_path, mesh8):
+    params = {
+        "w": _sharded(mesh8, jnp.full((8, 4), 1.5, jnp.bfloat16),
+                      P("data", None)),
+        "count": jnp.int32(7),
+    }
+    save_checkpoint(str(tmp_path), "t1", params)
+    tmpl = {"w": jnp.zeros((8, 4), jnp.bfloat16), "count": jnp.int32(0)}
+    sh = {"w": NamedSharding(mesh8, P("data", None)),
+          "count": NamedSharding(mesh8, P())}
+    out, _, _ = load_checkpoint(str(tmp_path), "t1",
+                                params_template=(tmpl, sh))
+    np.testing.assert_allclose(np.asarray(out["w"], np.float32), 1.5)
+    assert int(out["count"]) == 7
+
+
+def test_async_save_commits_latest_after_writes(tmp_path, mesh8):
+    params = {"w": _sharded(mesh8, jnp.ones((8, 128), jnp.float32),
+                            P("data", None))}
+    save_checkpoint(str(tmp_path), "a1", params, async_save=True)
+    wait_pending()
+    assert read_latest_tag(str(tmp_path)) == "a1"
+    out, _, _ = load_checkpoint(
+        str(tmp_path), "a1",
+        params_template=({"w": jnp.zeros((8, 128))},
+                         {"w": NamedSharding(mesh8, P("data", None))}))
+    np.testing.assert_allclose(np.asarray(out["w"]), 1.0)
+
+
+def test_async_save_snapshot_isolated_from_donation(tmp_path, mesh8):
+    """The D2H copy happens before save returns, so mutating (donating) the
+    array afterwards cannot corrupt the checkpoint."""
+    w = _sharded(mesh8, jnp.ones((8, 64), jnp.float32), P("data", None))
+    save_checkpoint(str(tmp_path), "a1", {"w": w}, async_save=True)
+    w2 = jax.jit(lambda x: x * 0.0, donate_argnums=0)(w)  # clobber buffer
+    del w2
+    wait_pending()
+    out, _, _ = load_checkpoint(
+        str(tmp_path), "a1",
+        params_template=({"w": jnp.zeros((8, 64))},
+                         {"w": NamedSharding(mesh8, P("data", None))}))
+    np.testing.assert_allclose(np.asarray(out["w"]), 1.0)
+
+
+def test_partial_coverage_rejected(tmp_path, mesh8):
+    params = {"w": _sharded(mesh8, jnp.ones((8, 8)), P("data", None))}
+    save_checkpoint(str(tmp_path), "t1", params)
+    # delete one shard file -> load must fail loudly, not zero-fill
+    victim = glob.glob(str(tmp_path / "t1" / "arrays" / "*.s3.npy"))[0]
+    os.remove(victim)
+    with pytest.raises((ValueError, FileNotFoundError)):
+        load_checkpoint(
+            str(tmp_path), "t1",
+            params_template=({"w": jnp.zeros((8, 8))},
+                             {"w": NamedSharding(mesh8, P("data", None))}))
