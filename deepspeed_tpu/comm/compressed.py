@@ -1,0 +1,95 @@
+"""Error-feedback compressed gradient allreduce.
+
+TPU rendering of the reference's 1-bit backends
+(``runtime/comm/nccl.py:15`` NcclBackend.compressed_allreduce :54 and the
+MPI variant): gradients cross the wire as int8 with per-tensor scales and
+the quantization error is fed back into the next step (worker + server
+residuals — the two error buffers of the reference's two-phase scheme).
+
+Two-phase exchange on the 'data' axis (inside a shard_map region):
+
+  phase 1  each rank quantizes (grad + worker_residual) to int8, the flat
+           vector is chunked over ranks and exchanged with all_to_all —
+           rank r receives everyone's chunk r and reduces it locally
+           (the reduce-scatter of the reference's igather+local-sum);
+  phase 2  rank r re-quantizes its reduced chunk (server residual feedback)
+           and all_gathers the int8 result; all ranks decode.
+
+Per-rank bytes on the wire: ~2n int8 vs ~8n fp32 for dense ring allreduce —
+the same 4x reduction the reference's compressed_allreduce delivers, with
+XLA moving int8 over ICI.
+
+All functions are pure; residuals live in the engine's compression state.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _quantize(v: jax.Array) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """v (n,) f32 → (int8 codes, scale, residual). Symmetric per-tensor
+    scaling: scale = max|v|/127."""
+    scale = jnp.max(jnp.abs(v)) / 127.0
+    safe = jnp.where(scale == 0.0, 1.0, scale)
+    q = jnp.clip(jnp.round(v / safe), -127, 127).astype(jnp.int8)
+    residual = v - q.astype(jnp.float32) * scale
+    return q, scale, residual
+
+
+def compressed_allreduce_flat(v: jax.Array, worker_res: jax.Array,
+                              server_res: jax.Array, axis: str
+                              ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Mean-allreduce a flat fp32 vector over mesh ``axis`` in int8.
+
+    Must run inside a shard_map manual over ``axis``. ``v`` length must be a
+    multiple of the axis size (caller pads). Returns (mean, new_worker_res,
+    new_server_res); server_res has length n/world (this rank's chunk)."""
+    world = lax.psum(1, axis)
+    n = v.shape[0]
+    chunk = n // world
+
+    # phase 1: worker error feedback + quantize + chunk exchange
+    q, scale, new_worker = _quantize(v + worker_res)
+    q2 = q.reshape(world, chunk)
+    recv = lax.all_to_all(q2, axis, split_axis=0, concat_axis=0,
+                          tiled=False)                      # (world, chunk)
+    scales = lax.all_gather(scale, axis)                    # (world,)
+    # reduce my chunk: sum_r recv[r] * scales[r]
+    summed = jnp.sum(recv.astype(jnp.float32) * scales[:, None], axis=0)
+
+    # phase 2: server error feedback + quantize + gather
+    sq, sscale, new_server = _quantize(summed + server_res)
+    gathered = lax.all_gather(sq, axis)                     # (world, chunk)
+    sscales = lax.all_gather(sscale, axis)                  # (world,)
+    total = (gathered.astype(jnp.float32)
+             * sscales[:, None]).reshape(n)
+    return total / world, new_worker, new_server
+
+
+def tree_flatten_pad(tree: Any, multiple: int) -> Tuple[jax.Array, Any, int]:
+    """Flatten a pytree of arrays into one padded f32 vector (the reference
+    flattens into one contiguous buffer for the same reason)."""
+    leaves = jax.tree.leaves(tree)
+    flat = jnp.concatenate([l.astype(jnp.float32).reshape(-1) for l in leaves])
+    n = flat.shape[0]
+    pad = (-n) % multiple
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    return flat, jax.tree.structure(tree), n
+
+
+def tree_unflatten_like(flat: jax.Array, tree: Any) -> Any:
+    """Inverse of tree_flatten_pad against a template tree."""
+    leaves = jax.tree.leaves(tree)
+    out = []
+    off = 0
+    for l in leaves:
+        size = int(l.size)
+        out.append(flat[off:off + size].reshape(l.shape).astype(l.dtype))
+        off += size
+    return jax.tree.unflatten(jax.tree.structure(tree), out)

@@ -1,0 +1,75 @@
+#!/usr/bin/env python
+"""Offload-tier validation — run on a real TPU chip (CPU XLA cannot lower
+host-pinned jit operands, so this lives outside the pytest CPU mesh suite).
+
+Checks: (1) trajectory equivalence offload vs no-offload; (2) optimizer
+state actually resides in pinned_host; (3) device-resident argument bytes
+drop by the fp32 master+moment footprint (via compiled memory_analysis).
+Measured on v5e / gpt2-125m: 1.62 -> 0.23 GiB device args (1.39 GiB saved),
+temps 1.56 -> 1.77 GiB."""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import jax, jax.numpy as jnp
+import deepspeed_tpu
+from deepspeed_tpu.models import create_model
+from deepspeed_tpu.parallel import mesh as mesh_mod
+
+def run(offload):
+    mesh_mod.reset_mesh()
+    model = create_model("gpt2-125m", dtype=jnp.bfloat16, remat=True,
+                         remat_policy="dots", max_seq_len=512)
+    cfg = {
+        "train_micro_batch_size_per_gpu": 8,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 0,
+                              "offload_optimizer": {"device": "cpu" if offload else "none"}},
+    }
+    engine, *_ = deepspeed_tpu.initialize(model=model, config=cfg)
+    kinds = {getattr(x.sharding, "memory_kind", None)
+             for x in jax.tree.leaves(engine.opt_state)}
+    ids = jax.random.randint(jax.random.PRNGKey(1), (1, 8, 512), 0,
+                             model.config.vocab_size)
+    losses = [float(engine.train_batch(batch={"input_ids": ids})) for _ in range(3)]
+    stats = jax.devices()[0].memory_stats() or {}
+    hbm = stats.get("bytes_in_use", 0)
+    del engine
+    return losses, kinds, hbm
+
+l_no, k_no, hbm_no = run(False)
+print("no-offload:", [round(l,4) for l in l_no], k_no, f"{hbm_no/2**30:.2f} GiB")
+l_off, k_off, hbm_off = run(True)
+print("offload:   ", [round(l,4) for l in l_off], k_off, f"{hbm_off/2**30:.2f} GiB")
+assert k_off == {"pinned_host"}, k_off
+for a, b in zip(l_no, l_off):
+    assert abs(a - b) < 1e-3, (a, b)
+
+# compiled-step memory accounting: device args must shrink by ~master+moments
+def arg_bytes(offload):
+    mesh_mod.reset_mesh()
+    model = create_model("gpt2-125m", dtype=jnp.bfloat16, remat=True,
+                         remat_policy="dots", max_seq_len=512)
+    cfg = {
+        "train_micro_batch_size_per_gpu": 8,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 0,
+                              "offload_optimizer": {"device": "cpu" if offload else "none"}},
+    }
+    engine, *_ = deepspeed_tpu.initialize(model=model, config=cfg)
+    step = engine._build_train_step()
+    ids = jax.random.randint(jax.random.PRNGKey(1), (1, 8, 512), 0,
+                             model.config.vocab_size)
+    batch = jax.device_put({"input_ids": ids},
+                           engine._batch_sharding({"input_ids": ids}, True))
+    with engine.mesh:
+        ma = step.lower(engine.params, engine.opt_state, engine.scaler_state,
+                        batch).compile().memory_analysis()
+    return ma.argument_size_in_bytes
+
+saved = (arg_bytes(False) - arg_bytes(True)) / 2**30
+print(f"device-resident argument bytes saved: {saved:.2f} GiB")
+assert saved > 1.2, saved
+print("OFFLOAD CHECK OK")
