@@ -1,0 +1,8 @@
+"""Autotuning — analog of ``deepspeed/autotuning`` (Autotuner
+autotuner.py:42, ResourceManager scheduler.py:33, tuner/ strategies):
+generate candidate configs over the tunable space (micro-batch, ZeRO stage,
+remat policy...), run each through the launcher, rank by the measured
+metric."""
+
+from .autotuner import (Autotuner, generate_experiments, grid_space,
+                        random_space)  # noqa: F401

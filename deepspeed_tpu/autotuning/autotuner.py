@@ -1,0 +1,149 @@
+"""Autotuner: experiment generation + sequential scheduler.
+
+Reference: ``autotuning/autotuner.py:42`` — reads the ``autotuning`` config
+section, builds experiment configs by expanding tunable lists (the
+``DEFAULT_TUNING_SPACE`` of micro-batch sizes x ZeRO stages x ...), runs each
+via the launcher with a results directory, and selects the best by metric
+(throughput/latency/FLOPS). The xgboost cost-model tuner is replaced by the
+two strategies that carry its weight at this scale: exhaustive grid and
+seeded random subsampling.
+
+An experiment here = (name, config overrides). Execution is pluggable — the
+default runner shells out through ``deepspeed-tpu`` exactly like the
+reference's ResourceManager does over pdsh, reading back a JSON metric file
+the trainee writes (reference: autotuning metric_path protocol).
+"""
+
+from __future__ import annotations
+
+import copy
+import itertools
+import json
+import os
+import random
+import subprocess
+import sys
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from ..utils.logging import logger
+
+# reference DEFAULT_TUNING_SPACE (autotuning/config.py): the knobs that move
+# throughput on TPU
+DEFAULT_SPACE: Dict[str, Sequence[Any]] = {
+    "train_micro_batch_size_per_gpu": [1, 2, 4, 8, 16, 32],
+    "zero_optimization.stage": [0, 1, 2, 3],
+}
+
+
+def _set_nested(cfg: Dict, dotted: str, value: Any) -> None:
+    node = cfg
+    parts = dotted.split(".")
+    for p in parts[:-1]:
+        node = node.setdefault(p, {})
+    node[parts[-1]] = value
+
+
+def grid_space(space: Dict[str, Sequence[Any]]) -> List[Dict[str, Any]]:
+    keys = sorted(space)
+    out = []
+    for combo in itertools.product(*(space[k] for k in keys)):
+        out.append(dict(zip(keys, combo)))
+    return out
+
+
+def random_space(space: Dict[str, Sequence[Any]], num_trials: int,
+                 seed: int = 0) -> List[Dict[str, Any]]:
+    full = grid_space(space)
+    rng = random.Random(seed)
+    if num_trials >= len(full):
+        return full
+    return rng.sample(full, num_trials)
+
+
+def generate_experiments(base_config: Dict[str, Any],
+                         space: Optional[Dict[str, Sequence[Any]]] = None,
+                         tuner_type: str = "gridsearch",
+                         num_trials: int = 50,
+                         seed: int = 0) -> List[Tuple[str, Dict[str, Any]]]:
+    """(name, full-config) per experiment — reference Autotuner's
+    _generate_experiments."""
+    space = dict(space or DEFAULT_SPACE)
+    if tuner_type == "gridsearch":
+        combos = grid_space(space)
+    elif tuner_type == "random":
+        combos = random_space(space, num_trials, seed)
+    else:
+        raise ValueError(f"unknown tuner_type '{tuner_type}' "
+                         "(gridsearch | random)")
+    experiments = []
+    for combo in combos:
+        cfg = copy.deepcopy(base_config)
+        parts = []
+        for key, val in sorted(combo.items()):
+            _set_nested(cfg, key, val)
+            parts.append(f"{key.split('.')[-1]}{val}")
+        experiments.append(("_".join(parts), cfg))
+    return experiments
+
+
+class Autotuner:
+    """Sequential experiment scheduler (the ResourceManager at 1-node scale).
+
+    ``runner``: callable (name, config) -> metric float or None on failure.
+    Default runner launches ``training_script`` through deepspeed-tpu with
+    the experiment config written to disk and reads the metric JSON the
+    script writes at $DSTPU_AUTOTUNING_METRIC_PATH.
+    """
+
+    def __init__(self, base_config: Dict[str, Any],
+                 results_dir: str = "autotuning_results",
+                 metric: str = "throughput",
+                 runner: Optional[Callable] = None,
+                 training_script: Optional[str] = None,
+                 script_args: Optional[List[str]] = None):
+        self.base_config = base_config
+        self.results_dir = results_dir
+        self.metric = metric
+        self.training_script = training_script
+        self.script_args = script_args or []
+        self.runner = runner or self._subprocess_runner
+        self.results: Dict[str, Optional[float]] = {}
+
+    def _subprocess_runner(self, name: str, config: Dict) -> Optional[float]:
+        exp_dir = os.path.join(self.results_dir, name)
+        os.makedirs(exp_dir, exist_ok=True)
+        cfg_path = os.path.join(exp_dir, "config.json")
+        metric_path = os.path.join(exp_dir, "metric.json")
+        with open(cfg_path, "w") as fh:
+            json.dump(config, fh)
+        env = dict(os.environ)
+        env["DSTPU_AUTOTUNING_METRIC_PATH"] = metric_path
+        cmd = [sys.executable, "-m", "deepspeed_tpu.launcher.runner",
+               self.training_script, "--deepspeed_config", cfg_path,
+               *self.script_args]
+        try:
+            subprocess.run(cmd, env=env, timeout=3600, check=True,
+                           capture_output=True)
+            with open(metric_path) as fh:
+                return float(json.load(fh)[self.metric])
+        except Exception as exc:  # failed experiments score None (ref: same)
+            logger.warning(f"experiment {name} failed: {exc}")
+            return None
+
+    def tune(self, space: Optional[Dict[str, Sequence[Any]]] = None,
+             tuner_type: str = "gridsearch", num_trials: int = 50
+             ) -> Tuple[Optional[str], Optional[float]]:
+        experiments = generate_experiments(self.base_config, space,
+                                           tuner_type, num_trials)
+        logger.info(f"autotuning: {len(experiments)} experiments")
+        best_name, best_val = None, None
+        for name, cfg in experiments:
+            val = self.runner(name, cfg)
+            self.results[name] = val
+            if val is not None and (best_val is None or val > best_val):
+                best_name, best_val = name, val
+        os.makedirs(self.results_dir, exist_ok=True)
+        with open(os.path.join(self.results_dir, "summary.json"), "w") as fh:
+            json.dump({"best": best_name, "metric": self.metric,
+                       "results": self.results}, fh, indent=1)
+        return best_name, best_val

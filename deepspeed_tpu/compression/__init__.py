@@ -1,0 +1,8 @@
+"""Compression suite — analog of ``deepspeed/compression`` (init_compression
+compress.py:95, compression_scheduler scheduler.py:12, method layers
+basic_layer.py:65-802): quantization-aware training, magnitude pruning
+(sparse/row/head), and layer reduction, driven by the same config schema."""
+
+from .compress import (CompressionPlan, apply_compression, init_compression,
+                       layer_reduction_init)  # noqa: F401
+from .scheduler import CompressionScheduler  # noqa: F401

@@ -1,0 +1,155 @@
+"""Compression transforms.
+
+Reference: ``compression/compress.py:95`` (init_compression walks the module
+and wraps layers), ``basic_layer.py:65-802`` (LinearLayer_Compress with
+weight/activation quantization, sparse/row/head pruning), ``helper.py``
+(layer reduction for distillation students).
+
+TPU rendering: a module walk over torch layers becomes a pure transform over
+the param pytree — ``apply_compression(params, plan, active)`` returns params
+with straight-through fake-quantization and/or pruning masks applied; the
+engine wraps the model loss so the transform sits in the differentiation path
+(quantization-aware training, with gradients flowing straight-through exactly
+like the reference's QuantAct/Quantizer autograd functions).
+
+Config schema mirrors the reference sections:
+    compression_training:
+      weight_quantization: {shared_parameters: {enabled, schedule_offset},
+                            different_groups: {g0: {params: {start_bits|bits,
+                            target_bits}, modules: [regex...]}}}
+      sparse_pruning:      {..., params: {dense_ratio}, modules: [...]}
+      row_pruning:         {..., params: {dense_ratio}, modules: [...]}
+      head_pruning:        {..., params: {dense_ratio, num_heads}, modules: [...]}
+      layer_reduction:     {enabled, keep_number_layer, teacher_layer: [...]}
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Any, Dict, FrozenSet, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass
+class CompressionPlan:
+    # method name -> {schedule_offset, params, modules(list of regex)}
+    methods: Dict[str, Dict[str, Any]]
+    layer_reduction: Optional[Dict[str, Any]] = None
+
+    def matches(self, method: str, param_path: str) -> bool:
+        mods = self.methods[method].get("modules", ["*"])
+        for pat in mods:
+            if pat == "*" or re.search(pat, param_path):
+                return True
+        return False
+
+
+def init_compression(config: Dict[str, Any]) -> CompressionPlan:
+    """Parse the ``compression_training`` section into a plan (reference
+    init_compression's policy extraction, module-walk deferred to apply)."""
+    section = config.get("compression_training", config)
+    methods: Dict[str, Dict[str, Any]] = {}
+    for name in ("weight_quantization", "sparse_pruning", "row_pruning",
+                 "head_pruning", "activation_quantization"):
+        spec = section.get(name)
+        if not spec:
+            continue
+        shared = spec.get("shared_parameters", {})
+        if not shared.get("enabled", True):
+            continue
+        groups = spec.get("different_groups", {})
+        params: Dict[str, Any] = {}
+        modules: List[str] = []
+        for group in groups.values():
+            params.update(group.get("params", {}))
+            modules += list(group.get("modules", []))
+        methods[name] = {
+            "schedule_offset": shared.get("schedule_offset", 0),
+            "schedule_offset_end": shared.get("schedule_offset_end"),
+            "params": params,
+            "modules": modules or ["*"],
+        }
+    reduction = section.get("layer_reduction")
+    if reduction and not reduction.get("enabled", True):
+        reduction = None
+    return CompressionPlan(methods=methods, layer_reduction=reduction)
+
+
+def _fake_quant_ste(w: jax.Array, bits: int) -> jax.Array:
+    """Symmetric per-tensor fake quantization with straight-through grads
+    (reference Quantizer autograd fn; ops/quantization.py has the Pallas
+    group-wise variant — per-tensor here matches basic_layer defaults)."""
+    qmax = 2.0 ** (bits - 1) - 1
+    scale = jnp.max(jnp.abs(w.astype(jnp.float32))) / qmax
+    scale = jnp.where(scale == 0, 1.0, scale)
+    q = jnp.round(w.astype(jnp.float32) / scale).clip(-qmax, qmax) * scale
+    # straight-through: forward quantized, backward identity
+    return (w.astype(jnp.float32)
+            + jax.lax.stop_gradient(q - w.astype(jnp.float32))).astype(w.dtype)
+
+
+def _magnitude_mask(w: jax.Array, dense_ratio: float, axis=None) -> jax.Array:
+    """Keep the top ``dense_ratio`` fraction by |magnitude| (reference
+    sparse/row pruning). axis=None: elementwise; axis=int: whole rows/cols
+    scored by their L1 norm."""
+    w32 = jnp.abs(w.astype(jnp.float32))
+    if axis is None:
+        flat = w32.reshape(-1)
+        k = max(1, int(round(flat.size * dense_ratio)))
+        thresh = jnp.sort(flat)[-k]
+        return (w32 >= thresh).astype(w.dtype)
+    scores = w32.sum(axis=tuple(i for i in range(w.ndim) if i != axis))
+    k = max(1, int(round(scores.size * dense_ratio)))
+    thresh = jnp.sort(scores)[-k]
+    keep = scores >= thresh
+    shape = [1] * w.ndim
+    shape[axis] = -1
+    return keep.reshape(shape).astype(w.dtype)
+
+
+def apply_compression(params: Any, plan: CompressionPlan,
+                      active: FrozenSet[str]) -> Any:
+    """Pure transform: apply every active method to matching params. Runs
+    inside the jitted loss (QAT straight-through)."""
+    if not active:
+        return params
+    flat = jax.tree_util.tree_flatten_with_path(params)
+    leaves, treedef = flat
+    out = []
+    for path, leaf in leaves:
+        key = "/".join(str(getattr(p, "key", p)) for p in path)
+        w = leaf
+        if leaf is not None and hasattr(leaf, "ndim") and leaf.ndim >= 2:
+            if ("weight_quantization" in active
+                    and plan.matches("weight_quantization", key)):
+                bits = int(plan.methods["weight_quantization"]["params"]
+                           .get("target_bits", plan.methods[
+                               "weight_quantization"]["params"]
+                           .get("start_bits", 8)))
+                w = _fake_quant_ste(w, bits)
+            if "sparse_pruning" in active and plan.matches("sparse_pruning", key):
+                ratio = float(plan.methods["sparse_pruning"]["params"]
+                              .get("dense_ratio", 0.5))
+                w = w * jax.lax.stop_gradient(_magnitude_mask(w, ratio))
+            if "row_pruning" in active and plan.matches("row_pruning", key):
+                ratio = float(plan.methods["row_pruning"]["params"]
+                              .get("dense_ratio", 0.5))
+                w = w * jax.lax.stop_gradient(
+                    _magnitude_mask(w, ratio, axis=w.ndim - 1))
+        out.append(w)
+    return jax.tree_util.tree_unflatten(treedef, [l for l in out])
+
+
+def layer_reduction_init(params: Any, keep_layers: List[int]) -> Any:
+    """Distillation-student init: keep the listed teacher layer indices
+    (reference helper.py student initialization from teacher_layer list).
+    Works on the stacked (L, ...) layer tree."""
+    def slice_layers(x):
+        return jnp.stack([x[i] for i in keep_layers])
+
+    out = dict(params)
+    out["layers"] = jax.tree.map(slice_layers, params["layers"])
+    return out

@@ -1,0 +1,26 @@
+"""Compression schedule: which methods are active at a given step.
+
+Reference ``compression/scheduler.py:12`` — each method has a
+``schedule_offset`` (step at which it turns on) and optionally
+``schedule_offset_end``. The scheduler resolves a boolean activation set per
+step; the engine re-specialises the (jitted) compressed forward only when
+that set changes, so the schedule costs at most one recompile per boundary.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, FrozenSet
+
+
+class CompressionScheduler:
+    def __init__(self, plan: "Any"):
+        self.plan = plan
+
+    def active_methods(self, global_step: int) -> FrozenSet[str]:
+        active = set()
+        for name, method in self.plan.methods.items():
+            start = method.get("schedule_offset", 0)
+            end = method.get("schedule_offset_end")
+            if global_step >= start and (end is None or global_step < end):
+                active.add(name)
+        return frozenset(active)

@@ -99,16 +99,25 @@ def logical_to_spec(axes: Optional[Tuple[str, ...]],
     mesh_axes: list = [rules.get(a) for a in axes]
     # never shard the scan-carried layer dim
     mesh_axes = [None if a == LAYERS else m for a, m in zip(axes, mesh_axes)]
-    if fsdp_axis is not None and fsdp_axis not in mesh_axes:
+    if fsdp_axis is not None:
+        # a mesh axis may appear once per PartitionSpec: drop components of
+        # the (possibly composite) fsdp axis already consumed by TP/EP rules
+        used = set()
+        for m in mesh_axes:
+            if m is None:
+                continue
+            used.update(m if isinstance(m, tuple) else (m,))
+        want = fsdp_axis if isinstance(fsdp_axis, tuple) else (fsdp_axis,)
+        free = tuple(a for a in want if a not in used)
         size = 1
         for s in shape:
             size *= s
-        if size >= fsdp_min_size:
+        if free and size >= fsdp_min_size:
             candidates = [i for i, (a, m) in enumerate(zip(axes, mesh_axes))
                           if m is None and a != LAYERS]
             if candidates:
                 best = max(candidates, key=lambda i: shape[i])
-                mesh_axes[best] = fsdp_axis
+                mesh_axes[best] = free if len(free) > 1 else free[0]
     return P(*mesh_axes)
 
 

@@ -62,6 +62,9 @@ _SIZES: Dict[str, Dict[str, Any]] = {
     # GShard/Switch-style 8-expert GPT (BASELINE tracked config #4)
     "moe-tiny": dict(family="gpt2", hidden_size=64, num_layers=2, num_heads=4,
                      vocab_size=256, max_seq_len=128, moe_num_experts=8),
+    "moe-gpt-125m-8e": dict(family="gpt2", hidden_size=768, num_layers=12,
+                            num_heads=12, vocab_size=50257, max_seq_len=1024,
+                            moe_num_experts=8),
     "moe-gpt-350m-8e": dict(family="gpt2", hidden_size=1024, num_layers=24,
                             num_heads=16, vocab_size=50257, max_seq_len=1024,
                             moe_num_experts=8),

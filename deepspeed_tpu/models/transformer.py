@@ -62,6 +62,10 @@ class TransformerConfig:
     moe_capacity_factor: float = 1.25
     moe_min_capacity: int = 4
     moe_aux_loss_coef: float = 0.01
+    moe_drop_tokens: bool = True      # False => infinite capacity (C = T)
+    moe_use_rts: bool = False         # random token selection (top-1 only)
+    moe_use_residual: bool = False    # PR-MoE: dense residual MLP + learned
+    #   2-way coefficient mix (reference moe/layer.py use_residual)
 
     def __post_init__(self):
         if self.num_kv_heads is None:
@@ -118,6 +122,15 @@ def init_params(rng: jax.Array, cfg: TransformerConfig) -> Dict[str, Any]:
     E = cfg.moe_num_experts
     if E > 0:
         layers["router"] = normal(next(keys), (L, H, E))
+        if cfg.moe_use_residual:
+            layers["res_mlp"] = {
+                "w_up": normal(next(keys), (L, H, F)),
+                "b_up": jnp.zeros((L, F), cfg.dtype),
+                "w_down": normal(next(keys), (L, F, H), resid_std),
+                "b_down": jnp.zeros((L, H), cfg.dtype),
+            }
+            layers["res_coef"] = {"w": normal(next(keys), (L, H, 2)),
+                                  "b": jnp.zeros((L, 2), cfg.dtype)}
         if cfg.activation == "swiglu":
             layers["mlp"] = {
                 "w_gate": normal(next(keys), (L, E, H, F)),
@@ -188,6 +201,12 @@ def param_axes(cfg: TransformerConfig) -> Dict[str, Any]:
     layer_axes = {"ln1": dict(ln), "ln2": dict(ln), "attn": attn, "mlp": mlp}
     if cfg.moe_num_experts > 0:
         layer_axes["router"] = (LAYERS, EMBED, None)
+        if cfg.moe_use_residual:
+            layer_axes["res_mlp"] = {
+                "w_up": (LAYERS, EMBED, MLP), "b_up": (LAYERS, MLP),
+                "w_down": (LAYERS, MLP, EMBED), "b_down": (LAYERS, EMBED)}
+            layer_axes["res_coef"] = {"w": (LAYERS, EMBED, None),
+                                      "b": (LAYERS, None)}
     axes: Dict[str, Any] = {
         "embed": {"tokens": (VOCAB, EMBED)},
         "layers": layer_axes,
@@ -468,10 +487,35 @@ def _layer_forward(cfg: TransformerConfig, x: jax.Array, layer: Dict[str, Any],
     if cfg.moe_num_experts > 0:
         from ..parallel.moe import moe_mlp
 
+        rts_rng = None
+        if cfg.moe_use_rts:
+            # loss_fn is pure (no rng arg); derive a per-call key from the
+            # activations so selection varies across batches/steps while
+            # staying deterministic for a given input
+            seed = jax.lax.bitcast_convert_type(
+                jnp.sum(h.astype(jnp.float32)), jnp.int32)
+            rts_rng = jax.random.PRNGKey(0)
+            rts_rng = jax.random.fold_in(rts_rng, seed)
         mlp_out, aux = moe_mlp(h, layer["router"], layer["mlp"], cfg.activation,
                                top_k=cfg.moe_top_k,
                                capacity_factor=cfg.moe_capacity_factor,
-                               min_capacity=cfg.moe_min_capacity)
+                               min_capacity=cfg.moe_min_capacity,
+                               drop_tokens=cfg.moe_drop_tokens,
+                               use_rts=cfg.moe_use_rts, rng=rts_rng)
+        if cfg.moe_use_residual:
+            # PR-MoE (reference moe/layer.py:120): dense MLP in parallel,
+            # mixed by a learned softmax coefficient over (moe, dense)
+            inner = jnp.einsum("bsh,hf->bsf", h, layer["res_mlp"]["w_up"]) \
+                + layer["res_mlp"]["b_up"]
+            inner = jax.nn.gelu(inner, approximate=True)
+            res_out = jnp.einsum("bsf,fh->bsh", inner,
+                                 layer["res_mlp"]["w_down"]) \
+                + layer["res_mlp"]["b_down"]
+            coef = jax.nn.softmax(
+                (jnp.einsum("bsh,hc->bsc", h, layer["res_coef"]["w"])
+                 + layer["res_coef"]["b"]).astype(jnp.float32), axis=-1
+            ).astype(h.dtype)
+            mlp_out = mlp_out * coef[..., 0:1] + res_out * coef[..., 1:2]
     elif cfg.activation == "swiglu":
         gate = jnp.einsum("bsh,hf->bsf", h, layer["mlp"]["w_gate"])
         up = jnp.einsum("bsh,hf->bsf", h, layer["mlp"]["w_up"])

@@ -11,12 +11,17 @@ and XLA routes them over ICI/DCN. This module owns:
   * the groups-accessor API surface of the reference (sizes/ranks), and
   * a process-global default mesh (mirror of the reference's module globals).
 
-Axis layout convention (outermost → innermost): ("pipe", "data", "seq", "model").
-Innermost axes change fastest across physically adjacent devices, so "model"
-(highest-bandwidth collectives: TP allreduce every layer) rides the shortest ICI
-hops, matching the scaling-book recipe. The expert axis is folded over
-("data",) or a sub-axis of it at MoE layer level via shard_map, mirroring the
-reference where ep_size must divide the dp world (groups.py:108).
+Axis layout convention (outermost → innermost):
+("pipe", "expert", "data", "seq", "model"). Innermost axes change fastest
+across physically adjacent devices, so "model" (highest-bandwidth collectives:
+TP allreduce every layer) rides the shortest ICI hops, matching the
+scaling-book recipe.
+
+The total data-parallel degree is expert x data: batch/grads/fsdp shard over
+the composite ``DATA_SHARD = ("expert", "data")`` tuple; MoE layers shard the
+expert dim over "expert" only, so each expert is replicated across its
+``data``-axis ranks — exactly the reference's expert-parallel +
+expert-DATA-parallel group structure (groups.py:108/156) with ep <= dp.
 """
 
 from __future__ import annotations
@@ -34,15 +39,16 @@ from ..utils.logging import logger
 
 # canonical axis names
 PIPE_AXIS = "pipe"
-DATA_AXIS = "data"      # DP *and* ZeRO/FSDP shard axis
+EXPERT_AXIS = "expert"  # expert parallelism (ep <= total dp)
+DATA_AXIS = "data"      # dp WITHIN an expert group (total dp = expert x data)
 SEQ_AXIS = "seq"        # sequence/context parallelism (Ulysses / ring)
 MODEL_AXIS = "model"    # tensor parallelism
-EXPERT_AXIS = "expert"  # expert parallelism (folded over data at MoE layers)
 
-MESH_AXES = (PIPE_AXIS, DATA_AXIS, SEQ_AXIS, MODEL_AXIS)
+MESH_AXES = (PIPE_AXIS, EXPERT_AXIS, DATA_AXIS, SEQ_AXIS, MODEL_AXIS)
+# composite spec entry for everything data-parallel (batch, grads, fsdp)
+DATA_SHARD = (EXPERT_AXIS, DATA_AXIS)
 
 _GLOBAL_MESH: Optional[Mesh] = None
-_GLOBAL_EP_SIZE: int = 1
 
 
 def build_mesh(parallel: Optional[ParallelConfig] = None,
@@ -59,19 +65,20 @@ def build_mesh(parallel: Optional[ParallelConfig] = None,
     world = len(devices)
     pp, tp, sp = (parallel.pipeline_parallel_size, parallel.tensor_parallel_size,
                   parallel.sequence_parallel_size)
+    ep = parallel.expert_parallel_size
     denom = pp * tp * sp
     if world % denom != 0:
         raise ValueError(f"world size {world} not divisible by pipe*seq*model = {denom}")
-    dp = parallel.data_parallel_size or world // denom
-    if pp * dp * sp * tp != world:
+    dp_total = parallel.data_parallel_size or world // denom
+    if pp * dp_total * sp * tp != world:
         raise ValueError(
-            f"mesh {pp}x{dp}x{sp}x{tp} (pipe,data,seq,model) != world size {world}")
-    if (dp * sp) % parallel.expert_parallel_size != 0:
+            f"mesh {pp}x{dp_total}x{sp}x{tp} (pipe,data,seq,model) != world size {world}")
+    if dp_total % ep != 0:
         raise ValueError(
-            f"expert_parallel_size {parallel.expert_parallel_size} must divide "
-            f"data*seq = {dp * sp} (reference: groups.py:108 ep<=dp constraint)")
+            f"expert_parallel_size {ep} must divide the data-parallel degree "
+            f"{dp_total} (reference: groups.py:108 ep<=dp constraint)")
 
-    shape = (pp, dp, sp, tp)
+    shape = (pp, ep, dp_total // ep, sp, tp)
     try:
         from jax.experimental import mesh_utils
 
@@ -79,14 +86,14 @@ def build_mesh(parallel: Optional[ParallelConfig] = None,
     except Exception:
         device_array = np.asarray(devices).reshape(shape)
     mesh = Mesh(device_array, MESH_AXES)
-    logger.info(f"Built mesh pipe={pp} data={dp} seq={sp} model={tp} over {world} devices")
+    logger.info(f"Built mesh pipe={pp} expert={ep} data={dp_total // ep} "
+                f"seq={sp} model={tp} over {world} devices")
     return mesh
 
 
-def set_mesh(mesh: Mesh, expert_parallel_size: int = 1) -> None:
-    global _GLOBAL_MESH, _GLOBAL_EP_SIZE
+def set_mesh(mesh: Mesh) -> None:
+    global _GLOBAL_MESH
     _GLOBAL_MESH = mesh
-    _GLOBAL_EP_SIZE = expert_parallel_size
 
 
 def get_mesh() -> Mesh:
@@ -97,21 +104,20 @@ def get_mesh() -> Mesh:
 
 
 def reset_mesh() -> None:
-    global _GLOBAL_MESH, _GLOBAL_EP_SIZE
+    global _GLOBAL_MESH
     _GLOBAL_MESH = None
-    _GLOBAL_EP_SIZE = 1
 
 
 @contextmanager
-def mesh_context(mesh: Mesh, expert_parallel_size: int = 1):
-    global _GLOBAL_MESH, _GLOBAL_EP_SIZE
-    prev, prev_ep = _GLOBAL_MESH, _GLOBAL_EP_SIZE
-    _GLOBAL_MESH, _GLOBAL_EP_SIZE = mesh, expert_parallel_size
+def mesh_context(mesh: Mesh):
+    global _GLOBAL_MESH
+    prev = _GLOBAL_MESH
+    _GLOBAL_MESH = mesh
     try:
         with mesh:
             yield mesh
     finally:
-        _GLOBAL_MESH, _GLOBAL_EP_SIZE = prev, prev_ep
+        _GLOBAL_MESH = prev
 
 
 # ---------------------------------------------------------------------------
@@ -124,7 +130,9 @@ def _axis_size(axis: str, mesh: Optional[Mesh] = None) -> int:
 
 
 def get_data_parallel_world_size(mesh: Optional[Mesh] = None) -> int:
-    return _axis_size(DATA_AXIS, mesh)
+    """TOTAL data-parallel degree (expert x data axes) — the reference's
+    dp_world, of which expert groups are a sub-division."""
+    return _axis_size(DATA_AXIS, mesh) * _axis_size(EXPERT_AXIS, mesh)
 
 
 def get_model_parallel_world_size(mesh: Optional[Mesh] = None) -> int:
@@ -139,11 +147,8 @@ def get_sequence_parallel_world_size(mesh: Optional[Mesh] = None) -> int:
     return _axis_size(SEQ_AXIS, mesh)
 
 
-def get_expert_parallel_world_size() -> int:
-    """EP degree of the active configuration (set via set_mesh/mesh_context).
-    EP is not a mesh axis — the expert dim folds over 'data' at MoE layers —
-    so unlike the sibling accessors there is no per-mesh variant."""
-    return _GLOBAL_EP_SIZE
+def get_expert_parallel_world_size(mesh: Optional[Mesh] = None) -> int:
+    return _axis_size(EXPERT_AXIS, mesh)
 
 
 def get_world_size(mesh: Optional[Mesh] = None) -> int:
@@ -160,8 +165,8 @@ def sharding(spec: P, mesh: Optional[Mesh] = None) -> NamedSharding:
 
 
 def batch_spec() -> P:
-    """Input-batch sharding: batch dim split over (pipe?, data); tokens over seq."""
-    return P(DATA_AXIS, SEQ_AXIS)
+    """Input-batch sharding: batch dim split over (expert, data); tokens over seq."""
+    return P(DATA_SHARD, SEQ_AXIS)
 
 
 def local_device_count() -> int:

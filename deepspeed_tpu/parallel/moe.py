@@ -23,7 +23,7 @@ from typing import Any, Dict, NamedTuple, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-from .mesh import DATA_AXIS, get_expert_parallel_world_size, get_mesh
+from .mesh import EXPERT_AXIS, get_expert_parallel_world_size, get_mesh
 from .sequence import constrain
 from jax.sharding import PartitionSpec as P
 
@@ -49,10 +49,17 @@ def _one_hot(x: jax.Array, n: int) -> jax.Array:
 
 def top1gating(logits: jax.Array, capacity_factor: float = 1.0,
                min_capacity: int = 4, noisy_gate_policy: Optional[str] = None,
-               rng: Optional[jax.Array] = None) -> GateOutput:
-    """Switch-style top-1 gating (reference sharded_moe.py:179)."""
+               rng: Optional[jax.Array] = None, drop_tokens: bool = True,
+               use_rts: bool = False) -> GateOutput:
+    """Switch-style top-1 gating (reference sharded_moe.py:179).
+
+    ``drop_tokens=False`` — infinite capacity (C=T; the reference computes a
+    dynamic max-count capacity, which jit cannot — C=T is the static-shape
+    equivalent; prefer capacity_factor at scale). ``use_rts`` — Random Token
+    Selection (sharded_moe.py:220): over-capacity tokens are chosen by random
+    priority instead of sequence order (needs ``rng``)."""
     T, E = logits.shape
-    C = _capacity(T, E, capacity_factor, min_capacity)
+    C = T if not drop_tokens else _capacity(T, E, capacity_factor, min_capacity)
     if noisy_gate_policy == "RSample" and rng is not None:
         logits_for_choice = logits + jax.random.gumbel(rng, logits.shape)
     else:
@@ -65,6 +72,15 @@ def top1gating(logits: jax.Array, capacity_factor: float = 1.0,
     me = gates.mean(axis=0)
     ce = mask.mean(axis=0)
     aux = jnp.sum(me * ce) * E
+
+    if use_rts and drop_tokens and rng is not None and C < T:
+        # keep a RANDOM capacity-subset per expert (reference mask1_rand +
+        # _top_idx): top-C random priorities, then positions as usual
+        pri = mask * jax.random.uniform(rng, mask.shape, jnp.float32)
+        _, top_idx = jax.lax.top_k(pri.T, C)                        # (E, C)
+        sel = jnp.zeros((E, T), jnp.float32).at[
+            jnp.arange(E)[:, None], top_idx].set(1.0)
+        mask = mask * sel.T
 
     # capacity assignment: position of each token within its expert queue
     pos_in_expert = jnp.cumsum(mask, axis=0) * mask                  # 1-based
@@ -80,11 +96,12 @@ def top1gating(logits: jax.Array, capacity_factor: float = 1.0,
 
 
 def top2gating(logits: jax.Array, capacity_factor: float = 1.0,
-               min_capacity: int = 4) -> GateOutput:
+               min_capacity: int = 4, drop_tokens: bool = True) -> GateOutput:
     """GShard top-2 gating (reference sharded_moe.py:277): second expert
     weighted by renormalised gate, both capacity-limited."""
     T, E = logits.shape
-    C = _capacity(T, E, 2 * capacity_factor, min_capacity)
+    C = T if not drop_tokens else _capacity(T, E, 2 * capacity_factor,
+                                            min_capacity)
     gates = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
 
     idx1 = jnp.argmax(gates, axis=-1)
@@ -122,18 +139,18 @@ def top2gating(logits: jax.Array, capacity_factor: float = 1.0,
 
 
 def _ep_active(num_experts: int) -> bool:
-    if get_expert_parallel_world_size() <= 1:
-        return False
     try:
-        dp = int(get_mesh().shape.get(DATA_AXIS, 1))
+        ep = get_expert_parallel_world_size()
     except Exception:
         return False
-    return dp > 1 and num_experts % dp == 0
+    return ep > 1 and num_experts % ep == 0
 
 
 def moe_mlp(x: jax.Array, router_w: jax.Array, experts: Dict[str, jax.Array],
             activation: str, top_k: int = 2, capacity_factor: float = 1.25,
-            min_capacity: int = 4) -> Tuple[jax.Array, jax.Array]:
+            min_capacity: int = 4, drop_tokens: bool = True,
+            use_rts: bool = False,
+            rng: Optional[jax.Array] = None) -> Tuple[jax.Array, jax.Array]:
     """MoE FFN for one layer. x (B, S, H); router_w (H, E); experts:
     w_up/w_down (+w_gate for swiglu) with leading expert dim E.
     Returns (out (B,S,H), aux_loss scalar)."""
@@ -142,14 +159,19 @@ def moe_mlp(x: jax.Array, router_w: jax.Array, experts: Dict[str, jax.Array],
     T = B * S
     xt = x.reshape(T, H)
     logits = xt.astype(jnp.float32) @ router_w.astype(jnp.float32)
-    gate = top2gating(logits, capacity_factor, min_capacity) if top_k == 2 else \
-        top1gating(logits, capacity_factor, min_capacity)
+    if top_k == 2 and use_rts:
+        raise ValueError("use_rts (Random Token Selection) is top-1 only, "
+                         "as in the reference (sharded_moe.py top1gating)")
+    gate = (top2gating(logits, capacity_factor, min_capacity,
+                       drop_tokens=drop_tokens) if top_k == 2 else
+            top1gating(logits, capacity_factor, min_capacity,
+                       drop_tokens=drop_tokens, use_rts=use_rts, rng=rng))
 
     dispatch = gate.dispatch.astype(x.dtype)                  # (T, E, C)
     dispatched = jnp.einsum("tec,th->ech", dispatch, xt)      # (E, C, H)
     if _ep_active(E):
         # EP: expert dim sharded over 'data' — XLA inserts the all-to-all here
-        dispatched = constrain(dispatched, P(DATA_AXIS, None, None))
+        dispatched = constrain(dispatched, P(EXPERT_AXIS, None, None))
 
     if activation == "swiglu":
         g = jnp.einsum("ech,ehf->ecf", dispatched, experts["w_gate"])
@@ -161,7 +183,7 @@ def moe_mlp(x: jax.Array, router_w: jax.Array, experts: Dict[str, jax.Array],
             approximate=True)
     expert_out = jnp.einsum("ecf,efh->ech", inner, experts["w_down"])
     if _ep_active(E):
-        expert_out = constrain(expert_out, P(DATA_AXIS, None, None))
+        expert_out = constrain(expert_out, P(EXPERT_AXIS, None, None))
 
     out = jnp.einsum("tec,ech->th", gate.combine.astype(x.dtype), expert_out)
     return out.reshape(B, S, H), gate.aux_loss

@@ -35,7 +35,7 @@ from jax.sharding import PartitionSpec as P
 
 from ..models.core import LAYERS, Model
 from ..utils.logging import logger
-from .mesh import DATA_AXIS, MODEL_AXIS, PIPE_AXIS, SEQ_AXIS, get_mesh
+from .mesh import DATA_SHARD, MODEL_AXIS, PIPE_AXIS, SEQ_AXIS, get_mesh
 
 PIPE_STAGE = "pipe_stage"   # logical axis for the stacked stage dim
 
@@ -249,7 +249,7 @@ def pipelined_loss_fn(cfg, num_stages: int):
             # CPU partitioner and adds no value (H dim is replicated anyway)
             from .sequence import constrain as _constrain
 
-            x = _constrain(x, P(DATA_AXIS, None, None))
+            x = _constrain(x, P(DATA_SHARD, None, None))
             recv_next = lax.ppermute(x, PIPE_AXIS,
                                      [(i, (i + 1) % P_) for i in range(P_)])
             return (recv_next, aux_acc), x

@@ -25,7 +25,7 @@ from typing import Optional
 import jax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from .mesh import DATA_AXIS, MODEL_AXIS, SEQ_AXIS, get_mesh
+from .mesh import DATA_SHARD, MODEL_AXIS, SEQ_AXIS, get_mesh
 
 
 def _active_mesh():
@@ -66,7 +66,7 @@ def constrain(x: jax.Array, spec: P) -> jax.Array:
 
 def hidden_spec() -> P:
     """(B, S, H) activations: batch over data, tokens over seq."""
-    return P(DATA_AXIS, SEQ_AXIS, None)
+    return P(DATA_SHARD, SEQ_AXIS, None)
 
 
 def heads_spec(num_heads: int) -> Optional[P]:
@@ -82,7 +82,7 @@ def heads_spec(num_heads: int) -> Optional[P]:
         return None
     if num_heads % max(sp * tp, 1) != 0:
         return None
-    return P(DATA_AXIS, None, (SEQ_AXIS, MODEL_AXIS), None)
+    return P(DATA_SHARD, None, (SEQ_AXIS, MODEL_AXIS), None)
 
 
 def sequence_parallel_enabled() -> bool:

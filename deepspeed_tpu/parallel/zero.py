@@ -31,7 +31,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..config.config import ZeroConfig
 from ..models.core import DEFAULT_TP_RULES, resolve_param_specs
 from ..utils.logging import logger
-from .mesh import DATA_AXIS, MODEL_AXIS
+from .mesh import DATA_SHARD, MODEL_AXIS
 
 
 class ZeroShardingPlan(NamedTuple):
@@ -55,7 +55,7 @@ def build_sharding_plan(stage: int, params_or_shapes: Any, axes: Any,
     rules = dict(DEFAULT_TP_RULES if tp_rules is None else tp_rules)
 
     tp_only = resolve_param_specs(params_or_shapes, axes, rules, fsdp_axis=None)
-    fsdp = resolve_param_specs(params_or_shapes, axes, rules, fsdp_axis=DATA_AXIS,
+    fsdp = resolve_param_specs(params_or_shapes, axes, rules, fsdp_axis=DATA_SHARD,
                                fsdp_min_size=fsdp_min_size)
 
     param_specs = fsdp if stage >= 3 else tp_only

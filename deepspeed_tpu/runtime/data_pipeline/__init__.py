@@ -1,0 +1,7 @@
+"""Data-efficiency pipeline — analog of ``deepspeed/runtime/data_pipeline``:
+curriculum learning (scheduler + difficulty-indexed sampler) and random
+layerwise token dropping (random-LTD)."""
+
+from .curriculum_scheduler import CurriculumScheduler  # noqa: F401
+from .data_sampler import CurriculumDataSampler  # noqa: F401
+from .random_ltd import RandomLTDScheduler, sample_token_subset  # noqa: F401

@@ -1,0 +1,85 @@
+"""Curriculum difficulty scheduler.
+
+Reference: ``runtime/data_pipeline/curriculum_scheduler.py:11`` — maps the
+global step to a difficulty value (typically sequence length). Schedules:
+
+  fixed_discrete  explicit (difficulty[i], max_step[i]) staircase
+  fixed_root      min + (step/total)^(1/power) * (max-min), rounded to
+                  difficulty_step multiples (power 1 == fixed_linear)
+  fixed_linear    alias for fixed_root with root_degree 1
+  custom          user callable step -> difficulty
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Dict, Optional
+
+
+class CurriculumScheduler:
+    def __init__(self, config: Dict[str, Any],
+                 custom_fn: Optional[Callable[[int], int]] = None):
+        for key in ("min_difficulty", "max_difficulty", "schedule_type"):
+            if key not in config:
+                raise ValueError(f"curriculum config requires '{key}'")
+        self.min_difficulty = int(config["min_difficulty"])
+        self.max_difficulty = int(config["max_difficulty"])
+        self.schedule_type = config["schedule_type"]
+        self.schedule = dict(config.get("schedule_config", {}))
+        self.current_difficulty = self.min_difficulty
+        self.custom_fn = custom_fn
+
+        if self.schedule_type == "fixed_discrete":
+            diff = self.schedule.get("difficulty")
+            max_step = self.schedule.get("max_step")
+            if not diff or max_step is None or len(diff) != len(max_step) + 1:
+                raise ValueError(
+                    "fixed_discrete needs schedule_config.difficulty (n) and "
+                    "max_step (n-1)")
+        elif self.schedule_type in ("fixed_root", "fixed_linear"):
+            if "total_curriculum_step" not in self.schedule:
+                raise ValueError(f"{self.schedule_type} needs "
+                                 "schedule_config.total_curriculum_step")
+            self.schedule.setdefault("difficulty_step", 8)
+            if self.schedule_type == "fixed_linear":
+                self.schedule["root_degree"] = 1
+            elif "root_degree" not in self.schedule:
+                raise ValueError("fixed_root needs schedule_config.root_degree")
+        elif self.schedule_type == "custom":
+            if custom_fn is None:
+                raise ValueError("custom schedule needs a custom_fn callable")
+        else:
+            raise ValueError(f"unknown curriculum schedule_type "
+                             f"'{self.schedule_type}'")
+
+    def get_difficulty(self, global_step: int) -> int:
+        if self.schedule_type == "fixed_discrete":
+            diff = self.schedule["difficulty"]
+            max_step = self.schedule["max_step"]
+            for d, s in zip(diff, max_step):
+                if global_step <= s:
+                    return int(d)
+            return int(diff[-1])
+        if self.schedule_type in ("fixed_root", "fixed_linear"):
+            total = self.schedule["total_curriculum_step"]
+            power = 1.0 / float(self.schedule["root_degree"])
+            frac = min(1.0, max(0.0, global_step / total))
+            raw = (self.min_difficulty
+                   + (self.max_difficulty - self.min_difficulty)
+                   * (frac ** power))
+            step_q = self.schedule["difficulty_step"]
+            quant = int(raw / step_q) * step_q
+            return int(min(self.max_difficulty,
+                           max(self.min_difficulty, quant)))
+        return int(min(self.max_difficulty,
+                       max(self.min_difficulty, self.custom_fn(global_step))))
+
+    def update_difficulty(self, global_step: int) -> int:
+        self.current_difficulty = self.get_difficulty(global_step)
+        return self.current_difficulty
+
+    def state_dict(self) -> Dict[str, Any]:
+        return {"current_difficulty": self.current_difficulty}
+
+    def load_state_dict(self, state: Dict[str, Any]) -> None:
+        self.current_difficulty = state["current_difficulty"]

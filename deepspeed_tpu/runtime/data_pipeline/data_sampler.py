@@ -1,0 +1,69 @@
+"""Curriculum-aware data sampler.
+
+Reference: ``data_pipeline/data_sampling/data_sampler.py:36``
+(DeepSpeedDataSampler): given a difficulty index per example (produced
+offline by the reference's DataAnalyzer), each step samples only examples
+whose difficulty <= the scheduler's current value, deterministically across
+ranks and resumable from a step counter.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Optional, Sequence
+
+import numpy as np
+
+from .curriculum_scheduler import CurriculumScheduler
+
+
+class CurriculumDataSampler:
+    """Yields index batches filtered by current curriculum difficulty."""
+
+    def __init__(self, difficulties: Sequence[int], batch_size: int,
+                 scheduler: CurriculumScheduler, seed: int = 0,
+                 drop_last: bool = True):
+        self.difficulties = np.asarray(difficulties)
+        if self.difficulties.ndim != 1 or len(self.difficulties) == 0:
+            raise ValueError("difficulties must be a non-empty 1-D sequence")
+        self.batch_size = int(batch_size)
+        self.scheduler = scheduler
+        self.seed = seed
+        self.drop_last = drop_last
+        self.global_step = 0
+        # pre-sort once: eligibility at difficulty d is a prefix of this order
+        self._order = np.argsort(self.difficulties, kind="stable")
+        self._sorted = self.difficulties[self._order]
+
+    def eligible(self, difficulty: int) -> np.ndarray:
+        """Indices with difficulty <= threshold (ascending-difficulty order)."""
+        cutoff = int(np.searchsorted(self._sorted, difficulty, side="right"))
+        return self._order[:cutoff]
+
+    def sample_batch(self, global_step: Optional[int] = None) -> np.ndarray:
+        step = self.global_step if global_step is None else global_step
+        difficulty = self.scheduler.update_difficulty(step)
+        pool = self.eligible(difficulty)
+        if len(pool) == 0:
+            raise ValueError(
+                f"no examples at difficulty <= {difficulty} — lower "
+                "min_difficulty or re-index the dataset")
+        rng = np.random.RandomState((self.seed * 1_000_003 + step) % 2**31)
+        if len(pool) >= self.batch_size:
+            picked = rng.choice(pool, size=self.batch_size, replace=False)
+        else:
+            picked = rng.choice(pool, size=self.batch_size, replace=True)
+        if global_step is None:
+            self.global_step += 1
+        return picked
+
+    def __iter__(self) -> Iterator[np.ndarray]:
+        while True:
+            yield self.sample_batch()
+
+    def state_dict(self):
+        return {"global_step": self.global_step,
+                "scheduler": self.scheduler.state_dict()}
+
+    def load_state_dict(self, state):
+        self.global_step = state["global_step"]
+        self.scheduler.load_state_dict(state["scheduler"])

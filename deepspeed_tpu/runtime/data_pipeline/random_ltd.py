@@ -1,0 +1,73 @@
+"""Random layerwise token dropping (random-LTD).
+
+Reference: ``data_pipeline/data_routing/basic_layer.py:14``
+(RandomLayerTokenDrop) + ``scheduler.py:38`` (RandomLTDScheduler) + the
+CUDA token_sort/gather_scatter kernels (csrc/random_ltd). The kernels'
+job — pick a random token subset, gather it, run the layer, scatter back —
+is three jnp ops on TPU; the schedule (how many tokens survive per step)
+is the same fixed_linear ramp.
+
+Static-shape discipline: the kept-token count changes only at schedule
+boundaries, so each count compiles once (jit cache discipline, like the
+curriculum seqlen).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .curriculum_scheduler import CurriculumScheduler
+
+
+class RandomLTDScheduler:
+    """Tokens-to-keep schedule (reference RandomLTDScheduler): a fixed_linear
+    ramp from ``random_ltd_layer_token`` up to the full sequence length."""
+
+    def __init__(self, config: Dict[str, Any]):
+        sched = config.get("schedule_config", config)
+        self.scheduler = CurriculumScheduler({
+            "min_difficulty": sched.get("min_value",
+                                        config.get("min_value", 128)),
+            "max_difficulty": sched.get("max_value",
+                                        config.get("max_value", 1024)),
+            "schedule_type": "fixed_linear",
+            "schedule_config": {
+                "total_curriculum_step": sched.get("total_layer_token_step",
+                                                   sched.get("total_curriculum_step", 1000)),
+                "difficulty_step": sched.get("difficulty_step", 8),
+            },
+        })
+
+    def get_seq_len(self, global_step: int) -> int:
+        return self.scheduler.update_difficulty(global_step)
+
+    def state_dict(self):
+        return self.scheduler.state_dict()
+
+    def load_state_dict(self, state):
+        self.scheduler.load_state_dict(state)
+
+
+def sample_token_subset(rng: jax.Array, seq_len: int, keep: int
+                        ) -> Tuple[jax.Array, jax.Array]:
+    """Random sorted subset of token positions (reference token_sort.cu):
+    returns (kept_idx (keep,), mask (seq_len,) bool)."""
+    perm = jax.random.permutation(rng, seq_len)
+    kept = jnp.sort(perm[:keep])
+    mask = jnp.zeros((seq_len,), bool).at[kept].set(True)
+    return kept, mask
+
+
+def gather_tokens(x: jax.Array, kept_idx: jax.Array) -> jax.Array:
+    """x (B, S, H) -> (B, keep, H) (reference gather_scatter.cu gather)."""
+    return jnp.take(x, kept_idx, axis=1)
+
+
+def scatter_tokens(full: jax.Array, part: jax.Array,
+                   kept_idx: jax.Array) -> jax.Array:
+    """Write processed kept tokens back into the full sequence; dropped
+    tokens keep their input activations (the reference's skip behavior)."""
+    return full.at[:, kept_idx].set(part)

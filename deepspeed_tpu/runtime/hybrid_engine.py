@@ -1,0 +1,88 @@
+"""Hybrid engine — RLHF training + generation sharing one weight set.
+
+Reference: ``runtime/hybrid_engine.py:32`` (DeepSpeedHybridEngine): trains
+like DeepSpeedEngine and serves ``generate()`` with the inference kernels,
+flipping the SAME weights between the two layouts (ZeRO-3 gathers per layer
+at generation, inference-sharded containers at :353-396).
+
+TPU rendering: the training params are global jax Arrays, so the "flip" is a
+``device_put`` onto the inference shardings (XLA emits the gather from the
+fsdp layout) — no per-layer hook machinery. The inference side is the
+standard InferenceEngine (KV arena, decode kernel, buckets); its params are
+refreshed from the training state on every generate after a train step.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+
+from ..utils.logging import log_dist
+from .engine import TrainEngine
+
+
+class HybridEngine(TrainEngine):
+    """TrainEngine + generate(). Construct via ``initialize(...,
+    hybrid_engine=True)`` or directly."""
+
+    def __init__(self, *args, inference_tp_size: int = 1,
+                 max_out_tokens: int = 1024, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._inference_tp = inference_tp_size
+        self._max_out_tokens = max_out_tokens
+        self._infer = None
+        self._infer_params_step = -1
+
+    def _inference_engine(self):
+        if self._infer is None:
+            from ..inference.engine import InferenceConfig, InferenceEngine
+            from ..models.core import Model
+
+            base = self.model
+            cfg = base.config
+            if base.pipelined:
+                from ..models.transformer import build_model
+
+                base = build_model(cfg, name=base.name + "-infer")
+            icfg = InferenceConfig(dtype=self.compute_dtype,
+                                   tensor_parallel=self._inference_tp,
+                                   max_out_tokens=self._max_out_tokens)
+            self._infer = InferenceEngine(base, icfg,
+                                          params=self._export_params())
+            self._infer_params_step = self.global_steps
+            log_dist("hybrid engine: inference side ready "
+                     f"(tp={self._inference_tp}, "
+                     f"arena={self._max_out_tokens})")
+        return self._infer
+
+    def _export_params(self) -> Any:
+        params = self.params
+        if self.model.pipelined:
+            from ..parallel.pipeline import _merge_stages
+
+            params = dict(params)
+            params["layers"] = _merge_stages(params["layers"])
+        return params
+
+    def refresh_inference_params(self) -> None:
+        """Reshard the CURRENT training weights into the inference layout
+        (the reference's train->eval flip, hybrid_engine.py:353)."""
+        infer = self._inference_engine()
+        params = self._export_params()
+        infer.params = jax.tree.map(
+            lambda x, s: jax.device_put(x, s), params, infer.param_shardings)
+        self._infer_params_step = self.global_steps
+
+    def generate(self, input_ids, **kwargs):
+        infer = self._inference_engine()
+        if self._infer_params_step != self.global_steps:
+            self.refresh_inference_params()
+        self.mark_step_boundary()
+        return infer.generate(input_ids, **kwargs)
+
+    def eval(self) -> None:  # reference API parity (module.eval() flip)
+        self.refresh_inference_params()
+
+    def train(self) -> None:
+        pass  # training state is always live; nothing to un-fuse

@@ -1,0 +1,60 @@
+"""Autotuner tests — experiment generation without runs (reference
+tests/unit/autotuning/test_autotuning.py pattern) + in-process scheduler."""
+
+import json
+
+import pytest
+
+from deepspeed_tpu.autotuning import (Autotuner, generate_experiments,
+                                      grid_space, random_space)
+
+BASE = {"train_micro_batch_size_per_gpu": 1,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-3}}}
+
+
+def test_grid_space_counts():
+    space = {"a": [1, 2], "b.c": ["x", "y", "z"]}
+    assert len(grid_space(space)) == 6
+
+
+def test_random_space_subsample_deterministic():
+    space = {"a": list(range(10)), "b": list(range(10))}
+    s1 = random_space(space, 7, seed=3)
+    s2 = random_space(space, 7, seed=3)
+    assert s1 == s2 and len(s1) == 7
+    assert random_space(space, 1000) == grid_space(space)
+
+
+def test_generate_experiments_applies_nested_overrides():
+    exps = generate_experiments(
+        BASE, {"train_micro_batch_size_per_gpu": [2, 4],
+               "zero_optimization.stage": [0, 3]})
+    assert len(exps) == 4
+    names = [n for n, _ in exps]
+    assert len(set(names)) == 4
+    for name, cfg in exps:
+        assert cfg["zero_optimization"]["stage"] in (0, 3)
+        assert cfg["train_micro_batch_size_per_gpu"] in (2, 4)
+        # base not mutated
+    assert "zero_optimization" not in BASE
+
+
+def test_unknown_tuner_rejected():
+    with pytest.raises(ValueError, match="tuner_type"):
+        generate_experiments(BASE, {"a": [1]}, tuner_type="bayes")
+
+
+def test_tune_picks_best_and_writes_summary(tmp_path):
+    def fake_runner(name, cfg):
+        mb = cfg["train_micro_batch_size_per_gpu"]
+        if cfg["zero_optimization"]["stage"] == 3 and mb == 8:
+            return None  # simulated OOM
+        return mb * (1.0 + cfg["zero_optimization"]["stage"])
+
+    tuner = Autotuner(BASE, results_dir=str(tmp_path), runner=fake_runner)
+    best, val = tuner.tune(space={"train_micro_batch_size_per_gpu": [2, 8],
+                                  "zero_optimization.stage": [0, 3]})
+    assert val == 8.0  # mb8/stage0 wins since mb8/stage3 "OOMs"
+    summary = json.loads((tmp_path / "summary.json").read_text())
+    assert summary["best"] == best
+    assert len(summary["results"]) == 4

@@ -1,0 +1,92 @@
+"""Compression suite tests — scheduler offsets, fake-quant STE, pruning
+masks, layer reduction (reference tests/unit/compression/test_compression.py
+concerns re-expressed over param pytrees)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.compression import (CompressionScheduler, apply_compression,
+                                       init_compression, layer_reduction_init)
+
+
+CFG = {
+    "compression_training": {
+        "weight_quantization": {
+            "shared_parameters": {"enabled": True, "schedule_offset": 10},
+            "different_groups": {
+                "g0": {"params": {"start_bits": 8, "target_bits": 4},
+                       "modules": ["attn", "mlp"]}},
+        },
+        "sparse_pruning": {
+            "shared_parameters": {"enabled": True, "schedule_offset": 20},
+            "different_groups": {
+                "g0": {"params": {"dense_ratio": 0.5}, "modules": ["mlp"]}},
+        },
+        "layer_reduction": {"enabled": True, "keep_number_layer": 2,
+                            "teacher_layer": [0, 3]},
+    }
+}
+
+
+def test_plan_and_schedule():
+    plan = init_compression(CFG)
+    sched = CompressionScheduler(plan)
+    assert sched.active_methods(5) == frozenset()
+    assert sched.active_methods(10) == {"weight_quantization"}
+    assert sched.active_methods(25) == {"weight_quantization",
+                                        "sparse_pruning"}
+    assert plan.matches("weight_quantization", "layers/attn/wq")
+    assert not plan.matches("sparse_pruning", "layers/attn/wq")
+
+
+def test_fake_quant_straight_through():
+    plan = init_compression(CFG)
+    params = {"layers": {"attn": {"wq": jnp.linspace(-1, 1, 64).reshape(8, 8)}}}
+
+    def loss(p):
+        q = apply_compression(p, plan, frozenset({"weight_quantization"}))
+        return jnp.sum(q["layers"]["attn"]["wq"] ** 2)
+
+    q = apply_compression(params, plan, frozenset({"weight_quantization"}))
+    w = np.asarray(params["layers"]["attn"]["wq"])
+    wq = np.asarray(q["layers"]["attn"]["wq"])
+    # 4-bit: few distinct levels, bounded error
+    assert len(np.unique(wq)) <= 16
+    assert np.abs(wq - w).max() <= np.abs(w).max() / 7 + 1e-6
+    # straight-through: grads flow as if identity-ish (non-zero everywhere)
+    g = jax.grad(loss)(params)["layers"]["attn"]["wq"]
+    assert float(jnp.abs(g).sum()) > 0
+
+
+def test_sparse_pruning_mask():
+    plan = init_compression(CFG)
+    params = {"layers": {"mlp": {"w_up": jnp.asarray(
+        np.random.RandomState(0).randn(16, 16), jnp.float32)}}}
+    out = apply_compression(params, plan, frozenset({"sparse_pruning"}))
+    w = np.asarray(out["layers"]["mlp"]["w_up"])
+    sparsity = (w == 0).mean()
+    assert 0.45 <= sparsity <= 0.55
+    # kept entries are the largest-magnitude ones
+    orig = np.abs(np.asarray(params["layers"]["mlp"]["w_up"]))
+    assert orig[w != 0].min() >= orig[w == 0].max() - 1e-6
+
+
+def test_inactive_is_identity():
+    plan = init_compression(CFG)
+    params = {"layers": {"attn": {"wq": jnp.ones((4, 4))}}}
+    out = apply_compression(params, plan, frozenset())
+    assert out["layers"]["attn"]["wq"] is params["layers"]["attn"]["wq"]
+
+
+def test_layer_reduction():
+    from deepspeed_tpu.models import create_model
+
+    model = create_model("tiny", dtype=jnp.float32, num_layers=4)
+    params = model.init(jax.random.PRNGKey(0))
+    student = layer_reduction_init(params, [0, 3])
+    assert student["layers"]["attn"]["wq"].shape[0] == 2
+    np.testing.assert_allclose(np.asarray(student["layers"]["attn"]["wq"][1]),
+                               np.asarray(params["layers"]["attn"]["wq"][3]))
+    np.testing.assert_allclose(np.asarray(student["embed"]["tokens"]),
+                               np.asarray(params["embed"]["tokens"]))

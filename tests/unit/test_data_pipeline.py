@@ -1,0 +1,118 @@
+"""Data-efficiency tests — curriculum scheduler math (reference
+test_data_efficiency.py semantics), sampler eligibility/resume, random-LTD
+subset mechanics."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime.data_pipeline import (CurriculumDataSampler,
+                                                 CurriculumScheduler,
+                                                 RandomLTDScheduler,
+                                                 sample_token_subset)
+from deepspeed_tpu.runtime.data_pipeline.random_ltd import (gather_tokens,
+                                                            scatter_tokens)
+
+
+class TestCurriculumScheduler:
+    def test_fixed_discrete(self):
+        s = CurriculumScheduler({
+            "min_difficulty": 1, "max_difficulty": 3,
+            "schedule_type": "fixed_discrete",
+            "schedule_config": {"difficulty": [1, 2, 3], "max_step": [5, 10]}})
+        assert s.get_difficulty(1) == 1
+        assert s.get_difficulty(5) == 1
+        assert s.get_difficulty(6) == 2
+        assert s.get_difficulty(10) == 2
+        assert s.get_difficulty(11) == 3
+        assert s.get_difficulty(10_000) == 3
+
+    def test_fixed_linear_ramp(self):
+        s = CurriculumScheduler({
+            "min_difficulty": 8, "max_difficulty": 128,
+            "schedule_type": "fixed_linear",
+            "schedule_config": {"total_curriculum_step": 100,
+                                "difficulty_step": 8}})
+        assert s.get_difficulty(0) == 8
+        assert s.get_difficulty(100) == 128
+        mid = s.get_difficulty(50)
+        assert 56 <= mid <= 72 and mid % 8 == 0
+        # monotone
+        vals = [s.get_difficulty(t) for t in range(0, 110, 10)]
+        assert vals == sorted(vals)
+
+    def test_fixed_root_slower_start(self):
+        lin = CurriculumScheduler({
+            "min_difficulty": 0, "max_difficulty": 100,
+            "schedule_type": "fixed_linear",
+            "schedule_config": {"total_curriculum_step": 100,
+                                "difficulty_step": 1}})
+        root = CurriculumScheduler({
+            "min_difficulty": 0, "max_difficulty": 100,
+            "schedule_type": "fixed_root",
+            "schedule_config": {"total_curriculum_step": 100,
+                                "root_degree": 2, "difficulty_step": 1}})
+        # sqrt ramp rises faster early
+        assert root.get_difficulty(25) > lin.get_difficulty(25)
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="min_difficulty"):
+            CurriculumScheduler({"max_difficulty": 2,
+                                 "schedule_type": "fixed_linear"})
+        with pytest.raises(ValueError, match="schedule_type"):
+            CurriculumScheduler({"min_difficulty": 1, "max_difficulty": 2,
+                                 "schedule_type": "warp"})
+
+
+class TestCurriculumSampler:
+    def _sampler(self, bs=4):
+        sched = CurriculumScheduler({
+            "min_difficulty": 1, "max_difficulty": 10,
+            "schedule_type": "fixed_linear",
+            "schedule_config": {"total_curriculum_step": 10,
+                                "difficulty_step": 1}})
+        diffs = np.arange(100) % 10 + 1
+        return CurriculumDataSampler(diffs, bs, sched, seed=1), diffs
+
+    def test_respects_difficulty(self):
+        sampler, diffs = self._sampler()
+        batch = sampler.sample_batch(global_step=0)   # difficulty 1
+        assert (diffs[batch] <= 1).all()
+        batch = sampler.sample_batch(global_step=5)   # difficulty ~5
+        assert (diffs[batch] <= sampler.scheduler.current_difficulty).all()
+
+    def test_deterministic_and_resumable(self):
+        s1, _ = self._sampler()
+        s2, _ = self._sampler()
+        b1 = [s1.sample_batch() for _ in range(5)]
+        s2.load_state_dict({"global_step": 3,
+                            "scheduler": {"current_difficulty": 1}})
+        b2 = [s2.sample_batch() for _ in range(2)]
+        np.testing.assert_array_equal(b1[3], b2[0])
+        np.testing.assert_array_equal(b1[4], b2[1])
+
+
+class TestRandomLTD:
+    def test_schedule_ramp(self):
+        s = RandomLTDScheduler({"min_value": 64, "max_value": 512,
+                                "schedule_config": {
+                                    "total_layer_token_step": 100,
+                                    "difficulty_step": 8}})
+        assert s.get_seq_len(0) == 64
+        assert s.get_seq_len(100) == 512
+
+    def test_subset_gather_scatter_roundtrip(self):
+        rng = jax.random.PRNGKey(0)
+        kept, mask = sample_token_subset(rng, 16, 6)
+        assert kept.shape == (6,) and int(mask.sum()) == 6
+        assert (np.diff(np.asarray(kept)) > 0).all()  # sorted
+        x = jnp.arange(2 * 16 * 4, dtype=jnp.float32).reshape(2, 16, 4)
+        part = gather_tokens(x, kept)
+        assert part.shape == (2, 6, 4)
+        back = scatter_tokens(x, part * 2, kept)
+        np.testing.assert_allclose(np.asarray(back[:, kept]),
+                                   np.asarray(part) * 2)
+        inv = ~np.asarray(mask)
+        np.testing.assert_allclose(np.asarray(back[:, inv]),
+                                   np.asarray(x[:, inv]))

@@ -27,7 +27,7 @@ def test_build_mesh_default(devices8):
 
 def test_build_mesh_3d(devices8):
     m = build(pp=2, tp=2)
-    assert m.shape == {"pipe": 2, "data": 2, "seq": 1, "model": 2}
+    assert dict(m.shape) == {"pipe": 2, "expert": 1, "data": 2, "seq": 1, "model": 2}
 
 
 def test_build_mesh_invalid(devices8):

@@ -90,9 +90,9 @@ class TestMoETraining:
     def test_moe_expert_parallel(self):
         """EP over the 8-device data axis: experts sharded, training works."""
         engine = _engine("moe-tiny", ep=8)
-        # expert weight leading dim sharded over data
+        # expert weight leading dim sharded over the 'expert' mesh axis
         spec = engine.plan.param_specs["layers"]["mlp"]["w_up"]
-        assert "data" in str(spec)
+        assert "expert" in str(spec)
         batch = _token_batch(engine)
         losses = [float(engine.train_batch(batch=batch)) for _ in range(5)]
         assert all(np.isfinite(losses))
@@ -174,3 +174,73 @@ class TestSequenceParallel:
                         "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
                         "zero_optimization": {"stage": 2},
                         "parallel": {"pipeline_parallel_size": 2}})
+
+
+class TestMoEV2:
+    def test_ep_smaller_than_dp_matches_dense(self):
+        """ep=2 < total dp=8: experts shard over the 'expert' axis, each
+        expert replicated across 4 'data' ranks — trajectory identical to
+        no-EP (reference expert-data-parallel groups, groups.py:156)."""
+        e1 = _engine("moe-tiny", ep=1)
+        e2 = _engine("moe-tiny", ep=2)
+        assert int(e2.mesh.shape["expert"]) == 2
+        assert int(e2.mesh.shape["data"]) == 4
+        batch = _token_batch(e1)
+        l1 = [float(e1.train_batch(batch=batch)) for _ in range(3)]
+        l2 = [float(e2.train_batch(batch=batch)) for _ in range(3)]
+        np.testing.assert_allclose(l1, l2, rtol=1e-4)
+
+    def test_no_drop_keeps_every_token(self):
+        from deepspeed_tpu.parallel.moe import top1gating
+
+        logits = jax.random.normal(jax.random.PRNGKey(0), (64, 4))
+        # heavily skewed: without capacity all tokens must still dispatch
+        logits = logits.at[:, 0].add(5.0)
+        out = top1gating(logits, capacity_factor=1.0, drop_tokens=False)
+        assert float(out.dispatch.sum()) == 64.0
+        dropped = top1gating(logits, capacity_factor=1.0, drop_tokens=True)
+        assert float(dropped.dispatch.sum()) < 64.0
+
+    def test_no_drop_top2(self):
+        from deepspeed_tpu.parallel.moe import top2gating
+
+        logits = jax.random.normal(jax.random.PRNGKey(2), (64, 4))
+        logits = logits.at[:, 0].add(5.0)
+        out = top2gating(logits, capacity_factor=0.5, drop_tokens=False)
+        # every token keeps both its experts
+        assert float(out.dispatch.sum()) == 128.0
+
+    def test_rts_top2_rejected(self):
+        from deepspeed_tpu.parallel.moe import moe_mlp
+
+        x = jnp.zeros((1, 8, 16))
+        router = jnp.zeros((16, 4))
+        experts = {"w_up": jnp.zeros((4, 16, 32)),
+                   "w_down": jnp.zeros((4, 32, 16))}
+        with pytest.raises(ValueError, match="top-1 only"):
+            moe_mlp(x, router, experts, "gelu", top_k=2, use_rts=True,
+                    rng=jax.random.PRNGKey(0))
+
+    def test_rts_random_selection(self):
+        from deepspeed_tpu.parallel.moe import top1gating
+
+        logits = jnp.zeros((64, 2)).at[:, 0].add(1.0)  # all want expert 0
+        seq = top1gating(logits, capacity_factor=1.0)
+        rts = top1gating(logits, capacity_factor=1.0, use_rts=True,
+                         rng=jax.random.PRNGKey(3))
+        C = 32
+        assert float(seq.dispatch.sum()) == C and float(rts.dispatch.sum()) == C
+        # sequential keeps the FIRST C tokens; RTS keeps a random subset
+        seq_tokens = np.asarray(seq.dispatch.sum(axis=(1, 2)))
+        rts_tokens = np.asarray(rts.dispatch.sum(axis=(1, 2)))
+        assert (seq_tokens[:C] == 1).all()
+        assert not (rts_tokens[:C] == 1).all()
+
+    def test_pr_moe_residual_trains(self):
+        engine = _engine("moe-tiny", ep=1, moe_use_residual=True,
+                         moe_top_k=1)
+        assert "res_mlp" in engine.params["layers"]
+        batch = _token_batch(engine)
+        losses = [float(engine.train_batch(batch=batch)) for _ in range(6)]
+        assert all(np.isfinite(losses))
+        assert losses[-1] < losses[0]

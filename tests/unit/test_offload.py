@@ -1,0 +1,41 @@
+"""Offload config-surface tests (CPU side). The functional validation runs
+on real TPU hardware via scripts/validate_offload_tpu.py — XLA CPU cannot
+lower host-pinned jit operands, so trajectory/memory checks cannot run on
+the virtual mesh."""
+
+import jax.numpy as jnp
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models import create_model
+
+
+def _cfg(**offload):
+    return {
+        "train_micro_batch_size_per_gpu": 2,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 0, **offload},
+    }
+
+
+def test_cpu_offload_rejected_on_cpu_backend():
+    model = create_model("tiny", dtype=jnp.float32)
+    with pytest.raises(ValueError, match="host memory kinds"):
+        deepspeed_tpu.initialize(
+            model=model,
+            config=_cfg(offload_optimizer={"device": "cpu"}))
+
+
+def test_nvme_offload_fails_loudly():
+    model = create_model("tiny", dtype=jnp.float32)
+    with pytest.raises(NotImplementedError, match="nvme"):
+        deepspeed_tpu.initialize(
+            model=model,
+            config=_cfg(offload_optimizer={"device": "nvme"}))
+
+
+def test_param_offload_fails_loudly():
+    model = create_model("tiny", dtype=jnp.float32)
+    with pytest.raises(NotImplementedError, match="offload_param"):
+        deepspeed_tpu.initialize(
+            model=model, config=_cfg(offload_param={"device": "cpu"}))

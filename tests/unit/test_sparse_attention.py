@@ -1,0 +1,102 @@
+"""Block-sparse attention tests — layout properties per config family
+(reference tests/unit/ops/sparse_attention concerns) + masked-attention
+equivalence."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.models.transformer import dot_product_attention
+from deepspeed_tpu.ops.sparse_attention import (BigBirdSparsityConfig,
+                                                BSLongformerSparsityConfig,
+                                                DenseSparsityConfig,
+                                                FixedSparsityConfig,
+                                                LocalSlidingWindowSparsityConfig,
+                                                layout_to_token_mask,
+                                                sparse_self_attention)
+
+
+def test_dense_layout_full():
+    layout = DenseSparsityConfig(num_heads=4, block=16).make_layout(64)
+    assert layout.shape == (4, 4, 4)
+    assert (layout == 1).all()
+
+
+def test_fixed_unidirectional_causal_and_global():
+    cfg = FixedSparsityConfig(num_heads=2, block=16, num_local_blocks=2,
+                              num_global_blocks=1, attention="unidirectional")
+    layout = cfg.make_layout(128)  # 8 blocks
+    # strictly-causal: nothing above the diagonal
+    assert (np.triu(layout[0], 1) == 0).all()
+    # diagonal always attends itself
+    assert (np.diag(layout[0]) == 1).all()
+    # global stripe: block 1 (last of first window) visible to later rows
+    assert (layout[0][2:, 1] == 1).all()
+
+
+def test_sliding_window_band():
+    cfg = LocalSlidingWindowSparsityConfig(num_heads=1, block=16,
+                                           num_sliding_window_blocks=3)
+    layout = cfg.make_layout(128)[0]
+    for i in range(8):
+        for j in range(8):
+            expect = 1 if (i - 1 <= j <= i) else 0
+            assert layout[i, j] == expect, (i, j)
+
+
+def test_bigbird_has_window_random_global():
+    cfg = BigBirdSparsityConfig(num_heads=1, block=16, num_random_blocks=2,
+                                num_sliding_window_blocks=3,
+                                num_global_blocks=1, seed=0)
+    layout = cfg.make_layout(256)[0]     # 16 blocks
+    assert (np.diag(layout) == 1).all()
+    assert (layout[:, 0] == 1).all() and (layout[0, :] == 1).all()
+    density = layout.mean()
+    assert 0.1 < density < 0.8           # sparse but not trivial
+
+
+def test_longformer_global_indices():
+    cfg = BSLongformerSparsityConfig(num_heads=1, block=16,
+                                     global_block_indices=(0, 3))
+    layout = cfg.make_layout(128)[0]
+    assert (layout[:, 0] == 1).all() and (layout[3, :] == 1).all()
+
+
+def test_block_divisibility_enforced():
+    with pytest.raises(ValueError, match="divisible"):
+        DenseSparsityConfig(num_heads=1, block=16).make_layout(100)
+
+
+def test_sparse_attention_matches_masked_dense():
+    cfg = FixedSparsityConfig(num_heads=2, block=16, num_local_blocks=2,
+                              attention="unidirectional")
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(ks[0], (2, 64, 2, 16))
+    k = jax.random.normal(ks[1], (2, 64, 2, 16))
+    v = jax.random.normal(ks[2], (2, 64, 2, 16))
+    out = sparse_self_attention(q, k, v, cfg)
+    tok = layout_to_token_mask(cfg.make_layout(64), 16)
+    tok = tok * jnp.tril(jnp.ones((64, 64), jnp.int32))[None]  # unidirectional
+    for h in range(2):
+        ref = dot_product_attention(q[:, :, h:h + 1], k[:, :, h:h + 1],
+                                    v[:, :, h:h + 1],
+                                    jnp.broadcast_to(tok[h][None], (2, 64, 64)),
+                                    causal=False)
+        np.testing.assert_allclose(np.asarray(out[:, :, h:h + 1]),
+                                   np.asarray(ref), atol=1e-6)
+
+
+def test_dense_config_equals_causal_attention():
+    # dense unidirectional layout == plain causal attention
+    cfg = FixedSparsityConfig(num_heads=1, block=16, num_local_blocks=4,
+                              num_global_blocks=1,
+                              attention="unidirectional")
+    # with window >= nblocks the local part covers everything
+    ks = jax.random.split(jax.random.PRNGKey(1), 3)
+    q = jax.random.normal(ks[0], (1, 64, 1, 16))
+    k = jax.random.normal(ks[1], (1, 64, 1, 16))
+    v = jax.random.normal(ks[2], (1, 64, 1, 16))
+    out = sparse_self_attention(q, k, v, cfg)
+    ref = dot_product_attention(q, k, v, None, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-6)
