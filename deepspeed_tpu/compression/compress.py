@@ -51,9 +51,15 @@ def init_compression(config: Dict[str, Any]) -> CompressionPlan:
     """Parse the ``compression_training`` section into a plan (reference
     init_compression's policy extraction, module-walk deferred to apply)."""
     section = config.get("compression_training", config)
+    if section.get("activation_quantization", {}).get(
+            "shared_parameters", {}).get("enabled", False):
+        raise NotImplementedError(
+            "activation_quantization needs a forward-activation hook, not a "
+            "param transform — not implemented yet (weight_quantization and "
+            "sparse/row/head pruning are)")
     methods: Dict[str, Dict[str, Any]] = {}
     for name in ("weight_quantization", "sparse_pruning", "row_pruning",
-                 "head_pruning", "activation_quantization"):
+                 "head_pruning"):
         spec = section.get(name)
         if not spec:
             continue
@@ -110,6 +116,19 @@ def _magnitude_mask(w: jax.Array, dense_ratio: float, axis=None) -> jax.Array:
     return keep.reshape(shape).astype(w.dtype)
 
 
+def _head_mask(w: jax.Array, num_heads: int, dense_ratio: float) -> jax.Array:
+    """Keep top ``dense_ratio`` heads by L1 norm of their slice of the last
+    dim (reference head pruning scores the attention output projection)."""
+    hd = w.shape[-1] // num_heads
+    scores = jnp.abs(w.astype(jnp.float32)).reshape(
+        -1, num_heads, hd).sum(axis=(0, 2))
+    k = max(1, int(round(num_heads * dense_ratio)))
+    thresh = jnp.sort(scores)[-k]
+    keep = (scores >= thresh).astype(w.dtype)              # (num_heads,)
+    mask = jnp.repeat(keep, hd)
+    return mask.reshape((1,) * (w.ndim - 1) + (w.shape[-1],))
+
+
 def apply_compression(params: Any, plan: CompressionPlan,
                       active: FrozenSet[str]) -> Any:
     """Pure transform: apply every active method to matching params. Runs
@@ -139,6 +158,14 @@ def apply_compression(params: Any, plan: CompressionPlan,
                               .get("dense_ratio", 0.5))
                 w = w * jax.lax.stop_gradient(
                     _magnitude_mask(w, ratio, axis=w.ndim - 1))
+            if "head_pruning" in active and plan.matches("head_pruning", key):
+                hp = plan.methods["head_pruning"]["params"]
+                ratio = float(hp.get("dense_ratio", 0.5))
+                heads = int(hp.get("num_heads", 0))
+                if heads <= 0:
+                    raise ValueError("head_pruning requires params.num_heads")
+                if w.shape[-1] % heads == 0:
+                    w = w * jax.lax.stop_gradient(_head_mask(w, heads, ratio))
         out.append(w)
     return jax.tree_util.tree_unflatten(treedef, [l for l in out])
 

@@ -69,7 +69,7 @@ class FixedSparsityConfig(SparsityConfig):
 
     num_local_blocks: int = 4
     num_global_blocks: int = 1
-    attention: str = "bidirectional"      # 'unidirectional' | 'bidirectional'
+    attention: str = "unidirectional"     # reference default (GPT-3 pattern)
     horizontal_global_attention: bool = False
 
     def __post_init__(self):
@@ -216,7 +216,10 @@ def sparse_self_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     mask = jnp.broadcast_to(tok[None], (B, N, S, S))
     if key_padding_mask is not None:
         mask = mask * key_padding_mask[:, None, None, :].astype(jnp.int32)
-    # per-head masks: run heads through the shared (B,S,T) mask path
+    if not config.different_layout_per_head:
+        # all heads share one layout: a single head-batched call with the
+        # (B,S,T) mask (dot_product_attention broadcasts it over heads)
+        return dot_product_attention(q, k, v, mask[:, 0], causal=False)
     outs = []
     for h in range(N):
         outs.append(dot_product_attention(

@@ -199,6 +199,44 @@ class TrainEngine:
                 training_data, batch_size=per_process,
                 collate_fn=collate_fn, seed=self.config.seed)
 
+        # curriculum learning (reference engine.py:1653 seqlen curriculum)
+        self._curriculum = None
+        if self.config.curriculum_learning.enabled:
+            if self.config.curriculum_learning.curriculum_type != "seqlen":
+                raise NotImplementedError(
+                    "only curriculum_type='seqlen' is implemented (the "
+                    "reference's primary mode); difficulty-indexed data "
+                    "selection is runtime/data_pipeline.CurriculumDataSampler")
+            from .data_pipeline import CurriculumScheduler
+
+            cl = self.config.curriculum_learning
+            self._curriculum = CurriculumScheduler({
+                "min_difficulty": cl.min_difficulty,
+                "max_difficulty": cl.max_difficulty,
+                "schedule_type": cl.schedule_type,
+                "schedule_config": dict(cl.schedule_config)})
+
+        # compression (reference compress.py:95 init_compression + scheduler)
+        self._compression_plan = None
+        self._compression_active = frozenset()
+        comp_cfg = {k: v for k, v in {
+            "weight_quantization": self.config.compression_training.weight_quantization,
+            "activation_quantization": self.config.compression_training.activation_quantization,
+            "sparse_pruning": self.config.compression_training.sparse_pruning,
+            "row_pruning": self.config.compression_training.row_pruning,
+            "head_pruning": self.config.compression_training.head_pruning,
+        }.items() if v}
+        if comp_cfg:
+            from ..compression import CompressionScheduler, init_compression
+
+            if self.model.pipelined:
+                raise NotImplementedError(
+                    "compression_training with pipeline parallelism is not "
+                    "supported yet")
+            self._compression_plan = init_compression(comp_cfg)
+            self._compression_sched = CompressionScheduler(self._compression_plan)
+            self._compression_active = self._compression_sched.active_methods(0)
+
         # bookkeeping
         self.global_steps = 0
         self.micro_steps = 0
@@ -469,8 +507,21 @@ class TrainEngine:
 
         pipelined = model.pipelined
 
+        base_loss_fn = model.loss_fn
+        if self._compression_plan is not None and self._compression_active:
+            from ..compression import apply_compression
+
+            plan = self._compression_plan
+            active = self._compression_active
+            orig = base_loss_fn
+            # QAT straight-through: compression transform inside the
+            # differentiation path; the step is rebuilt when the scheduler's
+            # active-method set changes (one recompile per boundary)
+            base_loss_fn = lambda p, b: orig(
+                apply_compression(p, plan, active), b)
+
         def micro_loss(params, mb, scale):
-            loss = model.loss_fn(params, mb)
+            loss = base_loss_fn(params, mb)
             return loss * scale / gas, loss
 
         grad_fn = jax.value_and_grad(micro_loss, has_aux=True)
@@ -561,6 +612,19 @@ class TrainEngine:
                 raise ValueError(
                     f"batch leading dim {leading} != gradient_accumulation_steps {gas}; "
                     f"shape must be (gas, micro_batch*dp, ...)")
+
+        if self._curriculum is not None:
+            # seqlen curriculum: truncate the token dim to the current
+            # difficulty (reference engine.py:1653); each distinct length is
+            # one extra jit trace, bounded by the schedule's quantisation
+            diff = self._curriculum.update_difficulty(self.global_steps)
+            batch = jax.tree.map(
+                lambda x: x[:, :, :diff] if np.ndim(x) == 3 else x, batch)
+        if self._compression_plan is not None:
+            act = self._compression_sched.active_methods(self.global_steps)
+            if act != self._compression_active:
+                self._compression_active = act
+                self._compiled_step = None    # re-specialise at the boundary
 
         if self._compiled_step is None:
             self._compiled_step = (self._build_onebit_train_step()
@@ -702,6 +766,26 @@ class TrainEngine:
             batch = jax.tree.map(lambda x: x[None], batch)
         with self.mesh:
             return jax.jit(self.model.loss_fn)(self.params, batch)
+
+    # -- profiling (reference flops_profiler engine hooks + NVTX ranges) --
+    def get_flops_profile(self):
+        """Per-module FLOPs/params breakdown + compiled-program cost
+        (reference FlopsProfiler.print_model_profile data)."""
+        from ..profiling import transformer_breakdown
+
+        cfg = self.model.config
+        if cfg is None:
+            raise ValueError("flops profile needs a transformer Model")
+        prof = transformer_breakdown(
+            cfg, self.train_micro_batch_size_per_gpu(), cfg.max_seq_len)
+        return {"profile": prof, "table": prof.table()}
+
+    def start_profile(self, log_dir: str = "/tmp/dstpu_trace") -> None:
+        """jax profiler trace (the nsys/NVTX analog; view in XProf)."""
+        jax.profiler.start_trace(log_dir)
+
+    def stop_profile(self) -> None:
+        jax.profiler.stop_trace()
 
     # -- monitor ----------------------------------------------------------
     def _write_monitor(self, loss: float, grad_norm: float) -> None:

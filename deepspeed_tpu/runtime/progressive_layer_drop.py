@@ -1,0 +1,37 @@
+"""Progressive Layer Drop schedule.
+
+Reference: ``runtime/progressive_layer_drop.py:10`` (ProgressiveLayerDrop):
+theta(t) = (1 - theta_0) * gamma-decaying ramp — the per-step keep
+probability passed into the model forward; layer i keeps with probability
+1 - (1 - theta) * i / L (deeper layers drop more). The schedule object is
+identical math; the stochastic skip itself plugs into the layer scan as a
+bernoulli residual gate.
+"""
+
+from __future__ import annotations
+
+import math
+
+
+class ProgressiveLayerDrop:
+    def __init__(self, theta: float = 0.5, gamma: float = 0.001):
+        self.theta = theta
+        self.gamma = gamma
+        self.current_theta = 1.0
+
+    def get_state(self):
+        return {"progressive_layer_drop": True, "pld_theta": self.get_theta()}
+
+    def get_theta(self) -> float:
+        return self.current_theta
+
+    def update_state(self, global_step: int) -> float:
+        """theta(t) = (1 - theta0) * exp(-gamma t) + theta0 (reference :31)."""
+        self.current_theta = ((1.0 - self.theta)
+                              * math.exp(-self.gamma * global_step)
+                              + self.theta)
+        return self.current_theta
+
+    def layer_keep_prob(self, layer_idx: int, num_layers: int) -> float:
+        """Keep probability for layer i (deeper drops more)."""
+        return 1.0 - (1.0 - self.current_theta) * (layer_idx + 1) / num_layers

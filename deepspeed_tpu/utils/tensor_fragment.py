@@ -1,0 +1,68 @@
+"""User access to (sharded) parameter/gradient/optimizer state.
+
+Reference: ``utils/tensor_fragment.py:12-144`` — ``safe_get_full_fp32_param``
+/ ``safe_get_full_grad`` / ``safe_get_full_optimizer_state`` reconstruct full
+tensors from ZeRO fragments via hp-param linkage. With global jax Arrays the
+"fragment mapping" is the sharding itself: a full view is one device_get.
+Paths address pytree leaves as '/'-joined keys (e.g.
+"layers/attn/wq").
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+
+def _get_by_path(tree: Any, path: str) -> Any:
+    node = tree
+    for part in path.split("/"):
+        if isinstance(node, dict):
+            node = node[part]
+        elif isinstance(node, (list, tuple)):
+            node = node[int(part)]
+        else:
+            node = getattr(node, part)
+    return node
+
+
+def safe_get_full_fp32_param(engine, path: str) -> np.ndarray:
+    """Full fp32 master weight for a param path (reference :22). Falls back
+    to the (bf16/fp16) model param upcast when no master copy exists."""
+    master = getattr(engine.opt_state, "master", None)
+    src = _get_by_path(master, path) if master is not None else None
+    if src is None:
+        src = _get_by_path(engine.params, path)
+    return np.asarray(jax.device_get(src), np.float32)
+
+
+def safe_get_full_param(engine, path: str) -> np.ndarray:
+    """Full model-precision param (ZeRO-3 gathers happen inside device_get)."""
+    return np.asarray(jax.device_get(_get_by_path(engine.params, path)))
+
+
+def safe_get_full_optimizer_state(engine, path: str, state_name: str
+                                  ) -> Optional[np.ndarray]:
+    """Full optimizer state tensor (e.g. 'mu'/'nu' for optax adam — the
+    reference's 'exp_avg'/'exp_avg_sq'; both namings accepted)."""
+    alias = {"exp_avg": "mu", "exp_avg_sq": "nu"}
+    state_name = alias.get(state_name, state_name)
+    for node in jax.tree_util.tree_leaves(
+            engine.opt_state.inner,
+            is_leaf=lambda x: hasattr(x, "_fields")):
+        if hasattr(node, state_name):
+            sub = getattr(node, state_name)
+            return np.asarray(jax.device_get(_get_by_path(sub, path)),
+                              np.float32)
+    return None
+
+
+def safe_get_full_grad(engine, path: str) -> Optional[np.ndarray]:
+    """Full gradient from the staged forward/backward protocol (reference
+    :66 — grads exist only between backward and step there too)."""
+    staged = getattr(engine, "_staged_grads", None)
+    if staged is None:
+        return None
+    return np.asarray(jax.device_get(_get_by_path(staged, path)), np.float32)

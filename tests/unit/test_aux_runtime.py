@@ -1,0 +1,111 @@
+"""Aux runtime parity tests: eigenvalue power iteration, progressive layer
+drop schedule, tensor-fragment access (reference runtime/eigenvalue.py,
+progressive_layer_drop.py, utils/tensor_fragment.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import deepspeed_tpu
+from deepspeed_tpu.models import create_model
+from deepspeed_tpu.runtime.eigenvalue import Eigenvalue, hvp
+from deepspeed_tpu.runtime.progressive_layer_drop import ProgressiveLayerDrop
+from deepspeed_tpu.utils.tensor_fragment import (safe_get_full_fp32_param,
+                                                 safe_get_full_grad,
+                                                 safe_get_full_optimizer_state,
+                                                 safe_get_full_param)
+
+
+class TestEigenvalue:
+    def test_quadratic_exact(self):
+        # loss = 0.5 x^T A x -> top eigenvalue of A
+        A = jnp.diag(jnp.asarray([5.0, 2.0, 1.0]))
+
+        def loss(params, batch):
+            x = params["x"]
+            return 0.5 * x @ A @ x
+
+        ev = Eigenvalue(max_iter=200, tol=1e-5)
+        top = ev.compute_eigenvalue(loss, {"x": jnp.ones(3)}, None,
+                                    jax.random.PRNGKey(0))
+        assert abs(top - 5.0) < 1e-2
+
+    def test_hvp_matches_full_hessian(self):
+        def loss(p, _):
+            x = p["x"]
+            return jnp.sum(x ** 4) + jnp.sum(x[0] * x[1])
+
+        x0 = {"x": jnp.asarray([1.0, 2.0, 3.0])}
+        v = {"x": jnp.asarray([1.0, 0.0, 0.0])}
+        got = hvp(loss, x0, None, v)["x"]
+        H = jax.hessian(lambda x: loss({"x": x}, None))(x0["x"])
+        np.testing.assert_allclose(np.asarray(got), np.asarray(H @ v["x"]),
+                                   rtol=1e-5)
+
+    def test_block_eigenvalues(self):
+        def loss(p, _):
+            return 3.0 * jnp.sum(p["a"] ** 2) + 0.5 * jnp.sum(p["b"] ** 2)
+
+        ev = Eigenvalue(max_iter=100)
+        out = ev.compute_block_eigenvalues(
+            loss, {"a": jnp.ones(4), "b": jnp.ones(4)}, None,
+            jax.random.PRNGKey(1))
+        assert abs(out["a"] - 6.0) < 0.1      # d2/dx2 of 3x^2
+        assert abs(out["b"] - 1.0) < 0.1
+
+
+class TestProgressiveLayerDrop:
+    def test_theta_ramp(self):
+        pld = ProgressiveLayerDrop(theta=0.5, gamma=0.01)
+        assert pld.update_state(0) == 1.0
+        mid = pld.update_state(100)
+        late = pld.update_state(10_000)
+        assert 0.5 < mid < 1.0
+        assert abs(late - 0.5) < 1e-3
+        # deeper layers drop more
+        pld.update_state(10_000)
+        assert pld.layer_keep_prob(0, 12) > pld.layer_keep_prob(11, 12)
+
+
+class TestTensorFragment:
+    def _engine(self, zero=3):
+        model = create_model("tiny", dtype=jnp.bfloat16)
+        engine, *_ = deepspeed_tpu.initialize(
+            model=model,
+            config={"train_micro_batch_size_per_gpu": 2,
+                    "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+                    "bf16": {"enabled": True},
+                    "zero_optimization": {"stage": zero},
+                    "parallel": {"data_parallel_size": 8}})
+        return engine
+
+    def test_full_fp32_param_from_zero3(self):
+        engine = self._engine(zero=3)
+        w = safe_get_full_fp32_param(engine, "layers/attn/wq")
+        assert w.dtype == np.float32
+        assert w.shape == tuple(engine.params["layers"]["attn"]["wq"].shape)
+        # matches the bf16 param it shadows
+        np.testing.assert_allclose(
+            w, np.asarray(jax.device_get(
+                engine.params["layers"]["attn"]["wq"]), np.float32),
+            atol=1e-2)
+
+    def test_optimizer_state_access(self):
+        engine = self._engine()
+        gb = engine.train_batch_size()
+        ids = jax.random.randint(jax.random.PRNGKey(0), (1, gb, 16), 0, 250)
+        engine.train_batch(batch={"input_ids": ids})
+        mu = safe_get_full_optimizer_state(engine, "layers/attn/wq", "exp_avg")
+        assert mu is not None and float(np.abs(mu).sum()) > 0
+
+    def test_grad_access_via_staged_protocol(self):
+        engine = self._engine(zero=0)
+        assert safe_get_full_grad(engine, "layers/attn/wq") is None
+        gb = engine.train_batch_size()
+        ids = jax.random.randint(jax.random.PRNGKey(0), (gb, 16), 0, 250)
+        engine.forward({"input_ids": ids})
+        engine.backward()
+        g = safe_get_full_grad(engine, "layers/attn/wq")
+        assert g is not None and float(np.abs(g).sum()) > 0
+        full = safe_get_full_param(engine, "embed/tokens")
+        assert full.shape[0] == 256

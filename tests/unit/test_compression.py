@@ -90,3 +90,28 @@ def test_layer_reduction():
                                np.asarray(params["layers"]["attn"]["wq"][3]))
     np.testing.assert_allclose(np.asarray(student["embed"]["tokens"]),
                                np.asarray(params["embed"]["tokens"]))
+
+
+def test_head_pruning():
+    cfg = {"compression_training": {
+        "head_pruning": {
+            "shared_parameters": {"enabled": True, "schedule_offset": 0},
+            "different_groups": {
+                "g0": {"params": {"dense_ratio": 0.5, "num_heads": 4},
+                       "modules": ["attn"]}}}}}
+    plan = init_compression(cfg)
+    w = jnp.asarray(np.random.RandomState(0).randn(16, 32), jnp.float32)
+    params = {"layers": {"attn": {"wq": w}}}
+    out = apply_compression(params, plan, frozenset({"head_pruning"}))
+    wq = np.asarray(out["layers"]["attn"]["wq"]).reshape(16, 4, 8)
+    head_zero = (wq == 0).all(axis=(0, 2))
+    assert head_zero.sum() == 2  # half the heads pruned whole
+
+
+def test_activation_quantization_rejected():
+    import pytest
+
+    with pytest.raises(NotImplementedError, match="activation_quantization"):
+        init_compression({"compression_training": {
+            "activation_quantization": {
+                "shared_parameters": {"enabled": True}}}})

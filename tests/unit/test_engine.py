@@ -209,3 +209,61 @@ def test_dataloader():
     e1 = [b["x"][:, 0].tolist() for b in dl2]
     e2 = [b["x"][:, 0].tolist() for b in dl2]
     assert e1 != e2
+
+
+def test_curriculum_seqlen_truncates(tmp_path):
+    from deepspeed_tpu.models import create_model
+
+    model = create_model("tiny", dtype=jnp.float32, max_seq_len=64)
+    engine, *_ = deepspeed_tpu.initialize(
+        model=model,
+        config={"train_micro_batch_size_per_gpu": 2,
+                "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+                "curriculum_learning": {
+                    "enabled": True, "curriculum_type": "seqlen",
+                    "min_difficulty": 8, "max_difficulty": 32,
+                    "schedule_type": "fixed_linear",
+                    "schedule_config": {"total_curriculum_step": 4,
+                                        "difficulty_step": 8}}})
+    gb = engine.train_batch_size()
+    ids = jax.random.randint(jax.random.PRNGKey(0), (1, gb, 32), 0, 250)
+    for _ in range(6):
+        loss = engine.train_batch(batch={"input_ids": ids})
+        assert np.isfinite(float(loss))
+    assert engine._curriculum.current_difficulty == 32
+
+
+def test_compression_schedule_kicks_in():
+    from deepspeed_tpu.models import create_model
+
+    model = create_model("tiny", dtype=jnp.float32)
+    engine, *_ = deepspeed_tpu.initialize(
+        model=model,
+        config={"train_micro_batch_size_per_gpu": 2,
+                "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+                "compression_training": {
+                    "weight_quantization": {
+                        "shared_parameters": {"enabled": True,
+                                              "schedule_offset": 3},
+                        "different_groups": {
+                            "g0": {"params": {"target_bits": 8},
+                                   "modules": ["attn", "mlp"]}}}}})
+    gb = engine.train_batch_size()
+    ids = jax.random.randint(jax.random.PRNGKey(0), (1, gb, 16), 0, 250)
+    losses = [float(engine.train_batch(batch={"input_ids": ids}))
+              for _ in range(6)]
+    assert all(np.isfinite(l) for l in losses)
+    assert engine._compression_active == {"weight_quantization"}
+
+
+def test_flops_profile_accessor():
+    from deepspeed_tpu.models import create_model
+
+    model = create_model("tiny", dtype=jnp.float32)
+    engine, *_ = deepspeed_tpu.initialize(
+        model=model,
+        config={"train_micro_batch_size_per_gpu": 2,
+                "optimizer": {"type": "adamw", "params": {"lr": 1e-3}}})
+    out = engine.get_flops_profile()
+    assert "attention" in out["table"]
+    assert out["profile"].total_params > 0
