@@ -1,0 +1,47 @@
+"""TP x MoE token mappings.
+
+Reference: ``moe/mappings.py:59-101`` (gather_tokens / drop_tokens autograd
+ops): before a TP-replicated MoE layer, the sequence shards held by tensor-
+parallel ranks are gathered (so every TP rank routes the full token set), and
+dropped back afterwards; backward reverses each. In SPMD these are sharding
+constraints on the token dim — XLA inserts the all-gather / slice and
+autodiff reverses them — expressed here with the same names and semantics.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .mesh import MODEL_AXIS, get_mesh
+
+
+_U = P.UNCONSTRAINED
+
+
+def gather_tokens(x: jax.Array, dim: int = 1) -> jax.Array:
+    """Make the token dim replicated across TP ranks (reference
+    gather_tokens: all-gather along the sequence dim over the mp group).
+    Other dims stay UNCONSTRAINED so the batch keeps its data sharding."""
+    mesh = get_mesh()
+    if int(mesh.shape.get(MODEL_AXIS, 1)) <= 1:
+        return x
+    spec = [_U] * x.ndim
+    spec[dim] = None
+    return lax.with_sharding_constraint(x, NamedSharding(mesh, P(*spec)))
+
+
+def drop_tokens(x: jax.Array, dim: int = 1) -> jax.Array:
+    """Re-shard the token dim over TP ranks (reference drop_tokens: each mp
+    rank keeps its slice); other dims stay UNCONSTRAINED."""
+    mesh = get_mesh()
+    if int(mesh.shape.get(MODEL_AXIS, 1)) <= 1:
+        return x
+    if x.shape[dim] % int(mesh.shape[MODEL_AXIS]) != 0:
+        raise ValueError(
+            f"token dim {x.shape[dim]} not divisible by tensor-parallel "
+            f"degree {int(mesh.shape[MODEL_AXIS])}")
+    spec = [_U] * x.ndim
+    spec[dim] = MODEL_AXIS
+    return lax.with_sharding_constraint(x, NamedSharding(mesh, P(*spec)))

@@ -1,0 +1,64 @@
+"""OnDevice — construction-placement context.
+
+Reference: ``utils/init_on_device.py`` (OnDevice): builds a torch module with
+all tensors on a chosen device or the meta device (shape-only). JAX analogs:
+
+  * ``device="meta"`` → ``abstract_init`` (jax.eval_shape): params as
+    ShapeDtypeStruct, zero memory — what the engine already uses for
+    sharding planning;
+  * a real device/sharding → jit the initializer with ``out_shardings`` so
+    params materialise directly where they live (zero.Init semantics;
+    engine.py does exactly this at construction).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+
+
+def abstract_init(init_fn: Callable, *args, **kwargs) -> Any:
+    """Meta-device construction: shapes/dtypes only (OnDevice('meta'))."""
+    return jax.eval_shape(init_fn, *args, **kwargs)
+
+
+class OnDevice:
+    """Context-style API parity. ``dtype`` overrides floating dtypes;
+    ``device='meta'`` yields abstract shapes, anything else materialises via
+    jit (optionally with ``shardings``)."""
+
+    def __init__(self, dtype=None, device: str = "meta", shardings=None):
+        self.dtype = dtype
+        self.device = device
+        self.shardings = shardings
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def init(self, init_fn: Callable, *args, **kwargs) -> Any:
+        def fn(*a, **k):
+            params = init_fn(*a, **k)
+            if self.dtype is not None:
+                from ..models.core import cast_floating
+
+                params = cast_floating(params, self.dtype)
+            return params
+
+        if self.device == "meta":
+            return jax.eval_shape(fn, *args, **kwargs)
+        if self.shardings is not None:
+            return jax.jit(fn, out_shardings=self.shardings)(*args, **kwargs)
+        if self.device in ("device", "default"):
+            return jax.jit(fn)(*args, **kwargs)
+        # a named backend ('cpu', 'tpu'): place on its first device — the
+        # reference's OnDevice('cpu') avoids accelerator OOM at construction
+        try:
+            target = jax.devices(self.device)[0]
+        except RuntimeError as exc:
+            raise ValueError(f"unknown OnDevice target '{self.device}' "
+                             "(meta | device | a jax backend name)") from exc
+        return jax.device_put(jax.jit(fn)(*args, **kwargs), target)

@@ -1,0 +1,44 @@
+"""Misc parity-shim tests: OnDevice construction placement, MoE TP token
+mappings (reference utils/init_on_device.py, moe/mappings.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.models import create_model
+from deepspeed_tpu.utils.init_on_device import OnDevice, abstract_init
+
+
+def test_ondevice_meta_is_abstract():
+    model = create_model("tiny", dtype=jnp.float32)
+    with OnDevice(device="meta") as ctx:
+        shapes = ctx.init(model.init, jax.random.PRNGKey(0))
+    leaf = jax.tree.leaves(shapes)[0]
+    assert isinstance(leaf, jax.ShapeDtypeStruct)
+    assert abstract_init(model.init, jax.random.PRNGKey(0))
+
+
+def test_ondevice_real_with_dtype():
+    model = create_model("tiny", dtype=jnp.float32)
+    with OnDevice(dtype=jnp.bfloat16, device="device") as ctx:
+        params = ctx.init(model.init, jax.random.PRNGKey(0))
+    assert params["embed"]["tokens"].dtype == jnp.bfloat16
+
+
+def test_moe_mappings_roundtrip():
+    from deepspeed_tpu.config.config import ParallelConfig
+    from deepspeed_tpu.parallel import mesh as mesh_mod
+    from deepspeed_tpu.parallel.moe_mappings import drop_tokens, gather_tokens
+
+    mesh = mesh_mod.build_mesh(ParallelConfig(tensor_parallel_size=2,
+                                              data_parallel_size=4))
+    x = jnp.arange(2 * 8 * 4, dtype=jnp.float32).reshape(2, 8, 4)
+
+    @jax.jit
+    def fn(x):
+        g = gather_tokens(drop_tokens(x))
+        return g * 2
+
+    with mesh_mod.mesh_context(mesh):
+        out = fn(x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x) * 2)
