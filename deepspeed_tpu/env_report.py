@@ -17,10 +17,15 @@ def get_report() -> str:
     from . import __version__
     from .ops import op_report
 
+    from .ops.aio import aio_compatible
+
     lines = ["-" * 76,
              "DeepSpeed-TPU op compatibility report",
              "-" * 76,
              op_report(),
+             f"{'async_io (native)':<28}"
+             f"{'ready' if aio_compatible() else 'no g++':<12}{'cpu':<16}"
+             "thread-pool positional I/O (csrc/aio)",
              "-" * 76]
     try:
         devices = jax.devices()

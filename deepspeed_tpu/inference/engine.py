@@ -57,9 +57,10 @@ def _bucket(n: int, mult: int = 64) -> int:
     return max(mult, ((n + mult - 1) // mult) * mult)
 
 
-def _sample(logits, rng, temperature: float, top_k: int) -> jax.Array:
-    """Greedy / temperature / top-k sampling — the ONE sampling rule, used
-    for the first token and every decode step alike."""
+def _sample(logits, rng, temperature: float, top_k: int,
+            top_p: float = 1.0) -> jax.Array:
+    """Greedy / temperature / top-k / top-p sampling — the ONE sampling
+    rule, used for the first token and every decode step alike."""
     logits = logits.astype(jnp.float32)
     if temperature == 0.0:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
@@ -67,6 +68,16 @@ def _sample(logits, rng, temperature: float, top_k: int) -> jax.Array:
     if top_k > 0:
         kth = jnp.sort(logits, axis=-1)[..., -top_k][..., None]
         logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p < 1.0:
+        # nucleus: keep the smallest prefix of descending-prob tokens whose
+        # cumulative mass reaches top_p (the top-1 token always survives)
+        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        keep_sorted = (cum - probs < top_p).at[..., 0].set(True)
+        cutoff = jnp.max(jnp.where(keep_sorted, sorted_logits, -jnp.inf),
+                         axis=-1, keepdims=True)
+        logits = jnp.where(logits >= cutoff, logits, -jnp.inf)
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
 
 
@@ -153,7 +164,7 @@ class InferenceEngine:
         return jax.jit(prefill, donate_argnums=(3,))
 
     def _decode_fn(self, n_new: int, temperature: float, top_k: int,
-                   eos_token_id: Optional[int]):
+                   top_p: float, eos_token_id: Optional[int]):
         cfg = self.model.config
         T_max = self.config.max_out_tokens
         from ..models.transformer import forward as model_forward
@@ -168,7 +179,7 @@ class InferenceEngine:
                 logits, cache, _ = model_forward(
                     params, tok[:, None], cfg,
                     attention_mask=valid, cache=cache, start_pos=idx)
-                nxt = _sample(logits[:, -1], rng, temperature, top_k)
+                nxt = _sample(logits[:, -1], rng, temperature, top_k, top_p)
                 if eos_token_id is not None:
                     nxt = jnp.where(done, eos_token_id, nxt)
                     done = done | (nxt == eos_token_id)
@@ -183,7 +194,7 @@ class InferenceEngine:
         return jax.jit(decode, donate_argnums=(1,))
 
     def generate(self, input_ids, attention_mask=None, max_new_tokens: int = 32,
-                 temperature: float = 0.0, top_k: int = 0,
+                 temperature: float = 0.0, top_k: int = 0, top_p: float = 1.0,
                  eos_token_id: Optional[int] = None, seed: int = 0,
                  return_ttft: bool = False):
         """Prompt ids (B, S) → generated ids (B, max_new_tokens).
@@ -215,10 +226,11 @@ class InferenceEngine:
         if key_p not in self._prefill_cache:
             self._prefill_cache[key_p] = self._prefill_fn(S_pad)
         n_rest = max_new_tokens - 1
-        key_d = (B, n_rest, float(temperature), int(top_k), eos_token_id)
+        key_d = (B, n_rest, float(temperature), int(top_k), float(top_p),
+                 eos_token_id)
         if n_rest > 0 and key_d not in self._decode_cache:
             self._decode_cache[key_d] = self._decode_fn(
-                n_rest, temperature, top_k, eos_token_id)
+                n_rest, temperature, top_k, top_p, eos_token_id)
 
         with self.mesh:
             cache = kv_cache.init_cache(cfg, B, T_max, self.config.dtype)
@@ -234,7 +246,7 @@ class InferenceEngine:
             last = jnp.take_along_axis(
                 logits, (lengths - 1)[:, None, None], axis=1)[:, 0]
             rng, r_first = jax.random.split(jax.random.PRNGKey(seed))
-            first = _sample(last, r_first, temperature, top_k)
+            first = _sample(last, r_first, temperature, top_k, top_p)
             first = jax.block_until_ready(first)
             ttft = time.perf_counter() - t0
             if n_rest == 0:

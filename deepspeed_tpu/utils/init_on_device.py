@@ -61,4 +61,8 @@ class OnDevice:
         except RuntimeError as exc:
             raise ValueError(f"unknown OnDevice target '{self.device}' "
                              "(meta | device | a jax backend name)") from exc
-        return jax.device_put(jax.jit(fn)(*args, **kwargs), target)
+        # construct ON the target backend — materialising on the default
+        # accelerator first would cause exactly the construction-time OOM
+        # this path exists to avoid
+        with jax.default_device(target):
+            return jax.jit(fn)(*args, **kwargs)

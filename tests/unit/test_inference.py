@@ -328,3 +328,33 @@ def test_checkpoint_roundtrip_into_inference(tmp_path):
     want = np.asarray(jax.jit(lambda p, b: model.apply(p, b)[0])(
         engine.params, {"input_ids": jnp.asarray(ids)}))
     np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-5)
+
+
+def test_top_p_restricts_support():
+    from deepspeed_tpu.inference.engine import _sample
+
+    # peaked distribution: token 0 has ~92% mass; top_p=0.5 must always pick it
+    logits = jnp.asarray([[5.0, 2.0, 1.0, 0.0]])
+    picks = {int(_sample(logits, jax.random.PRNGKey(i), 1.0, 0, 0.5)[0])
+             for i in range(20)}
+    assert picks == {0}
+    # top_p=1.0 with high temperature samples beyond token 0
+    picks = {int(_sample(logits, jax.random.PRNGKey(i), 5.0, 0, 1.0)[0])
+             for i in range(50)}
+    assert len(picks) > 1
+
+
+def test_generate_top_p_runs():
+    engine = init_inference("tiny", dtype=jnp.float32, max_out_tokens=128)
+    out = engine.generate(np.arange(8)[None], max_new_tokens=5,
+                          temperature=0.8, top_p=0.9, seed=1)
+    assert np.asarray(out).shape == (1, 5)
+
+
+def test_top_p_zero_is_greedy():
+    from deepspeed_tpu.inference.engine import _sample
+
+    logits = jnp.asarray([[5.0, 2.0, 1.0, 0.0]])
+    picks = {int(_sample(logits, jax.random.PRNGKey(i), 5.0, 0, 0.0)[0])
+             for i in range(20)}
+    assert picks == {0}
