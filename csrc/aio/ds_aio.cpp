@@ -12,6 +12,7 @@
 
 #include <atomic>
 #include <condition_variable>
+#include <cerrno>
 #include <cstring>
 #include <deque>
 #include <fcntl.h>
@@ -71,9 +72,14 @@ struct Handle {
         queue.pop_front();
       }
       bool ok = do_io(t);
-      if (!ok) errors.fetch_add(1);
-      completed.fetch_add(1);
-      inflight.fetch_sub(1);
+      {
+        // state changes under the mutex — a decrement outside it can race
+        // the wait_all predicate check and lose the wakeup
+        std::lock_guard<std::mutex> lk(mu);
+        if (!ok) errors.fetch_add(1);
+        completed.fetch_add(1);
+        inflight.fetch_sub(1);
+      }
       cv_done.notify_all();
     }
   }
@@ -84,7 +90,8 @@ struct Handle {
       ssize_t n =
           t.write ? pwrite(t.fd, t.buf + done, t.nbytes - done, t.offset + done)
                   : pread(t.fd, t.buf + done, t.nbytes - done, t.offset + done);
-      if (n <= 0) return false;
+      if (n < 0 && errno == EINTR) continue;  // interrupted — retry
+      if (n <= 0) return false;               // error, or EOF short read
       done += static_cast<size_t>(n);
     }
     return true;

@@ -75,7 +75,7 @@ def _sample(logits, rng, temperature: float, top_k: int,
         probs = jax.nn.softmax(sorted_logits, axis=-1)
         cum = jnp.cumsum(probs, axis=-1)
         keep_sorted = (cum - probs < top_p).at[..., 0].set(True)
-        cutoff = jnp.max(jnp.where(keep_sorted, sorted_logits, -jnp.inf),
+        cutoff = jnp.min(jnp.where(keep_sorted, sorted_logits, jnp.inf),
                          axis=-1, keepdims=True)
         logits = jnp.where(logits >= cutoff, logits, -jnp.inf)
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)

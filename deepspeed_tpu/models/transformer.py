@@ -255,8 +255,6 @@ def active_attention_impl(cfg: "TransformerConfig") -> str:
     """Introspection for benches/tests: which attention path will run."""
     if cfg.attention_impl is not None:
         return "custom"
-    if cfg.position == "alibi":
-        return "jnp"  # alibi forces the jnp path (no kernel support yet)
     return "flash_attention" if _kernels_active() else "jnp"
 
 
@@ -403,11 +401,7 @@ def _layer_forward(cfg: TransformerConfig, x: jax.Array, layer: Dict[str, Any],
     attn_fn = cfg.attention_impl or default_attention_impl()
     alibi = alibi_slopes(N) if cfg.position == "alibi" else None
     if alibi is not None:
-        if cfg.attention_impl is None:
-            # flash kernel has no alibi yet — jnp path (reference softmax.cu
-            # has the alibi variant; kernel support is a later refinement)
-            attn_fn = dot_product_attention
-        else:
+        if cfg.attention_impl is not None:
             import inspect
 
             sig = inspect.signature(cfg.attention_impl)
@@ -440,7 +434,7 @@ def _layer_forward(cfg: TransformerConfig, x: jax.Array, layer: Dict[str, Any],
                 valid = jnp.broadcast_to(causal_valid, (B, T))
             attn = decode_attention(q[:, 0], ck, cv, valid, alibi=alibi)[:, None]
         elif (static_prefill and S > 1 and cfg.attention_impl is None
-              and _kernels_active() and alibi is None and T % 128 == 0):
+              and _kernels_active() and T % 128 == 0):
             # prefill from position 0: queries sit at absolute rows 0..S-1, so
             # the flash kernel's 0-based causal col<=row over the arena is
             # exact and the (B, T_max) validity mask covers padding +
@@ -451,7 +445,10 @@ def _layer_forward(cfg: TransformerConfig, x: jax.Array, layer: Dict[str, Any],
             valid = (mask if mask is not None else
                      jnp.broadcast_to(
                          (jnp.arange(T)[None, :] < S).astype(jnp.int32), (B, T)))
-            attn = attn_fn(q, ck, cv, valid, causal=True)
+            if alibi is None:
+                attn = attn_fn(q, ck, cv, valid, causal=True)
+            else:
+                attn = attn_fn(q, ck, cv, valid, causal=True, alibi=alibi)
         else:
             k, v = ck, cv
             # causal over absolute positions: query s sits at idx+s, keys valid <= that

@@ -51,7 +51,10 @@ def _load() -> ctypes.CDLL:
         cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-pthread",
                src, "-o", so]
         logger.info(f"JIT-building aio extension: {' '.join(cmd)}")
-        subprocess.run(cmd, check=True, capture_output=True)
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"aio extension build failed:\n{proc.stderr}")
     lib = ctypes.CDLL(so)
     lib.dsaio_create.restype = ctypes.c_void_p
     lib.dsaio_create.argtypes = [ctypes.c_int] * 3
@@ -103,7 +106,9 @@ class AIOHandle:
             raise OSError(f"cannot open {path} for write")
         rc = self._lib.dsaio_submit_pwrite(self._h, fd, self._buf_ptr(arr),
                                            arr.nbytes, offset)
-        self._fds = getattr(self, "_fds", []) + [fd]
+        # keep the buffer alive until wait(): only the raw pointer crosses
+        # the ABI, so a GC'd array would hand the worker freed memory
+        self._pending = getattr(self, "_pending", []) + [(fd, arr)]
         return rc
 
     def async_pread(self, arr: np.ndarray, path: str, offset: int = 0) -> int:
@@ -112,16 +117,16 @@ class AIOHandle:
             raise OSError(f"cannot open {path} for read")
         rc = self._lib.dsaio_submit_pread(self._h, fd, self._buf_ptr(arr),
                                           arr.nbytes, offset)
-        self._fds = getattr(self, "_fds", []) + [fd]
+        self._pending = getattr(self, "_pending", []) + [(fd, arr)]
         return rc
 
     def wait(self) -> int:
         """Fence all submitted ops; returns total completed, raises on I/O
         errors (reference wait() semantics)."""
         done = self._lib.dsaio_wait(self._h)
-        for fd in getattr(self, "_fds", []):
+        for fd, _arr in getattr(self, "_pending", []):
             self._lib.dsaio_close(fd)
-        self._fds = []
+        self._pending = []
         if done < 0:
             raise OSError(f"{-done} aio operations failed")
         return int(done)

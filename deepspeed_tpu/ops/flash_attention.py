@@ -58,9 +58,10 @@ def _block_sizes(s: int, t: int) -> Tuple[int, int]:
 # ---------------------------------------------------------------------------
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, kvm_ref, o_ref, lse_ref,
+def _fwd_kernel(q_ref, k_ref, v_ref, kvm_ref, slopes_ref, o_ref, lse_ref,
                 acc, m_scr, l_scr, *, scale: float, causal: bool,
-                bq: int, bk: int, kv_len: int, has_mask: bool):
+                bq: int, bk: int, kv_len: int, has_mask: bool,
+                has_alibi: bool):
     i = pl.program_id(2)   # q block
     j = pl.program_id(3)   # kv block
     nj = pl.num_programs(3)
@@ -83,6 +84,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, kvm_ref, o_ref, lse_ref,
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)  # (bq, bk)
         col = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + j * bk
+        if has_alibi:
+            # key-position-linear bias (query term is softmax-shift-invariant)
+            s = s + slopes_ref[0, 0, 0] * col.astype(jnp.float32)
         mask = col < kv_len
         if causal:
             row = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + i * bq
@@ -112,16 +116,18 @@ def _fwd_kernel(q_ref, k_ref, v_ref, kvm_ref, o_ref, lse_ref,
         lse_ref[0, 0] = jnp.broadcast_to(lse, lse_ref[0, 0].shape)
 
 
-def _fwd(q: jax.Array, k: jax.Array, v: jax.Array, kvm: jax.Array, *,
+def _fwd(q: jax.Array, k: jax.Array, v: jax.Array, kvm: jax.Array,
+         slopes: jax.Array, *,
          causal: bool, scale: float, kv_len: int, has_mask: bool,
-         interpret: bool = False):
+         has_alibi: bool, interpret: bool = False):
     B, N, S, D = q.shape
     T = k.shape[2]
     bq, bk = _block_sizes(S, T)
     grid = (B, N, S // bq, T // bk)
 
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                               bq=bq, bk=bk, kv_len=kv_len, has_mask=has_mask)
+                               bq=bq, bk=bk, kv_len=kv_len, has_mask=has_mask,
+                               has_alibi=has_alibi)
     out_shape = [
         jax.ShapeDtypeStruct((B, N, S, D), q.dtype),
         jax.ShapeDtypeStruct((B, N, S, LANES), jnp.float32),  # lse (lane-padded)
@@ -134,6 +140,7 @@ def _fwd(q: jax.Array, k: jax.Array, v: jax.Array, kvm: jax.Array, *,
             pl.BlockSpec((1, 1, bk, D), lambda b, n, i, j: (b, n, j, 0)),
             pl.BlockSpec((1, 1, bk, D), lambda b, n, i, j: (b, n, j, 0)),
             pl.BlockSpec((1, 1, bk), lambda b, n, i, j: (b, 0, j)),
+            pl.BlockSpec((1, 1, LANES), lambda b, n, i, j: (n, 0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, 1, bq, D), lambda b, n, i, j: (b, n, i, 0)),
@@ -148,7 +155,7 @@ def _fwd(q: jax.Array, k: jax.Array, v: jax.Array, kvm: jax.Array, *,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(q, k, v, kvm)
+    )(q, k, v, kvm, slopes)
     return o, lse[..., 0]
 
 
@@ -158,8 +165,9 @@ def _fwd(q: jax.Array, k: jax.Array, v: jax.Array, kvm: jax.Array, *,
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, kvm_ref,
-                   dq_ref, acc, *, scale: float, causal: bool, bq: int,
-                   bk: int, kv_len: int, has_mask: bool):
+                   slopes_ref, dq_ref, acc, *, scale: float, causal: bool,
+                   bq: int, bk: int, kv_len: int, has_mask: bool,
+                   has_alibi: bool):
     i = pl.program_id(2)
     j = pl.program_id(3)
     nj = pl.num_programs(3)
@@ -179,6 +187,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, kvm_ref,
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         col = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + j * bk
+        if has_alibi:
+            s = s + slopes_ref[0, 0, 0] * col.astype(jnp.float32)
         mask = col < kv_len
         if causal:
             row = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + i * bq
@@ -201,9 +211,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, kvm_ref,
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, kvm_ref,
-                    dk_ref, dv_ref, dk_acc, dv_acc, *, scale: float,
-                    causal: bool, bq: int, bk: int, kv_len: int,
-                    has_mask: bool):
+                    slopes_ref, dk_ref, dv_ref, dk_acc, dv_acc, *,
+                    scale: float, causal: bool, bq: int, bk: int, kv_len: int,
+                    has_mask: bool, has_alibi: bool):
     j = pl.program_id(2)   # kv block (outer)
     i = pl.program_id(3)   # q block (inner, sequential)
     ni = pl.num_programs(3)
@@ -224,6 +234,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, kvm_ref,
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         col = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + j * bk
+        if has_alibi:
+            s = s + slopes_ref[0, 0, 0] * col.astype(jnp.float32)
         mask = col < kv_len
         if causal:
             row = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + i * bq
@@ -249,8 +261,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, kvm_ref,
 
 
 def _bwd(causal: bool, scale: float, kv_len: int, has_mask: bool,
-         interpret: bool, residuals, grads):
-    q, k, v, kvm, o, lse = residuals
+         has_alibi: bool, interpret: bool, residuals, grads):
+    q, k, v, kvm, slopes, o, lse = residuals
     do = grads[0]
     B, N, S, D = q.shape
     T = k.shape[2]
@@ -268,11 +280,13 @@ def _bwd(causal: bool, scale: float, kv_len: int, has_mask: bool,
         pl.BlockSpec((1, 1, bq, LANES), lambda b, n, x, y: (b, n, x, 0)),  # lse
         pl.BlockSpec((1, 1, bq, LANES), lambda b, n, x, y: (b, n, x, 0)),  # delta
         pl.BlockSpec((1, 1, bk), lambda b, n, x, y: (b, 0, y)),            # kv mask
+        pl.BlockSpec((1, 1, LANES), lambda b, n, x, y: (n, 0, 0)),         # slopes
     ]
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
-                          bq=bq, bk=bk, kv_len=kv_len, has_mask=has_mask),
+                          bq=bq, bk=bk, kv_len=kv_len, has_mask=has_mask,
+                          has_alibi=has_alibi),
         grid=(B, N, S // bq, T // bk),
         in_specs=common_specs,
         out_specs=[pl.BlockSpec((1, 1, bq, D), lambda b, n, x, y: (b, n, x, 0))],
@@ -281,7 +295,7 @@ def _bwd(causal: bool, scale: float, kv_len: int, has_mask: bool,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(q, k, v, do, lse_pad, delta, kvm)[0]
+    )(q, k, v, do, lse_pad, delta, kvm, slopes)[0]
 
     # dkv: swap loop order — kv block outer (parallel), q block inner (sequential)
     swapped_specs = [
@@ -292,10 +306,12 @@ def _bwd(causal: bool, scale: float, kv_len: int, has_mask: bool,
         pl.BlockSpec((1, 1, bq, LANES), lambda b, n, y, x: (b, n, x, 0)),
         pl.BlockSpec((1, 1, bq, LANES), lambda b, n, y, x: (b, n, x, 0)),
         pl.BlockSpec((1, 1, bk), lambda b, n, y, x: (b, 0, y)),
+        pl.BlockSpec((1, 1, LANES), lambda b, n, y, x: (n, 0, 0)),
     ]
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
-                          bq=bq, bk=bk, kv_len=kv_len, has_mask=has_mask),
+                          bq=bq, bk=bk, kv_len=kv_len, has_mask=has_mask,
+                          has_alibi=has_alibi),
         grid=(B, N, T // bk, S // bq),
         in_specs=swapped_specs,
         out_specs=[
@@ -309,8 +325,8 @@ def _bwd(causal: bool, scale: float, kv_len: int, has_mask: bool,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(q, k, v, do, lse_pad, delta, kvm)
-    return dq, dk, dv, jnp.zeros_like(kvm)
+    )(q, k, v, do, lse_pad, delta, kvm, slopes)
+    return dq, dk, dv, jnp.zeros_like(kvm), jnp.zeros_like(slopes)
 
 
 # ---------------------------------------------------------------------------
@@ -318,22 +334,28 @@ def _bwd(causal: bool, scale: float, kv_len: int, has_mask: bool,
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
-def _flash_core(q, k, v, kvm, causal: bool, scale: float, kv_len: int,
-                has_mask: bool, interpret: bool):
-    o, _ = _fwd(q, k, v, kvm, causal=causal, scale=scale, kv_len=kv_len,
-                has_mask=has_mask, interpret=interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10))
+def _flash_core(q, k, v, kvm, slopes, causal: bool, scale: float,
+                kv_len: int, has_mask: bool, has_alibi: bool,
+                interpret: bool):
+    o, _ = _fwd(q, k, v, kvm, slopes, causal=causal, scale=scale,
+                kv_len=kv_len, has_mask=has_mask, has_alibi=has_alibi,
+                interpret=interpret)
     return o
 
 
-def _flash_core_fwd(q, k, v, kvm, causal, scale, kv_len, has_mask, interpret):
-    o, lse = _fwd(q, k, v, kvm, causal=causal, scale=scale, kv_len=kv_len,
-                  has_mask=has_mask, interpret=interpret)
-    return o, (q, k, v, kvm, o, lse)
+def _flash_core_fwd(q, k, v, kvm, slopes, causal, scale, kv_len, has_mask,
+                    has_alibi, interpret):
+    o, lse = _fwd(q, k, v, kvm, slopes, causal=causal, scale=scale,
+                  kv_len=kv_len, has_mask=has_mask, has_alibi=has_alibi,
+                  interpret=interpret)
+    return o, (q, k, v, kvm, slopes, o, lse)
 
 
-def _flash_core_bwd(causal, scale, kv_len, has_mask, interpret, residuals, g):
-    return _bwd(causal, scale, kv_len, has_mask, interpret, residuals, (g,))
+def _flash_core_bwd(causal, scale, kv_len, has_mask, has_alibi, interpret,
+                    residuals, g):
+    return _bwd(causal, scale, kv_len, has_mask, has_alibi, interpret,
+                residuals, (g,))
 
 
 _flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
@@ -352,15 +374,18 @@ def _pad_to(x: jax.Array, axis: int, mult: int) -> jax.Array:
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     mask=None, causal: bool = True,
                     scale: Optional[float] = None,
+                    alibi: Optional[jax.Array] = None,
                     interpret: bool = False) -> jax.Array:
     """Drop-in replacement for models.transformer.dot_product_attention:
     q (B,S,N,D), k/v (B,T,Kh,D); returns (B,S,N,D). (B,T) key-padding masks
-    run in-kernel; only full (B,S,T) attention masks (rare — decode path,
-    which has its own kernel) fall back to the jnp path."""
+    and per-head ALiBi slopes (N,) run in-kernel; only full (B,S,T)
+    attention masks (rare — decode path, which has its own kernel) fall
+    back to the jnp path."""
     if mask is not None and mask.ndim != 2:
         from ..models.transformer import dot_product_attention
 
-        return dot_product_attention(q, k, v, mask, causal=causal)
+        return dot_product_attention(q, k, v, mask, causal=causal,
+                                     alibi=alibi)
     B, S, N, D = q.shape
     T, K = k.shape[1], k.shape[2]
     if K != N:  # GQA: expand KV heads (wrapper-level; kernel sees MHA)
@@ -379,21 +404,23 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
            else jnp.ones((B, T), jnp.float32))[:, None, :]  # (B,1,T): TPU
     # needs sublane dim == full array dim for the tiny mask block
     kvm = _pad_to(kvm, 2, bk)
-    o = _flash_core(qt, kt, vt, kvm, causal, scale, T, has_mask, interpret)
+    has_alibi = alibi is not None
+    slopes1 = (alibi.astype(jnp.float32).reshape(N) if has_alibi
+               else jnp.zeros((N,), jnp.float32))
+    # (N, 1, LANES) lane-broadcast layout so per-head blocks satisfy the TPU
+    # tiling rules and the kernel reads a static [0,0,0] scalar
+    slopes = jnp.broadcast_to(slopes1[:, None, None], (N, 1, LANES))
+    o = _flash_core(qt, kt, vt, kvm, slopes, causal, scale, T, has_mask,
+                    has_alibi, interpret)
     return o[:, :, :S].swapaxes(1, 2)
 
 
 def make_attention_impl(interpret: bool = False):
-    """attention_impl hook for TransformerConfig. ``alibi`` (BLOOM) is not
-    kernel-supported yet — those calls fall back to the jnp path."""
+    """attention_impl hook for TransformerConfig (ALiBi runs in-kernel —
+    the reference softmax.cu alibi variant)."""
 
     def impl(q, k, v, mask, causal=True, alibi=None):
-        if alibi is not None:
-            from ..models.transformer import dot_product_attention
-
-            return dot_product_attention(q, k, v, mask, causal=causal,
-                                         alibi=alibi)
         return flash_attention(q, k, v, mask=mask, causal=causal,
-                               interpret=interpret)
+                               alibi=alibi, interpret=interpret)
 
     return impl

@@ -123,6 +123,39 @@ class TestFlashAttention:
                                        atol=5e-4, rtol=1e-3,
                                        err_msg=f"d{name} mismatch")
 
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_alibi_in_kernel(self, causal):
+        from deepspeed_tpu.models.transformer import alibi_slopes
+
+        q, k, v = _qkv(s=256, n=4)
+        al = alibi_slopes(4)
+        out = flash_attention(q, k, v, causal=causal, alibi=al,
+                              interpret=INTERPRET)
+        ref = dot_product_attention(q, k, v, None, causal=causal, alibi=al)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_alibi_grads(self):
+        from deepspeed_tpu.models.transformer import alibi_slopes
+
+        q, k, v = _qkv(s=128, n=4)
+        al = alibi_slopes(4)
+
+        def loss_flash(q, k, v):
+            return jnp.sum(flash_attention(q, k, v, causal=True, alibi=al,
+                                           interpret=INTERPRET) ** 2)
+
+        def loss_ref(q, k, v):
+            return jnp.sum(dot_product_attention(q, k, v, None, causal=True,
+                                                 alibi=al) ** 2)
+
+        g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b, name in zip(g1, g2, "qkv"):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=5e-4, rtol=1e-3,
+                                       err_msg=f"d{name} mismatch")
+
     def test_full_mask_falls_back(self):
         q, k, v = _qkv(s=64)
         full = jnp.ones((2, 64, 64), jnp.int32)

@@ -338,6 +338,12 @@ def test_top_p_restricts_support():
     picks = {int(_sample(logits, jax.random.PRNGKey(i), 1.0, 0, 0.5)[0])
              for i in range(20)}
     assert picks == {0}
+    # near-flat top-3: the nucleus must contain MORE than the argmax
+    # (regression: a max-instead-of-min cutoff made any top_p<1 greedy)
+    logits = jnp.asarray([[2.0, 1.9, 1.8, -5.0]])
+    picks = {int(_sample(logits, jax.random.PRNGKey(i), 1.0, 0, 0.95)[0])
+             for i in range(200)}
+    assert picks == {0, 1, 2}, picks
     # top_p=1.0 with high temperature samples beyond token 0
     picks = {int(_sample(logits, jax.random.PRNGKey(i), 5.0, 0, 1.0)[0])
              for i in range(50)}
