@@ -175,12 +175,18 @@ class ParallelConfig(ConfigModel):
     pipeline_parallel_size: int = 1
     sequence_parallel_size: int = 1
     expert_parallel_size: int = 1
+    # ulysses: all-to-all head scatter (parallel/sequence.py)
+    # ring:    rotating-KV blockwise attention (parallel/ring.py)
+    sequence_parallel_impl: str = "ulysses"
 
     def validate(self) -> None:
         for name in ("tensor_parallel_size", "pipeline_parallel_size",
                      "sequence_parallel_size", "expert_parallel_size"):
             if getattr(self, name) < 1:
                 raise ConfigError(f"{name} must be >= 1")
+        if self.sequence_parallel_impl not in ("ulysses", "ring"):
+            raise ConfigError("sequence_parallel_impl must be 'ulysses' or "
+                              f"'ring', got '{self.sequence_parallel_impl}'")
 
 
 # ---------------------------------------------------------------------------

@@ -380,10 +380,15 @@ def _layer_forward(cfg: TransformerConfig, x: jax.Array, layer: Dict[str, Any],
     k = k.reshape(B, S, K, D)
     v = v.reshape(B, S, K, D)
 
-    # Ulysses SP / TP reshard: sequence gathered, heads scattered over
-    # ('seq','model') — XLA lowers this constraint to the head-scatter
-    # all-to-all (parallel/sequence.py). Training path only (no cache).
-    if cache is None:
+    # SP reshard around attention. Ulysses: sequence gathered, heads
+    # scattered over ('seq','model') — XLA lowers the constraint to the
+    # head-scatter all-to-all. Ring: tokens STAY seq-sharded; KV chunks
+    # rotate inside ring_attention instead. Training path only (no cache).
+    from ..parallel.ring import ring_attention_enabled
+
+    use_ring = (cache is None and ring_attention_enabled()
+                and cfg.attention_impl is None)
+    if cache is None and not use_ring:
         from ..parallel.sequence import heads_spec, constrain
 
         qspec = heads_spec(N)
@@ -462,6 +467,14 @@ def _layer_forward(cfg: TransformerConfig, x: jax.Array, layer: Dict[str, Any],
                 attn = attn_fn(q, k, v, full, causal=False)
             else:
                 attn = attn_fn(q, k, v, full, causal=False, alibi=alibi)
+    elif use_ring:
+        from ..parallel.ring import ring_attention
+
+        if alibi is not None:
+            raise NotImplementedError(
+                "ring attention + alibi is not supported yet — use "
+                "sequence_parallel_impl='ulysses' for BLOOM-family models")
+        attn = ring_attention(q, k, v, mask=mask, causal=True)
     else:
         if alibi is None:
             attn = attn_fn(q, k, v, mask, causal=True)

@@ -106,6 +106,21 @@ class TrainEngine:
         self.model = model
         self.mesh = mesh if mesh is not None else mesh_mod.build_mesh(config.parallel)
         mesh_mod.set_mesh(self.mesh)
+        from ..parallel.ring import set_ring_attention
+
+        ring = config.parallel.sequence_parallel_impl == "ring"
+        if ring and config.parallel.pipeline_parallel_size > 1:
+            raise ValueError(
+                "sequence_parallel_impl='ring' does not compose with "
+                "pipeline parallelism yet (nested manual shard_maps); use "
+                "'ulysses'")
+        if (ring and model.config is not None
+                and getattr(model.config, "attention_impl", None) is not None):
+            raise ValueError(
+                "sequence_parallel_impl='ring' replaces the attention "
+                "implementation — it cannot be combined with a custom "
+                "attention_impl (the ring setting would be silently dropped)")
+        set_ring_attention(ring)
         # SP ranks share the batch (tokens are sharded, not samples) — only
         # the (expert x data) axes multiply the batch (reference Ulysses
         # semantics; total dp subdivides into expert groups)
