@@ -101,8 +101,22 @@ def decode_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
     T, K = k_cache.shape[1], k_cache.shape[2]
     if T % LANES != 0:
         raise ValueError(f"cache length {T} must be a multiple of {LANES}")
-    # bt must divide T exactly (grid = T//bt) — largest power-of-two divisor
-    bt = next(b for b in (512, 256, 128) if T % b == 0)
+    # bt must divide T exactly (grid = T//bt) AND the double-buffered k/v
+    # blocks must fit scoped VMEM — at K=32,D=128 a 512 block sits ~100KB
+    # over the 16MB limit (observed on v5e), so budget half of VMEM
+    itemsize = jnp.dtype(k_cache.dtype).itemsize
+    per_t = K * D * itemsize * 4            # k+v, double-buffered
+    budget = 8 << 20
+    # bt is a middle block dim so sub-128 values are legal (the last-two-dims
+    # tiling rule applies to (K, D), taken whole); T % 128 == 0 implies every
+    # candidate divides T
+    bt = next((b for b in (512, 256, 128, 64, 32)
+               if T % b == 0 and b * per_t <= budget), None)
+    if bt is None:
+        raise ValueError(
+            f"decode_attention KV blocks do not fit VMEM: {K} kv-heads x "
+            f"head_dim {D} x {itemsize}B needs {per_t} B/token — reduce "
+            "kv heads per device (tensor parallelism) or cache dtype")
     scale = scale if scale is not None else D ** -0.5
     has_alibi = alibi is not None
     alibi_arr = (alibi.astype(jnp.float32).reshape(1, N) if has_alibi
