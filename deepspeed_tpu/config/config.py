@@ -317,6 +317,24 @@ class CheckpointConfig(ConfigModel):
 
 
 @dataclass
+class ProgressiveLayerDropConfig(ConfigModel):
+    """Reference: progressive_layer_drop section (runtime/engine.py:283,
+    progressive_layer_drop.py:10)."""
+
+    enabled: bool = False
+    theta: float = 0.5
+    gamma: float = 0.001
+
+    def validate(self) -> None:
+        if not 0.0 <= self.theta <= 1.0:
+            raise ConfigError(
+                f"progressive_layer_drop.theta must be in [0,1], got {self.theta}")
+        if self.gamma < 0.0:
+            raise ConfigError(
+                f"progressive_layer_drop.gamma must be >= 0, got {self.gamma}")
+
+
+@dataclass
 class DataEfficiencyConfig(ConfigModel):
     enabled: bool = False
     seed: int = 1234
@@ -374,6 +392,8 @@ class Config(ConfigModel):
     monitor: MonitorConfig = field(default_factory=MonitorConfig)
     elasticity: ElasticityConfig = field(default_factory=ElasticityConfig)
     curriculum_learning: CurriculumConfig = field(default_factory=CurriculumConfig)
+    progressive_layer_drop: ProgressiveLayerDropConfig = field(
+        default_factory=ProgressiveLayerDropConfig)
     data_efficiency: DataEfficiencyConfig = field(default_factory=DataEfficiencyConfig)
     compression_training: CompressionConfig = field(default_factory=CompressionConfig)
     aio: AIOConfig = field(default_factory=AIOConfig)

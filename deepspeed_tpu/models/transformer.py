@@ -50,6 +50,8 @@ class TransformerConfig:
     dropout: float = 0.0
     dtype: Any = jnp.float32                # compute/param dtype
     scan_unroll: int = 1                    # lax.scan unroll factor over layers
+    pld_enabled: bool = False               # progressive layer drop: batch
+    #   carries 'pld_theta'; layer i keeps with p = 1-(1-theta)*(i+1)/L
     remat: bool = False                     # activation checkpointing over layers
     remat_policy: str = "full"              # full | dots (save matmul outputs,
     #   recompute elementwise/attention — reference partition_activations analog)
@@ -256,6 +258,15 @@ def active_attention_impl(cfg: "TransformerConfig") -> str:
     if cfg.attention_impl is not None:
         return "custom"
     return "flash_attention" if _kernels_active() else "jnp"
+
+
+def _activation_derived_key(h: jax.Array, salt: int) -> jax.Array:
+    """Deterministic PRNG key from activation content — loss_fn carries no
+    rng argument, so stochastic features (RTS, PLD) derive their draws from
+    the data: varies across batches/steps, reproducible for a given input."""
+    seed = jax.lax.bitcast_convert_type(jnp.sum(h.astype(jnp.float32)),
+                                        jnp.int32)
+    return jax.random.fold_in(jax.random.PRNGKey(salt), seed)
 
 
 def resolve_remat_policy(cfg: "TransformerConfig"):
@@ -497,15 +508,8 @@ def _layer_forward(cfg: TransformerConfig, x: jax.Array, layer: Dict[str, Any],
     if cfg.moe_num_experts > 0:
         from ..parallel.moe import moe_mlp
 
-        rts_rng = None
-        if cfg.moe_use_rts:
-            # loss_fn is pure (no rng arg); derive a per-call key from the
-            # activations so selection varies across batches/steps while
-            # staying deterministic for a given input
-            seed = jax.lax.bitcast_convert_type(
-                jnp.sum(h.astype(jnp.float32)), jnp.int32)
-            rts_rng = jax.random.PRNGKey(0)
-            rts_rng = jax.random.fold_in(rts_rng, seed)
+        rts_rng = (_activation_derived_key(h, 0)
+                   if cfg.moe_use_rts else None)
         mlp_out, aux = moe_mlp(h, layer["router"], layer["mlp"], cfg.activation,
                                top_k=cfg.moe_top_k,
                                capacity_factor=cfg.moe_capacity_factor,
@@ -544,7 +548,9 @@ def forward(params: Dict[str, Any], input_ids: jax.Array,
             cfg: TransformerConfig,
             attention_mask: Optional[jax.Array] = None,
             cache: Optional[Dict[str, Any]] = None,
-            start_pos: Any = 0) -> Tuple[jax.Array, Optional[Dict[str, Any]], jax.Array]:
+            start_pos: Any = 0,
+            pld_theta: Optional[jax.Array] = None
+            ) -> Tuple[jax.Array, Optional[Dict[str, Any]], jax.Array]:
     """Token ids (B,S) → (logits (B,S,V), new_cache, moe_aux_loss). With
     ``cache``, runs in decode mode (cache is a per-layer stacked pytree; see
     inference/kv_cache.py)."""
@@ -560,13 +566,35 @@ def forward(params: Dict[str, Any], input_ids: jax.Array,
     static_prefill = (cache is not None
                       and isinstance(start_pos, int) and start_pos == 0)
 
+    use_pld = (cfg.pld_enabled and cache is None and pld_theta is not None)
+    L = cfg.num_layers
+
     def block(carry, layer_and_cache):
         h, aux_acc = carry
-        layer, layer_cache = layer_and_cache
-        h, new_cache, aux = _layer_forward(cfg, h, layer, attention_mask,
-                                           positions, layer_cache,
-                                           static_prefill=static_prefill)
-        return (h, aux_acc + aux), new_cache
+        if use_pld:
+            (layer, layer_cache), idx = layer_and_cache
+        else:
+            layer, layer_cache = layer_and_cache
+            idx = None
+        h_new, new_cache, aux = _layer_forward(cfg, h, layer, attention_mask,
+                                               positions, layer_cache,
+                                               static_prefill=static_prefill)
+        if use_pld:
+            # stochastic depth (reference progressive_layer_drop.py): layer i
+            # keeps with p = 1 - (1-theta)(i+1)/L, deeper layers drop more;
+            # kept outputs scaled 1/p for an unbiased expectation. The draw
+            # derives from the activations (loss_fn has no rng argument) so
+            # it varies across steps/batches but stays deterministic.
+            keep_p = 1.0 - (1.0 - pld_theta) * (idx + 1.0) / L
+            key = jax.random.fold_in(_activation_derived_key(h, 17),
+                                     idx.astype(jnp.int32))
+            gate = jax.random.bernoulli(key, keep_p).astype(jnp.float32)
+            h_new = h + ((gate / keep_p)
+                         * (h_new - h).astype(jnp.float32)).astype(h.dtype)
+            # same 1/keep_p rescale as the residual — otherwise deep layers'
+            # router balancing term is down-weighted in expectation
+            aux = aux * gate / keep_p
+        return (h_new, aux_acc + aux), new_cache
 
     block_fn = block
     if cfg.remat and cache is None:
@@ -574,9 +602,15 @@ def forward(params: Dict[str, Any], input_ids: jax.Array,
                                   policy=resolve_remat_policy(cfg))
 
     if cache is None:
-        (x, aux_total), _ = lax.scan(lambda c, layer: block_fn(c, (layer, None)),
-                                     (x, jnp.float32(0.0)), params["layers"],
-                                     unroll=cfg.scan_unroll)
+        if use_pld:
+            xs = ((params["layers"], None), jnp.arange(L, dtype=jnp.float32))
+            (x, aux_total), _ = lax.scan(block_fn, (x, jnp.float32(0.0)), xs,
+                                         unroll=cfg.scan_unroll)
+        else:
+            (x, aux_total), _ = lax.scan(
+                lambda c, layer: block_fn(c, (layer, None)),
+                (x, jnp.float32(0.0)), params["layers"],
+                unroll=cfg.scan_unroll)
         new_cache = None
     else:
         (x, aux_total), new_cache = lax.scan(block_fn, (x, jnp.float32(0.0)),
@@ -622,7 +656,8 @@ def build_model(cfg: TransformerConfig, name: str = "transformer") -> Model:
 
     def loss_fn(params, batch):
         logits, _, aux = forward(params, batch["input_ids"], cfg,
-                                 attention_mask=batch.get("attention_mask"))
+                                 attention_mask=batch.get("attention_mask"),
+                                 pld_theta=batch.get("pld_theta"))
         labels = batch.get("labels")
         if labels is None:
             labels = jnp.concatenate(

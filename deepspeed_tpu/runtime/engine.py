@@ -231,6 +231,29 @@ class TrainEngine:
                 "schedule_type": cl.schedule_type,
                 "schedule_config": dict(cl.schedule_config)})
 
+        # progressive layer drop (reference engine.py:283 / :1648 theta kwarg)
+        self._pld = None
+        if self.config.progressive_layer_drop.enabled:
+            if self.model.pipelined:
+                raise NotImplementedError(
+                    "progressive_layer_drop with pipeline parallelism is "
+                    "not supported yet")
+            if self._onebit:
+                raise NotImplementedError(
+                    "progressive_layer_drop with 1-bit optimizers is not "
+                    "supported (the compressed step's batch specs assume "
+                    "token-shaped leaves)")
+            if self.model.config is None:
+                raise NotImplementedError(
+                    "progressive_layer_drop needs a transformer Model (the "
+                    "layer scan applies the stochastic depth gate)")
+            from .progressive_layer_drop import ProgressiveLayerDrop
+
+            pld_cfg = self.config.progressive_layer_drop
+            self._pld = ProgressiveLayerDrop(theta=pld_cfg.theta,
+                                             gamma=pld_cfg.gamma)
+            self.model.config.pld_enabled = True
+
         # compression (reference compress.py:95 init_compression + scheduler)
         self._compression_plan = None
         self._compression_active = frozenset()
@@ -628,6 +651,11 @@ class TrainEngine:
                     f"batch leading dim {leading} != gradient_accumulation_steps {gas}; "
                     f"shape must be (gas, micro_batch*dp, ...)")
 
+        if self._pld is not None:
+            # theta decays per step; a traced scalar input, so no recompiles
+            theta = self._pld.update_state(self.global_steps)
+            batch = dict(batch)
+            batch["pld_theta"] = jnp.full((gas,), theta, jnp.float32)
         if self._curriculum is not None:
             # seqlen curriculum: truncate the token dim to the current
             # difficulty (reference engine.py:1653); each distinct length is
@@ -714,6 +742,11 @@ class TrainEngine:
                 "the staged forward/backward/step protocol is not available for "
                 "pipelined models — use train_batch() (the reference has the "
                 "same restriction: PipelineEngine only exposes train_batch)")
+        if self._pld is not None:
+            raise RuntimeError(
+                "progressive_layer_drop is driven by train_batch (per-step "
+                "theta injection); the staged forward/backward/step protocol "
+                "would silently run the full model")
         if self._compiled_micro is None:
             model, gas, fp16 = self.model, self.gradient_accumulation_steps(), self.fp16_enabled()
 

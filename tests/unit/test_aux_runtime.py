@@ -109,3 +109,42 @@ class TestTensorFragment:
         assert g is not None and float(np.abs(g).sum()) > 0
         full = safe_get_full_param(engine, "embed/tokens")
         assert full.shape[0] == 256
+
+
+class TestPLDIntegration:
+    def _engine(self, enabled, theta=0.5, gamma=0.0):
+        from deepspeed_tpu.parallel import mesh as mesh_mod
+
+        mesh_mod.reset_mesh()
+        model = create_model("tiny", dtype=jnp.float32, num_layers=4)
+        cfg = {"train_micro_batch_size_per_gpu": 2,
+               "steps_per_print": 1000,
+               "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+               "progressive_layer_drop": {"enabled": enabled,
+                                          "theta": theta, "gamma": gamma}}
+        engine, *_ = deepspeed_tpu.initialize(model=model, config=cfg)
+        return engine
+
+    def _batch(self, engine, seed=0):
+        gb = engine.train_batch_size()
+        ids = jax.random.randint(jax.random.PRNGKey(seed), (1, gb, 16), 0, 250)
+        return {"input_ids": ids}
+
+    def test_theta_one_matches_baseline(self):
+        # gamma=0, theta=1 -> keep prob 1 everywhere: must equal plain model
+        e1 = self._engine(False)
+        e2 = self._engine(True, theta=1.0)
+        b = self._batch(e1)
+        l1 = [float(e1.train_batch(batch=b)) for _ in range(3)]
+        l2 = [float(e2.train_batch(batch=b)) for _ in range(3)]
+        np.testing.assert_allclose(l1, l2, rtol=1e-5)
+
+    def test_dropping_trains_and_differs(self):
+        e1 = self._engine(False)
+        e2 = self._engine(True, theta=0.3, gamma=10.0)  # theta~0.3 from step 1
+        b = self._batch(e1)
+        l1 = [float(e1.train_batch(batch=b)) for _ in range(5)]
+        l2 = [float(e2.train_batch(batch=b)) for _ in range(5)]
+        assert all(np.isfinite(l2))
+        assert l2[-1] < l2[0]                  # still learns
+        assert not np.allclose(l1, l2, rtol=1e-5)  # drop really happens
