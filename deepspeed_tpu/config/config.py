@@ -326,9 +326,12 @@ class ProgressiveLayerDropConfig(ConfigModel):
     gamma: float = 0.001
 
     def validate(self) -> None:
-        if not 0.0 <= self.theta <= 1.0:
+        # theta is the keep-probability floor the decay converges to; 0 would
+        # drive the deepest layer's keep_p to 0 (and its 1/keep_p rescale
+        # unbounded), so require a positive limit
+        if not 0.0 < self.theta <= 1.0:
             raise ConfigError(
-                f"progressive_layer_drop.theta must be in [0,1], got {self.theta}")
+                f"progressive_layer_drop.theta must be in (0,1], got {self.theta}")
         if self.gamma < 0.0:
             raise ConfigError(
                 f"progressive_layer_drop.gamma must be >= 0, got {self.gamma}")

@@ -52,6 +52,13 @@ class TransformerConfig:
     scan_unroll: int = 1                    # lax.scan unroll factor over layers
     pld_enabled: bool = False               # progressive layer drop: batch
     #   carries 'pld_theta'; layer i keeps with p = 1-(1-theta)*(i+1)/L
+    # random-LTD (reference data_routing/basic_layer.py:14): listed layers run
+    # on a random ltd_keep-token subset; dropped tokens skip the layer
+    ltd_enabled: bool = False
+    ltd_layers: Optional[Tuple[int, ...]] = None  # None => all but first/last
+    ltd_keep: int = 0                       # tokens kept per LTD layer; STATIC
+    #   (the schedule changes it only at quantised boundaries, so each value
+    #   is one extra jit trace — same discipline as the seqlen curriculum)
     remat: bool = False                     # activation checkpointing over layers
     remat_policy: str = "full"              # full | dots (save matmul outputs,
     #   recompute elementwise/attention — reference partition_activations analog)
@@ -567,25 +574,70 @@ def forward(params: Dict[str, Any], input_ids: jax.Array,
                       and isinstance(start_pos, int) and start_pos == 0)
 
     use_pld = (cfg.pld_enabled and cache is None and pld_theta is not None)
+    use_ltd = (cfg.ltd_enabled and cache is None and 0 < cfg.ltd_keep < S)
     L = cfg.num_layers
+    if use_ltd:
+        # default mirrors the engine (engine.py random-LTD init): all but the
+        # first and last layer; degenerate depths keep at least one layer
+        ltd_layers = (cfg.ltd_layers if cfg.ltd_layers is not None
+                      else tuple(range(1, L - 1)) if L > 2
+                      else tuple(range(L - 1, L)))
+        ltd_flags = jnp.array([1.0 if i in ltd_layers else 0.0
+                               for i in range(L)], jnp.float32)
 
     def block(carry, layer_and_cache):
         h, aux_acc = carry
-        if use_pld:
+        ltd_flag = None
+        if use_ltd:
+            (layer, layer_cache), idx, ltd_flag = layer_and_cache
+        elif use_pld:
             (layer, layer_cache), idx = layer_and_cache
         else:
             layer, layer_cache = layer_and_cache
             idx = None
-        h_new, new_cache, aux = _layer_forward(cfg, h, layer, attention_mask,
-                                               positions, layer_cache,
-                                               static_prefill=static_prefill)
+        if use_ltd:
+            # gather a random sorted token subset, run the layer on it,
+            # scatter back — dropped tokens keep their input activations
+            # (reference RandomLayerTokenDrop + token_sort/gather_scatter
+            # kernels; sorted indices preserve the causal order so the
+            # subset's causal mask is exact)
+            def ltd_branch(hh):
+                # trace-time import: runtime already depends on models, so the
+                # reverse module-level import would be circular
+                from ..runtime.data_pipeline.random_ltd import (
+                    gather_tokens, sample_token_subset, scatter_tokens)
+
+                key = jax.random.fold_in(_activation_derived_key(hh, 23),
+                                         idx.astype(jnp.int32))
+                kept, _ = sample_token_subset(key, S, cfg.ltd_keep)
+                part = gather_tokens(hh, kept)
+                msk = (None if attention_mask is None
+                       else jnp.take(attention_mask, kept, axis=1))
+                out, _, aux = _layer_forward(cfg, part, layer, msk,
+                                             jnp.take(positions, kept), None)
+                return scatter_tokens(hh, out, kept), aux
+
+            def full_branch(hh):
+                out, _, aux = _layer_forward(cfg, hh, layer, attention_mask,
+                                             positions, None)
+                return out, aux
+
+            h_new, aux = lax.cond(ltd_flag > 0, ltd_branch, full_branch, h)
+            new_cache = None
+        else:
+            h_new, new_cache, aux = _layer_forward(
+                cfg, h, layer, attention_mask, positions, layer_cache,
+                static_prefill=static_prefill)
         if use_pld:
             # stochastic depth (reference progressive_layer_drop.py): layer i
             # keeps with p = 1 - (1-theta)(i+1)/L, deeper layers drop more;
             # kept outputs scaled 1/p for an unbiased expectation. The draw
             # derives from the activations (loss_fn has no rng argument) so
             # it varies across steps/batches but stays deterministic.
-            keep_p = 1.0 - (1.0 - pld_theta) * (idx + 1.0) / L
+            # floor keeps the 1/keep_p rescale finite even when theta has
+            # decayed to ~0 for the deepest layer (0/0 NaN otherwise)
+            keep_p = jnp.maximum(1.0 - (1.0 - pld_theta) * (idx + 1.0) / L,
+                                 0.01)
             key = jax.random.fold_in(_activation_derived_key(h, 17),
                                      idx.astype(jnp.int32))
             gate = jax.random.bernoulli(key, keep_p).astype(jnp.float32)
@@ -602,15 +654,17 @@ def forward(params: Dict[str, Any], input_ids: jax.Array,
                                   policy=resolve_remat_policy(cfg))
 
     if cache is None:
-        if use_pld:
+        # one scan; xs packing varies with the active stochastic features
+        # (block unpacks in the same order; None rides the pytree untouched)
+        if use_ltd:
+            xs = ((params["layers"], None), jnp.arange(L, dtype=jnp.float32),
+                  ltd_flags)
+        elif use_pld:
             xs = ((params["layers"], None), jnp.arange(L, dtype=jnp.float32))
-            (x, aux_total), _ = lax.scan(block_fn, (x, jnp.float32(0.0)), xs,
-                                         unroll=cfg.scan_unroll)
         else:
-            (x, aux_total), _ = lax.scan(
-                lambda c, layer: block_fn(c, (layer, None)),
-                (x, jnp.float32(0.0)), params["layers"],
-                unroll=cfg.scan_unroll)
+            xs = (params["layers"], None)
+        (x, aux_total), _ = lax.scan(block_fn, (x, jnp.float32(0.0)), xs,
+                                     unroll=cfg.scan_unroll)
         new_cache = None
     else:
         (x, aux_total), new_cache = lax.scan(block_fn, (x, jnp.float32(0.0)),

@@ -254,6 +254,37 @@ class TrainEngine:
                                              gamma=pld_cfg.gamma)
             self.model.config.pld_enabled = True
 
+        # random-LTD (reference data_pipeline/data_routing/basic_layer.py:14 +
+        # scheduler.py:38): listed layers run on a scheduled random token
+        # subset. The kept count is shape-affecting, so train_batch
+        # re-specialises the step at schedule boundaries.
+        self._random_ltd = None
+        de_cfg = self.config.data_efficiency
+        ltd_cfg = (de_cfg.data_routing.get("random_ltd", {})
+                   if de_cfg.enabled and isinstance(de_cfg.data_routing, dict)
+                   else {})
+        if ltd_cfg.get("enabled"):
+            if self.model.pipelined or self.model.config is None:
+                raise NotImplementedError(
+                    "random_ltd needs a non-pipelined transformer Model "
+                    "(the layer scan applies the token gather/scatter)")
+            if self._onebit:
+                raise NotImplementedError(
+                    "random_ltd with 1-bit optimizers is not supported")
+            from .data_pipeline import RandomLTDScheduler
+
+            self._random_ltd = RandomLTDScheduler(
+                ltd_cfg.get("random_ltd_schedule", ltd_cfg))
+            n_layers = self.model.config.num_layers
+            layer_ids = ltd_cfg.get("random_ltd_layer_id")
+            if layer_ids is None:
+                # default: all but the first and last layer (the reference's
+                # usual config); degenerate depths keep at least one layer
+                layer_ids = (range(1, n_layers - 1) if n_layers > 2
+                             else range(n_layers - 1, n_layers))
+            self.model.config.ltd_enabled = True
+            self.model.config.ltd_layers = tuple(int(i) for i in layer_ids)
+
         # compression (reference compress.py:95 init_compression + scheduler)
         self._compression_plan = None
         self._compression_active = frozenset()
@@ -663,6 +694,14 @@ class TrainEngine:
             diff = self._curriculum.update_difficulty(self.global_steps)
             batch = jax.tree.map(
                 lambda x: x[:, :, :diff] if np.ndim(x) == 3 else x, batch)
+        if self._random_ltd is not None:
+            # kept-token count is shape-affecting → re-specialise the step at
+            # schedule boundaries (bounded by the schedule's quantisation)
+            seq_len = int(jax.tree.leaves(batch)[0].shape[-1])
+            keep = min(self._random_ltd.get_seq_len(self.global_steps), seq_len)
+            if keep != self.model.config.ltd_keep:
+                self.model.config.ltd_keep = keep
+                self._compiled_step = None
         if self._compression_plan is not None:
             act = self._compression_sched.active_methods(self.global_steps)
             if act != self._compression_active:
@@ -812,6 +851,18 @@ class TrainEngine:
             # the pipelined loss_fn needs an (M, mb, ...) stack; for a plain
             # eval microbatch wrap it as a single-microbatch stack
             batch = jax.tree.map(lambda x: x[None], batch)
+        if self._random_ltd is not None:
+            # random-LTD is a training regulariser — evaluation must see the
+            # full sequence (reference eval path bypasses the LTD layers).
+            # ltd_keep=0 disables the gather in forward; the jit cache keys on
+            # nothing here, so trace once with it off and restore.
+            keep = self.model.config.ltd_keep
+            self.model.config.ltd_keep = 0
+            try:
+                with self.mesh:
+                    return jax.jit(self.model.loss_fn)(self.params, batch)
+            finally:
+                self.model.config.ltd_keep = keep
         with self.mesh:
             return jax.jit(self.model.loss_fn)(self.params, batch)
 

@@ -102,6 +102,62 @@ class TestRandomLTD:
         assert s.get_seq_len(0) == 64
         assert s.get_seq_len(100) == 512
 
+    def test_forward_wiring(self):
+        """LTD layers run on a token subset: forward stays shape-correct,
+        differs from the full model, and reduces to it at ltd_keep == S."""
+        from deepspeed_tpu.models import create_model
+
+        full = create_model("tiny", num_layers=4)
+        params = full.init(jax.random.PRNGKey(0))
+        ids = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0,
+                                 full.config.vocab_size)
+
+        ltd = create_model("tiny", num_layers=4, ltd_enabled=True,
+                           ltd_layers=(1, 2), ltd_keep=8)
+        base, _ = full.apply(params, {"input_ids": ids})
+        out, _ = ltd.apply(params, {"input_ids": ids})
+        assert out.shape == base.shape
+        assert np.isfinite(np.asarray(out)).all()
+        assert not np.allclose(np.asarray(out), np.asarray(base))
+
+        # keep == S => no drop anywhere, bit-identical to the plain model
+        noop = create_model("tiny", num_layers=4, ltd_enabled=True,
+                            ltd_keep=16)
+        noop_out, _ = noop.apply(params, {"input_ids": ids})
+        np.testing.assert_array_equal(np.asarray(noop_out), np.asarray(base))
+
+    def test_engine_schedule_drives_keep(self):
+        import deepspeed_tpu
+        from deepspeed_tpu.models import create_model
+
+        model = create_model("tiny")
+        cfg = {"train_micro_batch_size_per_gpu": 2,
+               "gradient_accumulation_steps": 1,
+               "steps_per_print": 1000,
+               "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+               "data_efficiency": {
+                   "enabled": True,
+                   "data_routing": {"random_ltd": {
+                       "enabled": True,
+                       "random_ltd_schedule": {
+                           "min_value": 8, "max_value": 32,
+                           "schedule_config": {"total_layer_token_step": 4,
+                                               "difficulty_step": 8}}}}}}
+        engine, *_ = deepspeed_tpu.initialize(model=model, config=cfg)
+        assert engine.model.config.ltd_enabled
+        assert engine.model.config.ltd_layers == (1,)  # tiny: 2 layers
+        ids = jax.random.randint(jax.random.PRNGKey(0),
+                                 (1, engine.train_batch_size(), 32), 0,
+                                 model.config.vocab_size)
+        keeps = []
+        for _ in range(6):
+            loss = engine.train_batch(batch={"input_ids": ids})
+            assert np.isfinite(float(loss))
+            keeps.append(engine.model.config.ltd_keep)
+        assert keeps[0] == 8               # ramp start
+        assert keeps[-1] == 32             # ramp done: full sequence
+        assert keeps == sorted(keeps)
+
     def test_subset_gather_scatter_roundtrip(self):
         rng = jax.random.PRNGKey(0)
         kept, mask = sample_token_subset(rng, 16, 6)
