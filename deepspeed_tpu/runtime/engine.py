@@ -77,11 +77,27 @@ class TrainEngine:
                 "optimizer 'cpuadam' is the host-offloaded Adam — set "
                 "zero_optimization.offload_optimizer.device='cpu' (refusing "
                 "to silently run plain device Adam)")
-        if config.zero_optimization.offload_optimizer.device == "nvme":
-            raise NotImplementedError(
-                "offload_optimizer.device='nvme' is not implemented yet — "
-                "design in docs/offload_design.md tier 2; use 'cpu' for "
-                "host-memory offload")
+        self._nvme_offload = (
+            config.zero_optimization.offload_optimizer.device == "nvme")
+        if self._nvme_offload:
+            # ZeRO-Infinity tier (docs/offload_design.md tier 2): the swapper
+            # owns the optimizer math, so only the Adam family is swappable —
+            # the reference has the same restriction (swappable_optimizer)
+            if opt_name not in ("adam", "adamw", "fusedadam", "cpuadam"):
+                raise ValueError(
+                    f"offload_optimizer.device='nvme' supports the Adam "
+                    f"family only, got '{config.optimizer.type}'")
+            if config.fp16.enabled:
+                raise NotImplementedError(
+                    "nvme offload + fp16 dynamic loss scaling is not "
+                    "supported (overflow-skip needs resident state); use bf16")
+            if jax.process_count() > 1:
+                raise NotImplementedError(
+                    "nvme offload is single-process for now (each process "
+                    "would need its own swap dir over addressable shards)")
+            if config.parallel.pipeline_parallel_size > 1:
+                raise NotImplementedError("nvme offload + pipeline "
+                                          "parallelism is not supported")
         if config.zero_optimization.offload_param.device != "none":
             raise NotImplementedError(
                 "offload_param is not implemented yet (optimizer-state "
@@ -180,11 +196,37 @@ class TrainEngine:
         with self.mesh:
             self.params = jax.jit(_init_cast, out_shardings=self.param_shardings)(rng)
 
-        # optimizer + scaler state, sharded per plan
-        master_shardings_tree = self._opt_state_shardings()
-        with self.mesh:
-            self.opt_state = jax.jit(self.optimizer.init,
-                                     out_shardings=master_shardings_tree)(self.params)
+        # optimizer + scaler state, sharded per plan (NVMe offload: the state
+        # lives in swap files instead — nothing is materialised in HBM)
+        self._nvme_swapper = None
+        if self._nvme_offload:
+            from .swap import NVMeOptimizerSwapper
+
+            off_cfg = self.config.zero_optimization.offload_optimizer
+            opt_params = dict(self.config.optimizer.params)
+            self._nvme_swapper = NVMeOptimizerSwapper(
+                swap_dir=os.path.join(
+                    off_cfg.nvme_path,
+                    f"dstpu_swap_p{jax.process_index()}"),
+                lr=float(opt_params.get("lr", 1e-3)),
+                betas=tuple(opt_params.get("betas", (0.9, 0.999))),
+                eps=float(opt_params.get("eps", 1e-8)),
+                weight_decay=float(opt_params.get("weight_decay", 0.0)),
+                adam_w_mode=opt_params.get(
+                    "adam_w_mode", self.config.optimizer.type.lower() != "adam"),
+                sub_group_bytes=
+                    self.config.zero_optimization.sub_group_size * 12,
+                aio_config={"block_size": self.config.aio.block_size,
+                            "queue_depth": self.config.aio.queue_depth,
+                            "thread_count": self.config.aio.thread_count})
+            self._nvme_swapper.init_from_params(self.params)
+            self.opt_state = None
+        else:
+            master_shardings_tree = self._opt_state_shardings()
+            with self.mesh:
+                self.opt_state = jax.jit(
+                    self.optimizer.init,
+                    out_shardings=master_shardings_tree)(self.params)
         self.scaler_state: LossScaleState = self.loss_scaler.init()
 
         # 1-bit compression state: per-rank worker residual + per-chunk
@@ -661,6 +703,38 @@ class TrainEngine:
             out_shardings=(self.param_shardings, opt_shardings, None, None, None),
             donate_argnums=(0, 1))
 
+    def _build_nvme_grads_step(self) -> Callable:
+        """Device half of the NVMe-offload step: loss + accumulated grads +
+        global grad norm; the optimizer update runs host-side in the swapper
+        (reference PipelinedOptimizerSwapper + cpu_adam split)."""
+        from .optimizer import _global_norm
+
+        model, gas = self.model, self.gradient_accumulation_steps()
+        grad_specs = self.plan.grad_specs
+
+        def grads_step(params, batch):
+            def one_micro(carry, mb):
+                grads_acc = carry
+                loss, grads = jax.value_and_grad(model.loss_fn)(params, mb)
+                grads = jax.tree.map(lambda a, g: a + g.astype(jnp.float32),
+                                     grads_acc, grads)
+                return grads, loss
+
+            zero_grads = jax.tree.map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), params)
+            if gas == 1:
+                grads, losses = one_micro(zero_grads,
+                                          jax.tree.map(lambda x: x[0], batch))
+                losses = losses[None]
+            else:
+                grads, losses = jax.lax.scan(one_micro, zero_grads, batch)
+            grads = jax.tree.map(lambda g: g / gas, grads)
+            grads = jax.lax.with_sharding_constraint(
+                grads, as_named(grad_specs, mesh_mod.get_mesh()))
+            return grads, jnp.mean(losses.astype(jnp.float32)), _global_norm(grads)
+
+        return jax.jit(grads_step, in_shardings=(self.param_shardings, None))
+
     # -- public train API -------------------------------------------------
     def train_batch(self, data_iter: Optional[Iterable] = None,
                     batch: Optional[Any] = None) -> jax.Array:
@@ -709,9 +783,10 @@ class TrainEngine:
                 self._compiled_step = None    # re-specialise at the boundary
 
         if self._compiled_step is None:
-            self._compiled_step = (self._build_onebit_train_step()
-                                   if self._onebit else
-                                   self._build_train_step())
+            self._compiled_step = (
+                self._build_nvme_grads_step() if self._nvme_swapper is not None
+                else self._build_onebit_train_step() if self._onebit
+                else self._build_train_step())
 
         # Steady-state path is SYNC-FREE: no host<->device scalar fetches per
         # step (each one drains the TPU queue — ruinous over remote tunnels).
@@ -722,7 +797,24 @@ class TrainEngine:
             self.timers(TRAIN_BATCH_TIMER).start(synchronize=True)
         with self.mesh:
             batch = self._globalize_batch(batch, leading_gas=True)
-            if self._onebit:
+            if self._nvme_swapper is not None:
+                # device: loss+grads; host: pipelined NVMe swap + Adam. The
+                # grad-norm fetch is a host sync, but the swap loop is
+                # host-driven anyway — no extra queue drain
+                grads, loss, grad_norm = self._compiled_step(self.params, batch)
+                clip = self.config.gradient_clipping
+                scale = 1.0
+                if clip and clip > 0:
+                    scale = min(clip / (float(grad_norm) + 1e-6), 1.0)
+                lr = float(self.optimizer.lr_schedule(self.global_steps))
+                self._nvme_swapper.lr = lr
+                self.params = self._nvme_swapper.step_update(
+                    self.params, grads, grad_scale=scale)
+                del grads
+                stats = StepStats(grad_norm=grad_norm,
+                                  skipped=jnp.asarray(False),
+                                  lr=jnp.float32(lr))
+            elif self._onebit:
                 (self.params, self.opt_state, self.scaler_state,
                  self._comp_state, loss, stats) = self._compiled_step(
                     self.params, self.opt_state, self.scaler_state,
@@ -786,6 +878,16 @@ class TrainEngine:
                 "progressive_layer_drop is driven by train_batch (per-step "
                 "theta injection); the staged forward/backward/step protocol "
                 "would silently run the full model")
+        if self._nvme_swapper is not None:
+            raise RuntimeError(
+                "nvme offload drives the optimizer from train_batch (the "
+                "swap pipeline wraps the whole step) — the staged "
+                "forward/backward/step protocol is not available")
+        if self._random_ltd is not None:
+            raise RuntimeError(
+                "random_ltd is driven by train_batch (per-step kept-token "
+                "schedule + step re-specialisation); the staged "
+                "forward/backward/step protocol would silently skip it")
         if self._compiled_micro is None:
             model, gas, fp16 = self.model, self.gradient_accumulation_steps(), self.fp16_enabled()
 
@@ -921,6 +1023,10 @@ class TrainEngine:
                      client_state=client_state, save_latest=save_latest,
                      tag_validation=self.config.checkpoint.tag_validation,
                      async_save=async_save)
+        if self._nvme_swapper is not None:
+            # the swap files ARE the optimizer state — snapshot them into the
+            # checkpoint (reference use_node_local_storage semantics)
+            self._nvme_swapper.snapshot_to(os.path.join(path, "nvme_state"))
         log_dist(f"saved checkpoint {path}")
         return path
 
@@ -929,18 +1035,37 @@ class TrainEngine:
                         load_lr_scheduler_states: bool = True) -> Tuple[Optional[str], Dict]:
         from .checkpoint import load_checkpoint as _load
 
-        opt_shardings = self._opt_state_shardings() if load_optimizer_states else None
+        load_resident_opt = (load_optimizer_states
+                             and self._nvme_swapper is None)
+        opt_shardings = self._opt_state_shardings() if load_resident_opt else None
         with self.mesh:
             result = _load(load_dir, tag,
                            params_template=(self.params, self.param_shardings),
                            opt_template=((self.opt_state, opt_shardings)
-                                         if load_optimizer_states else None))
+                                         if load_resident_opt else None))
         if result is None:
             return None, {}
         params, opt_state, client_state = result
         self.params = params
         if opt_state is not None:
             self.opt_state = opt_state
+        if load_optimizer_states and self._nvme_swapper is not None:
+            src = os.path.join(load_dir, tag or client_state.get("tag", ""),
+                               "nvme_state")
+            if not os.path.isdir(src):
+                # resolve via 'latest' the same way _load did
+                latest = os.path.join(load_dir, "latest")
+                if os.path.exists(latest):
+                    with open(latest) as f:
+                        src = os.path.join(load_dir, f.read().strip(),
+                                           "nvme_state")
+            if not os.path.isdir(src):
+                raise RuntimeError(
+                    f"checkpoint has no nvme_state snapshot at {src} — "
+                    "cannot restore NVMe optimizer state (pass "
+                    "load_optimizer_states=False to restore params only)")
+            self._nvme_swapper.restore_snapshot(
+                src, client_state.get("global_steps", 0))
         self.global_steps = client_state.get("global_steps", 0)
         self.micro_steps = client_state.get("micro_steps", 0)
         self.skipped_steps = client_state.get("skipped_steps", 0)

@@ -1,0 +1,3 @@
+"""NVMe swap tier — analog of ``deepspeed/runtime/swap_tensor``."""
+
+from .optimizer_swapper import NVMeOptimizerSwapper  # noqa: F401

@@ -26,12 +26,20 @@ def test_cpu_offload_rejected_on_cpu_backend():
             config=_cfg(offload_optimizer={"device": "cpu"}))
 
 
-def test_nvme_offload_fails_loudly():
+def test_nvme_offload_gates(tmp_path):
+    # nvme offload is implemented (tests/unit/test_nvme_offload.py); the
+    # remaining hard gates must still fail loudly
     model = create_model("tiny", dtype=jnp.float32)
-    with pytest.raises(NotImplementedError, match="nvme"):
-        deepspeed_tpu.initialize(
-            model=model,
-            config=_cfg(offload_optimizer={"device": "nvme"}))
+    cfg = _cfg(offload_optimizer={"device": "nvme",
+                                  "nvme_path": str(tmp_path)})
+    cfg["fp16"] = {"enabled": True}
+    with pytest.raises(NotImplementedError, match="fp16"):
+        deepspeed_tpu.initialize(model=model, config=cfg)
+    cfg2 = _cfg(offload_optimizer={"device": "nvme",
+                                   "nvme_path": str(tmp_path)})
+    cfg2["optimizer"] = {"type": "sgd", "params": {"lr": 1e-2}}
+    with pytest.raises(ValueError, match="Adam family"):
+        deepspeed_tpu.initialize(model=model, config=cfg2)
 
 
 def test_param_offload_fails_loudly():
