@@ -4,6 +4,7 @@ op_builder/, csrc/)."""
 from .decode_attention import decode_attention, reference_decode_attention
 from .flash_attention import flash_attention, make_attention_impl
 from .fused_adam import fused_adam_flat, reference_adam_flat
+from .fused_lamb import fused_lamb_flat, reference_lamb_flat
 from .normalization import fused_layer_norm, reference_layer_norm
 from .quantization import (dequantize_symmetric, fake_quantize,
                            quantize_symmetric, reference_quantize_symmetric)
@@ -14,6 +15,8 @@ register_op("flash_attention", flash_attention,
             description="FA2-style fused attention fwd+bwd")
 register_op("fused_adam", fused_adam_flat, reference=reference_adam_flat,
             description="flat-buffer Adam/AdamW update")
+register_op("fused_lamb", fused_lamb_flat, reference=reference_lamb_flat,
+            description="flat-buffer LAMB update (per-tensor trust ratio)")
 register_op("fused_layer_norm", fused_layer_norm, reference=reference_layer_norm,
             description="fused LayerNorm/RMSNorm")
 register_op("quantize_symmetric", quantize_symmetric,
@@ -33,7 +36,8 @@ def _ref_attn(q, k, v, mask=None, causal=True, **_):
 __all__ = [
     "decode_attention", "reference_decode_attention",
     "flash_attention", "make_attention_impl", "fused_adam_flat",
-    "reference_adam_flat", "fused_layer_norm", "reference_layer_norm",
+    "reference_adam_flat", "fused_lamb_flat", "reference_lamb_flat",
+    "fused_layer_norm", "reference_layer_norm",
     "quantize_symmetric", "dequantize_symmetric", "fake_quantize",
     "reference_quantize_symmetric", "available_ops", "get_op",
     "is_compatible", "op_report", "register_op",

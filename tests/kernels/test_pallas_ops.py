@@ -255,6 +255,57 @@ class TestFusedAdam:
                                    atol=1e-5, rtol=1e-5)
 
 
+class TestFusedLamb:
+    @pytest.mark.parametrize("wd", [0.0, 0.01])
+    def test_matches_reference(self, wd):
+        from deepspeed_tpu.ops import fused_lamb_flat, reference_lamb_flat
+
+        rng = np.random.RandomState(0)
+        n = 10000  # not a block multiple — exercises padding
+        p = jnp.asarray(rng.randn(n), jnp.float32)
+        g = jnp.asarray(rng.randn(n), jnp.float32)
+        p1 = p2 = p
+        m1 = v1 = m2 = v2 = jnp.zeros(n)
+        for step in range(1, 4):
+            p1, m1, v1 = fused_lamb_flat(p1, g, m1, v1, step, lr=1e-2,
+                                         weight_decay=wd, interpret=INTERPRET)
+            p2, m2, v2 = reference_lamb_flat(p2, g, m2, v2, step, lr=1e-2,
+                                             weight_decay=wd)
+        np.testing.assert_allclose(np.asarray(p1), np.asarray(p2),
+                                   atol=1e-5, rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(m1), np.asarray(m2), atol=1e-6)
+        np.testing.assert_allclose(np.asarray(v1), np.asarray(v2), atol=1e-6)
+
+    def test_trust_ratio_scales_step(self):
+        """LAMB's point: the applied step length is lr * ||p|| / ||u|| when
+        the ratio is inside the clamp window."""
+        from deepspeed_tpu.ops import fused_lamb_flat
+
+        rng = np.random.RandomState(2)
+        n = 8192
+        p = jnp.asarray(rng.randn(n), jnp.float32) * 5.0
+        g = jnp.asarray(rng.randn(n), jnp.float32)
+        p1, _, _ = fused_lamb_flat(p, g, jnp.zeros(n), jnp.zeros(n), 1,
+                                   lr=1e-2, interpret=INTERPRET)
+        step_norm = float(jnp.linalg.norm(p1 - p))
+        # applied step = lr * (||p||/||u||) * u, so its norm is lr * ||p||
+        expected = 1e-2 * float(jnp.linalg.norm(p))
+        assert abs(step_norm - expected) / expected < 0.05
+
+    def test_zero_param_tensor_uses_unit_ratio(self):
+        from deepspeed_tpu.ops import fused_lamb_flat, reference_lamb_flat
+
+        n = 8192
+        p = jnp.zeros(n)
+        g = jnp.ones(n)
+        p1, _, _ = fused_lamb_flat(p, g, jnp.zeros(n), jnp.zeros(n), 1,
+                                   lr=1e-2, interpret=INTERPRET)
+        p2, _, _ = reference_lamb_flat(p, g, jnp.zeros(n), jnp.zeros(n), 1,
+                                       lr=1e-2)
+        assert not np.allclose(np.asarray(p1), 0.0)  # ratio 1.0, not 0
+        np.testing.assert_allclose(np.asarray(p1), np.asarray(p2), atol=1e-6)
+
+
 class TestLayerNorm:
     @pytest.mark.parametrize("rms", [False, True])
     def test_forward(self, rms):
