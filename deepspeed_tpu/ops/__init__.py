@@ -1,6 +1,8 @@
 """deepspeed_tpu.ops — Pallas kernels + registry (reference: deepspeed/ops,
 op_builder/, csrc/)."""
 
+from .block_sparse_attention import (TilePlan, block_sparse_attention,
+                                     build_tile_plan)
 from .decode_attention import decode_attention, reference_decode_attention
 from .flash_attention import flash_attention, make_attention_impl
 from .fused_adam import fused_adam_flat, reference_adam_flat
@@ -25,6 +27,10 @@ register_op("quantize_symmetric", quantize_symmetric,
 register_op("decode_attention", decode_attention,
             reference=reference_decode_attention,
             description="single-query KV-cache decode attention (GQA, alibi)")
+register_op("block_sparse_attention", block_sparse_attention,
+            reference=lambda q, k, v, plan, **kw: _ref_attn(q, k, v),
+            description="block-skip sparse flash attention over a "
+                        "SparsityConfig tile plan (fwd + custom-VJP bwd)")
 
 
 def _ref_attn(q, k, v, mask=None, causal=True, **_):
@@ -34,6 +40,7 @@ def _ref_attn(q, k, v, mask=None, causal=True, **_):
 
 
 __all__ = [
+    "TilePlan", "block_sparse_attention", "build_tile_plan",
     "decode_attention", "reference_decode_attention",
     "flash_attention", "make_attention_impl", "fused_adam_flat",
     "reference_adam_flat", "fused_lamb_flat", "reference_lamb_flat",

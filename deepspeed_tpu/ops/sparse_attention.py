@@ -195,17 +195,70 @@ def layout_to_token_mask(layout: np.ndarray, block: int) -> jax.Array:
     return jnp.asarray(np.kron(layout, np.ones((block, block))), jnp.int32)
 
 
+# id(config) -> (config, {seq_len: plan}). The config object is PINNED in the
+# entry so its id can never be recycled onto a different config (a freed-id
+# collision would silently serve the wrong sparsity pattern).
+_PLAN_CACHE: dict = {}
+
+
+def tile_plan_for(config: SparsityConfig, seq_len: int):
+    """Cached TilePlan for (config, seq_len) — the static schedule the
+    block-skip kernels execute (block_sparse_attention.py)."""
+    from .block_sparse_attention import build_tile_plan
+
+    entry = _PLAN_CACHE.get(id(config))
+    if entry is None or entry[0] is not config:
+        entry = (config, {})
+        _PLAN_CACHE[id(config)] = entry
+    plans = entry[1]
+    if seq_len not in plans:
+        layout = np.asarray(config.make_layout(seq_len))
+        plans[seq_len] = build_tile_plan(layout, config.block, seq_len)
+    return plans[seq_len]
+
+
 def sparse_self_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                           config: SparsityConfig,
-                          key_padding_mask: Optional[jax.Array] = None
-                          ) -> jax.Array:
+                          key_padding_mask: Optional[jax.Array] = None,
+                          use_kernel: Optional[bool] = None,
+                          interpret: bool = False) -> jax.Array:
     """Reference SparseSelfAttention forward (sparse_self_attention.py:12):
     q/k/v (B, S, N, D) → (B, S, N, D), masked per the head layouts.
-    Unidirectional configs already encode causality in the layout."""
+    Unidirectional configs already encode causality in the layout.
+
+    ``use_kernel`` (default: auto on TPU) routes through the block-skip
+    Pallas kernels — O(active tiles) compute/HBM instead of a dense (S,S)
+    mask; the jnp mask path remains the parity oracle and the
+    key-padding-mask fallback."""
     B, S, N, D = q.shape
     if N != config.num_heads:
         raise ValueError(f"q has {N} heads, config expects {config.num_heads}")
     from ..models.transformer import dot_product_attention
+
+    if use_kernel is None:
+        import jax as _jax
+
+        use_kernel = (key_padding_mask is None and S % 128 == 0
+                      and 128 % config.block == 0
+                      and _jax.default_backend() == "tpu")
+        if use_kernel:
+            from .block_sparse_attention import (MAX_GRID_STEPS,
+                                                 sparse_grid_steps)
+
+            if sparse_grid_steps(B, tile_plan_for(config, S)) > MAX_GRID_STEPS:
+                # scalar-prefetch SMEM ceiling — see block_sparse_attention
+                use_kernel = False
+    if use_kernel:
+        if key_padding_mask is not None:
+            raise NotImplementedError(
+                "block-skip kernel path does not take key_padding_mask yet — "
+                "pass use_kernel=False (dense-mask fallback)")
+        from .block_sparse_attention import block_sparse_attention
+
+        plan = tile_plan_for(config, S)
+        causal = getattr(config, "attention", "bidirectional") == "unidirectional"
+        return block_sparse_attention(q, k, v, plan, causal=causal,
+                                      interpret=interpret)
 
     layout = config.make_layout(S)
     tok = layout_to_token_mask(layout, config.block)        # (N, S, S)

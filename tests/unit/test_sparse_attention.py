@@ -87,6 +87,79 @@ def test_sparse_attention_matches_masked_dense():
                                    np.asarray(ref), atol=1e-6)
 
 
+class TestBlockSkipKernel:
+    """The Pallas block-skip path must match the dense-mask oracle — forward
+    AND gradients (custom VJP with sparse dq/dkv kernels)."""
+
+    def _qkv(self, S=256, N=2, D=64, B=2, seed=0):
+        ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+        shape = (B, S, N, D)
+        return (jax.random.normal(ks[0], shape, jnp.float32),
+                jax.random.normal(ks[1], shape, jnp.float32),
+                jax.random.normal(ks[2], shape, jnp.float32))
+
+    @pytest.mark.parametrize("cfg", [
+        FixedSparsityConfig(num_heads=2, block=32, num_local_blocks=2,
+                            attention="unidirectional"),
+        FixedSparsityConfig(num_heads=2, block=32, num_local_blocks=2,
+                            num_global_blocks=1, attention="bidirectional"),
+        BigBirdSparsityConfig(num_heads=2, block=32, num_random_blocks=1,
+                              num_sliding_window_blocks=3,
+                              num_global_blocks=1),
+        LocalSlidingWindowSparsityConfig(num_heads=2, block=64,
+                                         num_sliding_window_blocks=3),
+    ])
+    def test_forward_matches_dense_oracle(self, cfg):
+        q, k, v = self._qkv()
+        out = sparse_self_attention(q, k, v, cfg, use_kernel=True,
+                                    interpret=True)
+        ref = sparse_self_attention(q, k, v, cfg, use_kernel=False)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_gradients_match_dense_oracle(self):
+        cfg = FixedSparsityConfig(num_heads=2, block=32, num_local_blocks=2,
+                                  num_global_blocks=1,
+                                  attention="unidirectional")
+        q, k, v = self._qkv(S=256)
+
+        def loss(fn):
+            def f(q, k, v):
+                return jnp.sum(fn(q, k, v) ** 2)
+            return jax.grad(f, argnums=(0, 1, 2))
+
+        sparse_fn = lambda q, k, v: sparse_self_attention(
+            q, k, v, cfg, use_kernel=True, interpret=True)
+        dense_fn = lambda q, k, v: sparse_self_attention(
+            q, k, v, cfg, use_kernel=False)
+        gs = loss(sparse_fn)(q, k, v)
+        gd = loss(dense_fn)(q, k, v)
+        for a, b, name in zip(gs, gd, "qkv"):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=5e-5, rtol=5e-5,
+                                       err_msg=f"d{name} mismatch")
+
+    def test_plan_density_and_skip(self):
+        from deepspeed_tpu.ops.sparse_attention import tile_plan_for
+
+        cfg = LocalSlidingWindowSparsityConfig(num_heads=1, block=64,
+                                               num_sliding_window_blocks=3)
+        plan = tile_plan_for(cfg, 1024)
+        # banded layout: most tiles are skipped
+        assert plan.density < 0.5
+        assert plan.kidx.shape[2] < 1024 // 128  # A << all tiles
+        # plan is cached per (config, S)
+        assert tile_plan_for(cfg, 1024) is plan
+
+    def test_padding_mask_kernel_rejected(self):
+        cfg = FixedSparsityConfig(num_heads=2, block=32, num_local_blocks=2)
+        q, k, v = self._qkv()
+        with pytest.raises(NotImplementedError, match="key_padding_mask"):
+            sparse_self_attention(q, k, v, cfg,
+                                  key_padding_mask=jnp.ones((2, 256)),
+                                  use_kernel=True, interpret=True)
+
+
 def test_dense_config_equals_causal_attention():
     # dense unidirectional layout == plain causal attention
     cfg = FixedSparsityConfig(num_heads=1, block=16, num_local_blocks=4,
