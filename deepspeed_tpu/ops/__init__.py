@@ -10,6 +10,8 @@ from .fused_lamb import fused_lamb_flat, reference_lamb_flat
 from .normalization import fused_layer_norm, reference_layer_norm
 from .quantization import (dequantize_symmetric, fake_quantize,
                            quantize_symmetric, reference_quantize_symmetric)
+from .spatial import (diffusers_attention, fused_group_norm,
+                      reference_group_norm)
 from .registry import available_ops, get_op, is_compatible, op_report, register_op
 
 register_op("flash_attention", flash_attention,
@@ -27,6 +29,9 @@ register_op("quantize_symmetric", quantize_symmetric,
 register_op("decode_attention", decode_attention,
             reference=reference_decode_attention,
             description="single-query KV-cache decode attention (GQA, alibi)")
+register_op("fused_group_norm", fused_group_norm,
+            reference=reference_group_norm,
+            description="spatial GroupNorm (diffusers UNet norm, NHWC tokens)")
 register_op("block_sparse_attention", block_sparse_attention,
             reference=lambda q, k, v, plan, **kw: _ref_attn(q, k, v),
             description="block-skip sparse flash attention over a "
@@ -46,6 +51,7 @@ __all__ = [
     "reference_adam_flat", "fused_lamb_flat", "reference_lamb_flat",
     "fused_layer_norm", "reference_layer_norm",
     "quantize_symmetric", "dequantize_symmetric", "fake_quantize",
-    "reference_quantize_symmetric", "available_ops", "get_op",
+    "reference_quantize_symmetric", "diffusers_attention", "fused_group_norm",
+    "reference_group_norm", "available_ops", "get_op",
     "is_compatible", "op_report", "register_op",
 ]
