@@ -1,0 +1,81 @@
+#!/usr/bin/env python
+"""ZeRO + offload benchmark — BASELINE tracked config #2 (ZeRO Adam on
+OPT-1.3B). Prints ONE JSON line.
+
+The single-chip showcase of the offload tier (reference ZeRO-Offload blog
+claim: 1.4B trainable on one V100-16GB, docs/_posts/2021-03-08-zero3-offload):
+OPT-1.3B AdamW training on one 16 GB chip — the fp32 master + moments
+(~15.6 GB, 12 bytes/param) live in host memory via
+``offload_optimizer.device='cpu'``; HBM holds only bf16 params + grads +
+remat'd activations. Without offload this config does not fit.
+
+``vs_baseline`` = MFU / 0.5 (same north-star normalisation as bench.py).
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import jax
+import jax.numpy as jnp
+
+from bench import peak_flops_per_chip
+
+
+def main() -> None:
+    import deepspeed_tpu
+    from deepspeed_tpu.models import create_model
+
+    preset = os.environ.get("BENCH_ZERO_MODEL", "opt-1.3b")
+    batch = int(os.environ.get("BENCH_ZERO_BATCH", 4))
+    seq = int(os.environ.get("BENCH_ZERO_SEQ", 1024))
+    stage = int(os.environ.get("BENCH_ZERO_STAGE", 2))
+    offload = os.environ.get("BENCH_ZERO_OFFLOAD", "cpu")
+    model = create_model(preset, dtype=jnp.bfloat16, remat=True,
+                         remat_policy="dots", max_seq_len=seq)
+    zero_cfg = {"stage": stage}
+    if offload != "none":
+        zero_cfg["offload_optimizer"] = {"device": offload}
+    cfg = {
+        "train_micro_batch_size_per_gpu": batch,
+        "gradient_accumulation_steps": 1,
+        "steps_per_print": 1000,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-4}},
+        "bf16": {"enabled": True},
+        "zero_optimization": zero_cfg,
+    }
+    engine, *_ = deepspeed_tpu.initialize(model=model, config=cfg)
+    ids = jax.random.randint(jax.random.PRNGKey(0), (1, batch, seq), 0,
+                             model.config.vocab_size)
+    batch_tree = {"input_ids": ids}
+    for _ in range(2):
+        loss = engine.train_batch(batch=batch_tree)
+    float(loss)
+
+    steps = int(os.environ.get("BENCH_STEPS", 5))
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = engine.train_batch(batch=batch_tree)
+    float(loss)
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = batch * seq * steps / dt
+    n_params = sum(int(p.size) for p in jax.tree.leaves(engine.params))
+    cfg_m = model.config
+    flops_per_token = (6 * n_params
+                       + 12 * cfg_m.num_layers * cfg_m.hidden_size * seq)
+    mfu = tokens_per_sec * flops_per_token / peak_flops_per_chip()
+    print(json.dumps({
+        "metric": f"{preset}_zero{stage}_offload-{offload}_train_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s",
+        "params": n_params,
+        "vs_baseline": round(mfu / 0.5, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()
