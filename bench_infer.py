@@ -48,8 +48,16 @@ def main() -> None:
     prompt_len = int(os.environ.get("BENCH_INFER_PROMPT", 512))
     n_new = int(os.environ.get("BENCH_INFER_NEW", 64))
     arena = int(os.environ.get("BENCH_INFER_ARENA", 1024))
+    # 'int8' => weight-only quantized storage (compute bf16): halves the
+    # weight side of the decode roofline denominator
+    dtype_name = os.environ.get("BENCH_INFER_DTYPE", "bf16")
+    if dtype_name not in ("bf16", "int8"):
+        raise SystemExit(f"BENCH_INFER_DTYPE must be bf16|int8, got "
+                         f"'{dtype_name}' — refusing to run a mislabelled "
+                         "benchmark")
+    dtype = "int8" if dtype_name == "int8" else jnp.bfloat16
 
-    engine = init_inference(model_name, dtype=jnp.bfloat16, max_out_tokens=arena)
+    engine = init_inference(model_name, dtype=dtype, max_out_tokens=arena)
     cfg = engine.model.config
     rng = np.random.RandomState(0)
     prompt = rng.randint(0, cfg.vocab_size, (1, prompt_len))
@@ -80,7 +88,7 @@ def main() -> None:
     frac = decode_tps / roofline_tps
 
     print(json.dumps({
-        "metric": f"{model_name}_bf16_p50_ttft_ms",
+        "metric": f"{model_name}_{dtype_name}_p50_ttft_ms",
         "value": round(p50_ttft * 1e3, 2),
         "unit": "ms",
         "decode_tokens_per_sec": round(decode_tps, 1),

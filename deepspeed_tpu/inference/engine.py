@@ -49,6 +49,34 @@ class InferenceConfig:
     replace_with_kernel_inject: bool = True   # platform Pallas kernels
     checkpoint: Optional[str] = None   # flat-npz path (save_16bit_model output)
     seed: int = 0
+    quantize_bits: Optional[int] = None  # 8 => weight-only int8 storage
+    #   (reference int8 kernel-injection mode): matmul weights quantized
+    #   per output channel, dequant fused into the GEMM — halves the
+    #   decode-phase HBM weight traffic. dtype='int8' sets this.
+
+    def __post_init__(self):
+        # dtype='int8' is storage quantization, not a compute dtype — the
+        # normalisation lives here so the config-dict path can't slip a
+        # string dtype into create_model/cast_floating (which would astype
+        # the weights to int8 and silently destroy them)
+        if self.dtype in ("int8", jnp.int8):
+            self.quantize_bits = 8
+            self.dtype = jnp.bfloat16
+        elif isinstance(self.dtype, str):
+            self.dtype = {"bf16": jnp.bfloat16, "bfloat16": jnp.bfloat16,
+                          "fp16": jnp.float16, "float16": jnp.float16,
+                          "fp32": jnp.float32, "float32": jnp.float32,
+                          }.get(self.dtype) or _reject_dtype(self.dtype)
+        if self.quantize_bits not in (None, 8):
+            raise NotImplementedError(
+                f"quantize_bits={self.quantize_bits}: only 8 is supported "
+                "(int4 would store unpacked bytes — no memory benefit over "
+                "int8, strictly worse accuracy)")
+
+
+def _reject_dtype(name: str):
+    raise ValueError(f"unknown inference dtype '{name}' (use bf16/fp16/fp32 "
+                     "or 'int8' for weight-only quantization)")
 
 
 def _bucket(n: int, mult: int = 64) -> int:
@@ -114,12 +142,41 @@ class InferenceEngine:
             lambda s: NamedSharding(self.mesh, s), specs,
             is_leaf=lambda x: isinstance(x, P))
 
+        if config.quantize_bits and tp > 1:
+            raise NotImplementedError(
+                "weight-only quantization with tensor_parallel > 1 is "
+                "not supported yet (the q8/scale leaves need their own "
+                "TP sharding rules)")
         if params is None:
             with self.mesh:
-                params = jax.jit(
-                    lambda key: cast_floating(model.init(key), config.dtype),
-                    out_shardings=self.param_shardings)(
-                        jax.random.PRNGKey(config.seed))
+                if config.quantize_bits:
+                    # init + quantize in ONE program: XLA liveness frees each
+                    # full-precision weight as its int8 replacement is
+                    # produced — materialising the whole bf16 tree first
+                    # OOMs at 13B on a 16GB chip
+                    from ..models.transformer import quantize_model_weights
+
+                    params = jax.jit(lambda key: quantize_model_weights(
+                        cast_floating(model.init(key), config.dtype),
+                        bits=config.quantize_bits))(
+                            jax.random.PRNGKey(config.seed))
+                else:
+                    params = jax.jit(
+                        lambda key: cast_floating(model.init(key), config.dtype),
+                        out_shardings=self.param_shardings)(
+                            jax.random.PRNGKey(config.seed))
+        elif config.quantize_bits:
+            # quantize BEFORE any tree-wide device_put: each host weight leaf
+            # transfers, quantizes, and frees individually, so a model whose
+            # full-precision weights exceed HBM (13B bf16 = 26GB on a 16GB
+            # chip) still loads — only its int8 form ever resides on device
+            from ..models.transformer import quantize_model_weights
+
+            params = cast_floating(params, config.dtype)
+            params = quantize_model_weights(params,
+                                            bits=config.quantize_bits,
+                                            donate=True)
+            params = jax.tree.map(jnp.asarray, params)  # remaining host leaves
         else:
             params = cast_floating(params, config.dtype)
             params = jax.tree.map(
@@ -280,7 +337,10 @@ def init_inference(model=None, config=None, tensor_parallel: Optional[int] = Non
     if tensor_parallel is not None:
         cfg.tensor_parallel = int(tensor_parallel)
     if dtype is not None:
+        # normalisation (incl. 'int8' → weight-only quantization) happens in
+        # InferenceConfig.__post_init__ — rebuild so it applies
         cfg.dtype = dtype
+        cfg.__post_init__()
     if max_out_tokens is not None:
         cfg.max_out_tokens = int(max_out_tokens)
     cfg.replace_with_kernel_inject = replace_with_kernel_inject

@@ -286,6 +286,96 @@ def resolve_remat_policy(cfg: "TransformerConfig"):
     return None
 
 
+def quantize_model_weights(params: Dict[str, Any], bits: int = 8,
+                           donate: bool = False) -> Dict[str, Any]:
+    """Weight-only quantization for inference (reference int8
+    kernel-injection mode, ``inference/quantization``): matmul weights
+    (attention qkv/o, dense MLP, untied lm_head) become
+    ``{"q8": int8, "s": fp32 per-output-channel scale}``. Embedding stays
+    dense (the token gather reads rows); biases/norms stay dense; MoE
+    expert banks are left dense (moe_mlp consumes them directly).
+    HBM weight traffic — the decode-phase roofline — drops ~2x (int8)."""
+    assert bits in (4, 8)
+    qmax = float(2 ** (bits - 1) - 1)
+
+    def _quant_math(w):
+        w32 = w.astype(jnp.float32)
+        absmax = jnp.max(jnp.abs(w32), axis=-2, keepdims=True)
+        s = jnp.where(absmax == 0.0, 1.0, absmax / qmax)
+        q = jnp.clip(jnp.round(w32 / s), -qmax, qmax).astype(jnp.int8)
+        return {"q8": q, "s": s}
+
+    # donate=True quantizes leaf-by-leaf, freeing each bf16 leaf as its int8
+    # replacement materialises — a whole-tree jit would transiently hold both
+    # copies (OOM at 7B on a 16GB chip). The explicit delete() matters:
+    # backends that ignore donation (remote/axon) would otherwise keep every
+    # source buffer alive until GC, which surfaces as a lazy OOM at the
+    # first fence.
+    if donate:
+        _jitted = jax.jit(_quant_math, donate_argnums=0)
+
+        def quant(w):
+            out = _jitted(w)
+            jax.block_until_ready(out)
+            try:
+                w.delete()
+            except Exception:
+                pass                     # already consumed by donation
+            return out
+    else:
+        quant = _quant_math
+
+    params = dict(params)
+    layers = dict(params["layers"])
+    attn = dict(layers["attn"])
+    for name in ("wq", "wk", "wv", "wo"):
+        attn[name] = quant(attn[name])
+    layers["attn"] = attn
+    if "router" not in layers:           # dense MLP only (skip MoE banks)
+        mlp = dict(layers["mlp"])
+        for name in ("w_up", "w_gate", "w_down"):
+            if name in mlp:
+                mlp[name] = quant(mlp[name])
+        layers["mlp"] = mlp
+    params["layers"] = layers
+    if "lm_head" in params:
+        params["lm_head"] = quant(params["lm_head"])
+    return params
+
+
+def _dense(w: Any, dtype: Any) -> jax.Array:
+    """Materialise a (possibly weight-only-quantized) weight as dense."""
+    if isinstance(w, dict) and "q8" in w:
+        return (w["q8"].astype(jnp.float32) * w["s"]).astype(dtype)
+    return w
+
+
+def _qeinsum(spec: str, x: jax.Array, w: Any, dtype: Any) -> jax.Array:
+    """Weight-site einsum with on-the-fly int8 dequant.
+
+    Decode-shaped calls (few tokens) route through the Pallas int8 matmul
+    (ops/quant_matmul.py) where each weight tile converts in VMEM under the
+    int8 DMA — XLA's own lowering converts the FULL weight at VPU rate
+    before the matmul, which is slower than bf16 on a memory-bound step.
+    Larger (prefill/training-shaped) calls use the XLA path with the scale
+    on the output; the optimization barrier stops XLA hoisting the
+    loop-invariant dequantized weight stack out of the token/layer loops
+    (hoisting materialises full-precision weights — OOM at 7B/16GB)."""
+    if isinstance(w, dict) and "q8" in w:
+        q8, s = w["q8"], w["s"]
+        B, S = x.shape[0], x.shape[1]
+        if (S * B <= 8 and q8.ndim == 2 and _kernels_active()
+                and q8.shape[0] % 128 == 0 and q8.shape[1] % 128 == 0):
+            from ..ops.quant_matmul import int8_matmul
+
+            out = int8_matmul(x.reshape(B * S, -1), q8, s, out_dtype=dtype)
+            return out.reshape(x.shape[:-1] + (q8.shape[1],))
+        x, q8 = lax.optimization_barrier((x, q8))
+        out = jnp.einsum(spec, x, q8.astype(dtype))
+        return out * s[..., 0, :].astype(dtype)
+    return jnp.einsum(spec, x, w)
+
+
 def _norm(x: jax.Array, scale: jax.Array, bias: Optional[jax.Array],
           kind: str, eps: float) -> jax.Array:
     if _kernels_active():
@@ -387,9 +477,9 @@ def _layer_forward(cfg: TransformerConfig, x: jax.Array, layer: Dict[str, Any],
     N, K, D = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
 
     h = _norm(x, layer["ln1"]["scale"], layer["ln1"].get("bias"), cfg.norm, cfg.norm_eps)
-    q = jnp.einsum("bsh,hd->bsd", h, layer["attn"]["wq"])
-    k = jnp.einsum("bsh,hd->bsd", h, layer["attn"]["wk"])
-    v = jnp.einsum("bsh,hd->bsd", h, layer["attn"]["wv"])
+    q = _qeinsum("bsh,hd->bsd", h, layer["attn"]["wq"], cfg.dtype)
+    k = _qeinsum("bsh,hd->bsd", h, layer["attn"]["wk"], cfg.dtype)
+    v = _qeinsum("bsh,hd->bsd", h, layer["attn"]["wv"], cfg.dtype)
     if "bq" in layer["attn"]:
         q = q + layer["attn"]["bq"]
         k = k + layer["attn"]["bk"]
@@ -500,7 +590,7 @@ def _layer_forward(cfg: TransformerConfig, x: jax.Array, layer: Dict[str, Any],
             attn = attn_fn(q, k, v, mask, causal=True, alibi=alibi)
 
     attn = attn.reshape(B, S, N * D)
-    attn_out = jnp.einsum("bsd,dh->bsh", attn, layer["attn"]["wo"])
+    attn_out = _qeinsum("bsd,dh->bsh", attn, layer["attn"]["wo"], cfg.dtype)
     if "bo" in layer["attn"]:
         attn_out = attn_out + layer["attn"]["bo"]
     if cache is None:
@@ -538,15 +628,15 @@ def _layer_forward(cfg: TransformerConfig, x: jax.Array, layer: Dict[str, Any],
             ).astype(h.dtype)
             mlp_out = mlp_out * coef[..., 0:1] + res_out * coef[..., 1:2]
     elif cfg.activation == "swiglu":
-        gate = jnp.einsum("bsh,hf->bsf", h, layer["mlp"]["w_gate"])
-        up = jnp.einsum("bsh,hf->bsf", h, layer["mlp"]["w_up"])
+        gate = _qeinsum("bsh,hf->bsf", h, layer["mlp"]["w_gate"], cfg.dtype)
+        up = _qeinsum("bsh,hf->bsf", h, layer["mlp"]["w_up"], cfg.dtype)
         inner = jax.nn.silu(gate) * up
-        mlp_out = jnp.einsum("bsf,fh->bsh", inner, layer["mlp"]["w_down"])
+        mlp_out = _qeinsum("bsf,fh->bsh", inner, layer["mlp"]["w_down"], cfg.dtype)
     else:
-        inner = jnp.einsum("bsh,hf->bsf", h, layer["mlp"]["w_up"]) + layer["mlp"]["b_up"]
+        inner = _qeinsum("bsh,hf->bsf", h, layer["mlp"]["w_up"], cfg.dtype) + layer["mlp"]["b_up"]
         inner = (jax.nn.relu(inner) if cfg.activation == "relu"
                  else jax.nn.gelu(inner, approximate=True))
-        mlp_out = jnp.einsum("bsf,fh->bsh", inner, layer["mlp"]["w_down"]) + layer["mlp"]["b_down"]
+        mlp_out = _qeinsum("bsf,fh->bsh", inner, layer["mlp"]["w_down"], cfg.dtype) + layer["mlp"]["b_down"]
     x = x + mlp_out
     return x, new_cache, aux
 
@@ -675,7 +765,7 @@ def forward(params: Dict[str, Any], input_ids: jax.Array,
     if cfg.tie_embeddings:
         logits = jnp.einsum("bsh,vh->bsv", x, params["embed"]["tokens"])
     else:
-        logits = jnp.einsum("bsh,hv->bsv", x, params["lm_head"])
+        logits = _qeinsum("bsh,hv->bsv", x, params["lm_head"], cfg.dtype)
     return logits, new_cache, aux_total
 
 

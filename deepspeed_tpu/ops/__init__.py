@@ -8,6 +8,7 @@ from .flash_attention import flash_attention, make_attention_impl
 from .fused_adam import fused_adam_flat, reference_adam_flat
 from .fused_lamb import fused_lamb_flat, reference_lamb_flat
 from .normalization import fused_layer_norm, reference_layer_norm
+from .quant_matmul import int8_matmul, reference_int8_matmul
 from .quantization import (dequantize_symmetric, fake_quantize,
                            quantize_symmetric, reference_quantize_symmetric)
 from .spatial import (diffusers_attention, fused_group_norm,
@@ -29,6 +30,8 @@ register_op("quantize_symmetric", quantize_symmetric,
 register_op("decode_attention", decode_attention,
             reference=reference_decode_attention,
             description="single-query KV-cache decode attention (GQA, alibi)")
+register_op("int8_matmul", int8_matmul, reference=reference_int8_matmul,
+            description="weight-only int8 GEMM (in-kernel tile dequant)")
 register_op("fused_group_norm", fused_group_norm,
             reference=reference_group_norm,
             description="spatial GroupNorm (diffusers UNet norm, NHWC tokens)")
@@ -51,7 +54,8 @@ __all__ = [
     "reference_adam_flat", "fused_lamb_flat", "reference_lamb_flat",
     "fused_layer_norm", "reference_layer_norm",
     "quantize_symmetric", "dequantize_symmetric", "fake_quantize",
-    "reference_quantize_symmetric", "diffusers_attention", "fused_group_norm",
+    "reference_quantize_symmetric", "int8_matmul", "reference_int8_matmul",
+    "diffusers_attention", "fused_group_norm",
     "reference_group_norm", "available_ops", "get_op",
     "is_compatible", "op_report", "register_op",
 ]

@@ -361,3 +361,39 @@ def test_op_report():
     report = op_report()
     assert "flash_attention" in report
     assert "fused_adam" in report
+
+
+class TestInt8Matmul:
+    @pytest.mark.parametrize("M,K,N", [(1, 512, 512), (8, 1024, 1536),
+                                       (3, 640, 384)])  # last: odd tiles
+    def test_matches_reference(self, M, K, N):
+        from deepspeed_tpu.ops import int8_matmul, reference_int8_matmul
+
+        rng = np.random.RandomState(0)
+        x = jnp.asarray(rng.randn(M, K), jnp.float32)
+        q8 = jnp.asarray(rng.randint(-127, 128, (K, N)), jnp.int8)
+        s = jnp.asarray(np.abs(rng.randn(1, N)) * 0.01, jnp.float32)
+        out = int8_matmul(x, q8, s, interpret=INTERPRET)
+        ref = reference_int8_matmul(x, q8, s)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-3, rtol=1e-4)
+
+    def test_unaligned_rejected(self):
+        from deepspeed_tpu.ops import int8_matmul
+
+        with pytest.raises(ValueError, match="128"):
+            int8_matmul(jnp.zeros((1, 700)), jnp.zeros((700, 300), jnp.int8),
+                        jnp.ones((1, 300)), interpret=INTERPRET)
+
+    def test_bf16_out(self):
+        from deepspeed_tpu.ops import int8_matmul, reference_int8_matmul
+
+        rng = np.random.RandomState(1)
+        x = jnp.asarray(rng.randn(2, 512), jnp.bfloat16)
+        q8 = jnp.asarray(rng.randint(-127, 128, (512, 512)), jnp.int8)
+        s = jnp.asarray(np.abs(rng.randn(1, 512)) * 0.01, jnp.float32)
+        out = int8_matmul(x, q8, s, interpret=INTERPRET)
+        assert out.dtype == jnp.bfloat16
+        ref = reference_int8_matmul(x, q8, s, out_dtype=jnp.float32)
+        np.testing.assert_allclose(np.asarray(out, np.float32),
+                                   np.asarray(ref), atol=0.5, rtol=2e-2)

@@ -364,3 +364,51 @@ def test_top_p_zero_is_greedy():
     picks = {int(_sample(logits, jax.random.PRNGKey(i), 5.0, 0, 0.0)[0])
              for i in range(20)}
     assert picks == {0}
+
+
+class TestInt8WeightOnly:
+    """Weight-only quantized inference (reference init_inference dtype=int8
+    kernel-injection mode): storage halves, logits stay close, generate is
+    self-consistent (greedy == its own full-forward argmax)."""
+
+    def test_logits_close_and_storage_halved(self):
+        from deepspeed_tpu.models.core import tree_bytes
+
+        e16 = init_inference("tiny", dtype=jnp.bfloat16, max_out_tokens=128)
+        e8 = init_inference("tiny", dtype="int8", max_out_tokens=128)
+        assert e8.config.quantize_bits == 8
+        # same underlying weights for a fair numeric comparison
+        from deepspeed_tpu.models.transformer import quantize_model_weights
+
+        e8.params = jax.jit(quantize_model_weights)(e16.params)
+
+        prompt = np.random.RandomState(0).randint(0, 250, size=(2, 16))
+        l16 = np.asarray(e16.forward(prompt), np.float32)
+        l8 = np.asarray(e8.forward(prompt), np.float32)
+        cos = (l16.ravel() @ l8.ravel()) / (
+            np.linalg.norm(l16) * np.linalg.norm(l8))
+        assert cos > 0.99, f"cosine {cos}"
+
+        def matmul_bytes(tree):
+            return sum(x.size * x.dtype.itemsize
+                       for x in jax.tree.leaves(tree))
+
+        w16 = matmul_bytes(e16.params["layers"]["attn"])
+        w8 = matmul_bytes(e8.params["layers"]["attn"])
+        assert w8 < 0.62 * w16          # int8 + scales + bf16 biases
+
+    def test_generate_self_consistent(self):
+        engine = init_inference("tiny", dtype="int8", max_out_tokens=128)
+        prompt = np.random.RandomState(1).randint(0, 250, size=(1, 12))
+        got = np.asarray(engine.generate(prompt, max_new_tokens=6))
+        ids = jnp.asarray(prompt, jnp.int32)
+        for i in range(6):
+            logits, _ = engine.model.apply(engine.params, {"input_ids": ids})
+            best = int(np.asarray(logits[0, -1], np.float32).argmax())
+            assert got[0, i] == best, f"step {i}"
+            ids = jnp.concatenate([ids, jnp.asarray([[best]], jnp.int32)], 1)
+
+    def test_int8_tp_rejected(self, devices8):
+        with pytest.raises(NotImplementedError, match="tensor_parallel"):
+            init_inference("tiny-llama", dtype="int8", tensor_parallel=2,
+                           max_out_tokens=128)
