@@ -80,7 +80,7 @@ def main() -> None:
         loss = engine.train_batch(batch=batch_tree)
     float(loss)
 
-    steps = int(os.environ.get("BENCH_STEPS", 10))
+    steps = int(os.environ.get("BENCH_STEPS", 30))
     t0 = time.perf_counter()
     for _ in range(steps):
         loss = engine.train_batch(batch=batch_tree)

@@ -30,8 +30,13 @@ def main() -> None:
     # 350m-8e (~1.7B total params) exceeds one v5e's HBM with optimizer
     # state; the 125m-8e variant (~560M) is the single-chip default
     preset = os.environ.get("BENCH_MOE_MODEL", "moe-gpt-125m-8e")
+    # unlike the dense bench, full unroll does NOT pay here: the expert
+    # dispatch/combine einsums dominate (25.1k tok/s unrolled vs 25.7k
+    # scanned on v5e) and the unrolled 8-expert program OOMs compile
+    unroll = int(os.environ.get("BENCH_UNROLL", 1))
     model = create_model(preset, dtype=jnp.bfloat16, remat=True,
-                         remat_policy="dots", max_seq_len=seq)
+                         remat_policy="dots", scan_unroll=unroll,
+                         max_seq_len=seq)
     cfg = {
         "train_micro_batch_size_per_gpu": batch,
         "steps_per_print": 1000,
