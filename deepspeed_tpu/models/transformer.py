@@ -47,7 +47,13 @@ class TransformerConfig:
     tie_embeddings: bool = True
     norm_eps: float = 1e-5
     rope_theta: float = 10000.0
-    dropout: float = 0.0
+    dropout: float = 0.0              # embed/attn-out/mlp-out dropout rate.
+    #   Applied only when dropout_enabled (the TrainEngine sets it; eval and
+    #   inference run dropout-free). Attention-PROBABILITY dropout is not
+    #   implemented (it would live inside the flash kernel) — these are the
+    #   residual-path sites of the reference transformer kernel.
+    dropout_enabled: bool = False     # draws derive from activations (no rng
+    #   arg in loss_fn): deterministic per (params, batch), varies per step
     dtype: Any = jnp.float32                # compute/param dtype
     scan_unroll: int = 1                    # lax.scan unroll factor over layers
     pld_enabled: bool = False               # progressive layer drop: batch
@@ -376,6 +382,18 @@ def _qeinsum(spec: str, x: jax.Array, w: Any, dtype: Any) -> jax.Array:
     return jnp.einsum(spec, x, w)
 
 
+def _dropout(x: jax.Array, cfg: "TransformerConfig", salt: int) -> jax.Array:
+    """Inverted dropout on a residual-path tensor; active only when the
+    engine enabled it (training). Key derives from the tensor's content —
+    varies across steps/batches/layers, reproducible for a given input."""
+    if not (cfg.dropout > 0.0 and cfg.dropout_enabled):
+        return x
+    keep = 1.0 - cfg.dropout
+    mask = jax.random.bernoulli(_activation_derived_key(x, salt), keep,
+                                x.shape)
+    return jnp.where(mask, x / keep, jnp.zeros_like(x)).astype(x.dtype)
+
+
 def _norm(x: jax.Array, scale: jax.Array, bias: Optional[jax.Array],
           kind: str, eps: float) -> jax.Array:
     if _kernels_active():
@@ -594,6 +612,8 @@ def _layer_forward(cfg: TransformerConfig, x: jax.Array, layer: Dict[str, Any],
     if "bo" in layer["attn"]:
         attn_out = attn_out + layer["attn"]["bo"]
     if cache is None:
+        attn_out = _dropout(attn_out, cfg, salt=31)
+    if cache is None:
         from ..parallel.sequence import constrain, hidden_spec, sequence_parallel_enabled
 
         if sequence_parallel_enabled():
@@ -637,6 +657,8 @@ def _layer_forward(cfg: TransformerConfig, x: jax.Array, layer: Dict[str, Any],
         inner = (jax.nn.relu(inner) if cfg.activation == "relu"
                  else jax.nn.gelu(inner, approximate=True))
         mlp_out = _qeinsum("bsf,fh->bsh", inner, layer["mlp"]["w_down"], cfg.dtype) + layer["mlp"]["b_down"]
+    if cache is None:
+        mlp_out = _dropout(mlp_out, cfg, salt=37)
     x = x + mlp_out
     return x, new_cache, aux
 
@@ -659,6 +681,8 @@ def forward(params: Dict[str, Any], input_ids: jax.Array,
     if cfg.embed_norm:
         x = _norm(x, params["embed_norm"]["scale"],
                   params["embed_norm"].get("bias"), "layernorm", cfg.norm_eps)
+    if cache is None:
+        x = _dropout(x, cfg, salt=29)
 
     static_prefill = (cache is not None
                       and isinstance(start_pos, int) and start_pos == 0)

@@ -273,6 +273,12 @@ class TrainEngine:
                 "schedule_type": cl.schedule_type,
                 "schedule_config": dict(cl.schedule_config)})
 
+        # dropout: the config carries the rate; only the TRAIN engine turns
+        # it on (inference/eval run the deterministic model)
+        if (self.model.config is not None
+                and getattr(self.model.config, "dropout", 0.0) > 0.0):
+            self.model.config.dropout_enabled = True
+
         # progressive layer drop (reference engine.py:283 / :1648 theta kwarg)
         self._pld = None
         if self.config.progressive_layer_drop.enabled:
@@ -953,18 +959,18 @@ class TrainEngine:
             # the pipelined loss_fn needs an (M, mb, ...) stack; for a plain
             # eval microbatch wrap it as a single-microbatch stack
             batch = jax.tree.map(lambda x: x[None], batch)
-        if self._random_ltd is not None:
-            # random-LTD is a training regulariser — evaluation must see the
-            # full sequence (reference eval path bypasses the LTD layers).
-            # ltd_keep=0 disables the gather in forward; the jit cache keys on
-            # nothing here, so trace once with it off and restore.
-            keep = self.model.config.ltd_keep
-            self.model.config.ltd_keep = 0
+        cfg = self.model.config
+        if cfg is not None and (self._random_ltd is not None
+                                or getattr(cfg, "dropout_enabled", False)):
+            # training regularisers (random-LTD, dropout) are off for eval —
+            # trace the eval program with them disabled and restore
+            keep, drop = cfg.ltd_keep, cfg.dropout_enabled
+            cfg.ltd_keep, cfg.dropout_enabled = 0, False
             try:
                 with self.mesh:
                     return jax.jit(self.model.loss_fn)(self.params, batch)
             finally:
-                self.model.config.ltd_keep = keep
+                cfg.ltd_keep, cfg.dropout_enabled = keep, drop
         with self.mesh:
             return jax.jit(self.model.loss_fn)(self.params, batch)
 

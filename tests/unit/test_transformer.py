@@ -145,3 +145,50 @@ def test_param_count_presets():
     params = m.init(jax.random.PRNGKey(0))
     n = param_count(params)
     assert 115e6 < n < 135e6  # ~124M
+
+
+class TestDropout:
+    """cfg.dropout applies at embed/attn-out/mlp-out when the train engine
+    enables it; eval and decode stay deterministic (reference transformer
+    kernel dropout semantics minus in-kernel attention-prob dropout — see
+    TransformerConfig.dropout)."""
+
+    def test_changes_training_forward_only_when_enabled(self):
+        from deepspeed_tpu.models import create_model
+
+        base = create_model("tiny")
+        params = base.init(jax.random.PRNGKey(0))
+        ids = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, 250)
+        out0, _ = base.apply(params, {"input_ids": ids})
+
+        off = create_model("tiny", dropout=0.5)           # rate set, not enabled
+        out_off, _ = off.apply(params, {"input_ids": ids})
+        np.testing.assert_array_equal(np.asarray(out_off), np.asarray(out0))
+
+        on = create_model("tiny", dropout=0.5, dropout_enabled=True)
+        out_on, _ = on.apply(params, {"input_ids": ids})
+        assert not np.allclose(np.asarray(out_on), np.asarray(out0))
+        assert np.isfinite(np.asarray(out_on)).all()
+
+    def test_engine_enables_eval_disables(self):
+        import deepspeed_tpu
+        from deepspeed_tpu.models import create_model
+
+        model = create_model("tiny", dropout=0.3)
+        engine, *_ = deepspeed_tpu.initialize(
+            model=model,
+            config={"train_micro_batch_size_per_gpu": 2,
+                    "steps_per_print": 1000,
+                    "optimizer": {"type": "adamw", "params": {"lr": 1e-3}}})
+        assert engine.model.config.dropout_enabled
+        ids = jax.random.randint(jax.random.PRNGKey(0),
+                                 (1, engine.train_batch_size(), 16), 0, 250)
+        l1 = float(engine.train_batch(batch={"input_ids": ids}))
+        assert np.isfinite(l1)
+        # eval is deterministic and dropout-free: matches a dropout-0 model
+        ev_batch = jax.tree.map(lambda x: x[0], {"input_ids": ids})
+        ev = float(engine.eval_loss(ev_batch))
+        ref = create_model("tiny")
+        ref_loss = float(jax.jit(ref.loss_fn)(engine.params, ev_batch))
+        np.testing.assert_allclose(ev, ref_loss, rtol=1e-6)
+        assert engine.model.config.dropout_enabled  # restored after eval
