@@ -62,6 +62,10 @@ class Model:
     # custom (loss, grads) producer — set by pipelinize_model to the explicit
     # 1F1B executor; engines prefer it over jax.value_and_grad(loss_fn)
     grad_fn: Optional[Callable[..., Any]] = None
+    # eval-mode loss: same semantics as loss_fn but with training regularisers
+    # (dropout, random-LTD) disabled via a config COPY — engines must not
+    # toggle shared config state to get eval behavior
+    eval_loss_fn: Optional[Callable[..., Any]] = None
 
 
 # ---------------------------------------------------------------------------

@@ -98,6 +98,14 @@ class TransformerConfig:
         return self.hidden_size // self.num_heads
 
 
+def eval_config(cfg: TransformerConfig) -> TransformerConfig:
+    """Config COPY with training regularisers off (dropout, random-LTD).
+    Engines trace eval programs against this copy instead of toggling shared
+    config fields (a mutate-restore window is not thread-safe and a
+    concurrent train trace would silently compile regulariser-free)."""
+    return dataclasses.replace(cfg, dropout_enabled=False, ltd_keep=0)
+
+
 # ---------------------------------------------------------------------------
 # init
 # ---------------------------------------------------------------------------
@@ -822,20 +830,24 @@ def build_model(cfg: TransformerConfig, name: str = "transformer") -> Model:
                                        cache=cache, start_pos=start_pos)
         return logits, new_cache
 
-    def loss_fn(params, batch):
-        logits, _, aux = forward(params, batch["input_ids"], cfg,
-                                 attention_mask=batch.get("attention_mask"),
-                                 pld_theta=batch.get("pld_theta"))
-        labels = batch.get("labels")
-        if labels is None:
-            labels = jnp.concatenate(
-                [batch["input_ids"][:, 1:],
-                 jnp.full((batch["input_ids"].shape[0], 1), -100, batch["input_ids"].dtype)],
-                axis=1)
-        loss = cross_entropy_loss(logits, labels, batch.get("attention_mask"))
-        if cfg.moe_num_experts > 0:
-            loss = loss + cfg.moe_aux_loss_coef * aux / max(cfg.num_layers, 1)
-        return loss
+    def make_loss(c: TransformerConfig):
+        def loss_fn(params, batch):
+            logits, _, aux = forward(params, batch["input_ids"], c,
+                                     attention_mask=batch.get("attention_mask"),
+                                     pld_theta=batch.get("pld_theta"))
+            labels = batch.get("labels")
+            if labels is None:
+                labels = jnp.concatenate(
+                    [batch["input_ids"][:, 1:],
+                     jnp.full((batch["input_ids"].shape[0], 1), -100, batch["input_ids"].dtype)],
+                    axis=1)
+            loss = cross_entropy_loss(logits, labels, batch.get("attention_mask"))
+            if c.moe_num_experts > 0:
+                loss = loss + c.moe_aux_loss_coef * aux / max(c.num_layers, 1)
+            return loss
 
-    return Model(init=init, apply=apply, loss_fn=loss_fn, axes=param_axes(cfg),
-                 config=cfg, name=name)
+        return loss_fn
+
+    return Model(init=init, apply=apply, loss_fn=make_loss(cfg),
+                 eval_loss_fn=make_loss(eval_config(cfg)),
+                 axes=param_axes(cfg), config=cfg, name=name)

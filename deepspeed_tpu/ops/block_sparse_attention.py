@@ -143,7 +143,10 @@ def _fwd_kernel(kidx_ref, mid_ref, q_ref, k_ref, v_ref, m_ref, o_ref, lse_ref,
     m_prev = m_scr[:, :1]
     m_cur = jnp.max(s, axis=1, keepdims=True)
     m_new = jnp.maximum(m_prev, m_cur)
-    p = jnp.exp(s - m_new)
+    # NEG_INF is finite, so a row that has seen no unmasked key would get
+    # p = exp(NEG_INF - NEG_INF) = 1 per column; keep such rows at l == 0 so
+    # the finalize zero-output branch actually fires.
+    p = jnp.where(m_new <= NEG_INF / 2, 0.0, jnp.exp(s - m_new))
     correction = jnp.exp(m_prev - m_new)
     l_new = correction * l_scr[:, :1] + jnp.sum(p, axis=1, keepdims=True)
     acc[:] = acc[:] * correction + jax.lax.dot_general(
@@ -229,7 +232,10 @@ def _bwd_dq_kernel(kidx_ref, mid_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         col = jax.lax.broadcasted_iota(jnp.int32, (TILE, TILE), 1) + j * TILE
         mask = mask & (col <= row)
     s = jnp.where(mask, s, NEG_INF)
-    p = jnp.exp(s - lse_ref[0, 0][:, :1])
+    lse = lse_ref[0, 0][:, :1]
+    # lse == NEG_INF marks key-less rows (see _fwd_kernel); their exp(s-lse)
+    # would be exp(0) = 1 because NEG_INF is finite — force p (hence ds) to 0.
+    p = jnp.where(lse <= NEG_INF / 2, 0.0, jnp.exp(s - lse))
     do = do_ref[0, 0].astype(jnp.float32)
     dp = jax.lax.dot_general(do, v_ref[0, 0].astype(jnp.float32),
                              (((1,), (1,)), ((), ())),
@@ -266,7 +272,8 @@ def _bwd_dkv_kernel(qidx_ref, mid_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         col = jax.lax.broadcasted_iota(jnp.int32, (TILE, TILE), 1) + j * TILE
         mask = mask & (col <= row)
     s = jnp.where(mask, s, NEG_INF)
-    p = jnp.exp(s - lse_ref[0, 0][:, :1])
+    lse = lse_ref[0, 0][:, :1]
+    p = jnp.where(lse <= NEG_INF / 2, 0.0, jnp.exp(s - lse))
     do = do_ref[0, 0].astype(jnp.float32)
     dv_acc[:] += jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
                                     preferred_element_type=jnp.float32)

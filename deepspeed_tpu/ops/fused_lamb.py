@@ -60,7 +60,12 @@ def fused_lamb_flat(params: jax.Array, grads: jax.Array, exp_avg: jax.Array,
     ratio, the reference granularity). Returns (params, exp_avg, exp_avg_sq).
 
     ``max_coeff``/``min_coeff`` clamp the trust ratio like the reference
-    FusedLamb's lamb_coeff bounds (ops/lamb/fused_lamb.py:27-28)."""
+    FusedLamb's lamb_coeff bounds (ops/lamb/fused_lamb.py:27-28).
+
+    DONATION: on the no-padding path the caller's ``exp_avg``/``exp_avg_sq``
+    device buffers are donated (``input_output_aliases``) and are INVALID
+    after this call — rebind the moments from the returned tuple (the
+    functional-update pattern every in-tree caller uses)."""
     n = params.shape[0]
     pad = (-n) % BLOCK
     if pad:

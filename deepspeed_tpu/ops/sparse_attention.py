@@ -195,10 +195,39 @@ def layout_to_token_mask(layout: np.ndarray, block: int) -> jax.Array:
     return jnp.asarray(np.kron(layout, np.ones((block, block))), jnp.int32)
 
 
-# id(config) -> (config, {seq_len: plan}). The config object is PINNED in the
-# entry so its id can never be recycled onto a different config (a freed-id
-# collision would silently serve the wrong sparsity pattern).
+# (config class, field values, seq_len) -> TilePlan. Keyed by VALUE, so
+# callers that construct a fresh (but equal) config per call share one entry
+# instead of growing the cache without bound.
 _PLAN_CACHE: dict = {}
+
+
+# configs whose field values are unhashable even after container conversion
+# fall back to identity keys; they are pinned here so a freed id can never be
+# recycled onto a different config (which would serve the wrong plan)
+_PLAN_CACHE_PINS: list = []
+
+
+def _hashable(v):
+    if isinstance(v, (list, tuple)):
+        return tuple(_hashable(e) for e in v)
+    if isinstance(v, dict):
+        return tuple(sorted((k, _hashable(x)) for k, x in v.items()))
+    if isinstance(v, np.ndarray):
+        return (v.shape, str(v.dtype), v.tobytes())
+    try:
+        hash(v)
+    except TypeError:
+        _PLAN_CACHE_PINS.append(v)
+        return ("__id__", id(v))
+    return v
+
+
+def _config_cache_key(config: SparsityConfig) -> tuple:
+    vals = [(f.name, _hashable(getattr(config, f.name)))
+            for f in dataclasses.fields(config)]
+    # the class OBJECT is part of the key: a reloaded/redefined subclass with
+    # identical fields but different make_layout must not hit a stale plan
+    return (type(config), tuple(vals))
 
 
 def tile_plan_for(config: SparsityConfig, seq_len: int):
@@ -206,15 +235,13 @@ def tile_plan_for(config: SparsityConfig, seq_len: int):
     block-skip kernels execute (block_sparse_attention.py)."""
     from .block_sparse_attention import build_tile_plan
 
-    entry = _PLAN_CACHE.get(id(config))
-    if entry is None or entry[0] is not config:
-        entry = (config, {})
-        _PLAN_CACHE[id(config)] = entry
-    plans = entry[1]
-    if seq_len not in plans:
+    key = (_config_cache_key(config), seq_len)
+    plan = _PLAN_CACHE.get(key)
+    if plan is None:
         layout = np.asarray(config.make_layout(seq_len))
-        plans[seq_len] = build_tile_plan(layout, config.block, seq_len)
-    return plans[seq_len]
+        plan = _PLAN_CACHE[key] = build_tile_plan(layout, config.block,
+                                                  seq_len)
+    return plan
 
 
 def sparse_self_attention(q: jax.Array, k: jax.Array, v: jax.Array,

@@ -502,7 +502,10 @@ def pipelinize_model(model: Model, num_stages: int) -> Model:
     if "lm_head" in axes:
         axes["lm_head"] = ("embed", None)
 
+    from ..models.transformer import eval_config
+
     loss_fn = pipelined_loss_fn(cfg, num_stages)
+    eval_loss_fn = pipelined_loss_fn(eval_config(cfg), num_stages)
     grad_fn = pipelined_grad_fn(cfg, num_stages)
 
     def apply(params, batch, **kw):
@@ -517,4 +520,5 @@ def pipelinize_model(model: Model, num_stages: int) -> Model:
 
     return Model(init=init, apply=apply, loss_fn=loss_fn, axes=axes,
                  config=cfg, name=f"{model.name}-pp{num_stages}",
-                 pipelined=True, num_stages=num_stages, grad_fn=grad_fn)
+                 pipelined=True, num_stages=num_stages, grad_fn=grad_fn,
+                 eval_loss_fn=eval_loss_fn)

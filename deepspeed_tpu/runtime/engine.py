@@ -369,6 +369,7 @@ class TrainEngine:
         self._staged_count = 0
         self._compiled_step = None
         self._compiled_micro = None
+        self._eval_step = None
         self._last_lr = float(self.config.optimizer.params.get("lr", 0.0))
         self._monitor = None
 
@@ -959,20 +960,36 @@ class TrainEngine:
             # the pipelined loss_fn needs an (M, mb, ...) stack; for a plain
             # eval microbatch wrap it as a single-microbatch stack
             batch = jax.tree.map(lambda x: x[None], batch)
-        cfg = self.model.config
-        if cfg is not None and (self._random_ltd is not None
-                                or getattr(cfg, "dropout_enabled", False)):
-            # training regularisers (random-LTD, dropout) are off for eval —
-            # trace the eval program with them disabled and restore
-            keep, drop = cfg.ltd_keep, cfg.dropout_enabled
-            cfg.ltd_keep, cfg.dropout_enabled = 0, False
-            try:
-                with self.mesh:
-                    return jax.jit(self.model.loss_fn)(self.params, batch)
-            finally:
-                cfg.ltd_keep, cfg.dropout_enabled = keep, drop
+        if self._eval_step is None:
+            # eval_loss_fn closes over an eval-mode config COPY (regularisers
+            # off) — no shared-config mutation, and the jitted step is cached
+            # so repeated eval calls don't retrace
+            if self.model.eval_loss_fn is not None:
+                self._eval_step = jax.jit(self.model.eval_loss_fn)
+            else:
+                cfg = self.model.config
+                loss_fn = self.model.loss_fn
+                if cfg is not None and hasattr(cfg, "dropout_enabled"):
+                    # custom Model without eval_loss_fn: toggle the shared
+                    # config's regularisers off around EVERY trace (the
+                    # wrapper body runs at trace time only — including
+                    # shape-driven retraces, and after train_batch has
+                    # raised ltd_keep). build_model-produced Models carry a
+                    # config-copy eval_loss_fn and never take this path.
+                    def eval_fn(params, batch):
+                        keep = getattr(cfg, "ltd_keep", 0)
+                        drop = cfg.dropout_enabled
+                        cfg.ltd_keep, cfg.dropout_enabled = 0, False
+                        try:
+                            return loss_fn(params, batch)
+                        finally:
+                            cfg.ltd_keep, cfg.dropout_enabled = keep, drop
+
+                    self._eval_step = jax.jit(eval_fn)
+                else:
+                    self._eval_step = jax.jit(loss_fn)
         with self.mesh:
-            return jax.jit(self.model.loss_fn)(self.params, batch)
+            return self._eval_step(self.params, batch)
 
     # -- profiling (reference flops_profiler engine hooks + NVTX ranges) --
     def get_flops_profile(self):

@@ -151,6 +151,48 @@ class TestBlockSkipKernel:
         # plan is cached per (config, S)
         assert tile_plan_for(cfg, 1024) is plan
 
+    def test_empty_layout_row_outputs_zero(self):
+        # A q-tile with NO active k-tiles must produce output 0 and zero
+        # gradients. The padded slot list still visits the all-zero mask id,
+        # and NEG_INF is finite — without the m_new guard the kernel would
+        # average visited V tiles instead (advisor finding r2).
+        from deepspeed_tpu.ops.block_sparse_attention import (
+            block_sparse_attention, build_tile_plan)
+
+        layout = np.zeros((1, 2, 2), np.int64)
+        layout[0, 0, 0] = 1          # q-tile 0 → k-tile 0; q-tile 1 → nothing
+        plan = build_tile_plan(layout, 128, 256)
+        ks = jax.random.split(jax.random.PRNGKey(3), 3)
+        q = jax.random.normal(ks[0], (1, 256, 1, 32), jnp.float32)
+        k = jax.random.normal(ks[1], (1, 256, 1, 32), jnp.float32)
+        v = jax.random.normal(ks[2], (1, 256, 1, 32), jnp.float32)
+
+        def f(q, k, v):
+            return block_sparse_attention(q, k, v, plan, interpret=True)
+
+        out = f(q, k, v)
+        # key-less rows: exactly zero
+        np.testing.assert_array_equal(np.asarray(out[:, 128:]), 0.0)
+        # active rows: match dense attention over the visible 128 keys
+        ref = dot_product_attention(q[:, :128], k[:, :128], v[:, :128],
+                                    None, causal=False)
+        np.testing.assert_allclose(np.asarray(out[:, :128]), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+        dq, dk, dv = jax.grad(lambda q, k, v: jnp.sum(f(q, k, v) ** 2),
+                              argnums=(0, 1, 2))(q, k, v)
+        np.testing.assert_array_equal(np.asarray(dq[:, 128:]), 0.0)
+        ref_g = jax.grad(lambda q, k, v: jnp.sum(dot_product_attention(
+            q, k, v, None, causal=False) ** 2), argnums=(0, 1, 2))(
+            q[:, :128], k[:, :128], v[:, :128])
+        for got, want, name in zip((dq, dk, dv), ref_g, "qkv"):
+            np.testing.assert_allclose(np.asarray(got[:, :128]),
+                                       np.asarray(want), atol=5e-5, rtol=5e-5,
+                                       err_msg=f"d{name} mismatch")
+            np.testing.assert_allclose(np.asarray(got[:, 128:]), 0.0,
+                                       atol=5e-6,
+                                       err_msg=f"d{name} tail not zero")
+
     def test_padding_mask_kernel_rejected(self):
         cfg = FixedSparsityConfig(num_heads=2, block=32, num_local_blocks=2)
         q, k, v = self._qkv()
