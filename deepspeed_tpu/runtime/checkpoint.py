@@ -251,6 +251,11 @@ def _restore_leaf(arrays_dir: str, info: Dict, template, sharding
                          f"{np.shape(template)}")
     target_dtype = np.dtype(template.dtype) if hasattr(template, "dtype") \
         else np.float32
+    if isinstance(sharding, str) and sharding == "host":
+        # param-offload tier: the leaf must stay HOST-resident numpy (the
+        # assembled tree can exceed HBM by design)
+        return _assemble_slice(arrays_dir, info, [[0, d] for d in shape],
+                               target_dtype)
     if sharding is None:
         full = _assemble_slice(arrays_dir, info, [[0, d] for d in shape],
                                target_dtype)

@@ -98,10 +98,63 @@ class TrainEngine:
             if config.parallel.pipeline_parallel_size > 1:
                 raise NotImplementedError("nvme offload + pipeline "
                                           "parallelism is not supported")
-        if config.zero_optimization.offload_param.device != "none":
-            raise NotImplementedError(
-                "offload_param is not implemented yet (optimizer-state "
-                "offload via offload_optimizer.device='cpu' is)")
+        self._param_offload_tier = config.zero_optimization.offload_param.device
+        if self._param_offload_tier != "none":
+            # ZeRO-3 param offload (docs/offload_design.md tier 3): the train
+            # step becomes a host-driven loop streaming layer blocks through
+            # HBM (runtime/param_offload.py); the executor owns ALL optimizer
+            # state (host fp32), so it composes with neither the resident
+            # optimizer paths nor the compressed-comm step
+            if config.zero_optimization.stage < 3:
+                raise ValueError(
+                    "offload_param requires ZeRO stage 3 (reference "
+                    "constraint: params are partitioned before offload)")
+            if opt_name not in ("adam", "adamw", "fusedadam", "cpuadam"):
+                raise ValueError(
+                    f"offload_param supports the Adam family only, got "
+                    f"'{config.optimizer.type}' (the streamed update is "
+                    "swap-aware AdamW, the reference's restriction too)")
+            if config.fp16.enabled:
+                raise NotImplementedError(
+                    "offload_param + fp16 dynamic loss scaling is not "
+                    "supported (overflow-skip needs resident grads); use bf16")
+            if self._onebit:
+                raise ValueError(
+                    "offload_param is incompatible with 1-bit optimizers")
+            if self._nvme_offload:
+                raise ValueError(
+                    "offload_param subsumes optimizer-state offload (its "
+                    "fp32 state is host-resident already) — leave "
+                    "offload_optimizer.device='none'")
+            if config.zero_optimization.offload_optimizer.device == "cpu":
+                raise ValueError(
+                    "offload_param subsumes optimizer-state offload — leave "
+                    "offload_optimizer.device='none'")
+            if jax.process_count() > 1:
+                raise NotImplementedError(
+                    "offload_param is single-process for now (each process "
+                    "would stream its addressable shard)")
+            if config.parallel.pipeline_parallel_size > 1:
+                raise NotImplementedError(
+                    "offload_param + pipeline parallelism is not supported "
+                    "(the segmented step IS a pipeline over layer blocks)")
+            # these gates must read the CONFIG (the engine only sets the
+            # model-config flags later, after the executor is built)
+            if config.progressive_layer_drop.enabled:
+                raise NotImplementedError(
+                    "offload_param + progressive_layer_drop is not supported "
+                    "(the segmented step has no theta plumbing)")
+            de = config.data_efficiency
+            if (de.enabled and isinstance(de.data_routing, dict)
+                    and de.data_routing.get("random_ltd", {}).get("enabled")):
+                raise NotImplementedError(
+                    "offload_param + random_ltd is not supported")
+            ct = config.compression_training
+            if any((ct.weight_quantization, ct.activation_quantization,
+                    ct.sparse_pruning, ct.row_pruning, ct.head_pruning)):
+                raise NotImplementedError(
+                    "offload_param + compression_training is not supported "
+                    "(the segmented step does not apply the QAT transform)")
         if (config.zero_optimization.offload_optimizer.device == "cpu"
                 and jax.default_backend() not in ("tpu", "gpu")):
             raise ValueError(
@@ -193,8 +246,36 @@ class TrainEngine:
         def _init_cast(key):
             return cast_floating(model.init(key), self.compute_dtype)
 
-        with self.mesh:
-            self.params = jax.jit(_init_cast, out_shardings=self.param_shardings)(rng)
+        self._param_offload = None
+        if self._param_offload_tier != "none":
+            # init must never materialise the full tree in HBM (the point is
+            # params > HBM): on an accelerator, compute on device but stream
+            # each leaf to pinned host memory; on the CPU backend (tests) a
+            # plain jit is already host-resident
+            if jax.default_backend() == "cpu":
+                with self.mesh:
+                    host_params = jax.jit(_init_cast)(rng)
+            else:
+                host_sh = jax.tree.map(
+                    lambda s: s.with_memory_kind("pinned_host"),
+                    self.param_shardings)
+                with self.mesh:
+                    host_params = jax.jit(_init_cast,
+                                          out_shardings=host_sh)(rng)
+            host_params = jax.tree.map(lambda x: np.asarray(x), host_params)
+            from .param_offload import ParamOffloadExecutor
+
+            self._param_offload = ParamOffloadExecutor(
+                model, self.mesh, self.plan, self.config,
+                lr_schedule=self.optimizer.lr_schedule,
+                host_params=host_params, compute_dtype=self.compute_dtype)
+            self._n_params = sum(int(np.prod(np.shape(l)))
+                                 for l in jax.tree.leaves(host_params))
+            self.params = None
+        else:
+            with self.mesh:
+                self.params = jax.jit(_init_cast,
+                                      out_shardings=self.param_shardings)(rng)
 
         # optimizer + scaler state, sharded per plan (NVMe offload: the state
         # lives in swap files instead — nothing is materialised in HBM)
@@ -221,6 +302,8 @@ class TrainEngine:
                             "thread_count": self.config.aio.thread_count})
             self._nvme_swapper.init_from_params(self.params)
             self.opt_state = None
+        elif self._param_offload is not None:
+            self.opt_state = None     # the executor owns all optimizer state
         else:
             master_shardings_tree = self._opt_state_shardings()
             with self.mesh:
@@ -373,7 +456,8 @@ class TrainEngine:
         self._last_lr = float(self.config.optimizer.params.get("lr", 0.0))
         self._monitor = None
 
-        n = param_count(self.params)
+        n = (self._n_params if self.params is None
+             else param_count(self.params))
         log_dist(f"engine ready: {n / 1e6:.1f}M params, zero_stage={self.config.zero_stage}, "
                  f"dtype={self.config.precision_dtype}, mesh={dict(self.mesh.shape)}, "
                  f"micro_batch={self.train_micro_batch_size_per_gpu()}, "
@@ -789,7 +873,7 @@ class TrainEngine:
                 self._compression_active = act
                 self._compiled_step = None    # re-specialise at the boundary
 
-        if self._compiled_step is None:
+        if self._compiled_step is None and self._param_offload is None:
             self._compiled_step = (
                 self._build_nvme_grads_step() if self._nvme_swapper is not None
                 else self._build_onebit_train_step() if self._onebit
@@ -804,7 +888,15 @@ class TrainEngine:
             self.timers(TRAIN_BATCH_TIMER).start(synchronize=True)
         with self.mesh:
             batch = self._globalize_batch(batch, leading_gas=True)
-            if self._nvme_swapper is not None:
+            if self._param_offload is not None:
+                # host-driven segmented step: params stream through HBM per
+                # layer block (runtime/param_offload.py)
+                loss, grad_norm = self._param_offload.train_step(batch)
+                lr = float(self.optimizer.lr_schedule(self.global_steps))
+                stats = StepStats(grad_norm=jnp.float32(grad_norm),
+                                  skipped=jnp.asarray(False),
+                                  lr=jnp.float32(lr))
+            elif self._nvme_swapper is not None:
                 # device: loss+grads; host: pipelined NVMe swap + Adam. The
                 # grad-norm fetch is a host sync, but the swap loop is
                 # host-driven anyway — no extra queue drain
@@ -890,6 +982,11 @@ class TrainEngine:
                 "nvme offload drives the optimizer from train_batch (the "
                 "swap pipeline wraps the whole step) — the staged "
                 "forward/backward/step protocol is not available")
+        if self._param_offload is not None:
+            raise RuntimeError(
+                "offload_param drives the whole step from train_batch (the "
+                "host streaming loop owns fwd/bwd/update) — the staged "
+                "forward/backward/step protocol is not available")
         if self._random_ltd is not None:
             raise RuntimeError(
                 "random_ltd is driven by train_batch (per-step kept-token "
@@ -956,6 +1053,10 @@ class TrainEngine:
 
     def eval_loss(self, batch: Any) -> jax.Array:
         self.mark_step_boundary()
+        if self._param_offload is not None:
+            with self.mesh:
+                batch = self._globalize_batch(batch, leading_gas=False)
+                return self._param_offload.eval_forward(batch)
         if self.model.pipelined:
             # the pipelined loss_fn needs an (M, mb, ...) stack; for a plain
             # eval microbatch wrap it as a single-microbatch stack
@@ -1042,7 +1143,20 @@ class TrainEngine:
                              if self.lr_scheduler is not None
                              and hasattr(self.lr_scheduler, "state_dict") else None),
         })
-        path = _save(save_dir, tag, params=self.params, opt_state=self.opt_state,
+        params = self.params
+        opt_state = self.opt_state
+        if self._param_offload is not None:
+            params = self._param_offload.params_for_checkpoint()
+            opt_state = self._param_offload.opt_state_arrays()
+            if async_save:
+                # the executor updates its host numpy storage IN PLACE every
+                # step — snapshot before handing to the background writer or
+                # the checkpoint tears between step N and N+1
+                copy_np = lambda x: (np.array(x) if isinstance(x, np.ndarray)
+                                     else x)
+                params = jax.tree.map(copy_np, params)
+                opt_state = jax.tree.map(copy_np, opt_state)
+        path = _save(save_dir, tag, params=params, opt_state=opt_state,
                      client_state=client_state, save_latest=save_latest,
                      tag_validation=self.config.checkpoint.tag_validation,
                      async_save=async_save)
@@ -1057,6 +1171,48 @@ class TrainEngine:
                         load_optimizer_states: bool = True,
                         load_lr_scheduler_states: bool = True) -> Tuple[Optional[str], Dict]:
         from .checkpoint import load_checkpoint as _load
+
+        if self._param_offload is not None:
+            po = self._param_offload
+            ptree = po.params_for_checkpoint()
+            psh = dict(po._res_shardings)
+            psh["layers"] = jax.tree.map(lambda _: "host", ptree["layers"])
+            opt_tpl = None
+            if load_optimizer_states:
+                ost = po.opt_state_arrays()
+                host_of = lambda t: jax.tree.map(lambda _: "host", t)
+                osh = {"step": "host",
+                       "layer_master": host_of(ost["layer_master"]),
+                       "layer_m": host_of(ost["layer_m"]),
+                       "layer_v": host_of(ost["layer_v"]),
+                       "res_master": po._res_shardings,
+                       "res_m": po._res_shardings,
+                       "res_v": po._res_shardings}
+                opt_tpl = (ost, osh)
+            with self.mesh:
+                result = _load(load_dir, tag,
+                               params_template=(ptree, psh),
+                               opt_template=opt_tpl)
+            if result is None:
+                return None, {}
+            params, opt_state, client_state = result
+            po.load_params(params)
+            if opt_state is not None:
+                po.load_opt_state(opt_state)
+            else:
+                # params-only load: the executor's own step counter drives
+                # its lr_schedule and Adam bias correction — resync or the
+                # next step silently applies lr_schedule(0)
+                po.step_count = client_state.get("global_steps", 0)
+            self.global_steps = client_state.get("global_steps", 0)
+            self.micro_steps = client_state.get("micro_steps", 0)
+            self.skipped_steps = client_state.get("skipped_steps", 0)
+            if (load_lr_scheduler_states and self.lr_scheduler is not None
+                    and client_state.get("lr_scheduler") is not None
+                    and hasattr(self.lr_scheduler, "load_state_dict")):
+                self.lr_scheduler.load_state_dict(client_state["lr_scheduler"])
+            log_dist(f"loaded checkpoint from {load_dir} (tag={tag or 'latest'})")
+            return load_dir, client_state
 
         load_resident_opt = (load_optimizer_states
                              and self._nvme_swapper is None)
@@ -1109,7 +1265,9 @@ class TrainEngine:
 
         os.makedirs(save_dir, exist_ok=True)
         path = os.path.join(save_dir, save_filename)
-        save_flat_weights(self.params, path)
+        params = (self._param_offload.params_for_checkpoint()
+                  if self._param_offload is not None else self.params)
+        save_flat_weights(params, path)
         return path
 
 

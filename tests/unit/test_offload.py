@@ -42,8 +42,10 @@ def test_nvme_offload_gates(tmp_path):
         deepspeed_tpu.initialize(model=model, config=cfg2)
 
 
-def test_param_offload_fails_loudly():
+def test_param_offload_requires_stage3():
+    # param offload is implemented (tests/unit/test_param_offload.py); the
+    # stage gate must still fail loudly
     model = create_model("tiny", dtype=jnp.float32)
-    with pytest.raises(NotImplementedError, match="offload_param"):
+    with pytest.raises(ValueError, match="stage 3"):
         deepspeed_tpu.initialize(
             model=model, config=_cfg(offload_param={"device": "cpu"}))

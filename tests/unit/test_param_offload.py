@@ -1,0 +1,173 @@
+"""ZeRO-3 parameter offload tests (runtime/param_offload.py).
+
+The bar (VERDICT r2 #1): a model whose params live off-device runs
+train_batch with trajectory equivalence against the resident engine, the
+NVMe tier streams through aio files, and checkpoints round-trip.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.models.transformer import TransformerConfig, build_model
+from deepspeed_tpu.parallel import mesh as mesh_mod
+
+pytestmark = pytest.mark.slow  # heavy virtual-mesh trajectory tests
+
+
+
+def _model():
+    return build_model(TransformerConfig(
+        vocab_size=128, hidden_size=32, num_layers=4, num_heads=2,
+        max_seq_len=32, dtype=jnp.float32, tie_embeddings=True))
+
+
+def _cfg(extra_zero=None, **kw):
+    zero = {"stage": 3}
+    zero.update(extra_zero or {})
+    cfg = {"train_micro_batch_size_per_gpu": 1,
+           "gradient_accumulation_steps": 1,
+           "steps_per_print": 1000,
+           "optimizer": {"type": "adamw",
+                         "params": {"lr": 5e-3, "weight_decay": 0.01}},
+           "zero_optimization": zero}
+    cfg.update(kw)
+    return cfg
+
+
+def _batch(gas=1, mb=8, S=32, seed=0):
+    # mb is the GLOBAL micro batch: micro_batch_per_gpu (1) x dp world (8)
+    rng = np.random.default_rng(seed)
+    return {"input_ids": rng.integers(0, 128, (gas, mb, S))}
+
+
+def _run(config, steps=4, gas=1, seed=0):
+    mesh_mod.reset_mesh()
+    engine, *_ = ds.initialize(model=_model(), config=config,
+                               rng=jax.random.PRNGKey(7))
+    losses = [float(engine.train_batch(batch=_batch(gas=gas, seed=seed + i)))
+              for i in range(steps)]
+    return engine, losses
+
+
+class TestParamOffloadCPU:
+    def test_trajectory_matches_resident_engine(self):
+        _, base = _run(_cfg(), steps=4)
+        eng, off = _run(_cfg(extra_zero={
+            "offload_param": {"device": "cpu", "buffer_size": 1}}), steps=4)
+        # buffer_size=1 byte => 1 layer per block => 4 blocks
+        assert eng._param_offload.num_blocks == 4
+        np.testing.assert_allclose(off, base, rtol=2e-4, atol=2e-5)
+        # fused path must report a real grad norm, not 0
+        with eng.mesh:
+            batch = eng._globalize_batch(_batch(seed=99), leading_gas=True)
+            _, gn = eng._param_offload.train_step(batch)
+        assert gn > 0.0
+
+    def test_multi_layer_blocks_and_remainder(self):
+        eng, off = _run(_cfg(extra_zero={
+            "offload_param": {"device": "cpu", "buffer_size": 10**9}}),
+            steps=3)
+        assert eng._param_offload.num_blocks == 1
+        _, base = _run(_cfg(), steps=3)
+        np.testing.assert_allclose(off, base, rtol=2e-4, atol=2e-5)
+        # remainder block: 4 layers in blocks of 3 -> (3, 1)
+        mesh_mod.reset_mesh()
+        m = _model()
+        eng3, *_ = ds.initialize(model=m, config=_cfg(extra_zero={
+            "offload_param": {"device": "cpu", "buffer_size": 3 * 9000}}),
+            rng=jax.random.PRNGKey(7))
+        po = eng3._param_offload
+        if po.num_blocks > 1:          # depends on per-layer bytes
+            assert po._bounds[-1][1] == 4
+        l0 = float(eng3.train_batch(batch=_batch()))
+        assert np.isfinite(l0)
+
+    def test_gas_accumulation_path(self):
+        cfg = _cfg(gradient_accumulation_steps=2)
+        _, base = _run(cfg, steps=3, gas=2)
+        cfg_off = _cfg(extra_zero={
+            "offload_param": {"device": "cpu", "buffer_size": 1}},
+            gradient_accumulation_steps=2)
+        _, off = _run(cfg_off, steps=3, gas=2)
+        np.testing.assert_allclose(off, base, rtol=2e-4, atol=2e-5)
+
+    def test_grad_clip_path(self):
+        cfg = _cfg(gradient_clipping=0.01)
+        _, base = _run(cfg, steps=3)
+        cfg_off = _cfg(extra_zero={
+            "offload_param": {"device": "cpu", "buffer_size": 1}},
+            gradient_clipping=0.01)
+        eng, off = _run(cfg_off, steps=3)
+        np.testing.assert_allclose(off, base, rtol=2e-4, atol=2e-5)
+
+    def test_eval_matches_resident(self):
+        mesh_mod.reset_mesh()
+        e1, _ = _run(_cfg(), steps=1)
+        ev1 = float(e1.eval_loss(jax.tree.map(lambda x: x[0], _batch(seed=9))))
+        e2, _ = _run(_cfg(extra_zero={
+            "offload_param": {"device": "cpu", "buffer_size": 1}}), steps=1)
+        ev2 = float(e2.eval_loss(jax.tree.map(lambda x: x[0], _batch(seed=9))))
+        np.testing.assert_allclose(ev2, ev1, rtol=2e-4)
+
+    def test_checkpoint_roundtrip(self, tmp_path):
+        eng, losses = _run(_cfg(extra_zero={
+            "offload_param": {"device": "cpu", "buffer_size": 1}}), steps=2)
+        eng.save_checkpoint(str(tmp_path / "ck"))
+        cont = [float(eng.train_batch(batch=_batch(seed=2 + i)))
+                for i in range(2)]
+
+        mesh_mod.reset_mesh()
+        eng2, *_ = ds.initialize(
+            model=_model(),
+            config=_cfg(extra_zero={
+                "offload_param": {"device": "cpu", "buffer_size": 1}}),
+            rng=jax.random.PRNGKey(0))    # different init — load overwrites
+        eng2.load_checkpoint(str(tmp_path / "ck"))
+        assert eng2.global_steps == 2
+        resumed = [float(eng2.train_batch(batch=_batch(seed=2 + i)))
+                   for i in range(2)]
+        np.testing.assert_allclose(resumed, cont, rtol=2e-4, atol=2e-5)
+
+    def test_gates(self):
+        mesh_mod.reset_mesh()
+        with pytest.raises(ValueError, match="stage 3"):
+            ds.initialize(model=_model(), config=_cfg(
+                extra_zero={"stage": 1,
+                            "offload_param": {"device": "cpu"}}))
+        mesh_mod.reset_mesh()
+        with pytest.raises(ValueError, match="Adam family"):
+            ds.initialize(model=_model(), config={
+                **_cfg(extra_zero={"offload_param": {"device": "cpu"}}),
+                "optimizer": {"type": "sgd", "params": {"lr": 1e-3}}})
+        mesh_mod.reset_mesh()
+        with pytest.raises(ValueError, match="subsumes"):
+            ds.initialize(model=_model(), config=_cfg(extra_zero={
+                "offload_param": {"device": "cpu"},
+                "offload_optimizer": {"device": "cpu"}}))
+        mesh_mod.reset_mesh()
+        with pytest.raises(NotImplementedError, match="progressive_layer_drop"):
+            ds.initialize(model=_model(), config={
+                **_cfg(extra_zero={"offload_param": {"device": "cpu"}}),
+                "progressive_layer_drop": {"enabled": True}})
+
+
+class TestParamOffloadNVMe:
+    def test_nvme_tier_trajectory_and_files(self, tmp_path):
+        _, base = _run(_cfg(), steps=3)
+        cfg = _cfg(extra_zero={"offload_param": {
+            "device": "nvme", "nvme_path": str(tmp_path),
+            "buffer_size": 1}})
+        eng, off = _run(cfg, steps=3)
+        np.testing.assert_allclose(off, base, rtol=2e-4, atol=2e-5)
+        import os
+        swap = [f for r, _, fs in os.walk(tmp_path) for f in fs
+                if f.startswith("params.block")]
+        assert len(swap) == eng._param_offload.num_blocks
+        # checkpoint materialises from files
+        p = eng._param_offload.params_for_checkpoint()
+        assert p["layers"]["attn"]["wq"].shape[0] == 4
+        eng._param_offload.close()
