@@ -48,14 +48,14 @@ def main() -> None:
     prompt_len = int(os.environ.get("BENCH_INFER_PROMPT", 512))
     n_new = int(os.environ.get("BENCH_INFER_NEW", 64))
     arena = int(os.environ.get("BENCH_INFER_ARENA", 1024))
-    # 'int8' => weight-only quantized storage (compute bf16): halves the
-    # weight side of the decode roofline denominator
+    # 'int8'/'int4' => weight-only quantized storage (compute bf16): halves/
+    # quarters the weight side of the decode roofline denominator
     dtype_name = os.environ.get("BENCH_INFER_DTYPE", "bf16")
-    if dtype_name not in ("bf16", "int8"):
-        raise SystemExit(f"BENCH_INFER_DTYPE must be bf16|int8, got "
+    if dtype_name not in ("bf16", "int8", "int4"):
+        raise SystemExit(f"BENCH_INFER_DTYPE must be bf16|int8|int4, got "
                          f"'{dtype_name}' — refusing to run a mislabelled "
                          "benchmark")
-    dtype = "int8" if dtype_name == "int8" else jnp.bfloat16
+    dtype = dtype_name if dtype_name.startswith("int") else jnp.bfloat16
 
     engine = init_inference(model_name, dtype=dtype, max_out_tokens=arena)
     cfg = engine.model.config

@@ -49,10 +49,14 @@ class InferenceConfig:
     replace_with_kernel_inject: bool = True   # platform Pallas kernels
     checkpoint: Optional[str] = None   # flat-npz path (save_16bit_model output)
     seed: int = 0
-    quantize_bits: Optional[int] = None  # 8 => weight-only int8 storage
-    #   (reference int8 kernel-injection mode): matmul weights quantized
-    #   per output channel, dequant fused into the GEMM — halves the
-    #   decode-phase HBM weight traffic. dtype='int8' sets this.
+    quantize_bits: Optional[int] = None  # 8/4 => weight-only int8/int4
+    #   storage (reference int8/int4 kernel-injection + groupwise quantizer
+    #   kernels): matmul weights quantized per output channel (int8) or per
+    #   (group, channel) with nibble packing (int4), dequant fused into the
+    #   GEMM — halves/quarters decode-phase HBM weight traffic.
+    #   dtype='int8'/'int4' sets this.
+    quantize_groups: Optional[int] = None  # int4 group size along K (None =>
+    #   one group per output channel; reference quantization_settings groups)
 
     def __post_init__(self):
         # dtype='int8' is storage quantization, not a compute dtype — the
@@ -62,16 +66,20 @@ class InferenceConfig:
         if self.dtype in ("int8", jnp.int8):
             self.quantize_bits = 8
             self.dtype = jnp.bfloat16
+        elif self.dtype in ("int4",):
+            self.quantize_bits = 4
+            self.dtype = jnp.bfloat16
         elif isinstance(self.dtype, str):
             self.dtype = {"bf16": jnp.bfloat16, "bfloat16": jnp.bfloat16,
                           "fp16": jnp.float16, "float16": jnp.float16,
                           "fp32": jnp.float32, "float32": jnp.float32,
                           }.get(self.dtype) or _reject_dtype(self.dtype)
-        if self.quantize_bits not in (None, 8):
+        if self.quantize_bits not in (None, 4, 8):
             raise NotImplementedError(
-                f"quantize_bits={self.quantize_bits}: only 8 is supported "
-                "(int4 would store unpacked bytes — no memory benefit over "
-                "int8, strictly worse accuracy)")
+                f"quantize_bits={self.quantize_bits}: 8 (per-channel) and "
+                "4 (nibble-packed, groupwise) are supported")
+        if self.quantize_groups is not None and self.quantize_bits != 4:
+            raise ValueError("quantize_groups applies to int4 only")
 
 
 def _reject_dtype(name: str):
@@ -158,7 +166,8 @@ class InferenceEngine:
 
                     params = jax.jit(lambda key: quantize_model_weights(
                         cast_floating(model.init(key), config.dtype),
-                        bits=config.quantize_bits))(
+                        bits=config.quantize_bits,
+                        group_size=config.quantize_groups))(
                             jax.random.PRNGKey(config.seed))
                 else:
                     params = jax.jit(
@@ -175,7 +184,8 @@ class InferenceEngine:
             params = cast_floating(params, config.dtype)
             params = quantize_model_weights(params,
                                             bits=config.quantize_bits,
-                                            donate=True)
+                                            donate=True,
+                                            group_size=config.quantize_groups)
             params = jax.tree.map(jnp.asarray, params)  # remaining host leaves
         else:
             params = cast_floating(params, config.dtype)

@@ -301,23 +301,34 @@ def resolve_remat_policy(cfg: "TransformerConfig"):
 
 
 def quantize_model_weights(params: Dict[str, Any], bits: int = 8,
-                           donate: bool = False) -> Dict[str, Any]:
-    """Weight-only quantization for inference (reference int8
-    kernel-injection mode, ``inference/quantization``): matmul weights
+                           donate: bool = False,
+                           group_size: Optional[int] = None) -> Dict[str, Any]:
+    """Weight-only quantization for inference (reference int8/int4
+    kernel-injection mode, ``inference/quantization``,
+    ``csrc/includes/quantization_utils.h:468`` 4-bit packing): matmul weights
     (attention qkv/o, dense MLP, untied lm_head) become
-    ``{"q8": int8, "s": fp32 per-output-channel scale}``. Embedding stays
-    dense (the token gather reads rows); biases/norms stay dense; MoE
-    expert banks are left dense (moe_mlp consumes them directly).
-    HBM weight traffic — the decode-phase roofline — drops ~2x (int8)."""
+    ``{"q8": int8, "s": fp32 per-output-channel scale}`` (8-bit) or
+    ``{"q4": nibble-packed uint8 (K/2, N), "s": (G, N) group scales}``
+    (4-bit). Embedding stays dense (the token gather reads rows);
+    biases/norms stay dense; MoE expert banks are left dense (moe_mlp
+    consumes them directly). HBM weight traffic — the decode-phase
+    roofline — drops ~2x (int8) / ~4x (int4)."""
     assert bits in (4, 8)
     qmax = float(2 ** (bits - 1) - 1)
 
-    def _quant_math(w):
-        w32 = w.astype(jnp.float32)
-        absmax = jnp.max(jnp.abs(w32), axis=-2, keepdims=True)
-        s = jnp.where(absmax == 0.0, 1.0, absmax / qmax)
-        q = jnp.clip(jnp.round(w32 / s), -qmax, qmax).astype(jnp.int8)
-        return {"q8": q, "s": s}
+    if bits == 4:
+        from ..ops.quant_matmul import quantize_int4
+
+        def _quant_math(w):
+            q4, s = quantize_int4(w, group_size)
+            return {"q4": q4, "s": s}
+    else:
+        def _quant_math(w):
+            w32 = w.astype(jnp.float32)
+            absmax = jnp.max(jnp.abs(w32), axis=-2, keepdims=True)
+            s = jnp.where(absmax == 0.0, 1.0, absmax / qmax)
+            q = jnp.clip(jnp.round(w32 / s), -qmax, qmax).astype(jnp.int8)
+            return {"q8": q, "s": s}
 
     # donate=True quantizes leaf-by-leaf, freeing each bf16 leaf as its int8
     # replacement materialises — a whole-tree jit would transiently hold both
@@ -361,6 +372,10 @@ def _dense(w: Any, dtype: Any) -> jax.Array:
     """Materialise a (possibly weight-only-quantized) weight as dense."""
     if isinstance(w, dict) and "q8" in w:
         return (w["q8"].astype(jnp.float32) * w["s"]).astype(dtype)
+    if isinstance(w, dict) and "q4" in w:
+        from ..ops.quant_matmul import unpack_int4
+
+        return unpack_int4(w["q4"], w["s"], dtype)
     return w
 
 
@@ -387,6 +402,23 @@ def _qeinsum(spec: str, x: jax.Array, w: Any, dtype: Any) -> jax.Array:
         x, q8 = lax.optimization_barrier((x, q8))
         out = jnp.einsum(spec, x, q8.astype(dtype))
         return out * s[..., 0, :].astype(dtype)
+    if isinstance(w, dict) and "q4" in w:
+        from ..ops.quant_matmul import unpack_int4
+
+        q4, s = w["q4"], w["s"]
+        B, S = x.shape[0], x.shape[1]
+        K2, N = q4.shape[-2:]
+        G = s.shape[-2]
+        gs = 2 * K2 // G
+        if (S * B <= 8 and q4.ndim == 2 and _kernels_active()
+                and K2 % 128 == 0 and N % 128 == 0
+                and (G == 1 or gs % 128 == 0)):
+            from ..ops.quant_matmul import int4_matmul
+
+            out = int4_matmul(x.reshape(B * S, -1), q4, s, out_dtype=dtype)
+            return out.reshape(x.shape[:-1] + (N,))
+        x, q4 = lax.optimization_barrier((x, q4))
+        return jnp.einsum(spec, x, unpack_int4(q4, s, dtype))
     return jnp.einsum(spec, x, w)
 
 
@@ -481,7 +513,18 @@ def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
             scores = jnp.where(mask[:, None, None, :].astype(bool), scores, neg)
         else:
             scores = jnp.where(mask[:, None, :, :].astype(bool), scores, neg)
+    from ..parallel.sequence import scores_spec, constrain as _sp_constrain
+
+    sspec = scores_spec(N)
+    if sspec is not None:
+        # pin the (B,N,S,T) layout to heads-over-('seq','model') so the
+        # softmax-backward reductions (B,N,S) stay in the attention region's
+        # natural sharding instead of XLA resharding them S-over-'seq' via
+        # involuntary full remat (zero3×TP×SP dryrun)
+        scores = _sp_constrain(scores, sspec)
     probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    if sspec is not None:
+        probs = _sp_constrain(probs, sspec)
     return jnp.einsum("bnst,btnd->bsnd", probs, v)
 
 
@@ -523,11 +566,21 @@ def _layer_forward(cfg: TransformerConfig, x: jax.Array, layer: Dict[str, Any],
     use_ring = (cache is None and ring_attention_enabled()
                 and cfg.attention_impl is None)
     if cache is None and not use_ring:
-        from ..parallel.sequence import heads_spec, constrain
+        from ..parallel.sequence import attn_out_spec, heads_spec, constrain
 
         qspec = heads_spec(N)
         kspec = heads_spec(K)
         if qspec is not None and kspec is not None:
+            # two-step reshard: first pin the natural post-reshape layout
+            # (tokens over 'seq', heads over 'model') so the head-scatter
+            # all-to-all is a 4D→4D transition — without this, the BACKWARD
+            # of the (B,S,N·D)→(B,S,N,D) reshape sees a heads-over-4-way
+            # cotangent and XLA falls into involuntary full remat
+            nat_q, nat_k = attn_out_spec(N), attn_out_spec(K)
+            if nat_q is not None and nat_k is not None:
+                q = constrain(q, nat_q)
+                k = constrain(k, nat_k)
+                v = constrain(v, nat_k)
             q = constrain(q, qspec)
             k = constrain(k, kspec)
             v = constrain(v, kspec)
@@ -615,6 +668,13 @@ def _layer_forward(cfg: TransformerConfig, x: jax.Array, layer: Dict[str, Any],
         else:
             attn = attn_fn(q, k, v, mask, causal=True, alibi=alibi)
 
+    if cache is None and not use_ring:
+        from ..parallel.sequence import attn_out_spec, constrain
+
+        out_spec = attn_out_spec(N)
+        if out_spec is not None:
+            # Ulysses inverse all-to-all on the 4D tensor (see attn_out_spec)
+            attn = constrain(attn, out_spec)
     attn = attn.reshape(B, S, N * D)
     attn_out = _qeinsum("bsd,dh->bsh", attn, layer["attn"]["wo"], cfg.dtype)
     if "bo" in layer["attn"]:

@@ -8,7 +8,9 @@ from .flash_attention import flash_attention, make_attention_impl
 from .fused_adam import fused_adam_flat, reference_adam_flat
 from .fused_lamb import fused_lamb_flat, reference_lamb_flat
 from .normalization import fused_layer_norm, reference_layer_norm
-from .quant_matmul import int8_matmul, reference_int8_matmul
+from .quant_matmul import (int4_matmul, int8_matmul, quantize_int4,
+                           reference_int4_matmul, reference_int8_matmul,
+                           unpack_int4)
 from .quantization import (dequantize_symmetric, fake_quantize,
                            quantize_symmetric, reference_quantize_symmetric)
 from .spatial import (diffusers_attention, fused_group_norm,
@@ -32,6 +34,8 @@ register_op("decode_attention", decode_attention,
             description="single-query KV-cache decode attention (GQA, alibi)")
 register_op("int8_matmul", int8_matmul, reference=reference_int8_matmul,
             description="weight-only int8 GEMM (in-kernel tile dequant)")
+register_op("int4_matmul", int4_matmul, reference=reference_int4_matmul,
+            description="weight-only int4 GEMM (nibble-packed, group scales)")
 register_op("fused_group_norm", fused_group_norm,
             reference=reference_group_norm,
             description="spatial GroupNorm (diffusers UNet norm, NHWC tokens)")
@@ -55,6 +59,7 @@ __all__ = [
     "fused_layer_norm", "reference_layer_norm",
     "quantize_symmetric", "dequantize_symmetric", "fake_quantize",
     "reference_quantize_symmetric", "int8_matmul", "reference_int8_matmul",
+    "int4_matmul", "reference_int4_matmul", "quantize_int4", "unpack_int4",
     "diffusers_attention", "fused_group_norm",
     "reference_group_norm", "available_ops", "get_op",
     "is_compatible", "op_report", "register_op",

@@ -99,3 +99,133 @@ def reference_int8_matmul(x, q8, scale, out_dtype=None):
     out_dtype = out_dtype or x.dtype
     w = q8.astype(jnp.float32) * scale.astype(jnp.float32)
     return (x.astype(jnp.float32) @ w).astype(out_dtype)
+
+
+# ---------------------------------------------------------------------------
+# int4: nibble-packed weights + per-group scales
+# ---------------------------------------------------------------------------
+#
+# Reference: the 4-bit groupwise quantizer kernels
+# (csrc/quantization/quantize.cu, csrc/includes/quantization_utils.h:468 —
+# Params<qType, numBits=4> packs two values per int8).
+#
+# Packing layout: rows [0, K/2) ride in the LOW nibble, rows [K/2, K) in the
+# HIGH nibble of a (K/2, N) uint8 array. Unpacking then never interleaves
+# rows — each uint8 tile yields two CONTIGUOUS weight tiles (rows k and
+# k + K/2), which pair with two x tiles fed through separate BlockSpecs.
+# Scales are per (group, out-channel): s (G, N), groups contiguous along K.
+
+
+def quantize_int4(w: jax.Array, group_size: int | None = None
+                  ) -> Tuple[jax.Array, jax.Array]:
+    """(..., K, N) float → (q4 (..., K/2, N) uint8, s (..., G, N) fp32).
+    Symmetric, qmax=7. ``group_size`` groups along K (None => one group per
+    output channel). Leading dims (stacked layers) ride along."""
+    *lead, K, N = w.shape
+    if K % 2:
+        raise ValueError(f"int4 packing needs even K, got {K}")
+    gs = group_size or K
+    if K % gs or (group_size and (K // 2) % gs):
+        raise ValueError(f"group_size {gs} must divide K/2 ({K // 2})")
+    G = K // gs
+    w32 = w.astype(jnp.float32).reshape(*lead, G, gs, N)
+    absmax = jnp.max(jnp.abs(w32), axis=-2)                  # (..., G, N)
+    s = jnp.where(absmax == 0.0, 1.0, absmax / 7.0)
+    q = jnp.clip(jnp.round(w32 / s[..., None, :]), -7, 7).astype(jnp.int32)
+    q = q.reshape(*lead, K, N)
+    lo = q[..., :K // 2, :] & 0xF
+    hi = (q[..., K // 2:, :] & 0xF) << 4
+    return (lo | hi).astype(jnp.uint8), s
+
+
+def unpack_int4(q4: jax.Array, s: jax.Array, out_dtype=jnp.float32
+                ) -> jax.Array:
+    """Dense dequant oracle: (..., K/2, N) uint8 + (..., G, N) scales →
+    (..., K, N)."""
+    q = q4.astype(jnp.int32)
+    lo = ((q & 0xF) ^ 8) - 8            # sign-extend 4-bit two's complement
+    hi = ((q >> 4) ^ 8) - 8
+    w = jnp.concatenate([lo, hi], axis=-2).astype(jnp.float32)  # (..., K, N)
+    *lead, K, N = w.shape
+    G = s.shape[-2]
+    w = w.reshape(*lead, G, K // G, N) * s[..., None, :].astype(jnp.float32)
+    return w.reshape(*lead, K, N).astype(out_dtype)
+
+
+def _kernel4(xl_ref, xh_ref, q_ref, s_ref, o_ref, acc, *, nk2: int, bk2: int,
+             gs: int, K2: int):
+    k = pl.program_id(1)
+
+    @pl.when(k == 0)
+    def _init():
+        acc[:] = jnp.zeros_like(acc)
+
+    q = q_ref[:].astype(jnp.int32)                     # (bk2, bn) packed
+    lo = (((q & 0xF) ^ 8) - 8).astype(xl_ref.dtype)    # int4 exact in bf16
+    hi = (((q >> 4) ^ 8) - 8).astype(xl_ref.dtype)
+    pl_lo = jax.lax.dot_general(xl_ref[:], lo, (((1,), (0,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+    pl_hi = jax.lax.dot_general(xh_ref[:], hi, (((1,), (0,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+    # per-k-tile group scales applied to the partial products — exact as
+    # long as each k tile lies inside one group (enforced by the caller);
+    # s rides as a full (G, bn) block, the group row picked dynamically
+    g_lo = jax.lax.div(k * bk2, gs)
+    g_hi = jax.lax.div(K2 + k * bk2, gs)
+    s_lo = s_ref[pl.ds(g_lo, 1), :].astype(jnp.float32)
+    s_hi = s_ref[pl.ds(g_hi, 1), :].astype(jnp.float32)
+    acc[:] += pl_lo * s_lo + pl_hi * s_hi
+
+    @pl.when(k == nk2 - 1)
+    def _finalize():
+        o_ref[:] = acc[:].astype(o_ref.dtype)
+
+
+def int4_matmul(x: jax.Array, q4: jax.Array, scale: jax.Array,
+                out_dtype=None, interpret: bool = False) -> jax.Array:
+    """x (M, K) @ dequant(q4 (K/2, N), s (G, N)) -> (M, N). Each packed tile
+    dequants to TWO weight tiles in VMEM (quarter the HBM bytes of bf16)."""
+    M, K = x.shape
+    K2, N = q4.shape
+    if K != 2 * K2:
+        raise ValueError(f"x K={K} vs packed K/2={K2}")
+    G = scale.shape[0]
+    gs = K // G
+    out_dtype = out_dtype or x.dtype
+    mpad = (-M) % 8
+    if mpad:
+        x = jnp.pad(x, ((0, mpad), (0, 0)))
+    Mp = x.shape[0]
+    if K2 % 128 or N % 128:
+        raise ValueError(f"int4_matmul needs K/2,N % 128 == 0, got {K2}x{N}")
+    bk2 = _tile(K2, BK)
+    if G > 1:
+        # k tiles must not straddle group boundaries
+        bk2 = min(bk2, _tile(gs, BK))
+    bn = _tile(N, BN)
+    nk2 = K2 // bk2
+
+    out = pl.pallas_call(
+        functools.partial(_kernel4, nk2=nk2, bk2=bk2, gs=gs, K2=K2),
+        grid=(N // bn, nk2),
+        in_specs=[
+            pl.BlockSpec((Mp, bk2), lambda n, k: (0, k)),
+            pl.BlockSpec((Mp, bk2), lambda n, k: (0, k + nk2)),
+            pl.BlockSpec((bk2, bn), lambda n, k: (k, n)),
+            pl.BlockSpec((G, bn), lambda n, k: (0, n)),
+        ],
+        out_specs=pl.BlockSpec((Mp, bn), lambda n, k: (0, n)),
+        out_shape=jax.ShapeDtypeStruct((Mp, N), out_dtype),
+        scratch_shapes=[pltpu.VMEM((Mp, bn), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=interpret,
+    )(x, x, q4, scale)
+    return out[:M]
+
+
+def reference_int4_matmul(x, q4, scale, out_dtype=None):
+    """Oracle: dense unpack+dequant then matmul."""
+    out_dtype = out_dtype or x.dtype
+    w = unpack_int4(q4, scale, jnp.float32)
+    return (x.astype(jnp.float32) @ w).astype(out_dtype)

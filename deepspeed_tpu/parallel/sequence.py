@@ -85,6 +85,43 @@ def heads_spec(num_heads: int) -> Optional[P]:
     return P(DATA_SHARD, None, (SEQ_AXIS, MODEL_AXIS), None)
 
 
+def attn_out_spec(num_heads: int) -> Optional[P]:
+    """(B, S, N, D) attention OUTPUT, before the head-merge reshape: tokens
+    re-scattered over 'seq' (the Ulysses inverse all-to-all), heads kept on
+    'model' for the row-parallel wo contraction. Constraining here — on the
+    4D tensor — matters: merging N into H first leaves H sharded over
+    ('seq','model'), and the (B,S,N·D) reshape into the P(data,seq,None)
+    consumer is a sharding transition XLA can only do by full
+    rematerialisation (observed: '[SPMD] Involuntary full rematerialization'
+    in the zero3×TP×SP dryrun)."""
+    mesh = _active_mesh()
+    if mesh is None:
+        return None
+    sp = int(mesh.shape.get(SEQ_AXIS, 1))
+    tp = int(mesh.shape.get(MODEL_AXIS, 1))
+    if sp == 1 and tp == 1:
+        return None
+    if tp > 1 and num_heads % tp != 0:
+        return None
+    return P(DATA_SHARD, SEQ_AXIS, MODEL_AXIS if tp > 1 else None, None)
+
+
+def scores_spec(num_heads: int) -> Optional[P]:
+    """(B, N, S, T) attention scores/probs inside the Ulysses region: heads
+    over ('seq','model'), sequence gathered. None when SP is off or the head
+    count doesn't divide the axis product."""
+    mesh = _active_mesh()
+    if mesh is None:
+        return None
+    sp = int(mesh.shape.get(SEQ_AXIS, 1))
+    tp = int(mesh.shape.get(MODEL_AXIS, 1))
+    if sp == 1:
+        return None
+    if num_heads % max(sp * tp, 1) != 0:
+        return None
+    return P(DATA_SHARD, (SEQ_AXIS, MODEL_AXIS), None, None)
+
+
 def sequence_parallel_enabled() -> bool:
     mesh = _active_mesh()
     return mesh is not None and int(mesh.shape.get(SEQ_AXIS, 1)) > 1

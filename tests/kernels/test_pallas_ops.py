@@ -397,3 +397,54 @@ class TestInt8Matmul:
         ref = reference_int8_matmul(x, q8, s, out_dtype=jnp.float32)
         np.testing.assert_allclose(np.asarray(out, np.float32),
                                    np.asarray(ref), atol=0.5, rtol=2e-2)
+
+
+class TestInt4Matmul:
+    def test_pack_roundtrip_exact(self):
+        from deepspeed_tpu.ops import quantize_int4, unpack_int4
+
+        rng = np.random.RandomState(0)
+        w = jnp.asarray(rng.randn(512, 256), jnp.float32)
+        q4, s = quantize_int4(w, group_size=128)
+        assert q4.shape == (256, 256) and q4.dtype == jnp.uint8
+        assert s.shape == (4, 256)
+        # unpack(pack(w)) must equal the quantization grid exactly:
+        # re-quantizing the unpacked weight is a fixed point
+        w_hat = unpack_int4(q4, s, jnp.float32)
+        q4b, s_b = quantize_int4(w_hat, group_size=128)
+        np.testing.assert_array_equal(np.asarray(q4), np.asarray(q4b))
+        np.testing.assert_allclose(np.asarray(s), np.asarray(s_b), rtol=1e-6)
+        # quantization error bounded by half a step per group
+        step = np.asarray(s)[:, None, :]
+        err = np.abs(np.asarray(w_hat - w)).reshape(4, 128, 256)
+        assert (err <= step * 0.5 + 1e-7).all()
+
+    @pytest.mark.parametrize("M,K,N,gs", [(1, 512, 512, None),
+                                          (8, 1024, 768, 128),
+                                          (3, 512, 384, 256)])
+    def test_matches_reference(self, M, K, N, gs):
+        from deepspeed_tpu.ops import (int4_matmul, quantize_int4,
+                                       reference_int4_matmul)
+
+        rng = np.random.RandomState(2)
+        x = jnp.asarray(rng.randn(M, K), jnp.float32)
+        w = jnp.asarray(rng.randn(K, N) * 0.02, jnp.float32)
+        q4, s = quantize_int4(w, group_size=gs)
+        out = int4_matmul(x, q4, s, interpret=INTERPRET)
+        ref = reference_int4_matmul(x, q4, s)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-3, rtol=1e-4)
+
+    def test_unaligned_rejected(self):
+        from deepspeed_tpu.ops import int4_matmul
+
+        with pytest.raises(ValueError, match="128"):
+            int4_matmul(jnp.zeros((1, 700)),
+                        jnp.zeros((350, 300), jnp.uint8),
+                        jnp.ones((1, 300)), interpret=INTERPRET)
+
+    def test_bad_group_rejected(self):
+        from deepspeed_tpu.ops import quantize_int4
+
+        with pytest.raises(ValueError, match="group_size"):
+            quantize_int4(jnp.zeros((512, 128)), group_size=384)

@@ -32,6 +32,7 @@ def naive_greedy(model, params, prompt, n_new):
 
 
 @pytest.mark.parametrize("preset", ["tiny", "tiny-llama", "tiny-bloom", "tiny-opt"])
+@pytest.mark.slow
 def test_cache_logits_match_full_forward(preset):
     """Teacher-forced KV-cache correctness: prefill + per-token decode steps
     must reproduce the full-forward logits at every position."""
@@ -62,6 +63,7 @@ def test_cache_logits_match_full_forward(preset):
                                    err_msg=f"decode step at pos {pos}")
 
 
+@pytest.mark.slow
 def test_generate_matches_naive_loop():
     """Greedy generate == naive full-recompute loop. Token mismatches are
     accepted only at genuine fp32 near-ties (top-2 gap < 1e-4), after which
@@ -86,6 +88,7 @@ def test_generate_matches_naive_loop():
             ids = jnp.concatenate([ids, jnp.asarray([[best]], jnp.int32)], 1)
 
 
+@pytest.mark.slow
 def test_generate_positions_not_bucket_shifted():
     """Decoded tokens must take positions from the TRUE prompt length, not
     the compile bucket (regression: prompt 12 bucketed to 64 gave the first
@@ -134,6 +137,7 @@ def test_generate_eos_stops():
     assert (toks2[0, hit[0]:] == eos).all()
 
 
+@pytest.mark.slow
 def test_generate_temperature_reproducible():
     engine = init_inference("tiny", dtype=jnp.float32, max_out_tokens=128)
     prompt = np.arange(8)[None]
@@ -143,6 +147,7 @@ def test_generate_temperature_reproducible():
     assert np.asarray(a).shape == (1, 6)
 
 
+@pytest.mark.slow
 def test_ttft_reported():
     engine = init_inference("tiny", dtype=jnp.float32, max_out_tokens=128)
     out, ttft = engine.generate(np.arange(8)[None], max_new_tokens=2,
@@ -151,6 +156,7 @@ def test_ttft_reported():
     assert np.asarray(out).shape == (1, 2)
 
 
+@pytest.mark.slow
 def test_tensor_parallel_generation_matches(devices8):
     prompt = np.arange(10)[None]
     e1 = init_inference("tiny-llama", dtype=jnp.float32, max_out_tokens=128)
@@ -169,6 +175,7 @@ def test_tensor_parallel_generation_matches(devices8):
 
 
 @pytest.mark.parametrize("preset", ["tiny", "tiny-llama"])
+@pytest.mark.slow
 def test_kernel_prefill_decode_branches(preset, monkeypatch):
     """Drive the Pallas prefill/decode cache branches on CPU via interpret
     mode (on TPU they are the default; CPU normally takes the jnp path)."""
@@ -238,6 +245,7 @@ def _ours_logits(preset, hf_model, ids):
     return np.asarray(engine.forward(ids))
 
 
+@pytest.mark.slow
 def test_hf_import_gpt2():
     transformers = pytest.importorskip("transformers")
     __import__("torch").manual_seed(10)
@@ -350,6 +358,7 @@ def test_top_p_restricts_support():
     assert len(picks) > 1
 
 
+@pytest.mark.slow
 def test_generate_top_p_runs():
     engine = init_inference("tiny", dtype=jnp.float32, max_out_tokens=128)
     out = engine.generate(np.arange(8)[None], max_new_tokens=5,
@@ -366,6 +375,7 @@ def test_top_p_zero_is_greedy():
     assert picks == {0}
 
 
+@pytest.mark.slow
 class TestInt8WeightOnly:
     """Weight-only quantized inference (reference init_inference dtype=int8
     kernel-injection mode): storage halves, logits stay close, generate is
@@ -412,3 +422,52 @@ class TestInt8WeightOnly:
         with pytest.raises(NotImplementedError, match="tensor_parallel"):
             init_inference("tiny-llama", dtype="int8", tensor_parallel=2,
                            max_out_tokens=128)
+
+
+@pytest.mark.slow
+class TestInt4WeightOnly:
+    """4-bit weight-only inference (reference 4-bit groupwise quantizer
+    kernels, csrc/includes/quantization_utils.h:468): storage quarters,
+    logits stay close, generate is self-consistent."""
+
+    def test_logits_close_and_storage_quartered(self):
+        e16 = init_inference("tiny", dtype=jnp.bfloat16, max_out_tokens=128)
+        e4 = init_inference("tiny", dtype="int4", max_out_tokens=128,
+                            config={"quantize_groups": 32, "dtype": "int4"})
+        assert e4.config.quantize_bits == 4
+        from deepspeed_tpu.models.transformer import quantize_model_weights
+
+        e4.params = jax.jit(lambda p: quantize_model_weights(
+            p, bits=4, group_size=32))(e16.params)
+
+        prompt = np.random.RandomState(0).randint(0, 250, size=(2, 16))
+        l16 = np.asarray(e16.forward(prompt), np.float32)
+        l4 = np.asarray(e4.forward(prompt), np.float32)
+        cos = (l16.ravel() @ l4.ravel()) / (
+            np.linalg.norm(l16) * np.linalg.norm(l4))
+        assert cos > 0.97, f"cosine {cos}"
+
+        def matmul_bytes(tree):
+            return sum(x.size * x.dtype.itemsize
+                       for x in jax.tree.leaves(tree))
+
+        w16 = matmul_bytes(e16.params["layers"]["attn"])
+        w4 = matmul_bytes(e4.params["layers"]["attn"])
+        assert w4 < 0.40 * w16          # packed nibbles + scales + biases
+
+    def test_generate_self_consistent(self):
+        engine = init_inference("tiny", dtype="int4", max_out_tokens=128)
+        prompt = np.random.RandomState(1).randint(0, 250, size=(1, 12))
+        got = np.asarray(engine.generate(prompt, max_new_tokens=6))
+        ids = jnp.asarray(prompt, jnp.int32)
+        for i in range(6):
+            logits, _ = engine.model.apply(engine.params, {"input_ids": ids})
+            best = int(np.asarray(logits[0, -1], np.float32).argmax())
+            assert got[0, i] == best, f"step {i}"
+            ids = jnp.concatenate([ids, jnp.asarray([[best]], jnp.int32)], 1)
+
+    def test_groups_require_int4(self):
+        from deepspeed_tpu.inference.engine import InferenceConfig
+
+        with pytest.raises(ValueError, match="int4"):
+            InferenceConfig(dtype="int8", quantize_groups=64)
