@@ -217,7 +217,8 @@ def _hashable(v):
     try:
         hash(v)
     except TypeError:
-        _PLAN_CACHE_PINS.append(v)
+        if not any(p is v for p in _PLAN_CACHE_PINS):
+            _PLAN_CACHE_PINS.append(v)
         return ("__id__", id(v))
     return v
 

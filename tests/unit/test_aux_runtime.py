@@ -5,6 +5,7 @@ progressive_layer_drop.py, utils/tensor_fragment.py)."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 import deepspeed_tpu
 from deepspeed_tpu.models import create_model
@@ -67,6 +68,7 @@ class TestProgressiveLayerDrop:
         assert pld.layer_keep_prob(0, 12) > pld.layer_keep_prob(11, 12)
 
 
+@pytest.mark.slow
 class TestTensorFragment:
     def _engine(self, zero=3):
         model = create_model("tiny", dtype=jnp.bfloat16)
@@ -111,6 +113,7 @@ class TestTensorFragment:
         assert full.shape[0] == 256
 
 
+@pytest.mark.slow
 class TestPLDIntegration:
     def _engine(self, enabled, theta=0.5, gamma=0.0):
         from deepspeed_tpu.parallel import mesh as mesh_mod

@@ -126,6 +126,7 @@ class TestRandomLTD:
         noop_out, _ = noop.apply(params, {"input_ids": ids})
         np.testing.assert_array_equal(np.asarray(noop_out), np.asarray(base))
 
+    @pytest.mark.slow
     def test_engine_schedule_drives_keep(self):
         import deepspeed_tpu
         from deepspeed_tpu.models import create_model

@@ -59,6 +59,7 @@ def test_zero_stage_matches_stage0(stage):
     jax.tree.map(lambda a, b: np.testing.assert_allclose(a, b, atol=1e-5), p0, ps)
 
 
+@pytest.mark.slow
 def test_gradient_accumulation_equivalence():
     """gas=2 with micro_batch b must equal gas=1 with batch 2b (same samples) —
     the reference's GAS contract."""
@@ -114,6 +115,7 @@ def test_fp16_overflow_skips_step():
                  params_before, params_after)
 
 
+@pytest.mark.slow
 def test_forward_backward_step_api_matches_train_batch():
     """The reference three-call protocol must produce the same params as the
     fused train_batch path."""
@@ -133,6 +135,7 @@ def test_forward_backward_step_api_matches_train_batch():
         jax.device_get(e1.params), jax.device_get(e2.params))
 
 
+@pytest.mark.slow
 def test_transformer_zero3_trains():
     model = create_model("tiny")
     engine = _make_engine(zero_stage=3, model=model,
@@ -211,6 +214,7 @@ def test_dataloader():
     assert e1 != e2
 
 
+@pytest.mark.slow
 def test_curriculum_seqlen_truncates(tmp_path):
     from deepspeed_tpu.models import create_model
 
@@ -233,6 +237,7 @@ def test_curriculum_seqlen_truncates(tmp_path):
     assert engine._curriculum.current_difficulty == 32
 
 
+@pytest.mark.slow
 def test_compression_schedule_kicks_in():
     from deepspeed_tpu.models import create_model
 

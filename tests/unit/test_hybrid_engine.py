@@ -5,10 +5,14 @@ training updates, ZeRO-3/pipelined layouts flip correctly."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from deepspeed_tpu.models import create_model
 from deepspeed_tpu.runtime.hybrid_engine import HybridEngine
 from deepspeed_tpu.config.config import load_config
+
+pytestmark = pytest.mark.slow  # heavy virtual-mesh trajectory tests
+
 
 
 def _hybrid(zero=0, pp=1, **cfg_extra):

@@ -10,6 +10,9 @@ import deepspeed_tpu
 from deepspeed_tpu.models import create_model
 from deepspeed_tpu.parallel.moe import top1gating, top2gating, _capacity
 
+pytestmark = pytest.mark.slow  # heavy virtual-mesh trajectory tests
+
+
 
 def _engine(preset="tiny", tp=1, sp=1, ep=1, zero=0, gas=1,
             sequence_parallel_impl="ulysses", **model_kw):

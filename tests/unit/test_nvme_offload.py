@@ -14,8 +14,9 @@ import deepspeed_tpu
 from deepspeed_tpu.models import create_model
 from deepspeed_tpu.ops.aio import aio_compatible
 
-pytestmark = pytest.mark.skipif(not aio_compatible(),
-                                reason="aio extension needs g++")
+pytestmark = [pytest.mark.skipif(not aio_compatible(),
+                                 reason="aio extension needs g++"),
+              pytest.mark.slow]
 
 
 def _cfg(tmp_path, nvme: bool, clip=0.0):

@@ -16,6 +16,9 @@ from deepspeed_tpu.comm.compressed import (compressed_allreduce_flat,
 from deepspeed_tpu.models import create_model
 from deepspeed_tpu.parallel import mesh as mesh_mod
 
+pytestmark = pytest.mark.slow  # heavy virtual-mesh trajectory tests
+
+
 
 class TestCompressedAllreduce:
     def _run(self, per_rank, worker=None, server=None):

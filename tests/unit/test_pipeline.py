@@ -17,6 +17,8 @@ from deepspeed_tpu.parallel.schedule import (BackwardPass, ForwardPass,
                                              InferenceSchedule, LoadMicroBatch,
                                              OptimizerStep, TrainSchedule)
 
+pytestmark = pytest.mark.slow  # heavy virtual-mesh trajectory tests
+
 
 class TestSchedules:
     def test_train_schedule_length(self):

@@ -117,6 +117,7 @@ class TestBlockSkipKernel:
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    atol=2e-5, rtol=2e-5)
 
+    @pytest.mark.slow
     def test_gradients_match_dense_oracle(self):
         cfg = FixedSparsityConfig(num_heads=2, block=32, num_local_blocks=2,
                                   num_global_blocks=1,
@@ -151,6 +152,7 @@ class TestBlockSkipKernel:
         # plan is cached per (config, S)
         assert tile_plan_for(cfg, 1024) is plan
 
+    @pytest.mark.slow
     def test_empty_layout_row_outputs_zero(self):
         # A q-tile with NO active k-tiles must produce output 0 and zero
         # gradients. The padded slot list still visits the all-zero mask id,

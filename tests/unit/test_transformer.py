@@ -46,6 +46,7 @@ def test_causality(model):
                            np.asarray(logits2[:, -1], np.float32))
 
 
+@pytest.mark.slow
 def test_kv_cache_matches_full_forward(model):
     """Prefill + token-by-token decode must reproduce the full forward — the
     correctness contract of the reference's KV-cache kernels
@@ -91,6 +92,7 @@ def test_padding_mask(model):
                                np.asarray(logits2[:, :4], np.float32), atol=1e-5)
 
 
+@pytest.mark.slow
 def test_loss_decreases_with_training():
     model = create_model("tiny")
     params = model.init(jax.random.PRNGKey(0))
@@ -114,6 +116,7 @@ def test_cross_entropy_ignore_index():
     assert float(loss) == pytest.approx(np.log(10), rel=1e-5)
 
 
+@pytest.mark.slow
 def test_remat_matches(model):
     cfg_remat = TransformerConfig(**{**model.config.__dict__, "remat": True})
     m2 = build_model(cfg_remat)
@@ -140,6 +143,7 @@ def test_param_specs_tp_and_fsdp(model):
     assert d[tok_key] == P("model", "data")
 
 
+@pytest.mark.slow
 def test_param_count_presets():
     m = create_model("gpt2-125m")
     params = m.init(jax.random.PRNGKey(0))
@@ -147,6 +151,7 @@ def test_param_count_presets():
     assert 115e6 < n < 135e6  # ~124M
 
 
+@pytest.mark.slow
 class TestDropout:
     """cfg.dropout applies at embed/attn-out/mlp-out when the train engine
     enables it; eval and decode stay deterministic (reference transformer
