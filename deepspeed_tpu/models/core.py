@@ -66,6 +66,11 @@ class Model:
     # (dropout, random-LTD) disabled via a config COPY — engines must not
     # toggle shared config state to get eval behavior
     eval_loss_fn: Optional[Callable[..., Any]] = None
+    # (rng, lo, blen) -> layers subtree for layers [lo, lo+blen), identical
+    # to the corresponding slice of init(rng)["layers"] — lets the ZeRO-3
+    # param-offload tier initialise one block at a time without ever
+    # materialising the full stack
+    init_layer_block: Optional[Callable[..., Any]] = None
 
 
 # ---------------------------------------------------------------------------

@@ -112,87 +112,115 @@ def eval_config(cfg: TransformerConfig) -> TransformerConfig:
 
 
 def init_params(rng: jax.Array, cfg: TransformerConfig) -> Dict[str, Any]:
-    H, L = cfg.hidden_size, cfg.num_layers
-    N, K, D, F, V = (cfg.num_heads, cfg.num_kv_heads, cfg.head_dim,
-                     cfg.ffn_hidden_size, cfg.vocab_size)
-    keys = iter(jax.random.split(rng, 16))
+    H = cfg.hidden_size
+    N, K, D, V = (cfg.num_heads, cfg.num_kv_heads, cfg.head_dim,
+                  cfg.vocab_size)
+    # fixed key slots (branch-independent): 0 embed, 1 pos, 2 layers base,
+    # 3 lm_head — the layers base key feeds init_layer_params, which draws
+    # per (leaf, layer) via fold_in so any layer RANGE can be initialised
+    # without materialising the full stack (the param-offload tier streams
+    # block inits; slicing a whole-leaf draw kept the full RNG pipeline
+    # live in HBM)
+    ks = jax.random.split(rng, 16)
     std = 0.02
-    # GPT-2-style scaled init on residual-writing projections
-    resid_std = std / (2 * L) ** 0.5
 
     def normal(key, shape, s=std):
         return (jax.random.normal(key, shape, jnp.float32) * s).astype(cfg.dtype)
 
     params: Dict[str, Any] = {
-        "embed": {"tokens": normal(next(keys), (V, H))},
+        "embed": {"tokens": normal(ks[0], (V, H))},
     }
     if cfg.position == "learned":
-        params["pos"] = normal(next(keys), (cfg.max_seq_len, H), 0.01)
+        params["pos"] = normal(ks[1], (cfg.max_seq_len, H), 0.01)
     if cfg.embed_norm:
         params["embed_norm"] = {"scale": jnp.ones((H,), cfg.dtype),
                                 "bias": jnp.zeros((H,), cfg.dtype)}
 
-    layers: Dict[str, Any] = {
-        "ln1": {"scale": jnp.ones((L, H), cfg.dtype)},
-        "ln2": {"scale": jnp.ones((L, H), cfg.dtype)},
-        "attn": {
-            "wq": normal(next(keys), (L, H, N * D)),
-            "wk": normal(next(keys), (L, H, K * D)),
-            "wv": normal(next(keys), (L, H, K * D)),
-            "wo": normal(next(keys), (L, N * D, H), resid_std),
-        },
-    }
-    E = cfg.moe_num_experts
-    if E > 0:
-        layers["router"] = normal(next(keys), (L, H, E))
-        if cfg.moe_use_residual:
-            layers["res_mlp"] = {
-                "w_up": normal(next(keys), (L, H, F)),
-                "b_up": jnp.zeros((L, F), cfg.dtype),
-                "w_down": normal(next(keys), (L, F, H), resid_std),
-                "b_down": jnp.zeros((L, H), cfg.dtype),
-            }
-            layers["res_coef"] = {"w": normal(next(keys), (L, H, 2)),
-                                  "b": jnp.zeros((L, 2), cfg.dtype)}
-        if cfg.activation == "swiglu":
-            layers["mlp"] = {
-                "w_gate": normal(next(keys), (L, E, H, F)),
-                "w_up": normal(next(keys), (L, E, H, F)),
-                "w_down": normal(next(keys), (L, E, F, H), resid_std),
-            }
-        else:
-            layers["mlp"] = {
-                "w_up": normal(next(keys), (L, E, H, F)),
-                "w_down": normal(next(keys), (L, E, F, H), resid_std),
-            }
-    elif cfg.activation == "swiglu":
-        layers["mlp"] = {
-            "w_gate": normal(next(keys), (L, H, F)),
-            "w_up": normal(next(keys), (L, H, F)),
-            "w_down": normal(next(keys), (L, F, H), resid_std),
-        }
-    else:
-        layers["mlp"] = {
-            "w_up": normal(next(keys), (L, H, F)),
-            "b_up": jnp.zeros((L, F), cfg.dtype),
-            "w_down": normal(next(keys), (L, F, H), resid_std),
-            "b_down": jnp.zeros((L, H), cfg.dtype),
-        }
-    if cfg.norm == "layernorm":
-        layers["ln1"]["bias"] = jnp.zeros((L, H), cfg.dtype)
-        layers["ln2"]["bias"] = jnp.zeros((L, H), cfg.dtype)
-        layers["attn"]["bq"] = jnp.zeros((L, N * D), cfg.dtype)
-        layers["attn"]["bk"] = jnp.zeros((L, K * D), cfg.dtype)
-        layers["attn"]["bv"] = jnp.zeros((L, K * D), cfg.dtype)
-        layers["attn"]["bo"] = jnp.zeros((L, H), cfg.dtype)
-    params["layers"] = layers
+    params["layers"] = init_layer_params(ks[2], cfg, 0, cfg.num_layers)
 
     params["final_norm"] = {"scale": jnp.ones((H,), cfg.dtype)}
     if cfg.norm == "layernorm":
         params["final_norm"]["bias"] = jnp.zeros((H,), cfg.dtype)
     if not cfg.tie_embeddings:
-        params["lm_head"] = normal(next(keys), (H, V))
+        params["lm_head"] = normal(ks[3], (H, V))
     return params
+
+
+def init_layer_params(base_key: jax.Array, cfg: TransformerConfig,
+                      lo: Any, blen: int) -> Dict[str, Any]:
+    """Layer-stack params for layers [lo, lo+blen): leaves shaped
+    (blen, ...). Draws are per (leaf, layer) — ``fold_in(fold_in(base, tag),
+    layer_idx)`` — so ANY range reproduces exactly the same values the full
+    init produces (ZeRO-3 param offload inits one block at a time)."""
+    H, L = cfg.hidden_size, cfg.num_layers
+    N, K, D, F = (cfg.num_heads, cfg.num_kv_heads, cfg.head_dim,
+                  cfg.ffn_hidden_size)
+    std = 0.02
+    # GPT-2-style scaled init on residual-writing projections
+    resid_std = std / (2 * L) ** 0.5
+    E = cfg.moe_num_experts
+
+    def one_layer(li):
+        def normal(tag, shape, s=std):
+            k = jax.random.fold_in(jax.random.fold_in(base_key, tag), li)
+            return (jax.random.normal(k, shape, jnp.float32) * s
+                    ).astype(cfg.dtype)
+
+        layer: Dict[str, Any] = {
+            "ln1": {"scale": jnp.ones((H,), cfg.dtype)},
+            "ln2": {"scale": jnp.ones((H,), cfg.dtype)},
+            "attn": {
+                "wq": normal(0, (H, N * D)),
+                "wk": normal(1, (H, K * D)),
+                "wv": normal(2, (H, K * D)),
+                "wo": normal(3, (N * D, H), resid_std),
+            },
+        }
+        if E > 0:
+            layer["router"] = normal(4, (H, E))
+            if cfg.moe_use_residual:
+                layer["res_mlp"] = {
+                    "w_up": normal(5, (H, F)),
+                    "b_up": jnp.zeros((F,), cfg.dtype),
+                    "w_down": normal(6, (F, H), resid_std),
+                    "b_down": jnp.zeros((H,), cfg.dtype),
+                }
+                layer["res_coef"] = {"w": normal(7, (H, 2)),
+                                     "b": jnp.zeros((2,), cfg.dtype)}
+            if cfg.activation == "swiglu":
+                layer["mlp"] = {
+                    "w_gate": normal(8, (E, H, F)),
+                    "w_up": normal(9, (E, H, F)),
+                    "w_down": normal(10, (E, F, H), resid_std),
+                }
+            else:
+                layer["mlp"] = {
+                    "w_up": normal(9, (E, H, F)),
+                    "w_down": normal(10, (E, F, H), resid_std),
+                }
+        elif cfg.activation == "swiglu":
+            layer["mlp"] = {
+                "w_gate": normal(8, (H, F)),
+                "w_up": normal(9, (H, F)),
+                "w_down": normal(10, (F, H), resid_std),
+            }
+        else:
+            layer["mlp"] = {
+                "w_up": normal(9, (H, F)),
+                "b_up": jnp.zeros((F,), cfg.dtype),
+                "w_down": normal(10, (F, H), resid_std),
+                "b_down": jnp.zeros((H,), cfg.dtype),
+            }
+        if cfg.norm == "layernorm":
+            layer["ln1"]["bias"] = jnp.zeros((H,), cfg.dtype)
+            layer["ln2"]["bias"] = jnp.zeros((H,), cfg.dtype)
+            layer["attn"]["bq"] = jnp.zeros((N * D,), cfg.dtype)
+            layer["attn"]["bk"] = jnp.zeros((K * D,), cfg.dtype)
+            layer["attn"]["bv"] = jnp.zeros((K * D,), cfg.dtype)
+            layer["attn"]["bo"] = jnp.zeros((H,), cfg.dtype)
+        return layer
+
+    return jax.vmap(one_layer)(lo + jnp.arange(blen))
 
 
 def param_axes(cfg: TransformerConfig) -> Dict[str, Any]:
@@ -908,6 +936,10 @@ def build_model(cfg: TransformerConfig, name: str = "transformer") -> Model:
 
         return loss_fn
 
+    def init_layer_block(rng, lo, blen):
+        return init_layer_params(jax.random.split(rng, 16)[2], cfg, lo, blen)
+
     return Model(init=init, apply=apply, loss_fn=make_loss(cfg),
                  eval_loss_fn=make_loss(eval_config(cfg)),
+                 init_layer_block=init_layer_block,
                  axes=param_axes(cfg), config=cfg, name=name)

@@ -248,29 +248,18 @@ class TrainEngine:
 
         self._param_offload = None
         if self._param_offload_tier != "none":
-            # init must never materialise the full tree in HBM (the point is
-            # params > HBM): on an accelerator, compute on device but stream
-            # each leaf to pinned host memory; on the CPU backend (tests) a
-            # plain jit is already host-resident
-            if jax.default_backend() == "cpu":
-                with self.mesh:
-                    host_params = jax.jit(_init_cast)(rng)
-            else:
-                host_sh = jax.tree.map(
-                    lambda s: s.with_memory_kind("pinned_host"),
-                    self.param_shardings)
-                with self.mesh:
-                    host_params = jax.jit(_init_cast,
-                                          out_shardings=host_sh)(rng)
-            host_params = jax.tree.map(lambda x: np.asarray(x), host_params)
+            # the executor owns materialisation: init must never hold the
+            # full tree in HBM (the point is params > HBM) — on accelerators
+            # it inits on device and streams each block to pinned host; on
+            # the CPU backend (tests) a plain jit is already host-resident
             from .param_offload import ParamOffloadExecutor
 
             self._param_offload = ParamOffloadExecutor(
                 model, self.mesh, self.plan, self.config,
                 lr_schedule=self.optimizer.lr_schedule,
-                host_params=host_params, compute_dtype=self.compute_dtype)
-            self._n_params = sum(int(np.prod(np.shape(l)))
-                                 for l in jax.tree.leaves(host_params))
+                init_fn=_init_cast, rng=rng,
+                compute_dtype=self.compute_dtype)
+            self._n_params = self._param_offload.n_params
             self.params = None
         else:
             with self.mesh:

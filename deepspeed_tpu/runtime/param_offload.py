@@ -27,11 +27,18 @@ Every block shares one compiled fwd/vjp/update executable (identical shapes;
 the remainder block adds at most one more trace). Peak HBM = resident params
 + ≤2 streamed blocks + G boundary activations — independent of L.
 
-fp32 master weights + Adam moments for the streamed layers live on the host
-(12 bytes/param, the ZeRO "P_os+g" taxonomy) as numpy views over the same
-storage the engine exposes as ``params["layers"]``; the ``nvme`` tier keeps
-the bf16 param blocks in aio-written files instead (one flat file per block)
-with read-ahead on the swap-in path.
+Storage backends for the off-device state (bf16 params + fp32 master/moments,
+14 bytes/param):
+
+* ``pinned`` (default on accelerator backends): per-block jax arrays with
+  ``memory_kind='pinned_host'`` — DEVICE-ADJACENT host RAM. Fetch is a
+  PCIe-speed ``device_put`` between memory spaces; the update jit writes its
+  outputs straight back to pinned host via ``out_shardings``, so the Python
+  process never touches the bytes. This matters doubly on a tunneled dev
+  chip, where a numpy round-trip would cross the network.
+* ``np`` (CPU backend — tests — and the bf16 params of the nvme tier):
+  plain numpy, mutated in place; the nvme tier stages the param blocks
+  through aio-written flat files (one per block) with read-ahead.
 """
 
 from __future__ import annotations
@@ -66,6 +73,21 @@ def _safe_sharding(mesh, spec: P, shape: Tuple[int, ...]) -> NamedSharding:
         size = int(np.prod([mesh.shape[n] for n in names]))
         out.append(a if dim % size == 0 else None)
     return NamedSharding(mesh, P(*out))
+
+
+def pinned_host_supported() -> bool:
+    """True when the backend can run the pinned-host streaming path. The XLA
+    CPU backend nominally exposes the memory kind but its SPMD partitioner
+    rejects the placement annotations (RET_CHECK has_sharding, observed on
+    the 8-device virtual mesh) — tests exercise the numpy backend instead;
+    measured on the attached v5e: pinned↔HBM moves at 400-800 GB/s."""
+    if jax.default_backend() == "cpu":
+        return False
+    try:
+        jax.devices()[0].memory("pinned_host")
+        return True
+    except Exception:
+        return False
 
 
 class _NVMeParamStore:
@@ -139,7 +161,7 @@ class ParamOffloadExecutor:
     the engine delegates train/eval/checkpoint to it."""
 
     def __init__(self, model, mesh, plan, config, *, lr_schedule: Callable,
-                 host_params: Any, compute_dtype):
+                 init_fn: Callable, rng, compute_dtype):
         cfg = model.config
         if cfg is None:
             raise ValueError("offload_param requires a transformer Model")
@@ -164,79 +186,221 @@ class ParamOffloadExecutor:
         self.grad_clip = float(config.gradient_clipping or 0.0)
         self.gas = config.gradient_accumulation_steps
         self.step_count = 0
+        # pinned-host storage whenever the backend has the memory kind; the
+        # nvme tier needs numpy buffers for the aio files
+        self._pinned = (self.device_tier == "cpu" and pinned_host_supported())
 
-        # -- split: layer leaves vs resident ------------------------------
-        layers_tree = host_params["layers"]
-        kv, self._layers_treedef = _tree_leaves_with_path(layers_tree)
-        self._layer_paths = [jax.tree_util.keystr(p) for p, _ in kv]
-        # np.array (copy): leaves arriving as np views over jax buffers are
-        # read-only, and this storage is updated in place every step
-        layer_leaves = [np.array(l) for _, l in kv]
-        L = int(layer_leaves[0].shape[0])
+        # -- shapes / block split (no materialisation yet) -----------------
+        shapes = jax.eval_shape(init_fn, rng)
+        kv_shapes, self._layers_treedef = _tree_leaves_with_path(
+            shapes["layers"])
+        layer_shapes = [l for _, l in kv_shapes]
+        L = int(layer_shapes[0].shape[0])
         self.num_layers = L
-        bytes_per_layer = sum(l.nbytes // L for l in layer_leaves)
+        bytes_per_layer = sum(
+            int(np.prod(l.shape)) * l.dtype.itemsize // L
+            for l in layer_shapes)
         per = max(1, int(zo.offload_param.buffer_size) // max(bytes_per_layer, 1))
         self.layers_per_block = min(L, per)
         self.num_blocks = -(-L // self.layers_per_block)
         self._bounds = [(g * self.layers_per_block,
                          min((g + 1) * self.layers_per_block, L))
                         for g in range(self.num_blocks)]
+        self.n_params = sum(int(np.prod(l.shape))
+                            for l in jax.tree.leaves(shapes))
 
-        # host storage: bf16 layer params (cpu tier: these ARE the arrays the
-        # engine exposes as params["layers"]; nvme tier: staged to files)
-        self._host_layers: Optional[List[np.ndarray]] = layer_leaves
-        self._store: Optional[_NVMeParamStore] = None
-        if self.device_tier == "nvme":
-            self._store = _NVMeParamStore(
-                os.path.join(zo.offload_param.nvme_path,
-                             f"dstpu_param_swap_p{jax.process_index()}"),
-                aio_config={"block_size": config.aio.block_size,
-                            "queue_depth": config.aio.queue_depth,
-                            "thread_count": config.aio.thread_count})
-            for g, (lo, hi) in enumerate(self._bounds):
-                self._store.write_block(
-                    g, [l[lo:hi] for l in layer_leaves], wait=False)
-            self._store.flush()
-            self._host_layers = None      # files own the bf16 params now
-
-        # fp32 optimizer state for the streamed layers (host, always)
-        self._master = [l.astype(np.float32) for l in layer_leaves]
-        self._m = [np.zeros_like(x) for x in self._master]
-        self._v = [np.zeros_like(x) for x in self._master]
-        self._acc: Optional[List[np.ndarray]] = None    # gas>1 grad accum
-
-        # resident (embed/pos/norm/head): device arrays + device fp32 state
-        self.resident = {k: v for k, v in host_params.items() if k != "layers"}
+        # resident / block shardings
+        res_shapes = {k: v for k, v in shapes.items() if k != "layers"}
         res_specs = {k: v for k, v in plan.param_specs.items() if k != "layers"}
         self._res_shardings = jax.tree.map(
-            lambda x, s: _safe_sharding(mesh, s, np.shape(x)),
-            self.resident, res_specs)
-        self.resident = jax.tree.map(
-            lambda x, s: jax.device_put(x, s), self.resident, self._res_shardings)
-        self._res_master = jax.tree.map(
-            lambda x: jnp.asarray(x, jnp.float32), self.resident)
-        self._res_m = jax.tree.map(jnp.zeros_like, self._res_master)
-        self._res_v = jax.tree.map(jnp.zeros_like, self._res_master)
-
-        # block device shardings: the layers specs applied to an (Lb, ...)
-        # slice; non-leading dims are identical across blocks, the leading
-        # (layer) dim is never sharded, so one set serves every block
+            lambda x, s: _safe_sharding(mesh, s, tuple(x.shape)),
+            res_shapes, res_specs)
         layer_specs = [s for _, s in _tree_leaves_with_path(
             plan.param_specs["layers"])[0]]
+        # non-leading dims are identical across blocks and the leading
+        # (layer) dim is never sharded, so one set serves every block
         self._block_shardings = [
             _safe_sharding(mesh, s,
                            (self.layers_per_block,) + tuple(l.shape[1:]))
-            for s, l in zip(layer_specs, layer_leaves)]
+            for s, l in zip(layer_specs, layer_shapes)]
+        if self._pinned:
+            self._pinned_shardings = [
+                s.with_memory_kind("pinned_host")
+                for s in self._block_shardings]
+
+        # -- materialise params + optimizer state --------------------------
+        G = self.num_blocks
+        if self._pinned:
+            # per-BLOCK init jits: each call draws the model init and keeps
+            # only one block's slice (dynamic offset → one compiled program
+            # serves every full block; XLA fuses the slice into the RNG, so
+            # neither the full tree nor a full leaf set is ever live in HBM;
+            # a single whole-tree init jit OOMed at 7B with all the host
+            # transfers in flight). The slices are bit-identical to the
+            # resident engine's init — same key, same draws.
+            def init_res(key):
+                params = init_fn(key)
+                resident = {k: v for k, v in params.items() if k != "layers"}
+                res_master = jax.tree.map(
+                    lambda x: x.astype(jnp.float32), resident)
+                return resident, res_master
+
+            pin = list(self._pinned_shardings)
+            with mesh:
+                self.resident, self._res_master = jax.jit(
+                    init_res,
+                    out_shardings=(self._res_shardings,
+                                   self._res_shardings))(rng)
+                self._pblocks, self._pmaster, self._pm, self._pv = (
+                    [], [], [], [])
+                if model.init_layer_block is not None:
+                    # per-block init via the model's layer-range hook: peak
+                    # HBM = one block of layers (dynamic lo → one compiled
+                    # program for all full blocks)
+                    from ..models.core import cast_floating
+
+                    def init_block(key, lo, blen: int):
+                        tree = cast_floating(
+                            model.init_layer_block(key, lo, blen),
+                            self.compute_dtype)
+                        blk = [l for _, l in _tree_leaves_with_path(tree)[0]]
+                        ma = [b.astype(jnp.float32) for b in blk]
+                        z = [jnp.zeros(b.shape, jnp.float32) for b in blk]
+                        return blk, ma, z, [x for x in z]
+
+                    fn = jax.jit(init_block, static_argnums=(2,),
+                                 out_shardings=(pin, pin, pin, pin))
+                    for lo, hi in self._bounds:
+                        blk, ma, m_, v_ = fn(rng, lo, hi - lo)
+                        self._pblocks.append(list(blk))
+                        self._pmaster.append(list(ma))
+                        self._pm.append(list(m_))
+                        self._pv.append(list(v_))
+                else:
+                    # fallback for custom Models: per-leaf dynamic-slice
+                    # programs — only the selected leaf survives DCE, so
+                    # peak HBM = one full leaf's init pipeline
+                    def init_leaf_block(key, lo, leaf_idx: int, blen: int):
+                        params = init_fn(key)
+                        leaves = [l for _, l in _tree_leaves_with_path(
+                            params["layers"])[0]]
+                        b = jax.lax.dynamic_slice_in_dim(
+                            leaves[leaf_idx], lo, blen, axis=0)
+                        ma = b.astype(jnp.float32)
+                        z = jnp.zeros(b.shape, jnp.float32)
+                        return b, ma, z, z
+
+                    wrappers = [
+                        jax.jit(init_leaf_block, static_argnums=(2, 3),
+                                out_shardings=(psh, psh, psh, psh))
+                        for psh in self._pinned_shardings]
+                    for lo, hi in self._bounds:
+                        blk, ma, m_, v_ = [], [], [], []
+                        for i, fn in enumerate(wrappers):
+                            b, a, mm, vv = fn(rng, lo, i, hi - lo)
+                            blk.append(b)
+                            ma.append(a)
+                            m_.append(mm)
+                            v_.append(vv)
+                        self._pblocks.append(blk)
+                        self._pmaster.append(ma)
+                        self._pm.append(m_)
+                        self._pv.append(v_)
+            self._host_layers = None
+            self._master = self._m = self._v = None
+            self._store = None
+        else:
+            # numpy backend (CPU tests / nvme file tier)
+            if jax.default_backend() == "cpu":
+                # CPU: a plain jit is host-resident already
+                with mesh:
+                    params = jax.jit(init_fn)(rng)
+                kv, _ = _tree_leaves_with_path(params["layers"])
+                # np.array (copy): np views over jax buffers are read-only,
+                # and this storage is updated in place every step
+                layer_leaves = [np.array(l) for _, l in kv]
+                resident_dev = {k: v for k, v in params.items()
+                                if k != "layers"}
+            elif model.init_layer_block is not None:
+                # accelerator + nvme tier: per-block init on device,
+                # device_get to np — never the full tree in HBM
+                from ..models.core import cast_floating
+
+                def res_only(key):
+                    params = init_fn(key)
+                    return {k: v for k, v in params.items() if k != "layers"}
+
+                def blk_init(key, lo, blen: int):
+                    tree = cast_floating(
+                        model.init_layer_block(key, lo, blen),
+                        self.compute_dtype)
+                    return [l for _, l in _tree_leaves_with_path(tree)[0]]
+
+                with mesh:
+                    resident_dev = jax.jit(
+                        res_only, out_shardings=self._res_shardings)(rng)
+                    fn = jax.jit(blk_init, static_argnums=(2,))
+                    layer_leaves = [
+                        np.empty((L,) + tuple(l.shape[1:]),
+                                 jnp.dtype(l.dtype))
+                        for l in layer_shapes]
+                    for lo, hi in self._bounds:
+                        for dst, src in zip(layer_leaves,
+                                            jax.device_get(
+                                                fn(rng, lo, hi - lo))):
+                            dst[lo:hi] = np.asarray(src)
+            else:
+                # custom Model on an accelerator: stream the whole-tree init
+                # to pinned host, then pull to np (one-time cost)
+                host_sh = jax.tree.map(
+                    lambda s: s.with_memory_kind("pinned_host"),
+                    {"layers": jax.tree_util.tree_unflatten(
+                        self._layers_treedef,
+                        [_safe_sharding(mesh, s, tuple(l.shape))
+                         for s, l in zip(layer_specs, layer_shapes)]),
+                     **self._res_shardings})
+                with mesh:
+                    params = jax.jit(init_fn, out_shardings=host_sh)(rng)
+                kv, _ = _tree_leaves_with_path(params["layers"])
+                layer_leaves = [np.array(l) for _, l in kv]
+                resident_dev = jax.tree.map(
+                    lambda x, s: jax.device_put(np.asarray(x), s),
+                    {k: v for k, v in params.items() if k != "layers"},
+                    self._res_shardings)
+            self._host_layers: Optional[List[np.ndarray]] = layer_leaves
+            self._store: Optional[_NVMeParamStore] = None
+            if self.device_tier == "nvme":
+                self._store = _NVMeParamStore(
+                    os.path.join(zo.offload_param.nvme_path,
+                                 f"dstpu_param_swap_p{jax.process_index()}"),
+                    aio_config={"block_size": config.aio.block_size,
+                                "queue_depth": config.aio.queue_depth,
+                                "thread_count": config.aio.thread_count})
+                for g, (lo, hi) in enumerate(self._bounds):
+                    self._store.write_block(
+                        g, [l[lo:hi] for l in layer_leaves], wait=False)
+                self._store.flush()
+                self._host_layers = None   # files own the bf16 params now
+            self._master = [l.astype(np.float32) for l in layer_leaves]
+            self._m = [np.zeros_like(x) for x in self._master]
+            self._v = [np.zeros_like(x) for x in self._master]
+            self.resident = jax.tree.map(
+                lambda x, s: jax.device_put(x, s), resident_dev,
+                self._res_shardings)
+            self._res_master = jax.tree.map(
+                lambda x: jnp.asarray(x, jnp.float32), self.resident)
+        self._res_m = jax.tree.map(jnp.zeros_like, self._res_master)
+        self._res_v = jax.tree.map(jnp.zeros_like, self._res_master)
+        self._acc = None                  # gas>1 grad accumulators (lazy)
 
         self._build_step_fns(model)
-        tier = self.device_tier
+        state_gb = self.n_params * 14 / 1e9
         logger.info(
-            f"param offload ({tier}): {L} layers in {self.num_blocks} blocks "
-            f"of {self.layers_per_block} "
+            f"param offload ({self.device_tier}"
+            f"{'/pinned' if self._pinned else ''}): {L} layers in "
+            f"{self.num_blocks} blocks of {self.layers_per_block} "
             f"({bytes_per_layer * self.layers_per_block / 1e6:.0f} MB/block "
-            f"on device; {sum(l.nbytes for l in layer_leaves) / 1e9:.2f} GB "
-            f"params + {3 * sum(m.nbytes for m in self._master) / 1e9:.2f} GB "
-            f"fp32 state off-device)")
+            f"in HBM; ~{state_gb:.2f} GB params+state off-device)")
 
     # -- compiled segments (shared across blocks) --------------------------
     def _build_step_fns(self, model) -> None:
@@ -332,12 +496,43 @@ class ParamOffloadExecutor:
             return ([o[0] for o in out], [o[1] for o in out],
                     [o[2] for o in out], [o[3] for o in out])
 
-        self._block_update = jax.jit(adamw_leaves, donate_argnums=(0, 2, 3, 4))
         def sqnorm(ls):
             return sum(jnp.vdot(l.astype(jnp.float32), l.astype(jnp.float32))
                        for l in ls)
 
         self._sqnorm = jax.jit(sqnorm)
+
+        if self._pinned:
+            # the updated block streams straight back to pinned host via
+            # out_shardings — the Python process never holds the bytes (no
+            # donation: inputs are HBM, outputs pinned; different spaces)
+            pin = list(self._pinned_shardings)
+            self._block_update = jax.jit(
+                adamw_leaves, out_shardings=(pin, pin, pin, pin))
+
+            def acc_add(acc, g, inv):
+                # acc arrives pinned; compute needs device operands, so hop
+                # through device memory inside the jit (traceable device_put)
+                acc_d = [jax.device_put(a, s)
+                         for a, s in zip(acc, self._block_shardings)]
+                new = [a + x.astype(jnp.float32) * inv
+                       for a, x in zip(acc_d, g)]
+                # running sq-norm rides along so the boundary never has to
+                # re-read the accumulators just to compute the grad norm
+                return new, sqnorm(new)
+
+            self._acc_add = jax.jit(acc_add, out_shardings=(pin, None))
+            leaf_tails = [tuple(p.shape[1:]) for p in self._pblocks[0]]
+            self._acc_zeros = jax.jit(
+                lambda: [[jnp.zeros((hi - lo,) + tail, jnp.float32)
+                          for tail in leaf_tails]
+                         for (lo, hi) in self._bounds],
+                out_shardings=[[sh.with_memory_kind("pinned_host")
+                                for sh in self._block_shardings]
+                               for _ in self._bounds])
+        else:
+            self._block_update = jax.jit(adamw_leaves,
+                                         donate_argnums=(0, 2, 3, 4))
 
         def res_update(params, grads, master, m, v, step, lr, gscale):
             leaves_p, td = jax.tree.flatten(params)
@@ -357,20 +552,32 @@ class ParamOffloadExecutor:
 
     # -- block fetch/store -------------------------------------------------
     def _block_host_leaves(self, g: int) -> List[np.ndarray]:
+        """NUMPY leaves of block g (np backends; pinned uses device_get)."""
         lo, hi = self._bounds[g]
+        if self._pinned:
+            return [np.asarray(x) for x in jax.device_get(self._pblocks[g])]
         if self._store is not None:
             return self._store.read_block(g)
         return [l[lo:hi] for l in self._host_layers]
 
     def _fetch_block(self, g: int) -> List[jax.Array]:
-        return [jax.device_put(l, s) for l, s in
-                zip(self._block_host_leaves(g), self._block_shardings)]
+        # single device_put call for the whole block (one dispatch — the
+        # per-leaf loop costs a host round-trip per leaf)
+        if self._pinned:
+            return jax.device_put(self._pblocks[g], self._block_shardings)
+        return jax.device_put(self._block_host_leaves(g),
+                              self._block_shardings)
 
     def _prefetch(self, g: int) -> None:
         if self._store is not None and 0 <= g < self.num_blocks:
             self._store.prefetch_block(g)
 
     def _store_block(self, g: int, dev_leaves: List[jax.Array]) -> None:
+        if self._pinned:
+            # dev_leaves already carry pinned_host shardings (update jit
+            # out_shardings) — just rebind
+            self._pblocks[g] = dev_leaves
+            return
         host = [np.asarray(x) for x in jax.device_get(dev_leaves)]
         if self._store is not None:
             self._store.write_block(g, host, wait=False)
@@ -382,12 +589,22 @@ class ParamOffloadExecutor:
     def _opt_slices_on_device(self, g: int):
         """Stream this block's fp32 master/moments H2D, sharded like the
         params (same shapes → same specs)."""
+        if self._pinned:
+            return jax.device_put(
+                (self._pmaster[g], self._pm[g], self._pv[g]),
+                (self._block_shardings,) * 3)
         lo, hi = self._bounds[g]
-        put = lambda xs: [jax.device_put(x[lo:hi], s)
-                          for x, s in zip(xs, self._block_shardings)]
-        return put(self._master), put(self._m), put(self._v)
+        return jax.device_put(
+            tuple([x[lo:hi] for x in xs]
+                  for xs in (self._master, self._m, self._v)),
+            (self._block_shardings,) * 3)
 
     def _writeback_opt(self, g: int, new_ma, new_m, new_v) -> None:
+        if self._pinned:
+            self._pmaster[g] = new_ma
+            self._pm[g] = new_m
+            self._pv[g] = new_v
+            return
         lo, hi = self._bounds[g]
         for dst, src in zip(self._master, jax.device_get(new_ma)):
             dst[lo:hi] = src
@@ -406,6 +623,14 @@ class ParamOffloadExecutor:
                 axis=1)
         return labels
 
+    def _init_acc(self) -> None:
+        if self._acc is not None:
+            return
+        if self._pinned:
+            self._acc = self._acc_zeros()    # jit cached in _build_step_fns
+        else:
+            self._acc = [np.zeros(m.shape, np.float32) for m in self._master]
+
     def train_step(self, batch_stack: Any) -> Tuple[jax.Array, float]:
         """One full step over (gas, mb, ...) microbatches. Returns
         (mean_loss, grad_norm)."""
@@ -415,11 +640,12 @@ class ParamOffloadExecutor:
         G, gas = self.num_blocks, self.gas
         fused = (gas == 1 and self.grad_clip == 0.0)
 
-        if not fused and self._acc is None:
-            self._acc = [np.zeros(m.shape, np.float32) for m in self._master]
+        if not fused:
+            self._init_acc()
         res_grads_total = None
         losses = []
         sq_parts: List[jax.Array] = []    # fused path: per-block grad sq-norms
+        acc_sq: Dict[int, jax.Array] = {}  # pinned acc path: running norms
 
         for mi in range(gas):
             mb = jax.tree.map(lambda x: x[mi], batch_stack)
@@ -453,12 +679,19 @@ class ParamOffloadExecutor:
                 nxt = self._fetch_block(g - 1) if g > 0 else None
                 dx, dblock = self._block_vjp(dev_block, acts[g], mask, dx)
                 if fused:
+                    # separate vjp/norm/update dispatches measured FASTER
+                    # than one fused program here: the fused program puts
+                    # the whole update on the dx dependency chain, stalling
+                    # block g-1's vjp behind g's optimizer math
                     sq_parts.append(self._sqnorm(dblock))
                     master, m, v = self._opt_slices_on_device(g)
                     new_p, new_ma, new_m, new_v = self._block_update(
                         dev_block, dblock, master, m, v, step, lr, 1.0)
                     self._store_block(g, new_p)
                     self._writeback_opt(g, new_ma, new_m, new_v)
+                elif self._pinned:
+                    self._acc[g], acc_sq[g] = self._acc_add(
+                        self._acc[g], dblock, inv_gas)
                 else:
                     lo, hi = self._bounds[g]
                     for dst, src in zip(self._acc,
@@ -481,25 +714,37 @@ class ParamOffloadExecutor:
             sq_parts.append(self._sqnorm(jax.tree.leaves(res_grads_total)))
             grad_norm = float(jnp.sqrt(sum(sq_parts)))
         if not fused:
-            sq = sum(float(np.vdot(a, a)) for a in self._acc)
-            sq += sum(float(jnp.vdot(g_, g_)) for g_ in
-                      jax.tree.leaves(res_grads_total))
+            if self._pinned:
+                # the running norms came back with the last micro's acc_add
+                # — no extra pinned→HBM read pass
+                sq = sum(float(acc_sq[g]) for g in range(G))
+            else:
+                sq = sum(float(np.vdot(a, a)) for a in self._acc)
+            sq += float(self._sqnorm(jax.tree.leaves(res_grads_total)))
             grad_norm = float(np.sqrt(sq))
             if self.grad_clip > 0.0 and grad_norm > self.grad_clip:
                 gscale = self.grad_clip / (grad_norm + 1e-6)
             for g in range(G):
                 self._prefetch(g + 1)
                 dev_block = self._fetch_block(g)
-                lo, hi = self._bounds[g]
                 master, m, v = self._opt_slices_on_device(g)
-                acc_dev = [jax.device_put(a[lo:hi], s) for a, s in
-                           zip(self._acc, self._block_shardings)]
+                if self._pinned:
+                    acc_dev = jax.device_put(self._acc[g],
+                                             self._block_shardings)
+                else:
+                    lo, hi = self._bounds[g]
+                    acc_dev = jax.device_put([a[lo:hi] for a in self._acc],
+                                             self._block_shardings)
                 new_p, new_ma, new_m, new_v = self._block_update(
                     dev_block, acc_dev, master, m, v, step, lr, gscale)
                 self._store_block(g, new_p)
                 self._writeback_opt(g, new_ma, new_m, new_v)
+            # zero the accumulators for the next step
+            if self._pinned:
+                self._acc = None
+            else:
                 for a in self._acc:
-                    a[lo:hi] = 0.0
+                    a[...] = 0.0
 
         (self.resident, self._res_master, self._res_m,
          self._res_v) = self._res_update(
@@ -527,11 +772,13 @@ class ParamOffloadExecutor:
     def params_for_checkpoint(self) -> Any:
         """Full params tree: resident device leaves + assembled host layer
         leaves (np, (L, ...))."""
-        if self._store is not None:
+        if self._pinned or self._store is not None:
+            first = self._block_host_leaves(0)
             full = [np.empty((self.num_layers,) + tuple(l.shape[1:]), l.dtype)
-                    for l in self._block_host_leaves(0)]
+                    for l in first]
             for g, (lo, hi) in enumerate(self._bounds):
-                for dst, src in zip(full, self._block_host_leaves(g)):
+                leaves = first if g == 0 else self._block_host_leaves(g)
+                for dst, src in zip(full, leaves):
                     dst[lo:hi] = src
             leaves = full
         else:
@@ -544,29 +791,52 @@ class ParamOffloadExecutor:
     def load_params(self, tree: Any) -> None:
         kv, _ = _tree_leaves_with_path(tree["layers"])
         leaves = [np.asarray(l) for _, l in kv]
-        if self._store is not None:
+        if self._pinned:
+            for g, (lo, hi) in enumerate(self._bounds):
+                self._pblocks[g] = [
+                    jax.device_put(l[lo:hi], s) for l, s in
+                    zip(leaves, self._pinned_shardings)]
+                self._pmaster[g] = [
+                    jax.device_put(l[lo:hi].astype(np.float32), s)
+                    for l, s in zip(leaves, self._pinned_shardings)]
+        elif self._store is not None:
             for g, (lo, hi) in enumerate(self._bounds):
                 self._store.write_block(g, [l[lo:hi] for l in leaves],
                                         wait=False)
             self._store.flush()
+            self._master = [l.astype(np.float32) for l in leaves]
         else:
             for dst, src in zip(self._host_layers, leaves):
                 dst[...] = src
-        self._master = [l.astype(np.float32) for l in leaves]
+            self._master = [l.astype(np.float32) for l in leaves]
         resident = {k: v for k, v in tree.items() if k != "layers"}
-        self.resident = jax.tree.map(lambda x, s: jax.device_put(np.asarray(x), s),
-                                     resident, self._res_shardings)
+        self.resident = jax.tree.map(
+            lambda x, s: jax.device_put(np.asarray(x), s),
+            resident, self._res_shardings)
         self._res_master = jax.tree.map(
             lambda x: jnp.asarray(x, jnp.float32), self.resident)
+
+    def _opt_leaves_np(self, which: str) -> List[np.ndarray]:
+        if not self._pinned:
+            src = {"master": self._master, "m": self._m, "v": self._v}[which]
+            return list(src)
+        blocks = {"master": self._pmaster, "m": self._pm,
+                  "v": self._pv}[which]
+        full = [np.empty((self.num_layers,) + tuple(s.shape[1:]), np.float32)
+                for s in blocks[0]]
+        for g, (lo, hi) in enumerate(self._bounds):
+            for dst, src in zip(full, jax.device_get(blocks[g])):
+                dst[lo:hi] = np.asarray(src)
+        return full
 
     def opt_state_arrays(self) -> Dict[str, Any]:
         """Optimizer state for checkpoint: layer m/v/master (np) + resident
         trees + step counter."""
         return {
             "step": np.int64(self.step_count),
-            "layer_master": list(self._master),
-            "layer_m": list(self._m),
-            "layer_v": list(self._v),
+            "layer_master": self._opt_leaves_np("master"),
+            "layer_m": self._opt_leaves_np("m"),
+            "layer_v": self._opt_leaves_np("v"),
             "res_master": self._res_master,
             "res_m": self._res_m,
             "res_v": self._res_v,
@@ -574,9 +844,19 @@ class ParamOffloadExecutor:
 
     def load_opt_state(self, state: Dict[str, Any]) -> None:
         self.step_count = int(state["step"])
-        self._master = [np.asarray(x, np.float32) for x in state["layer_master"]]
-        self._m = [np.asarray(x, np.float32) for x in state["layer_m"]]
-        self._v = [np.asarray(x, np.float32) for x in state["layer_v"]]
+        masters = [np.asarray(x, np.float32) for x in state["layer_master"]]
+        ms = [np.asarray(x, np.float32) for x in state["layer_m"]]
+        vs = [np.asarray(x, np.float32) for x in state["layer_v"]]
+        if self._pinned:
+            for g, (lo, hi) in enumerate(self._bounds):
+                put = lambda leaves: [
+                    jax.device_put(l[lo:hi], s) for l, s in
+                    zip(leaves, self._pinned_shardings)]
+                self._pmaster[g] = put(masters)
+                self._pm[g] = put(ms)
+                self._pv[g] = put(vs)
+        else:
+            self._master, self._m, self._v = masters, ms, vs
         put32 = lambda x, s: jax.device_put(np.asarray(x, np.float32), s)
         self._res_master = jax.tree.map(put32, state["res_master"],
                                         self._res_shardings)

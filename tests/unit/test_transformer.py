@@ -197,3 +197,33 @@ class TestDropout:
         ref_loss = float(jax.jit(ref.loss_fn)(engine.params, ev_batch))
         np.testing.assert_allclose(ev, ref_loss, rtol=1e-6)
         assert engine.model.config.dropout_enabled  # restored after eval
+
+
+@pytest.mark.parametrize("kw", [
+    dict(),                                             # gelu + layernorm
+    dict(activation="swiglu", norm="rmsnorm", position="rope",
+         tie_embeddings=False),
+    dict(moe_num_experts=4, moe_use_residual=True),
+])
+def test_init_layer_block_matches_init_slice(kw):
+    """Load-bearing contract for ZeRO-3 param offload: Model.init_layer_block
+    (rng, lo, blen) must be BIT-IDENTICAL to the corresponding slice of
+    init(rng)["layers"] — pinned-host runs init one block at a time and must
+    train from exactly the weights the resident engine would."""
+    from deepspeed_tpu.models.transformer import (TransformerConfig,
+                                                  build_model)
+
+    cfg = TransformerConfig(vocab_size=64, hidden_size=16, num_layers=5,
+                            num_heads=2, max_seq_len=16, **kw)
+    model = build_model(cfg)
+    rng = jax.random.PRNGKey(42)
+    full = model.init(rng)["layers"]
+    for lo, blen in ((0, 2), (2, 2), (4, 1), (0, 5)):
+        blk = model.init_layer_block(rng, lo, blen)
+        want = jax.tree.map(lambda l: l[lo:lo + blen], full)
+        for (pa, a), (pb, b) in zip(
+                jax.tree_util.tree_flatten_with_path(blk)[0],
+                jax.tree_util.tree_flatten_with_path(want)[0]):
+            np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b),
+                err_msg=f"{jax.tree_util.keystr(pa)} [{lo}:{lo + blen}]")
