@@ -196,6 +196,11 @@ class InferenceEngine:
 
         self._prefill_cache: Dict[Tuple, Any] = {}
         self._decode_cache: Dict[Tuple, Any] = {}
+        # engine-owned KV arena, allocated once per batch size and donated
+        # through prefill/decode each call (reference InferenceContext
+        # allocates its workspace once, inference_context.h:49) — per-call
+        # allocation is wasted HBM traffic at serving cadence
+        self._arena: Dict[int, Any] = {}
         self._fwd = None
         n = sum(int(p.size) for p in jax.tree.leaves(self.params))
         log_dist(f"inference engine ready: {n / 1e6:.1f}M params, tp={tp}, "
@@ -236,26 +241,30 @@ class InferenceEngine:
         T_max = self.config.max_out_tokens
         from ..models.transformer import forward as model_forward
 
-        def decode(params, cache, valid, first_tok, rng):
+        def decode(params, cache, valid, first_tok, lengths, rng):
             def step(carry, rng):
-                cache, valid, tok, done = carry
+                cache, valid, tok, pos, done = carry
                 idx = cache["index"][0]
-                # the incoming token becomes a valid key at position idx
+                # the incoming token becomes a valid key at ARENA column idx
+                # (uniform across rows); its POSITION is per-row — a ragged
+                # row's first decode token sits at its true prompt length,
+                # not the padded array width
                 valid = jax.lax.dynamic_update_slice(
                     valid, jnp.ones((valid.shape[0], 1), valid.dtype), (0, idx))
                 logits, cache, _ = model_forward(
                     params, tok[:, None], cfg,
-                    attention_mask=valid, cache=cache, start_pos=idx)
+                    attention_mask=valid, cache=cache, start_pos=idx,
+                    positions=pos[:, None])
                 nxt = _sample(logits[:, -1], rng, temperature, top_k, top_p)
                 if eos_token_id is not None:
                     nxt = jnp.where(done, eos_token_id, nxt)
                     done = done | (nxt == eos_token_id)
-                return (cache, valid, nxt, done), nxt
+                return (cache, valid, nxt, pos + 1, done), nxt
 
             done = jnp.zeros(first_tok.shape, bool)
             rngs = jax.random.split(rng, n_new)
-            (cache, valid, _, _), toks = jax.lax.scan(
-                step, (cache, valid, first_tok, done), rngs)
+            (cache, valid, _, _, _), toks = jax.lax.scan(
+                step, (cache, valid, first_tok, lengths, done), rngs)
             return jnp.moveaxis(toks, 0, 1), cache  # (B, n_new)
 
         return jax.jit(decode, donate_argnums=(1,))
@@ -266,10 +275,12 @@ class InferenceEngine:
                  return_ttft: bool = False):
         """Prompt ids (B, S) → generated ids (B, max_new_tokens).
 
-        Ragged prompts: pass ``attention_mask`` (B, S); prompts are treated as
-        right-padded. Decoded tokens take positions S, S+1, ... (S = prompt
-        array width) — exact for full-width prompts; shorter rows in a ragged
-        batch see HF-right-padding position semantics.
+        Ragged prompts: pass ``attention_mask`` (B, S); prompts are treated
+        as right-padded. Decoded tokens take each row's TRUE next positions
+        (len_b, len_b+1, ...) — batched ragged generation matches serving
+        each prompt alone. (alibi models: the per-KEY alibi bias still uses
+        arena columns, so ragged BLOOM batches remain approximate for the
+        generated-token keys of short rows.)
         ``return_ttft``: also return wall seconds to first token (prefill)."""
         cfg = self.model.config
         ids = jnp.asarray(np.asarray(input_ids), jnp.int32)
@@ -300,7 +311,18 @@ class InferenceEngine:
                 n_rest, temperature, top_k, top_p, eos_token_id)
 
         with self.mesh:
-            cache = kv_cache.init_cache(cfg, B, T_max, self.config.dtype)
+            cache = self._arena.pop(B, None)
+            # single-workspace policy (reference InferenceContext): a batch
+            # size change frees the old arena instead of pinning one arena
+            # per B seen over the process lifetime
+            self._arena.clear()
+            if cache is None:
+                cache = kv_cache.init_cache(cfg, B, T_max, self.config.dtype)
+            else:
+                # reuse the engine-owned arena: reset the write cursor; the
+                # stale keys stay masked by `valid` and are overwritten as
+                # prefill/decode proceed
+                cache = {**cache, "index": jnp.zeros_like(cache["index"])}
             t0 = time.perf_counter()
             logits, cache = self._prefill_cache[key_p](
                 self.params, ids_pad, valid, cache)
@@ -320,8 +342,9 @@ class InferenceEngine:
                 out = first[:, None]
             else:
                 rest, cache = self._decode_cache[key_d](
-                    self.params, cache, valid, first, rng)
+                    self.params, cache, valid, first, lengths, rng)
                 out = jnp.concatenate([first[:, None], rest], axis=1)
+            self._arena[B] = cache
         return (out, ttft) if return_ttft else out
 
 

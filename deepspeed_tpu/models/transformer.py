@@ -489,10 +489,15 @@ def rope_table(positions: jax.Array, head_dim: int, theta: float) -> Tuple[jax.A
 
 
 def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
-    """x: (B, S, n, D); cos/sin: (S, D/2) or broadcastable."""
+    """x: (B, S, n, D); cos/sin: (S, D/2) shared or (B, S, D/2) per-row
+    (ragged-batch decode positions)."""
     x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
-    c = cos[None, :, None, :]
-    s = sin[None, :, None, :]
+    if cos.ndim == 3:
+        c = cos[:, :, None, :]
+        s = sin[:, :, None, :]
+    else:
+        c = cos[None, :, None, :]
+        s = sin[None, :, None, :]
     return jnp.concatenate([x1 * c - x2 * s, x1 * s + x2 * c], axis=-1).astype(x.dtype)
 
 
@@ -764,14 +769,19 @@ def forward(params: Dict[str, Any], input_ids: jax.Array,
             attention_mask: Optional[jax.Array] = None,
             cache: Optional[Dict[str, Any]] = None,
             start_pos: Any = 0,
-            pld_theta: Optional[jax.Array] = None
+            pld_theta: Optional[jax.Array] = None,
+            positions: Optional[jax.Array] = None
             ) -> Tuple[jax.Array, Optional[Dict[str, Any]], jax.Array]:
     """Token ids (B,S) → (logits (B,S,V), new_cache, moe_aux_loss). With
     ``cache``, runs in decode mode (cache is a per-layer stacked pytree; see
-    inference/kv_cache.py)."""
+    inference/kv_cache.py). ``positions``: explicit absolute positions, (S,)
+    shared or (B, S) per-row — ragged batches decode with each row's TRUE
+    token index (the KV arena column stays uniform; only the position
+    values differ)."""
     B, S = input_ids.shape
     x = params["embed"]["tokens"][input_ids].astype(cfg.dtype)
-    positions = jnp.arange(S) + start_pos
+    if positions is None:
+        positions = jnp.arange(S) + start_pos
     if cfg.position == "learned":
         x = x + params["pos"][positions].astype(cfg.dtype)
     if cfg.embed_norm:

@@ -230,6 +230,21 @@ class ParamOffloadExecutor:
 
         # -- materialise params + optimizer state --------------------------
         G = self.num_blocks
+
+        def _block_leaves_fn():
+            """The ONE block-init core both accelerator tiers share: cast +
+            flatten of the model's layer-range hook (a casting fix must
+            apply to pinned and nvme alike or the tiers would silently
+            initialise from different weights)."""
+            from ..models.core import cast_floating
+
+            def block_leaves(key, lo, blen: int):
+                tree = cast_floating(model.init_layer_block(key, lo, blen),
+                                     self.compute_dtype)
+                return [l for _, l in _tree_leaves_with_path(tree)[0]]
+
+            return block_leaves
+
         if self._pinned:
             # per-BLOCK init jits: each call draws the model init and keeps
             # only one block's slice (dynamic offset → one compiled program
@@ -257,13 +272,10 @@ class ParamOffloadExecutor:
                     # per-block init via the model's layer-range hook: peak
                     # HBM = one block of layers (dynamic lo → one compiled
                     # program for all full blocks)
-                    from ..models.core import cast_floating
+                    block_leaves = _block_leaves_fn()
 
                     def init_block(key, lo, blen: int):
-                        tree = cast_floating(
-                            model.init_layer_block(key, lo, blen),
-                            self.compute_dtype)
-                        blk = [l for _, l in _tree_leaves_with_path(tree)[0]]
+                        blk = block_leaves(key, lo, blen)
                         ma = [b.astype(jnp.float32) for b in blk]
                         z = [jnp.zeros(b.shape, jnp.float32) for b in blk]
                         return blk, ma, z, [x for x in z]
@@ -324,22 +336,14 @@ class ParamOffloadExecutor:
             elif model.init_layer_block is not None:
                 # accelerator + nvme tier: per-block init on device,
                 # device_get to np — never the full tree in HBM
-                from ..models.core import cast_floating
-
                 def res_only(key):
                     params = init_fn(key)
                     return {k: v for k, v in params.items() if k != "layers"}
 
-                def blk_init(key, lo, blen: int):
-                    tree = cast_floating(
-                        model.init_layer_block(key, lo, blen),
-                        self.compute_dtype)
-                    return [l for _, l in _tree_leaves_with_path(tree)[0]]
-
                 with mesh:
                     resident_dev = jax.jit(
                         res_only, out_shardings=self._res_shardings)(rng)
-                    fn = jax.jit(blk_init, static_argnums=(2,))
+                    fn = jax.jit(_block_leaves_fn(), static_argnums=(2,))
                     layer_leaves = [
                         np.empty((L,) + tuple(l.shape[1:]),
                                  jnp.dtype(l.dtype))

@@ -123,6 +123,49 @@ def test_generate_ragged_prompts_right_padded():
     np.testing.assert_array_equal(np.asarray(got[1:2]), np.asarray(short))
 
 
+@pytest.mark.parametrize("preset", ["tiny", "tiny-llama"])  # learned + rope
+def test_generate_ragged_matches_solo_prompt(preset):
+    """Exact ragged positions: a short row in a ragged batch must generate
+    the SAME tokens as serving that prompt alone at its true width — decode
+    positions are per-row (len_b, len_b+1, ...), not the padded array
+    width."""
+    engine = init_inference(preset, dtype=jnp.float32, max_out_tokens=128)
+    rng = np.random.RandomState(3)
+    full = rng.randint(0, 250, size=(2, 10)).astype(np.int64)
+    mask = np.ones((2, 10), np.int32)
+    mask[1, 6:] = 0
+    full[1, 6:] = 0
+    got = np.asarray(engine.generate(full, attention_mask=mask,
+                                     max_new_tokens=4))
+    solo = np.asarray(engine.generate(full[1:2, :6], max_new_tokens=4))
+    np.testing.assert_array_equal(got[1:2], solo)
+
+
+def test_arena_allocated_once_and_reused(monkeypatch):
+    """The KV arena is engine-owned: repeated generate() calls at the same
+    batch size must not re-allocate it (reference InferenceContext
+    workspace discipline)."""
+    from deepspeed_tpu.inference import kv_cache
+
+    engine = init_inference("tiny", dtype=jnp.float32, max_out_tokens=128)
+    calls = []
+    orig = kv_cache.init_cache
+
+    def counting(*a, **kw):
+        calls.append(a)
+        return orig(*a, **kw)
+
+    monkeypatch.setattr(kv_cache, "init_cache", counting)
+    prompt = np.arange(8)[None]
+    a = np.asarray(engine.generate(prompt, max_new_tokens=4))
+    b = np.asarray(engine.generate(prompt, max_new_tokens=4))
+    c = np.asarray(engine.generate(prompt, max_new_tokens=4))
+    assert len(calls) == 1, f"arena allocated {len(calls)} times"
+    np.testing.assert_array_equal(a, b)
+    np.testing.assert_array_equal(b, c)
+    assert 1 in engine._arena
+
+
 def test_generate_eos_stops():
     engine = init_inference("tiny", dtype=jnp.float32, max_out_tokens=128)
     prompt = np.arange(8)[None]
