@@ -53,10 +53,15 @@ def import_hf_state_dict(state_dict: Dict[str, Any], cfg, family: str
         "llama": _import_llama,
         "mistral": _import_llama,
         "bloom": _import_bloom,
+        "gptj": _import_gptj,
+        "gptneox": _import_gptneox,
+        "bert": _import_bert,
+        "distilbert": _import_distilbert,
     }.get(fam)
     if mapper is None:
         raise ValueError(f"no HF import mapping for family '{family}' "
-                         "(have: gpt2, opt, llama, mistral, bloom)")
+                         "(have: gpt2, opt, llama, mistral, bloom, gptj, "
+                         "gptneox, bert, distilbert)")
     return mapper(sd, cfg)
 
 
@@ -200,6 +205,190 @@ def _import_bloom(sd, cfg):
         "layers": _stack(layers),
         "final_norm": {"scale": _a(sd[pre + "ln_f.weight"]),
                        "bias": _a(sd[pre + "ln_f.bias"])},
+    }
+
+
+def _rotary_perm(w_t: np.ndarray, N: int, D: int, rd: int) -> np.ndarray:
+    """GPT-J stores rotary dims INTERLEAVED (rotate_every_two); permuting
+    each head's rotary columns to evens-then-odds converts exactly to the
+    rotate-half convention apply_rope implements (attention is invariant to
+    a shared per-head q/k column permutation). w_t: (H, N*D) input-major."""
+    H = w_t.shape[0]
+    w = w_t.reshape(H, N, D)
+    perm = np.concatenate([np.arange(0, rd, 2), np.arange(1, rd, 2)])
+    rot = w[:, :, :rd][:, :, perm]
+    return np.ascontiguousarray(
+        np.concatenate([rot, w[:, :, rd:]], axis=2).reshape(H, N * D))
+
+
+def _import_gptj(sd, cfg):
+    """GPT-J (reference module_inject/containers/gptj.py): parallel
+    attn+mlp residual off ONE LayerNorm, partial interleaved rotary, no
+    attention biases, biased untied lm_head."""
+    N, D, rd = cfg.num_heads, cfg.head_dim, cfg.rotary_dim or cfg.head_dim
+    H = cfg.hidden_size
+    zeros = lambda n: np.zeros((n,), np.float32)
+    layers = []
+    for i in range(cfg.num_layers):
+        p = f"transformer.h.{i}."
+        ln = {"scale": _a(sd[p + "ln_1.weight"]),
+              "bias": _a(sd[p + "ln_1.bias"])}
+        layers.append({
+            # one shared LN: ln2 aliases ln1 (parallel_residual reads ln2
+            # for the MLP branch)
+            "ln1": dict(ln), "ln2": dict(ln),
+            "attn": {
+                "wq": _rotary_perm(_t(sd[p + "attn.q_proj.weight"]), N, D, rd),
+                "wk": _rotary_perm(_t(sd[p + "attn.k_proj.weight"]), N, D, rd),
+                "wv": _t(sd[p + "attn.v_proj.weight"]),
+                "bq": zeros(N * D), "bk": zeros(N * D), "bv": zeros(N * D),
+                "wo": _t(sd[p + "attn.out_proj.weight"]),
+                "bo": zeros(H),
+            },
+            "mlp": {
+                "w_up": _t(sd[p + "mlp.fc_in.weight"]),
+                "b_up": _a(sd[p + "mlp.fc_in.bias"]),
+                "w_down": _t(sd[p + "mlp.fc_out.weight"]),
+                "b_down": _a(sd[p + "mlp.fc_out.bias"]),
+            },
+        })
+    return {
+        "embed": {"tokens": _a(sd["transformer.wte.weight"])},
+        "layers": _stack(layers),
+        "final_norm": {"scale": _a(sd["transformer.ln_f.weight"]),
+                       "bias": _a(sd["transformer.ln_f.bias"])},
+        "lm_head": _t(sd["lm_head.weight"]),
+        "lm_head_b": _a(sd["lm_head.bias"]),
+    }
+
+
+def _import_gptneox(sd, cfg):
+    """GPT-NeoX (reference module_inject/containers/gptneox.py): fused qkv
+    with per-head (q|k|v) row interleave, parallel residual with its own
+    post_attention_layernorm, partial rotate-half rotary, untied embed_out."""
+    H, N, D = cfg.hidden_size, cfg.num_heads, cfg.head_dim
+    pre = "gpt_neox."
+    layers = []
+    for i in range(cfg.num_layers):
+        p = f"{pre}layers.{i}."
+        qkv_w = _a(sd[p + "attention.query_key_value.weight"])  # (3H, H)
+        qkv_b = _a(sd[p + "attention.query_key_value.bias"])
+        w = qkv_w.reshape(N, 3, D, H)
+        b = qkv_b.reshape(N, 3, D)
+        layers.append({
+            "ln1": {"scale": _a(sd[p + "input_layernorm.weight"]),
+                    "bias": _a(sd[p + "input_layernorm.bias"])},
+            "ln2": {"scale": _a(sd[p + "post_attention_layernorm.weight"]),
+                    "bias": _a(sd[p + "post_attention_layernorm.bias"])},
+            "attn": {
+                "wq": np.ascontiguousarray(w[:, 0].reshape(N * D, H).T),
+                "wk": np.ascontiguousarray(w[:, 1].reshape(N * D, H).T),
+                "wv": np.ascontiguousarray(w[:, 2].reshape(N * D, H).T),
+                "bq": b[:, 0].reshape(-1), "bk": b[:, 1].reshape(-1),
+                "bv": b[:, 2].reshape(-1),
+                "wo": _t(sd[p + "attention.dense.weight"]),
+                "bo": _a(sd[p + "attention.dense.bias"]),
+            },
+            "mlp": {
+                "w_up": _t(sd[p + "mlp.dense_h_to_4h.weight"]),
+                "b_up": _a(sd[p + "mlp.dense_h_to_4h.bias"]),
+                "w_down": _t(sd[p + "mlp.dense_4h_to_h.weight"]),
+                "b_down": _a(sd[p + "mlp.dense_4h_to_h.bias"]),
+            },
+        })
+    return {
+        "embed": {"tokens": _a(sd[pre + "embed_in.weight"])},
+        "layers": _stack(layers),
+        "final_norm": {"scale": _a(sd[pre + "final_layer_norm.weight"]),
+                       "bias": _a(sd[pre + "final_layer_norm.bias"])},
+        "lm_head": _t(sd["embed_out.weight"]),
+    }
+
+
+def _strip_prefix(sd, prefix):
+    if any(k.startswith(prefix) for k in sd):
+        return {k[len(prefix):]: v for k, v in sd.items()
+                if k.startswith(prefix)}
+    return sd
+
+
+def _import_bert(sd, cfg):
+    """BERT (reference module_inject/containers/bert.py): post-LN encoder —
+    LayerNorm AFTER each residual add, bidirectional attention, token-type
+    embeddings, no final norm (exercises the non-causal path end to end)."""
+    sd = _strip_prefix(sd, "bert.")
+    layers = []
+    for i in range(cfg.num_layers):
+        p = f"encoder.layer.{i}."
+        layers.append({
+            # post-LN mapping: ln1 = attention.output.LayerNorm (applied to
+            # x + attn_out), ln2 = output.LayerNorm (x + mlp_out)
+            "ln1": {"scale": _a(sd[p + "attention.output.LayerNorm.weight"]),
+                    "bias": _a(sd[p + "attention.output.LayerNorm.bias"])},
+            "ln2": {"scale": _a(sd[p + "output.LayerNorm.weight"]),
+                    "bias": _a(sd[p + "output.LayerNorm.bias"])},
+            "attn": {
+                "wq": _t(sd[p + "attention.self.query.weight"]),
+                "wk": _t(sd[p + "attention.self.key.weight"]),
+                "wv": _t(sd[p + "attention.self.value.weight"]),
+                "bq": _a(sd[p + "attention.self.query.bias"]),
+                "bk": _a(sd[p + "attention.self.key.bias"]),
+                "bv": _a(sd[p + "attention.self.value.bias"]),
+                "wo": _t(sd[p + "attention.output.dense.weight"]),
+                "bo": _a(sd[p + "attention.output.dense.bias"]),
+            },
+            "mlp": {
+                "w_up": _t(sd[p + "intermediate.dense.weight"]),
+                "b_up": _a(sd[p + "intermediate.dense.bias"]),
+                "w_down": _t(sd[p + "output.dense.weight"]),
+                "b_down": _a(sd[p + "output.dense.bias"]),
+            },
+        })
+    return {
+        "embed": {"tokens": _a(sd["embeddings.word_embeddings.weight"])},
+        "pos": _a(sd["embeddings.position_embeddings.weight"]),
+        "type_embed": _a(sd["embeddings.token_type_embeddings.weight"]),
+        "embed_norm": {"scale": _a(sd["embeddings.LayerNorm.weight"]),
+                       "bias": _a(sd["embeddings.LayerNorm.bias"])},
+        "layers": _stack(layers),
+    }
+
+
+def _import_distilbert(sd, cfg):
+    """DistilBERT (reference module_inject/containers/distil_bert.py):
+    BERT-style post-LN encoder without token types."""
+    sd = _strip_prefix(sd, "distilbert.")
+    layers = []
+    for i in range(cfg.num_layers):
+        p = f"transformer.layer.{i}."
+        layers.append({
+            "ln1": {"scale": _a(sd[p + "sa_layer_norm.weight"]),
+                    "bias": _a(sd[p + "sa_layer_norm.bias"])},
+            "ln2": {"scale": _a(sd[p + "output_layer_norm.weight"]),
+                    "bias": _a(sd[p + "output_layer_norm.bias"])},
+            "attn": {
+                "wq": _t(sd[p + "attention.q_lin.weight"]),
+                "wk": _t(sd[p + "attention.k_lin.weight"]),
+                "wv": _t(sd[p + "attention.v_lin.weight"]),
+                "bq": _a(sd[p + "attention.q_lin.bias"]),
+                "bk": _a(sd[p + "attention.k_lin.bias"]),
+                "bv": _a(sd[p + "attention.v_lin.bias"]),
+                "wo": _t(sd[p + "attention.out_lin.weight"]),
+                "bo": _a(sd[p + "attention.out_lin.bias"]),
+            },
+            "mlp": {
+                "w_up": _t(sd[p + "ffn.lin1.weight"]),
+                "b_up": _a(sd[p + "ffn.lin1.bias"]),
+                "w_down": _t(sd[p + "ffn.lin2.weight"]),
+                "b_down": _a(sd[p + "ffn.lin2.bias"]),
+            },
+        })
+    return {
+        "embed": {"tokens": _a(sd["embeddings.word_embeddings.weight"])},
+        "pos": _a(sd["embeddings.position_embeddings.weight"]),
+        "embed_norm": {"scale": _a(sd["embeddings.LayerNorm.weight"]),
+                       "bias": _a(sd["embeddings.LayerNorm.bias"])},
+        "layers": _stack(layers),
     }
 
 

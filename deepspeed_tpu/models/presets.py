@@ -20,9 +20,18 @@ _FAMILIES: Dict[str, Dict[str, Any]] = {
     "bloom": dict(norm="layernorm", position="alibi", activation="gelu",
                   tie_embeddings=True, embed_norm=True),
     "gptj": dict(norm="layernorm", position="rope", activation="gelu",
-                 tie_embeddings=False),
+                 tie_embeddings=False, parallel_residual=True,
+                 lm_head_bias=True),
     "gptneox": dict(norm="layernorm", position="rope", activation="gelu",
-                    tie_embeddings=False),
+                    tie_embeddings=False, parallel_residual=True),
+    "bert": dict(norm="layernorm", norm_position="post", position="learned",
+                 activation="gelu-exact", tie_embeddings=True, causal=False,
+                 embed_norm=True, type_vocab_size=2, final_norm=False,
+                 norm_eps=1e-12),
+    "distilbert": dict(norm="layernorm", norm_position="post",
+                       position="learned", activation="gelu-exact",
+                       tie_embeddings=True, causal=False, embed_norm=True,
+                       final_norm=False, norm_eps=1e-12),
     "llama": dict(norm="rmsnorm", position="rope", activation="swiglu",
                   tie_embeddings=False, norm_eps=1e-6),
     "mistral": dict(norm="rmsnorm", position="rope", activation="swiglu",
@@ -49,6 +58,19 @@ _SIZES: Dict[str, Dict[str, Any]] = {
                       vocab_size=32000, max_seq_len=4096, ffn_hidden_size=13824),
     "bloom-7b": dict(family="bloom", hidden_size=4096, num_layers=30, num_heads=32,
                      vocab_size=250880, max_seq_len=2048),
+    "gptj-6b": dict(family="gptj", hidden_size=4096, num_layers=28,
+                    num_heads=16, vocab_size=50400, max_seq_len=2048,
+                    rotary_dim=64),
+    "gptneox-20b": dict(family="gptneox", hidden_size=6144, num_layers=44,
+                        num_heads=64, vocab_size=50432, max_seq_len=2048,
+                        rotary_dim=24),    # rotary_pct 0.25 of head_dim 96
+    "bert-base": dict(family="bert", hidden_size=768, num_layers=12,
+                      num_heads=12, vocab_size=30522, max_seq_len=512),
+    "bert-large": dict(family="bert", hidden_size=1024, num_layers=24,
+                       num_heads=16, vocab_size=30522, max_seq_len=512),
+    "distilbert-base": dict(family="distilbert", hidden_size=768,
+                            num_layers=6, num_heads=12, vocab_size=30522,
+                            max_seq_len=512),
     # tiny debug models (reference tests/unit/simple_model.py scale)
     "tiny": dict(family="gpt2", hidden_size=64, num_layers=2, num_heads=4,
                  vocab_size=256, max_seq_len=128),
@@ -59,6 +81,17 @@ _SIZES: Dict[str, Dict[str, Any]] = {
                      vocab_size=256, max_seq_len=128),
     "tiny-bloom": dict(family="bloom", hidden_size=64, num_layers=2, num_heads=4,
                        vocab_size=256, max_seq_len=128),
+    "tiny-gptj": dict(family="gptj", hidden_size=64, num_layers=2,
+                      num_heads=4, vocab_size=256, max_seq_len=128,
+                      rotary_dim=8),
+    "tiny-gptneox": dict(family="gptneox", hidden_size=64, num_layers=2,
+                         num_heads=4, vocab_size=256, max_seq_len=128,
+                         rotary_dim=4),
+    "tiny-bert": dict(family="bert", hidden_size=64, num_layers=2,
+                      num_heads=4, vocab_size=256, max_seq_len=128),
+    "tiny-distilbert": dict(family="distilbert", hidden_size=64,
+                            num_layers=2, num_heads=4, vocab_size=256,
+                            max_seq_len=128),
     # GShard/Switch-style 8-expert GPT (BASELINE tracked config #4)
     "moe-tiny": dict(family="gpt2", hidden_size=64, num_layers=2, num_heads=4,
                      vocab_size=256, max_seq_len=128, moe_num_experts=8),

@@ -1,0 +1,164 @@
+"""Spatial (diffusers-family) model blocks — the UNet/VAE consumer of the
+spatial kernels.
+
+Reference: ``module_inject/containers/unet.py`` + ``containers/vae.py`` and
+the diffusers ``generic_policies`` path (``module_inject/replace_policy.py:26``
+``UNetPolicy``/``VAEPolicy``), which swap a diffusers UNet/VAE's GroupNorm
+and attention modules for the fused CUDA ops. Here the same coverage is a
+small JAX module family whose hot ops route through ``ops/spatial.py``:
+
+  * ``resnet_block``   — GroupNorm → silu → conv3x3 ×2 + skip (the
+    diffusers ResnetBlock2D shape; VAE decoder workhorse)
+  * ``attention_block`` — GroupNorm → qkv over flattened H·W tokens →
+    non-causal attention (``diffusers_attention``) → proj + residual (the
+    AttentionBlock/Transformer2D single-head spatial attention)
+  * ``mid_block``      — resnet → attention → resnet (UNet/VAE mid block)
+
+Layout is NHWC (channels-last — the TPU-native conv layout; diffusers'
+NCHW weights transpose at import). ``use_kernel=None`` auto-routes to the
+Pallas kernels on TPU with the jnp path as oracle/fallback, the same
+platform-probe discipline as the transformer stack.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _use_kernel(name: str, use_kernel: Optional[bool],
+                interpret: bool) -> bool:
+    """Kernel-vs-fallback routing through the ops REGISTRY (the one place
+    encoding per-op platform compatibility) — explicit use_kernel/interpret
+    override it, auto (None) defers to registry.is_compatible."""
+    if interpret or use_kernel is True:
+        return True
+    if use_kernel is False:
+        return False
+    from ..ops.registry import is_compatible
+
+    return is_compatible(name)
+
+
+def group_norm(x: jax.Array, scale: jax.Array, bias: jax.Array,
+               groups: int, eps: float = 1e-6,
+               use_kernel: Optional[bool] = None,
+               interpret: bool = False) -> jax.Array:
+    """(B, H, W, C) GroupNorm routed through the fused Pallas kernel
+    (ops/spatial.py) — flattens spatial dims to the (B, HW, C) token layout
+    the kernel reduces over."""
+    B, H, W, C = x.shape
+    tokens = x.reshape(B, H * W, C)
+    if _use_kernel("fused_group_norm", use_kernel, interpret):
+        from ..ops.spatial import fused_group_norm
+
+        out = fused_group_norm(tokens, scale, bias, groups, eps=eps,
+                               interpret=interpret)
+    else:
+        from ..ops.spatial import reference_group_norm
+
+        out = reference_group_norm(tokens, scale, bias, groups, eps=eps)
+    return out.reshape(B, H, W, C)
+
+
+def conv2d(x: jax.Array, w: jax.Array, b: Optional[jax.Array] = None,
+           stride: int = 1, padding: str = "SAME") -> jax.Array:
+    """NHWC conv; w: (kh, kw, Cin, Cout)."""
+    out = jax.lax.conv_general_dilated(
+        x, w, window_strides=(stride, stride), padding=padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    if b is not None:
+        out = out + b
+    return out
+
+
+def resnet_block(x: jax.Array, p: Dict[str, Any], groups: int = 8,
+                 use_kernel: Optional[bool] = None,
+                 interpret: bool = False) -> jax.Array:
+    """diffusers ResnetBlock2D: GN→silu→conv, GN→silu→conv, + skip
+    (1x1-conv'd when channel counts differ)."""
+    h = group_norm(x, p["norm1"]["scale"], p["norm1"]["bias"], groups,
+                   use_kernel=use_kernel, interpret=interpret)
+    h = jax.nn.silu(h)
+    h = conv2d(h, p["conv1"]["w"], p["conv1"]["b"])
+    h = group_norm(h, p["norm2"]["scale"], p["norm2"]["bias"], groups,
+                   use_kernel=use_kernel, interpret=interpret)
+    h = jax.nn.silu(h)
+    h = conv2d(h, p["conv2"]["w"], p["conv2"]["b"])
+    skip = x
+    if "shortcut" in p:
+        skip = conv2d(x, p["shortcut"]["w"], p["shortcut"]["b"])
+    return skip + h
+
+
+def attention_block(x: jax.Array, p: Dict[str, Any], groups: int = 8,
+                    use_kernel: Optional[bool] = None,
+                    interpret: bool = False) -> jax.Array:
+    """diffusers AttentionBlock: GN → single-head attention over H·W
+    tokens → proj, + residual (the VAE mid-block attention; reference
+    diffusers_attention.py:23)."""
+    B, H, W, C = x.shape
+    h = group_norm(x, p["norm"]["scale"], p["norm"]["bias"], groups,
+                   use_kernel=use_kernel, interpret=interpret)
+    tokens = h.reshape(B, H * W, C)
+    q = tokens @ p["q"]["w"] + p["q"]["b"]
+    k = tokens @ p["k"]["w"] + p["k"]["b"]
+    v = tokens @ p["v"]["w"] + p["v"]["b"]
+    if _use_kernel("diffusers_attention", use_kernel, interpret):
+        from ..ops.spatial import diffusers_attention
+
+        attn = diffusers_attention(q[:, :, None, :], k[:, :, None, :],
+                                   v[:, :, None, :], interpret=interpret)
+        attn = attn[:, :, 0, :]
+    else:
+        from .transformer import dot_product_attention
+
+        attn = dot_product_attention(q[:, :, None, :], k[:, :, None, :],
+                                     v[:, :, None, :], None,
+                                     causal=False)[:, :, 0, :]
+    out = attn @ p["proj"]["w"] + p["proj"]["b"]
+    return x + out.reshape(B, H, W, C)
+
+
+def mid_block(x: jax.Array, p: Dict[str, Any], groups: int = 8,
+              use_kernel: Optional[bool] = None,
+              interpret: bool = False) -> jax.Array:
+    """UNet/VAE mid block: resnet → attention → resnet."""
+    x = resnet_block(x, p["resnet1"], groups, use_kernel, interpret)
+    x = attention_block(x, p["attn"], groups, use_kernel, interpret)
+    return resnet_block(x, p["resnet2"], groups, use_kernel, interpret)
+
+
+def init_mid_block(rng: jax.Array, channels: int, k: int = 3
+                   ) -> Dict[str, Any]:
+    """Random init of a mid block (parity tests / smoke); conv weights
+    (kh, kw, Cin, Cout)."""
+    keys = jax.random.split(rng, 12)
+    C = channels
+    std = 0.1
+
+    def conv(key, kh):
+        return {"w": jax.random.normal(key, (kh, kh, C, C), jnp.float32) * std,
+                "b": jnp.zeros((C,), jnp.float32)}
+
+    def lin(key):
+        return {"w": jax.random.normal(key, (C, C), jnp.float32) * std,
+                "b": jnp.zeros((C,), jnp.float32)}
+
+    def norm():
+        return {"scale": jnp.ones((C,), jnp.float32),
+                "bias": jnp.zeros((C,), jnp.float32)}
+
+    def resnet(k0, k1):
+        return {"norm1": norm(), "conv1": conv(k0, k),
+                "norm2": norm(), "conv2": conv(k1, k)}
+
+    return {
+        "resnet1": resnet(keys[0], keys[1]),
+        "attn": {"norm": norm(), "q": lin(keys[2]), "k": lin(keys[3]),
+                 "v": lin(keys[4]), "proj": lin(keys[5])},
+        "resnet2": resnet(keys[6], keys[7]),
+    }

@@ -41,10 +41,21 @@ class TransformerConfig:
     ffn_hidden_size: Optional[int] = None   # None => 4*hidden (gelu) / llama rule (swiglu)
     max_seq_len: int = 1024
     norm: str = "layernorm"                 # layernorm | rmsnorm
+    norm_position: str = "pre"              # pre | post (post: BERT-family
+    #   encoders — LN applied AFTER each residual add, no final norm)
     position: str = "learned"               # learned | rope | alibi
     embed_norm: bool = False                # LayerNorm after embedding (BLOOM)
     activation: str = "gelu"                # gelu | relu | swiglu
     tie_embeddings: bool = True
+    causal: bool = True                     # False: bidirectional encoder
+    parallel_residual: bool = False         # x + attn(ln1(x)) + mlp(ln2(x))
+    #   (GPT-J/GPT-NeoX; GPT-J shares one LN — its import aliases ln2=ln1)
+    rotary_dim: Optional[int] = None        # partial rotary: rope on the
+    #   first rotary_dim dims of each head (GPT-J/NeoX), None => full head
+    type_vocab_size: int = 0                # >0: token-type embeddings (BERT)
+    final_norm: bool = True                 # False: no norm after the last
+    #   layer (post-LN encoders norm inside the block)
+    lm_head_bias: bool = False              # untied head carries a bias (GPT-J)
     norm_eps: float = 1e-5
     rope_theta: float = 10000.0
     dropout: float = 0.0              # embed/attn-out/mlp-out dropout rate.
@@ -132,17 +143,22 @@ def init_params(rng: jax.Array, cfg: TransformerConfig) -> Dict[str, Any]:
     }
     if cfg.position == "learned":
         params["pos"] = normal(ks[1], (cfg.max_seq_len, H), 0.01)
+    if cfg.type_vocab_size > 0:
+        params["type_embed"] = normal(ks[4], (cfg.type_vocab_size, H))
     if cfg.embed_norm:
         params["embed_norm"] = {"scale": jnp.ones((H,), cfg.dtype),
                                 "bias": jnp.zeros((H,), cfg.dtype)}
 
     params["layers"] = init_layer_params(ks[2], cfg, 0, cfg.num_layers)
 
-    params["final_norm"] = {"scale": jnp.ones((H,), cfg.dtype)}
-    if cfg.norm == "layernorm":
-        params["final_norm"]["bias"] = jnp.zeros((H,), cfg.dtype)
+    if cfg.final_norm:
+        params["final_norm"] = {"scale": jnp.ones((H,), cfg.dtype)}
+        if cfg.norm == "layernorm":
+            params["final_norm"]["bias"] = jnp.zeros((H,), cfg.dtype)
     if not cfg.tie_embeddings:
         params["lm_head"] = normal(ks[3], (H, V))
+        if cfg.lm_head_bias:
+            params["lm_head_b"] = jnp.zeros((V,), cfg.dtype)
     return params
 
 
@@ -261,15 +277,21 @@ def param_axes(cfg: TransformerConfig) -> Dict[str, Any]:
     axes: Dict[str, Any] = {
         "embed": {"tokens": (VOCAB, EMBED)},
         "layers": layer_axes,
-        "final_norm": ({"scale": (EMBED,), "bias": (EMBED,)}
-                       if cfg.norm == "layernorm" else {"scale": (EMBED,)}),
     }
+    if cfg.final_norm:
+        axes["final_norm"] = ({"scale": (EMBED,), "bias": (EMBED,)}
+                              if cfg.norm == "layernorm"
+                              else {"scale": (EMBED,)})
     if cfg.position == "learned":
         axes["pos"] = (SEQ, EMBED)
+    if cfg.type_vocab_size > 0:
+        axes["type_embed"] = (None, EMBED)
     if cfg.embed_norm:
         axes["embed_norm"] = {"scale": (EMBED,), "bias": (EMBED,)}
     if not cfg.tie_embeddings:
         axes["lm_head"] = (EMBED, VOCAB)
+        if cfg.lm_head_bias:
+            axes["lm_head_b"] = (VOCAB,)
     return axes
 
 
@@ -578,7 +600,13 @@ def _layer_forward(cfg: TransformerConfig, x: jax.Array, layer: Dict[str, Any],
     B, S, H = x.shape
     N, K, D = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
 
-    h = _norm(x, layer["ln1"]["scale"], layer["ln1"].get("bias"), cfg.norm, cfg.norm_eps)
+    post_ln = cfg.norm_position == "post"
+    if post_ln:
+        h = x      # post-LN (BERT family): raw input feeds attention; the
+        #            norm is applied after each residual add below
+    else:
+        h = _norm(x, layer["ln1"]["scale"], layer["ln1"].get("bias"),
+                  cfg.norm, cfg.norm_eps)
     q = _qeinsum("bsh,hd->bsd", h, layer["attn"]["wq"], cfg.dtype)
     k = _qeinsum("bsh,hd->bsd", h, layer["attn"]["wk"], cfg.dtype)
     v = _qeinsum("bsh,hd->bsd", h, layer["attn"]["wv"], cfg.dtype)
@@ -619,9 +647,19 @@ def _layer_forward(cfg: TransformerConfig, x: jax.Array, layer: Dict[str, Any],
             v = constrain(v, kspec)
 
     if cfg.position == "rope":
-        cos, sin = rope_table(positions, D, cfg.rope_theta)
-        q = apply_rope(q, cos, sin)
-        k = apply_rope(k, cos, sin)
+        rd = cfg.rotary_dim or D
+        cos, sin = rope_table(positions, rd, cfg.rope_theta)
+        if rd < D:
+            # partial rotary (GPT-J/NeoX): rope on the first rd dims only.
+            # (GPT-J's interleaved convention is handled at import time by
+            # permuting the rotary columns of wq/wk into rotate-half order.)
+            q = jnp.concatenate(
+                [apply_rope(q[..., :rd], cos, sin), q[..., rd:]], axis=-1)
+            k = jnp.concatenate(
+                [apply_rope(k[..., :rd], cos, sin), k[..., rd:]], axis=-1)
+        else:
+            q = apply_rope(q, cos, sin)
+            k = apply_rope(k, cos, sin)
 
     attn_fn = cfg.attention_impl or default_attention_impl()
     alibi = alibi_slopes(N) if cfg.position == "alibi" else None
@@ -697,9 +735,9 @@ def _layer_forward(cfg: TransformerConfig, x: jax.Array, layer: Dict[str, Any],
         attn = ring_attention(q, k, v, mask=mask, causal=True)
     else:
         if alibi is None:
-            attn = attn_fn(q, k, v, mask, causal=True)
+            attn = attn_fn(q, k, v, mask, causal=cfg.causal)
         else:
-            attn = attn_fn(q, k, v, mask, causal=True, alibi=alibi)
+            attn = attn_fn(q, k, v, mask, causal=cfg.causal, alibi=alibi)
 
     if cache is None and not use_ring:
         from ..parallel.sequence import attn_out_spec, constrain
@@ -719,9 +757,20 @@ def _layer_forward(cfg: TransformerConfig, x: jax.Array, layer: Dict[str, Any],
 
         if sequence_parallel_enabled():
             attn_out = constrain(attn_out, hidden_spec())
-    x = x + attn_out
-
-    h = _norm(x, layer["ln2"]["scale"], layer["ln2"].get("bias"), cfg.norm, cfg.norm_eps)
+    if cfg.parallel_residual:
+        # GPT-J/NeoX: x + attn(ln1(x)) + mlp(ln2(x)) — one residual add,
+        # the MLP reads the ORIGINAL x through its own norm
+        h = _norm(x, layer["ln2"]["scale"], layer["ln2"].get("bias"),
+                  cfg.norm, cfg.norm_eps)
+    elif post_ln:
+        # BERT family: norm AFTER the residual add; the normed sum feeds MLP
+        x = _norm(x + attn_out, layer["ln1"]["scale"],
+                  layer["ln1"].get("bias"), cfg.norm, cfg.norm_eps)
+        h = x
+    else:
+        x = x + attn_out
+        h = _norm(x, layer["ln2"]["scale"], layer["ln2"].get("bias"),
+                  cfg.norm, cfg.norm_eps)
     aux = jnp.float32(0.0)
     if cfg.moe_num_experts > 0:
         from ..parallel.moe import moe_mlp
@@ -756,11 +805,18 @@ def _layer_forward(cfg: TransformerConfig, x: jax.Array, layer: Dict[str, Any],
     else:
         inner = _qeinsum("bsh,hf->bsf", h, layer["mlp"]["w_up"], cfg.dtype) + layer["mlp"]["b_up"]
         inner = (jax.nn.relu(inner) if cfg.activation == "relu"
-                 else jax.nn.gelu(inner, approximate=True))
+                 else jax.nn.gelu(inner,
+                                  approximate=cfg.activation != "gelu-exact"))
         mlp_out = _qeinsum("bsf,fh->bsh", inner, layer["mlp"]["w_down"], cfg.dtype) + layer["mlp"]["b_down"]
     if cache is None:
         mlp_out = _dropout(mlp_out, cfg, salt=37)
-    x = x + mlp_out
+    if cfg.parallel_residual:
+        x = x + attn_out + mlp_out
+    elif post_ln:
+        x = _norm(x + mlp_out, layer["ln2"]["scale"],
+                  layer["ln2"].get("bias"), cfg.norm, cfg.norm_eps)
+    else:
+        x = x + mlp_out
     return x, new_cache, aux
 
 
@@ -770,7 +826,8 @@ def forward(params: Dict[str, Any], input_ids: jax.Array,
             cache: Optional[Dict[str, Any]] = None,
             start_pos: Any = 0,
             pld_theta: Optional[jax.Array] = None,
-            positions: Optional[jax.Array] = None
+            positions: Optional[jax.Array] = None,
+            token_type_ids: Optional[jax.Array] = None
             ) -> Tuple[jax.Array, Optional[Dict[str, Any]], jax.Array]:
     """Token ids (B,S) → (logits (B,S,V), new_cache, moe_aux_loss). With
     ``cache``, runs in decode mode (cache is a per-layer stacked pytree; see
@@ -784,6 +841,11 @@ def forward(params: Dict[str, Any], input_ids: jax.Array,
         positions = jnp.arange(S) + start_pos
     if cfg.position == "learned":
         x = x + params["pos"][positions].astype(cfg.dtype)
+    if cfg.type_vocab_size > 0:
+        # BERT segment embeddings; absent ids mean segment 0 (HF default)
+        tti = (jnp.zeros((B, S), jnp.int32) if token_type_ids is None
+               else token_type_ids)
+        x = x + params["type_embed"][tti].astype(cfg.dtype)
     if cfg.embed_norm:
         x = _norm(x, params["embed_norm"]["scale"],
                   params["embed_norm"].get("bias"), "layernorm", cfg.norm_eps)
@@ -890,13 +952,25 @@ def forward(params: Dict[str, Any], input_ids: jax.Array,
         (x, aux_total), new_cache = lax.scan(block_fn, (x, jnp.float32(0.0)),
                                              (params["layers"], cache))
 
-    x = _norm(x, params["final_norm"]["scale"], params["final_norm"].get("bias"),
-              cfg.norm, cfg.norm_eps)
+    logits = head_logits(params, x, cfg)
+    return logits, new_cache, aux_total
+
+
+def head_logits(params: Dict[str, Any], x: jax.Array,
+                cfg: TransformerConfig) -> jax.Array:
+    """Final norm + output projection — THE one head implementation (the
+    pipeline and param-offload executors call it too; a config knob added
+    here must not be re-implemented there)."""
+    if cfg.final_norm:
+        x = _norm(x, params["final_norm"]["scale"],
+                  params["final_norm"].get("bias"), cfg.norm, cfg.norm_eps)
     if cfg.tie_embeddings:
         logits = jnp.einsum("bsh,vh->bsv", x, params["embed"]["tokens"])
     else:
         logits = _qeinsum("bsh,hv->bsv", x, params["lm_head"], cfg.dtype)
-    return logits, new_cache, aux_total
+        if "lm_head_b" in params:
+            logits = logits + params["lm_head_b"]
+    return logits
 
 
 def cross_entropy_loss(logits: jax.Array, labels: jax.Array,

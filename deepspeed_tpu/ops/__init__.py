@@ -36,6 +36,9 @@ register_op("int8_matmul", int8_matmul, reference=reference_int8_matmul,
             description="weight-only int8 GEMM (in-kernel tile dequant)")
 register_op("int4_matmul", int4_matmul, reference=reference_int4_matmul,
             description="weight-only int4 GEMM (nibble-packed, group scales)")
+register_op("diffusers_attention", diffusers_attention,
+            reference=diffusers_attention,
+            description="spatial self/cross attention (flash, non-causal)")
 register_op("fused_group_norm", fused_group_norm,
             reference=reference_group_norm,
             description="spatial GroupNorm (diffusers UNet norm, NHWC tokens)")

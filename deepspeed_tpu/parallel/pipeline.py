@@ -184,13 +184,9 @@ def _stage_helpers(cfg):
         return x, jnp.sum(auxs)
 
     def head_loss(et, h, lbl, msk):
-        h = _norm(h, et["final_norm"]["scale"], et["final_norm"].get("bias"),
-                  cfg.norm, cfg.norm_eps)
-        if cfg.tie_embeddings:
-            logits = jnp.einsum("bsh,vh->bsv", h, et["embed"]["tokens"])
-        else:
-            logits = jnp.einsum("bsh,hv->bsv", h, et["lm_head"])
-        return cross_entropy_loss(logits, lbl, msk)
+        from ..models.transformer import head_logits
+
+        return cross_entropy_loss(head_logits(et, h, cfg), lbl, msk)
 
     def derive_labels(ids):
         return jnp.concatenate(

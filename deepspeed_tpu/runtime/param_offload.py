@@ -446,16 +446,10 @@ class ParamOffloadExecutor:
                 return x
 
             def head_loss(resident, x, labels, mask):
-                x = _norm(x, resident["final_norm"]["scale"],
-                          resident["final_norm"].get("bias"), c.norm,
-                          c.norm_eps)
-                if c.tie_embeddings:
-                    logits = jnp.einsum("bsh,vh->bsv", x,
-                                        resident["embed"]["tokens"])
-                else:
-                    logits = _qeinsum("bsh,hv->bsv", x, resident["lm_head"],
-                                      c.dtype)
-                return cross_entropy_loss(logits, labels, mask)
+                from ..models.transformer import head_logits
+
+                return cross_entropy_loss(head_logits(resident, x, c),
+                                          labels, mask)
 
             return embed_fwd, block_fwd, head_loss
 

@@ -31,7 +31,8 @@ def naive_greedy(model, params, prompt, n_new):
     return jnp.stack(out, axis=1)
 
 
-@pytest.mark.parametrize("preset", ["tiny", "tiny-llama", "tiny-bloom", "tiny-opt"])
+@pytest.mark.parametrize("preset", ["tiny", "tiny-llama", "tiny-bloom",
+                                    "tiny-opt", "tiny-gptj", "tiny-gptneox"])
 @pytest.mark.slow
 def test_cache_logits_match_full_forward(preset):
     """Teacher-forced KV-cache correctness: prefill + per-token decode steps
@@ -327,6 +328,104 @@ def test_hf_import_opt():
                                _hf_logits(hf, ids), atol=2e-3, rtol=2e-3)
 
 
+@pytest.mark.slow
+def test_hf_import_gptj():
+    """GPT-J: parallel residual + partial INTERLEAVED rotary (converted to
+    rotate-half at import) + biased untied head."""
+    transformers = pytest.importorskip("transformers")
+    __import__("torch").manual_seed(14)
+    cfg = transformers.GPTJConfig(
+        vocab_size=256, n_positions=128, n_embd=64, n_layer=2, n_head=4,
+        rotary_dim=8, attn_pdrop=0.0, embd_pdrop=0.0, resid_pdrop=0.0)
+    hf = transformers.GPTJForCausalLM(cfg).eval()
+    ids = np.random.RandomState(4).randint(0, 256, (2, 16))
+    np.testing.assert_allclose(_ours_logits("tiny-gptj", hf, ids),
+                               _hf_logits(hf, ids), atol=2e-3, rtol=2e-3)
+
+
+@pytest.mark.slow
+def test_hf_import_gptneox():
+    """GPT-NeoX: fused per-head qkv interleave + parallel residual with its
+    own post-attention LN + 25% rotate-half rotary."""
+    transformers = pytest.importorskip("transformers")
+    __import__("torch").manual_seed(15)
+    cfg = transformers.GPTNeoXConfig(
+        vocab_size=256, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=256, rotary_pct=0.25,
+        max_position_embeddings=128, use_parallel_residual=True,
+        hidden_dropout=0.0, attention_dropout=0.0)
+    hf = transformers.GPTNeoXForCausalLM(cfg).eval()
+    ids = np.random.RandomState(5).randint(0, 256, (2, 16))
+    np.testing.assert_allclose(_ours_logits("tiny-gptneox", hf, ids),
+                               _hf_logits(hf, ids), atol=2e-3, rtol=2e-3)
+
+
+def _encoder_expected(hf, ids, **kw):
+    """HF encoder last_hidden_state mapped through the shared embedding —
+    the linear map our tied 'logits' apply, so hidden parity <=> logit
+    parity."""
+    import torch
+
+    with torch.no_grad():
+        hidden = hf(torch.tensor(ids), **kw).last_hidden_state
+        E = hf.get_input_embeddings().weight
+        return (hidden @ E.T).float().numpy()
+
+
+@pytest.mark.slow
+def test_hf_import_bert():
+    """BERT: the NON-CAUSAL post-LN encoder path end to end — bidirectional
+    attention, token-type embeddings, LN after each residual, no final
+    norm."""
+    transformers = pytest.importorskip("transformers")
+    torch = __import__("torch")
+    torch.manual_seed(16)
+    cfg = transformers.BertConfig(
+        vocab_size=256, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=256,
+        max_position_embeddings=128, type_vocab_size=2, hidden_act="gelu",
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0)
+    hf = transformers.BertModel(cfg).eval()
+    ids = np.random.RandomState(6).randint(0, 256, (2, 16))
+    ours = _ours_logits("tiny-bert", hf, ids)
+    np.testing.assert_allclose(ours, _encoder_expected(hf, ids),
+                               atol=2e-3, rtol=2e-3)
+    # bidirectionality probe: flipping a LATER token must change EARLIER
+    # positions' outputs (a causal model would leave them untouched)
+    ids2 = ids.copy()
+    ids2[:, -1] = (ids2[:, -1] + 1) % 256
+    ours2 = _ours_logits("tiny-bert", hf, ids2)
+    assert np.abs(ours2[:, 0] - ours[:, 0]).max() > 1e-4
+    # token types flow through
+    engine = init_inference("tiny-bert", dtype=jnp.float32,
+                            max_out_tokens=128, hf_model=hf)
+    tti = np.zeros_like(ids)
+    tti[:, 8:] = 1
+    from deepspeed_tpu.models.transformer import forward as fwd
+
+    got = np.asarray(fwd(engine.params, jnp.asarray(ids), engine.model.config,
+                         token_type_ids=jnp.asarray(tti))[0])
+    np.testing.assert_allclose(
+        got, _encoder_expected(hf, ids, token_type_ids=torch.tensor(tti)),
+        atol=2e-3, rtol=2e-3)
+
+
+@pytest.mark.slow
+def test_hf_import_distilbert():
+    transformers = pytest.importorskip("transformers")
+    __import__("torch").manual_seed(17)
+    cfg = transformers.DistilBertConfig(
+        vocab_size=256, dim=64, n_layers=2, n_heads=4, hidden_dim=256,
+        max_position_embeddings=128, dropout=0.0, attention_dropout=0.0,
+        activation="gelu", sinusoidal_pos_embds=False)
+    hf = transformers.DistilBertModel(cfg).eval()
+    ids = np.random.RandomState(7).randint(0, 256, (2, 16))
+    np.testing.assert_allclose(_ours_logits("tiny-distilbert", hf, ids),
+                               _encoder_expected(hf, ids),
+                               atol=2e-3, rtol=2e-3)
+
+
+@pytest.mark.slow
 def test_hf_import_bloom():
     transformers = pytest.importorskip("transformers")
     __import__("torch").manual_seed(13)
