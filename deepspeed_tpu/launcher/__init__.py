@@ -5,6 +5,8 @@
   launch.py      per-node process spawner with env rendezvous injection
                  (reference launcher/launch.py:216)
   multinode.py   PDSH/SSH command builders (reference multinode_runner.py:18)
+  elastic_agent.py  worker monitor + restart/re-rendezvous loop
+                 (reference elasticity/elastic_agent.py:28; ds-tpu-elastic CLI)
 
 TPU difference that shapes the design: one JAX process drives ALL local chips,
 so the spawner defaults to one process per host (not per accelerator); the
@@ -12,4 +14,5 @@ so the spawner defaults to one process per host (not per accelerator); the
 layouts.
 """
 
+from .elastic_agent import ElasticAgent, ElasticAgentConfig  # noqa: F401
 from .runner import fetch_hostfile, main  # noqa: F401

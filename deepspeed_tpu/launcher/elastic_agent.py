@@ -1,0 +1,239 @@
+"""Elastic agent: worker monitor + restart loop with re-rendezvous.
+
+Reference: ``elasticity/elastic_agent.py:28`` (DSElasticAgent — monitors the
+worker group, restarts failed workers up to ``max_restarts`` with a fresh
+rendezvous, and re-resolves membership on change). The TPU analog is
+launcher-level: the agent owns the per-node worker subprocesses; on any
+worker failure it
+
+  1. terminates the surviving workers (the group restarts as a unit — a
+     partial group would deadlock in the first collective),
+  2. re-rendezvouses: restart count bumps, MASTER_PORT moves to a fresh
+     port, and (when the elastic config allows fewer workers) membership
+     shrinks to the next valid world size with the global batch held
+     constant via the elasticity batch math (compute_elastic_config),
+  3. respawns the workers, which resume from the latest checkpoint (the
+     training script's own load_checkpoint(latest) — the same contract the
+     reference's workers follow).
+
+Env contract per worker (on top of launch.py's RANK/WORLD_SIZE/MASTER_*):
+  DSTPU_RESTART_COUNT   how many times the group has been restarted
+  DSTPU_ELASTIC_MICRO   per-worker micro batch for the CURRENT membership
+                        (only when an elasticity config is given)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import subprocess
+import sys
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+from ..utils.logging import logger
+from .launch import build_rank_env
+
+
+@dataclasses.dataclass
+class ElasticAgentConfig:
+    max_restarts: int = 3
+    monitor_interval: float = 0.2
+    master_addr: str = "127.0.0.1"
+    master_port: int = 29600
+    min_workers: Optional[int] = None   # None => always restart at full size
+    # optional framework-config dict with an "elasticity" section: membership
+    # changes recompute the micro batch so the global batch stays fixed
+    elastic_config: Optional[Dict[str, Any]] = None
+    cpu_devices_per_proc: int = 0       # testing: virtual CPU devices
+
+
+class WorkerGroupFailure(RuntimeError):
+    pass
+
+
+class ElasticAgent:
+    """Single-node worker-group supervisor (multi-node composes by running
+    one agent per node under the multinode runner)."""
+
+    def __init__(self, cmd: Sequence[str], nprocs: int,
+                 config: Optional[ElasticAgentConfig] = None,
+                 env_base: Optional[Dict[str, str]] = None):
+        self.cmd = list(cmd)
+        self.nprocs = int(nprocs)
+        self.cfg = config or ElasticAgentConfig()
+        self.env_base = dict(env_base or {})
+        self.restart_count = 0
+        self.procs: List[subprocess.Popen] = []
+        self._world = self.nprocs
+        if self.cfg.elastic_config is not None:
+            # fail at CONSTRUCTION, not at first spawn: the starting world
+            # size must be one of the elastic set or the micro-batch math
+            # has no answer for it
+            from ..elasticity import compute_elastic_config
+
+            _, valid = compute_elastic_config(self.cfg.elastic_config)
+            if self.nprocs not in valid:
+                raise ValueError(
+                    f"nprocs={self.nprocs} is not in the elastic valid "
+                    f"world-size set {sorted(valid)} — pick one of those "
+                    "(or drop the elastic config)")
+
+    # -- membership -------------------------------------------------------
+    def _next_membership(self, failed: bool) -> int:
+        """World size for the next incarnation. Full size unless shrinking
+        is allowed AND a failure just happened; then the next valid elastic
+        world size below the current one (global batch preserved)."""
+        if not failed or self.cfg.min_workers is None:
+            return self._world
+        if self._world <= self.cfg.min_workers:
+            return self._world
+        candidate = self._world - 1
+        if self.cfg.elastic_config is not None:
+            from ..elasticity import compute_elastic_config
+
+            _, valid = compute_elastic_config(self.cfg.elastic_config)
+            valid = sorted(w for w in valid
+                           if self.cfg.min_workers <= w < self._world)
+            if not valid:
+                return self._world
+            candidate = valid[-1]
+        return max(candidate, self.cfg.min_workers)
+
+    def _micro_for(self, world: int) -> Optional[int]:
+        if self.cfg.elastic_config is None:
+            return None
+        from ..elasticity import compute_elastic_config
+
+        _, _, micro = compute_elastic_config(self.cfg.elastic_config,
+                                             world_size=world,
+                                             return_microbatch=True)
+        return micro
+
+    # -- lifecycle --------------------------------------------------------
+    def _spawn(self) -> None:
+        port = self.cfg.master_port + self.restart_count   # re-rendezvous
+        world_info = {"localhost": self._world}
+        rank_envs = build_rank_env(world_info, "localhost",
+                                   self.cfg.master_addr, port)
+        micro = self._micro_for(self._world)
+        self.procs = []
+        for env_add in rank_envs:
+            env = dict(os.environ)
+            env.update(self.env_base)
+            env.update(env_add)
+            env["DSTPU_RESTART_COUNT"] = str(self.restart_count)
+            if micro is not None:
+                env["DSTPU_ELASTIC_MICRO"] = str(micro)
+            if self.cfg.cpu_devices_per_proc:
+                env["JAX_PLATFORMS"] = "cpu"
+                flags = env.get("XLA_FLAGS", "")
+                env["XLA_FLAGS"] = (
+                    flags + " --xla_force_host_platform_device_count="
+                    f"{self.cfg.cpu_devices_per_proc}")
+            self.procs.append(subprocess.Popen(self.cmd, env=env))
+        logger.info(
+            f"elastic agent: spawned {self._world} workers "
+            f"(restart {self.restart_count}, port {port}"
+            + (f", micro={micro}" if micro is not None else "") + ")")
+
+    def _terminate_all(self) -> None:
+        for p in self.procs:
+            if p.poll() is None:
+                p.terminate()
+        deadline = time.time() + 10
+        for p in self.procs:
+            try:
+                p.wait(timeout=max(0.1, deadline - time.time()))
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait()            # reap — no zombies across restarts
+
+    def run(self) -> int:
+        """Supervise until the group exits cleanly; returns the exit code.
+        Raises WorkerGroupFailure after max_restarts is exhausted."""
+        import signal
+
+        def _on_signal(signum, frame):
+            # preemption path: take the worker group down with the agent
+            # (launch.py does the same; orphaned workers would pin the chips)
+            self._terminate_all()
+            raise SystemExit(128 + signum)
+
+        prev = {}
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            try:
+                prev[sig] = signal.signal(sig, _on_signal)
+            except ValueError:
+                pass                 # non-main thread (tests): skip handlers
+        self._spawn()
+        try:
+            while True:
+                rcs = [p.poll() for p in self.procs]
+                if all(rc == 0 for rc in rcs):
+                    logger.info("elastic agent: worker group completed")
+                    return 0
+                failed = [rc for rc in rcs if rc not in (None, 0)]
+                if failed:
+                    logger.error(
+                        f"elastic agent: worker failed rc={failed[0]} "
+                        f"(restart {self.restart_count}/"
+                        f"{self.cfg.max_restarts})")
+                    self._terminate_all()
+                    if self.restart_count >= self.cfg.max_restarts:
+                        raise WorkerGroupFailure(
+                            f"worker group failed {self.restart_count + 1} "
+                            f"times (max_restarts={self.cfg.max_restarts})")
+                    self._world = self._next_membership(failed=True)
+                    self.restart_count += 1
+                    self._spawn()
+                time.sleep(self.cfg.monitor_interval)
+        finally:
+            self._terminate_all()
+            for sig, handler in prev.items():
+                try:
+                    signal.signal(sig, handler)
+                except ValueError:
+                    pass
+
+
+def main(args: Optional[List[str]] = None) -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        description="deepspeed-tpu elastic agent (worker monitor + restart)")
+    parser.add_argument("--nprocs", type=int, required=True)
+    parser.add_argument("--max_restarts", type=int, default=3)
+    parser.add_argument("--min_workers", type=int, default=None)
+    parser.add_argument("--master_addr", default="127.0.0.1")
+    parser.add_argument("--master_port", type=int, default=29600)
+    parser.add_argument("--cpu_devices_per_proc", type=int, default=0)
+    parser.add_argument("--elastic_config", default=None,
+                        help="JSON config file with an 'elasticity' section "
+                             "(membership changes recompute the micro batch)")
+    parser.add_argument("training_script")
+    parser.add_argument("training_script_args", nargs="...")
+    opts = parser.parse_args(args)
+    elastic = None
+    if opts.elastic_config:
+        import json
+
+        with open(opts.elastic_config) as f:
+            elastic = json.load(f)
+    agent = ElasticAgent(
+        [sys.executable, opts.training_script] + opts.training_script_args,
+        nprocs=opts.nprocs,
+        config=ElasticAgentConfig(
+            max_restarts=opts.max_restarts, min_workers=opts.min_workers,
+            master_addr=opts.master_addr, master_port=opts.master_port,
+            cpu_devices_per_proc=opts.cpu_devices_per_proc,
+            elastic_config=elastic))
+    try:
+        return agent.run()
+    except WorkerGroupFailure as e:
+        logger.error(str(e))
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -3,6 +3,7 @@
 assertions, no cluster needed) plus a real 2-process local smoke test
 (the DistributedExec pattern driven through the actual CLI)."""
 
+import json
 import os
 import subprocess
 import sys
@@ -151,3 +152,123 @@ def test_ds_report_cli():
     assert out.returncode == 0, out.stderr
     assert "flash_attention" in out.stdout
     assert "jax version" in out.stdout
+
+
+class TestElasticAgent:
+    """Reference elasticity/elastic_agent.py:28 semantics: worker failure →
+    group restart with re-rendezvous, up to max_restarts; resume from the
+    latest checkpoint; membership shrink recomputes the elastic micro
+    batch."""
+
+    @pytest.mark.slow
+    def test_kill_worker_restarts_and_resumes(self, tmp_path):
+        from deepspeed_tpu.launcher.elastic_agent import (ElasticAgent,
+                                                          ElasticAgentConfig)
+
+        log = tmp_path / "steps.jsonl"
+        script = tmp_path / "worker.py"
+        script.write_text(textwrap.dedent("""
+            import json, os, sys
+            sys.path.insert(0, %r)
+            import jax
+            jax.config.update("jax_platforms", "cpu")
+            import numpy as np
+            import jax.numpy as jnp
+            import deepspeed_tpu as ds
+            from deepspeed_tpu.models.transformer import (TransformerConfig,
+                                                          build_model)
+
+            ckpt_root, log_path, total = sys.argv[1], sys.argv[2], int(sys.argv[3])
+            rank = os.environ["RANK"]
+            restart = int(os.environ["DSTPU_RESTART_COUNT"])
+            ckpt = os.path.join(ckpt_root, f"rank{rank}")
+            model = build_model(TransformerConfig(
+                vocab_size=64, hidden_size=16, num_layers=2, num_heads=2,
+                max_seq_len=16))
+            engine, *_ = ds.initialize(model=model, config={
+                "train_micro_batch_size_per_gpu": 2, "steps_per_print": 1000,
+                "optimizer": {"type": "adamw", "params": {"lr": 1e-3}}})
+            engine.load_checkpoint(ckpt)          # no-op on first run
+            start = engine.global_steps
+            rng = np.random.default_rng(0)
+            for step in range(start, total):
+                loss = float(engine.train_batch(
+                    batch={"input_ids": rng.integers(0, 64, (1, 2, 16))}))
+                engine.save_checkpoint(ckpt)
+                with open(log_path, "a") as f:
+                    f.write(json.dumps({"rank": rank, "restart": restart,
+                                        "step": step}) + chr(10))
+                if step == 2 and restart == 0 and rank == "0":
+                    os._exit(17)                  # simulated worker death
+            print("WORKER-DONE", rank, flush=True)
+        """ % REPO))
+        agent = ElasticAgent(
+            [sys.executable, str(script), str(tmp_path / "ck"), str(log),
+             "5"],
+            nprocs=2,
+            config=ElasticAgentConfig(max_restarts=2, master_port=29530),
+            env_base={"PYTHONPATH": REPO, "JAX_PLATFORMS": "cpu",
+                      "XLA_FLAGS": "--xla_force_host_platform_device_count=1"})
+        rc = agent.run()
+        assert rc == 0
+        assert agent.restart_count == 1
+        lines = [json.loads(l)
+                 for l in log.read_text().splitlines()]
+        r0 = [l for l in lines if l["rank"] == "0"]
+        # incarnation 0 died after step 2; incarnation 1 RESUMED at step 3
+        # (checkpoint restore), not step 0
+        steps_by_restart = {}
+        for l in r0:
+            steps_by_restart.setdefault(l["restart"], []).append(l["step"])
+        assert steps_by_restart[0] == [0, 1, 2]
+        assert steps_by_restart[1][0] == 3, steps_by_restart
+        assert steps_by_restart[1][-1] == 4
+
+    def test_membership_shrink_recomputes_micro(self, tmp_path):
+        from deepspeed_tpu.launcher.elastic_agent import (ElasticAgent,
+                                                          ElasticAgentConfig)
+
+        probe = tmp_path / "probe.py"
+        # workers only survive at world size <= 2 — the agent must shrink
+        # membership to the next VALID elastic world size and re-spawn with
+        # the recomputed micro batch in the env
+        probe.write_text(textwrap.dedent("""
+            import json, os, sys
+            with open(sys.argv[1], "a") as f:
+                f.write(json.dumps({
+                    "world": os.environ["WORLD_SIZE"],
+                    "micro": os.environ.get("DSTPU_ELASTIC_MICRO"),
+                    "port": os.environ["MASTER_PORT"]}) + chr(10))
+            sys.exit(0 if int(os.environ["WORLD_SIZE"]) <= 2 else 1)
+        """))
+        log = tmp_path / "probe.jsonl"
+        elastic = {"elasticity": {
+            "enabled": True, "max_train_batch_size": 16,
+            "micro_batch_sizes": [2, 4], "min_gpus": 1, "max_gpus": 8,
+            "version": 0.1}}
+        agent = ElasticAgent(
+            [sys.executable, str(probe), str(log)], nprocs=4,
+            config=ElasticAgentConfig(max_restarts=3, min_workers=1,
+                                      master_port=29540,
+                                      elastic_config=elastic))
+        rc = agent.run()
+        assert rc == 0
+        assert agent._world == 2 and agent.restart_count == 1
+        lines = [json.loads(l)
+                 for l in log.read_text().splitlines()]
+        # re-rendezvous: the port moved between incarnations
+        assert lines[0]["port"] != lines[-1]["port"]
+        assert lines[-1]["world"] == "2"
+        assert lines[-1]["micro"] is not None
+
+    def test_max_restarts_exhausted(self, tmp_path):
+        from deepspeed_tpu.launcher.elastic_agent import (ElasticAgent,
+                                                          ElasticAgentConfig,
+                                                          WorkerGroupFailure)
+
+        agent = ElasticAgent(
+            [sys.executable, "-c", "import sys; sys.exit(3)"], nprocs=1,
+            config=ElasticAgentConfig(max_restarts=1, master_port=29550))
+        with pytest.raises(WorkerGroupFailure, match="max_restarts"):
+            agent.run()
+        assert agent.restart_count == 1
