@@ -51,15 +51,9 @@ def init_compression(config: Dict[str, Any]) -> CompressionPlan:
     """Parse the ``compression_training`` section into a plan (reference
     init_compression's policy extraction, module-walk deferred to apply)."""
     section = config.get("compression_training", config)
-    if section.get("activation_quantization", {}).get(
-            "shared_parameters", {}).get("enabled", False):
-        raise NotImplementedError(
-            "activation_quantization needs a forward-activation hook, not a "
-            "param transform — not implemented yet (weight_quantization and "
-            "sparse/row/head pruning are)")
     methods: Dict[str, Dict[str, Any]] = {}
-    for name in ("weight_quantization", "sparse_pruning", "row_pruning",
-                 "head_pruning"):
+    for name in ("weight_quantization", "activation_quantization",
+                 "sparse_pruning", "row_pruning", "head_pruning"):
         spec = section.get(name)
         if not spec:
             continue
@@ -87,7 +81,9 @@ def init_compression(config: Dict[str, Any]) -> CompressionPlan:
 def _fake_quant_ste(w: jax.Array, bits: int) -> jax.Array:
     """Symmetric per-tensor fake quantization with straight-through grads
     (reference Quantizer autograd fn; ops/quantization.py has the Pallas
-    group-wise variant — per-tensor here matches basic_layer defaults)."""
+    group-wise variant — per-tensor here matches basic_layer defaults).
+    Also the ACTIVATION quantizer (reference QuantAct): the transformer
+    applies it to layer inputs when cfg.act_quant_bits > 0."""
     qmax = 2.0 ** (bits - 1) - 1
     scale = jnp.max(jnp.abs(w.astype(jnp.float32))) / qmax
     scale = jnp.where(scale == 0, 1.0, scale)
@@ -95,6 +91,29 @@ def _fake_quant_ste(w: jax.Array, bits: int) -> jax.Array:
     # straight-through: forward quantized, backward identity
     return (w.astype(jnp.float32)
             + jax.lax.stop_gradient(q - w.astype(jnp.float32))).astype(w.dtype)
+
+
+def fake_quant_activation(x: jax.Array, bits: int) -> jax.Array:
+    """Public activation fake-quant (QuantAct analog) — per-tensor symmetric
+    with straight-through gradients."""
+    return _fake_quant_ste(x, bits)
+
+
+def _fake_quant_ste_layered(w: jax.Array, layer_bits) -> jax.Array:
+    """Per-LAYER fake quantization of a stacked (L, ...) leaf — the MoQ
+    rendering: the eigenvalue schedule assigns each layer its own bit width
+    (reference runtime/quantize.py Quantizer with eigenvalue-scaled periods,
+    engine.py:1479)."""
+    L = w.shape[0]
+    bits = jnp.asarray(layer_bits, jnp.float32).reshape(
+        (L,) + (1,) * (w.ndim - 1))
+    qmax = 2.0 ** (bits - 1) - 1
+    w32 = w.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(w32.reshape(L, -1)), axis=1).reshape(
+        (L,) + (1,) * (w.ndim - 1))
+    scale = jnp.where(absmax == 0, 1.0, absmax / qmax)
+    q = jnp.clip(jnp.round(w32 / scale), -qmax, qmax) * scale
+    return (w32 + jax.lax.stop_gradient(q - w32)).astype(w.dtype)
 
 
 def _magnitude_mask(w: jax.Array, dense_ratio: float, axis=None) -> jax.Array:
@@ -144,11 +163,16 @@ def apply_compression(params: Any, plan: CompressionPlan,
         if leaf is not None and hasattr(leaf, "ndim") and leaf.ndim >= 2:
             if ("weight_quantization" in active
                     and plan.matches("weight_quantization", key)):
-                bits = int(plan.methods["weight_quantization"]["params"]
-                           .get("target_bits", plan.methods[
-                               "weight_quantization"]["params"]
-                           .get("start_bits", 8)))
-                w = _fake_quant_ste(w, bits)
+                wq = plan.methods["weight_quantization"]
+                layer_bits = wq.get("layer_bits")
+                if (layer_bits is not None and key.startswith("layers/")
+                        and leaf.shape[0] == len(layer_bits)):
+                    # MoQ: per-layer bit widths from the eigenvalue schedule
+                    w = _fake_quant_ste_layered(w, layer_bits)
+                else:
+                    bits = int(wq["params"].get(
+                        "target_bits", wq["params"].get("start_bits", 8)))
+                    w = _fake_quant_ste(w, bits)
             if "sparse_pruning" in active and plan.matches("sparse_pruning", key):
                 ratio = float(plan.methods["sparse_pruning"]["params"]
                               .get("dense_ratio", 0.5))

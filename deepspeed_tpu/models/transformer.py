@@ -76,6 +76,9 @@ class TransformerConfig:
     ltd_keep: int = 0                       # tokens kept per LTD layer; STATIC
     #   (the schedule changes it only at quantised boundaries, so each value
     #   is one extra jit trace — same discipline as the seqlen curriculum)
+    act_quant_bits: int = 0           # >0: fake-quantize layer input
+    #   activations (QAT; reference QuantAct) — the engine sets it from the
+    #   compression schedule; STATIC (one re-jit per boundary)
     remat: bool = False                     # activation checkpointing over layers
     remat_policy: str = "full"              # full | dots (save matmul outputs,
     #   recompute elementwise/attention — reference partition_activations analog)
@@ -607,6 +610,11 @@ def _layer_forward(cfg: TransformerConfig, x: jax.Array, layer: Dict[str, Any],
     else:
         h = _norm(x, layer["ln1"]["scale"], layer["ln1"].get("bias"),
                   cfg.norm, cfg.norm_eps)
+    if cfg.act_quant_bits and cache is None:
+        # activation QAT (reference QuantAct): quantize the attention input
+        from ..compression.compress import fake_quant_activation
+
+        h = fake_quant_activation(h, cfg.act_quant_bits)
     q = _qeinsum("bsh,hd->bsd", h, layer["attn"]["wq"], cfg.dtype)
     k = _qeinsum("bsh,hd->bsd", h, layer["attn"]["wk"], cfg.dtype)
     v = _qeinsum("bsh,hd->bsd", h, layer["attn"]["wv"], cfg.dtype)
@@ -771,6 +779,10 @@ def _layer_forward(cfg: TransformerConfig, x: jax.Array, layer: Dict[str, Any],
         x = x + attn_out
         h = _norm(x, layer["ln2"]["scale"], layer["ln2"].get("bias"),
                   cfg.norm, cfg.norm_eps)
+    if cfg.act_quant_bits and cache is None:
+        from ..compression.compress import fake_quant_activation
+
+        h = fake_quant_activation(h, cfg.act_quant_bits)   # MLP input
     aux = jnp.float32(0.0)
     if cfg.moe_num_experts > 0:
         from ..parallel.moe import moe_mlp

@@ -84,3 +84,62 @@ class Eigenvalue:
             out[name] = self.compute_eigenvalue(
                 block_loss, sub, batch, jax.random.fold_in(rng, i))
         return out
+
+    def compute_layer_eigenvalues(self, loss_fn: Callable, params: Dict,
+                                  batch: Any, rng: jax.Array
+                                  ) -> List[float]:
+        """Top Hessian eigenvalue per LAYER of the stacked layers subtree —
+        the MoQ sensitivity signal (reference engine.py:1479 feeds these
+        into the quantizer's per-layer schedule). Layer l's block is its
+        slice of every (L, ...) leaf, other layers held fixed.
+
+        ONE jitted HVP serves every layer (the layer index is a traced
+        argument) — per-layer closures would compile L separate
+        training-step-sized programs at every MoQ eval."""
+        layers = params["layers"]
+        L = int(jax.tree.leaves(layers)[0].shape[0])
+
+        def layer_hvp(p, b, blk, vec, l):
+            def layer_loss(one):
+                merged = jax.tree.map(
+                    lambda full, o: jax.lax.dynamic_update_index_in_dim(
+                        full, o.astype(full.dtype), l, 0),
+                    p["layers"], one)
+                return loss_fn({**p, "layers": merged}, b)
+
+            g = jax.grad(layer_loss)
+            _, tangent = jax.jvp(g, (blk,), (vec,))
+            return tangent
+
+        hvp_j = jax.jit(layer_hvp)
+
+        def norm(tree):
+            return jnp.sqrt(sum(jnp.sum(jnp.square(x.astype(jnp.float32)))
+                                for x in jax.tree.leaves(tree)))
+
+        out: List[float] = []
+        for l in range(L):
+            block = jax.tree.map(lambda x: x[l], layers)
+            leaves, treedef = jax.tree_util.tree_flatten(block)
+            keys = jax.random.split(jax.random.fold_in(rng, l), len(leaves))
+            v = jax.tree_util.tree_unflatten(
+                treedef, [jax.random.normal(k, x.shape, jnp.float32)
+                          for k, x in zip(keys, leaves)])
+            n = norm(v) + self.stability
+            v = jax.tree.map(lambda x: (x / n).astype(jnp.float32), v)
+            eig = 0.0
+            for _ in range(self.max_iter):
+                hv = hvp_j(params, batch, block, v, l)
+                new_eig = float(sum(
+                    jnp.sum(a.astype(jnp.float32) * b.astype(jnp.float32))
+                    for a, b in zip(jax.tree.leaves(v),
+                                    jax.tree.leaves(hv))))
+                n = norm(hv) + self.stability
+                v = jax.tree.map(lambda x: (x / n).astype(jnp.float32), hv)
+                if eig and (abs(new_eig - eig)
+                            / (abs(eig) + self.stability) < self.tol):
+                    eig = new_eig
+                    break
+                eig = new_eig
+            out.append(eig)
+        return out

@@ -425,6 +425,42 @@ class TrainEngine:
             self._compression_plan = init_compression(comp_cfg)
             self._compression_sched = CompressionScheduler(self._compression_plan)
             self._compression_active = self._compression_sched.active_methods(0)
+            if "activation_quantization" in self._compression_plan.methods:
+                if self.model.config is None:
+                    raise NotImplementedError(
+                        "activation_quantization needs a transformer Model "
+                        "(the quantizer sits on layer inputs inside the "
+                        "scan; a config-less Model has no hook point)")
+                # schedule_offset=0: active from the very first step — the
+                # boundary check below only fires on CHANGES
+                self._apply_act_quant(self._compression_active)
+        # MoQ: eigenvalue-driven per-layer quantization bits (reference
+        # engine.py:1479 block_eigenvalue -> quantizer.different_precision)
+        self._moq_eigenvalue = None
+        wq_raw = (self.config.compression_training.weight_quantization
+                  or {}) if self._compression_plan is not None else {}
+        ev_cfg = (wq_raw.get("shared_parameters", {}) or {}).get(
+            "eigenvalue", {})
+        if ev_cfg.get("enabled"):
+            if self.model.config is None or self.model.pipelined:
+                raise NotImplementedError(
+                    "MoQ eigenvalue scheduling needs a non-pipelined "
+                    "transformer Model (per-layer blocks come from the "
+                    "stacked layer tree)")
+            from .eigenvalue import Eigenvalue
+
+            self._moq_eigenvalue = Eigenvalue(
+                verbose=ev_cfg.get("verbose", False),
+                max_iter=int(ev_cfg.get("max_iter", 10)),
+                tol=float(ev_cfg.get("tol", 1e-2)),
+                stability=float(ev_cfg.get("stability", 1e-6)))
+            self._moq_eval_step = int(ev_cfg.get("eval_step", 100))
+            # MoQ ramp length: an average-sensitivity layer walks
+            # start_bits -> target_bits over this many steps (independent of
+            # schedule_offset_end, which DEACTIVATES the method entirely)
+            self._moq_ramp = int(ev_cfg.get("ramp_steps",
+                                            10 * self._moq_eval_step))
+            self._moq_rng = jax.random.PRNGKey(self.config.seed + 101)
 
         # bookkeeping
         self.global_steps = 0
@@ -861,6 +897,11 @@ class TrainEngine:
             if act != self._compression_active:
                 self._compression_active = act
                 self._compiled_step = None    # re-specialise at the boundary
+                self._apply_act_quant(act)
+            if (self._moq_eigenvalue is not None
+                    and "weight_quantization" in act
+                    and self.global_steps % self._moq_eval_step == 0):
+                self._update_moq_bits(batch)
 
         if self._compiled_step is None and self._param_offload is None:
             self._compiled_step = (
@@ -931,6 +972,50 @@ class TrainEngine:
         self._steps_since_sync += 1
         self._tput_window_start = self._tput_window_start or time.time()
         return loss
+
+    def _apply_act_quant(self, active) -> None:
+        """Activation QAT toggles through the model config (the quantizer
+        sits on layer INPUTS inside the scan; one re-jit per boundary)."""
+        if self.model.config is None:
+            return
+        aq = 0
+        if "activation_quantization" in active:
+            p = self._compression_plan.methods[
+                "activation_quantization"]["params"]
+            aq = int(p.get("bits", p.get("target_bits", 8)))
+        self.model.config.act_quant_bits = aq
+
+    def _update_moq_bits(self, batch: Any) -> None:
+        """MoQ: recompute per-layer quantization bits from layer Hessian
+        eigenvalues (sensitivity). More sensitive layers (larger |eig|)
+        quantize LATER along the start_bits→target_bits schedule — the
+        reference's eigenvalue-scaled quantization periods
+        (engine.py:1479, runtime/quantize.py)."""
+        wq = self._compression_plan.methods["weight_quantization"]
+        p = wq["params"]
+        start = int(p.get("start_bits", 16))
+        target = int(p.get("target_bits", 8))
+        off = int(wq.get("schedule_offset", 0))
+        ramp = int(self._moq_ramp)
+        # progress is UNCAPPED before the per-layer division: a layer with
+        # sensitivity rel reaches target at step off + rel*ramp — sensitive
+        # layers quantize later but always get there (a capped prog would
+        # freeze rel>1 layers at intermediate bits forever)
+        prog = max(0.0, (self.global_steps - off) / max(1, ramp))
+        mb = jax.tree.map(lambda x: x[0], batch)
+        rng = jax.random.fold_in(self._moq_rng, self.global_steps)
+        evs = self._moq_eigenvalue.compute_layer_eigenvalues(
+            self.model.loss_fn, self.params, mb, rng)
+        evs_arr = np.abs(np.asarray(evs, np.float64)) + 1e-12
+        rel = evs_arr / evs_arr.mean()          # >1 => more sensitive
+        eff = np.clip(prog / rel, 0.0, 1.0)     # sensitive => slower
+        lo, hi = min(start, target), max(start, target)
+        bits = tuple(int(b) for b in np.clip(
+            np.round(start - (start - target) * eff), lo, hi))
+        if wq.get("layer_bits") != bits:
+            wq["layer_bits"] = bits
+            self._compiled_step = None
+            log_dist(f"MoQ eigenvalue schedule: layer bits -> {bits}")
 
     def _sync_step_stats(self, stats: StepStats) -> None:
         """Materialise lazily-accumulated device counters (one queue drain)."""
