@@ -4,5 +4,6 @@ generate candidate configs over the tunable space (micro-batch, ZeRO stage,
 remat policy...), run each through the launcher, rank by the measured
 metric."""
 
-from .autotuner import (Autotuner, generate_experiments, grid_space,
-                        random_space)  # noqa: F401
+from .autotuner import (Autotuner, ResourceManager, generate_experiments,
+                        grid_space, random_space)  # noqa: F401
+from .cost_model import TpuCostModel  # noqa: F401

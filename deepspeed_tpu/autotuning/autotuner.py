@@ -1,17 +1,26 @@
-"""Autotuner: experiment generation + sequential scheduler.
+"""Autotuner: experiment generation + schedulers (sequential / concurrent /
+model-based).
 
 Reference: ``autotuning/autotuner.py:42`` — reads the ``autotuning`` config
 section, builds experiment configs by expanding tunable lists (the
 ``DEFAULT_TUNING_SPACE`` of micro-batch sizes x ZeRO stages x ...), runs each
 via the launcher with a results directory, and selects the best by metric
-(throughput/latency/FLOPS). The xgboost cost-model tuner is replaced by the
-two strategies that carry its weight at this scale: exhaustive grid and
-seeded random subsampling.
+(throughput/latency/FLOPS). Three strategies:
+
+  * gridsearch / random — exhaustive / seeded subsample
+  * model_based — the reference's ``tuner/model_based_tuner.py`` +
+    ``cost_model.py``, TPU-rendered: the XGBoost surrogate becomes the
+    DETERMINISTIC analytic model in ``cost_model.TpuCostModel`` (roofline +
+    ZeRO memory arithmetic), which prunes OOM configs outright and ranks
+    the rest so only the top slice is measured
 
 An experiment here = (name, config overrides). Execution is pluggable — the
 default runner shells out through ``deepspeed-tpu`` exactly like the
 reference's ResourceManager does over pdsh, reading back a JSON metric file
 the trainee writes (reference: autotuning metric_path protocol).
+``ResourceManager`` runs experiments CONCURRENTLY over a slot pool
+(reference autotuning/scheduler.py:33) — on a shared dev chip default 1
+slot; on a pod, one slot per node.
 """
 
 from __future__ import annotations
@@ -131,19 +140,90 @@ class Autotuner:
             return None
 
     def tune(self, space: Optional[Dict[str, Sequence[Any]]] = None,
-             tuner_type: str = "gridsearch", num_trials: int = 50
-             ) -> Tuple[Optional[str], Optional[float]]:
-        experiments = generate_experiments(self.base_config, space,
-                                           tuner_type, num_trials)
+             tuner_type: str = "gridsearch", num_trials: int = 50,
+             model_info: Optional[Dict[str, Any]] = None,
+             max_parallel: int = 1,
+             **model_kwargs) -> Tuple[Optional[str], Optional[float]]:
+        """Run the sweep. ``model_based``: rank the grid with the analytic
+        cost model, measure only the top ``num_trials`` feasible configs
+        (reference ModelBasedTuner's surrogate-guided selection)."""
+        if tuner_type == "model_based":
+            if model_info is None:
+                model_info = (self.base_config.get("autotuning", {})
+                              .get("model_info"))
+            if not model_info or "num_params" not in model_info:
+                raise ValueError(
+                    "tuner_type='model_based' needs model_info with "
+                    "num_params (reference autotuning.model_info section)")
+            from .cost_model import TpuCostModel
+
+            model = TpuCostModel(model_info=model_info, **model_kwargs)
+            all_exps = generate_experiments(self.base_config, space,
+                                            "gridsearch", num_trials)
+            scored = [(model.predict_throughput(cfg), name, cfg)
+                      for name, cfg in all_exps]
+            feasible = [(s, n, c) for s, n, c in scored if s > 0.0]
+            feasible.sort(key=lambda t: -t[0])
+            pruned = len(all_exps) - len(feasible)
+            experiments = [(n, c) for _, n, c in feasible[:num_trials]]
+            logger.info(
+                f"autotuning(model_based): {len(all_exps)} grid points, "
+                f"{pruned} pruned as infeasible, measuring top "
+                f"{len(experiments)}")
+            self.predictions = {n: s for s, n, _ in scored}
+        else:
+            experiments = generate_experiments(self.base_config, space,
+                                               tuner_type, num_trials)
+            self.predictions = {}
         logger.info(f"autotuning: {len(experiments)} experiments")
+        manager = ResourceManager(self.runner, max_parallel=max_parallel)
+        sweep_results = manager.run(experiments)
+        self.results.update(sweep_results)
         best_name, best_val = None, None
-        for name, cfg in experiments:
-            val = self.runner(name, cfg)
-            self.results[name] = val
+        for name, val in sweep_results.items():   # THIS sweep only — a
+            # reused tuner must not return a stale best from a prior space
             if val is not None and (best_val is None or val > best_val):
                 best_name, best_val = name, val
         os.makedirs(self.results_dir, exist_ok=True)
         with open(os.path.join(self.results_dir, "summary.json"), "w") as fh:
             json.dump({"best": best_name, "metric": self.metric,
-                       "results": self.results}, fh, indent=1)
+                       "results": self.results,
+                       "predictions": self.predictions}, fh, indent=1)
         return best_name, best_val
+
+
+class ResourceManager:
+    """Concurrent experiment scheduler (reference autotuning/scheduler.py:33
+    ResourceManager): a slot pool drains the experiment queue; each slot
+    runs one experiment at a time through the pluggable runner (which shells
+    out via the launcher, so slots map naturally onto nodes)."""
+
+    def __init__(self, runner: Callable[[str, Dict], Optional[float]],
+                 max_parallel: int = 1):
+        self.runner = runner
+        self.max_parallel = max(1, int(max_parallel))
+
+    def run(self, experiments: Sequence[Tuple[str, Dict]]
+            ) -> Dict[str, Optional[float]]:
+        if self.max_parallel == 1:
+            results: Dict[str, Optional[float]] = {}
+            for name, cfg in experiments:
+                try:
+                    results[name] = self.runner(name, cfg)
+                except Exception as exc:   # failed experiments score None
+                    logger.warning(f"experiment {name} failed: {exc}")
+                    results[name] = None
+            return results
+        from concurrent.futures import ThreadPoolExecutor
+
+        results: Dict[str, Optional[float]] = {}
+        with ThreadPoolExecutor(max_workers=self.max_parallel) as pool:
+            futures = {pool.submit(self.runner, name, cfg): name
+                       for name, cfg in experiments}
+            for fut, name in futures.items():
+                try:
+                    results[name] = fut.result()
+                except Exception as exc:       # failed experiments score None
+                    logger.warning(f"experiment {name} failed: {exc}")
+                    results[name] = None
+        return results

@@ -1,0 +1,122 @@
+"""Deterministic TPU cost model for autotuning.
+
+Reference: ``autotuning/tuner/cost_model.py`` + ``model_based_tuner.py`` —
+the reference learns an XGBoost surrogate from observed runs; on TPU the
+performance structure is analytic enough to write down directly (the
+flops-profiler formulas + the roofline + ZeRO memory arithmetic), which
+makes the "model" deterministic and zero-shot: it prunes infeasible configs
+(OOM) outright and ranks the rest, so the tuner measures only a top slice
+of the grid instead of sweeping it.
+
+Inputs come from the config's ``model_info`` section (the reference has the
+same section, ``autotuning.model_info.num_params``) plus the platform
+constants bench.py/bench_infer.py already use.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+HBM_BW = {  # bytes/s (bench_infer.py table)
+    "v5 lite": 819e9, "v5e": 819e9, "v5litepod": 819e9,
+    "v5p": 2765e9, "v4": 1228e9, "v6e": 1640e9, "v6 lite": 1640e9,
+}
+PEAK_FLOPS = {
+    "v5 lite": 197e12, "v5e": 197e12, "v5litepod": 197e12,
+    "v5p": 459e12, "v4": 275e12, "v6e": 918e12, "v6 lite": 918e12,
+}
+ICI_BW = 4.8e10          # bytes/s per link-direction class estimate
+
+
+def _platform(kind: Optional[str], table: Dict[str, float],
+              default: float) -> float:
+    if kind:
+        for key, val in table.items():
+            if key in kind.lower():
+                return val
+    return default
+
+
+@dataclasses.dataclass
+class TpuCostModel:
+    """Analytic throughput/memory model for ONE training config.
+
+    ``model_info``: num_params (required), hidden_size, num_layers,
+    seq_length, vocab_size (optional, improve the activation estimate).
+    """
+
+    model_info: Dict[str, Any]
+    hbm_bytes: float = 16e9
+    device_kind: Optional[str] = None
+    world_size: int = 1
+    mfu: float = 0.5                 # achievable fraction of peak (north star)
+    overhead_s: float = 2e-3         # per-microbatch dispatch/step overhead
+
+    def __post_init__(self):
+        self.peak = _platform(self.device_kind, PEAK_FLOPS, 197e12)
+        self.bw = _platform(self.device_kind, HBM_BW, 819e9)
+        self.n = float(self.model_info["num_params"])
+        self.hidden = float(self.model_info.get("hidden_size", 0) or
+                            (self.n / 12) ** (1 / 3) * 2)   # rough fallback
+        self.layers = float(self.model_info.get("num_layers", 12))
+        self.seq = float(self.model_info.get("seq_length", 1024))
+        self.vocab = float(self.model_info.get("vocab_size", 50257))
+
+    # -- memory ----------------------------------------------------------
+    def memory_bytes(self, config: Dict[str, Any]) -> float:
+        zo = config.get("zero_optimization", {})
+        stage = int(zo.get("stage", 0))
+        micro = int(config.get("train_micro_batch_size_per_gpu", 1))
+        off_opt = zo.get("offload_optimizer", {}).get("device", "none")
+        off_par = zo.get("offload_param", {}).get("device", "none")
+        W = max(1, self.world_size)
+        n = self.n
+        params = 2 * n / (W if (stage >= 3 or off_par != "none") else 1)
+        if off_par != "none":
+            params = 2 * n / max(self.layers, 1) * 2   # ~2 streamed blocks
+        grads = 4 * n / (W if stage >= 2 else 1)
+        opt = 12 * n / (W if stage >= 1 else 1)
+        if off_opt != "none":
+            opt = 0.0
+        if off_par != "none":
+            opt = 0.0
+            grads = 4 * n / max(self.layers, 1) * 2
+        remat = bool(config.get("_remat", True))
+        act_per_tok = self.hidden * self.layers * (2.0 if remat else 16.0)
+        acts = micro * self.seq * act_per_tok
+        # the (B, S, V) logits + their fp32 softmax reduction dominate at
+        # large micro batches (the actual OOM boundary on small models)
+        logits = micro * self.seq * self.vocab * 2
+        return params + grads + opt + acts + logits
+
+    def fits(self, config: Dict[str, Any]) -> bool:
+        return self.memory_bytes(config) <= self.hbm_bytes * 0.92
+
+    # -- throughput ------------------------------------------------------
+    def predict_throughput(self, config: Dict[str, Any]) -> float:
+        """Predicted tokens/s/chip; 0.0 for configs that do not fit."""
+        if not self.fits(config):
+            return 0.0
+        zo = config.get("zero_optimization", {})
+        stage = int(zo.get("stage", 0))
+        micro = int(config.get("train_micro_batch_size_per_gpu", 1))
+        gas = int(config.get("gradient_accumulation_steps", 1))
+        off_opt = zo.get("offload_optimizer", {}).get("device", "none")
+        off_par = zo.get("offload_param", {}).get("device", "none")
+        W = max(1, self.world_size)
+        tokens = micro * self.seq
+        flops = tokens * (6 * self.n
+                          + 12 * self.layers * self.hidden * self.seq)
+        compute_t = flops / (self.peak * self.mfu)
+        # optimizer-state HBM traffic per step amortises over gas micros
+        hbm_t = (16 * self.n / self.bw) / max(gas, 1)
+        step_t = max(compute_t, hbm_t) + self.overhead_s
+        if W > 1 and stage >= 1:
+            # ZeRO collectives per boundary: reduce-scatter + allgather
+            step_t += (2 * 2 * self.n * (W - 1) / W) / ICI_BW / max(gas, 1)
+        if off_opt != "none":
+            step_t += (16 * self.n / 4e11) / max(gas, 1)   # PCIe round trip
+        if off_par != "none":
+            step_t += 14 * self.n / 4e11                   # stream all state
+        return tokens / step_t                              # per chip

@@ -149,9 +149,24 @@ def _head_mask(w: jax.Array, num_heads: int, dense_ratio: float) -> jax.Array:
 
 
 def apply_compression(params: Any, plan: CompressionPlan,
-                      active: FrozenSet[str]) -> Any:
+                      active: FrozenSet[str],
+                      handled_elsewhere: FrozenSet[str] = frozenset()
+                      ) -> Any:
     """Pure transform: apply every active method to matching params. Runs
-    inside the jitted loss (QAT straight-through)."""
+    inside the jitted loss (QAT straight-through).
+
+    ``activation_quantization`` is NOT a param transform — it lives on the
+    model's forward (TransformerConfig.act_quant_bits, wired by the
+    engine). Callers that handle it that way pass it in
+    ``handled_elsewhere``; anyone else gets a loud error instead of a
+    silent no-op."""
+    if "activation_quantization" in active - handled_elsewhere:
+        raise NotImplementedError(
+            "activation_quantization quantizes ACTIVATIONS, not params — "
+            "apply_compression cannot express it. Use the engine path "
+            "(compression_training config on a transformer Model, which "
+            "sets cfg.act_quant_bits), or fake_quant_activation directly "
+            "in your forward")
     if not active:
         return params
     flat = jax.tree_util.tree_flatten_with_path(params)

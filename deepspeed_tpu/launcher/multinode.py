@@ -91,8 +91,81 @@ class SSHRunner(MultiNodeRunner):
         return cmds
 
 
+class OpenMPIRunner(MultiNodeRunner):
+    """Reference OpenMPIRunner (multinode_runner.py:107): one mpirun starts
+    the per-node command on every host (-npernode 1 — one JAX process
+    drives all local chips); env rides -x exports, the node name resolves
+    remotely from hostname."""
+
+    name = "openmpi"
+
+    def backend_exists(self) -> bool:
+        import shutil
+
+        return shutil.which("ompi_info") is not None
+
+    def get_cmd(self, hosts, node_cmds):
+        first = next(iter(node_cmds.values()))
+        export_args: List[str] = []
+        for k, v in sorted(self.default_exports().items()):
+            export_args += ["-x", f"{k}={v}"]
+        remote = ("export DSTPU_NODE_NAME=$(hostname); exec "
+                  + " ".join(shlex.quote(c) for c in first))
+        return [["mpirun", "-n", str(len(hosts)), "-npernode", "1",
+                 "-host", ",".join(hosts), "--mca", "btl", "^openib"]
+                + export_args + ["bash", "-c", remote]]
+
+
+class MPICHRunner(MultiNodeRunner):
+    """Reference MPICHRunner (multinode_runner.py:160): hydra mpirun with
+    -genv exports."""
+
+    name = "mpich"
+
+    def backend_exists(self) -> bool:
+        import shutil
+
+        return shutil.which("mpirun") is not None
+
+    def get_cmd(self, hosts, node_cmds):
+        first = next(iter(node_cmds.values()))
+        export_args: List[str] = []
+        for k, v in sorted(self.default_exports().items()):
+            export_args += ["-genv", k, v]
+        remote = ("export DSTPU_NODE_NAME=$(hostname); exec "
+                  + " ".join(shlex.quote(c) for c in first))
+        return [["mpirun", "-n", str(len(hosts)), "-ppn", "1",
+                 "-hosts", ",".join(hosts)]
+                + export_args + ["bash", "-c", remote]]
+
+
+class SlurmRunner(MultiNodeRunner):
+    """Reference SlurmRunner (multinode_runner.py:208): srun starts one
+    task per node inside an allocation; env rides --export."""
+
+    name = "slurm"
+
+    def backend_exists(self) -> bool:
+        import shutil
+
+        return shutil.which("srun") is not None
+
+    def get_cmd(self, hosts, node_cmds):
+        first = next(iter(node_cmds.values()))
+        exports = "--export=ALL"
+        for k, v in sorted(self.default_exports().items()):
+            exports += f",{k}={v}"
+        remote = ("export DSTPU_NODE_NAME=$(hostname); exec "
+                  + " ".join(shlex.quote(c) for c in first))
+        return [["srun", "-n", str(len(hosts)), "--ntasks-per-node", "1",
+                 "--nodelist", ",".join(hosts), exports,
+                 "bash", "-c", remote]]
+
+
 def get_runner(name: str, exports=None) -> MultiNodeRunner:
-    runners = {"pdsh": PDSHRunner, "ssh": SSHRunner}
+    runners = {"pdsh": PDSHRunner, "ssh": SSHRunner,
+               "openmpi": OpenMPIRunner, "mpich": MPICHRunner,
+               "slurm": SlurmRunner}
     if name not in runners:
         raise ValueError(f"unknown launcher backend '{name}' "
                          f"(have: {sorted(runners)})")

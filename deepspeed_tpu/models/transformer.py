@@ -347,9 +347,20 @@ def resolve_remat_policy(cfg: "TransformerConfig"):
     """remat_policy knob → jax.checkpoint policy. Measured on v5e (gpt2-125m
     b32 s1024): "dots" 101.6k tok/s vs "full" 100.4k; saving the attention
     output as well was a wash (99.4k) — flash-fwd recompute is cheaper than
-    the extra HBM traffic."""
+    the extra HBM traffic.
+
+    "offload-dots" is the reference's cpu_checkpointing
+    (activation_checkpointing/checkpointing.py): saved matmul outputs live
+    in pinned HOST memory instead of HBM — XLA streams them out during
+    forward and back in for backward (the hand-written
+    copy_to_device/partition machinery dissolves into the offload policy).
+    Accelerator backends only; trades PCIe traffic for HBM residency on
+    long sequences."""
     if cfg.remat_policy == "dots":
         return jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+    if cfg.remat_policy == "offload-dots":
+        return jax.checkpoint_policies.offload_dot_with_no_batch_dims(
+            "device", "pinned_host")
     return None
 
 

@@ -165,6 +165,76 @@ class BigBirdSparsityConfig(SparsityConfig):
 
 
 @dataclasses.dataclass
+class VariableSparsityConfig(SparsityConfig):
+    """Variable layout (reference :239): Fixed extended with per-window
+    local block sizes (``local_window_blocks`` — the last entry repeats for
+    the remaining windows), optional random blocks per row, and global
+    blocks given as indices or [start, end) ranges."""
+
+    num_random_blocks: int = 0
+    local_window_blocks: tuple = (4,)
+    global_block_indices: tuple = (0,)
+    global_block_end_indices: Optional[tuple] = None
+    attention: str = "bidirectional"
+    horizontal_global_attention: bool = False
+    seed: int = 0
+
+    def __post_init__(self):
+        if self.global_block_end_indices is not None:
+            if len(self.global_block_indices) != len(
+                    self.global_block_end_indices):
+                raise ValueError(
+                    "global_block_end_indices must pair 1:1 with "
+                    "global_block_indices")
+            for s, e in zip(self.global_block_indices,
+                            self.global_block_end_indices):
+                if s >= e:
+                    raise ValueError(
+                        f"global block range [{s}, {e}) is empty")
+        if self.horizontal_global_attention and self.attention != "bidirectional":
+            raise ValueError("horizontal global attention requires "
+                             "bidirectional attention")
+
+    def make_layout(self, seq_len: int) -> np.ndarray:
+        layout = self.setup_layout(seq_len)
+        n = layout.shape[1]
+        uni = self.attention == "unidirectional"
+        rng = np.random.RandomState(self.seed)
+        for h in range(layout.shape[0]):
+            # random blocks per row
+            if self.num_random_blocks:
+                k = min(self.num_random_blocks, n)
+                for i in range(n):
+                    layout[h, i, rng.choice(n, size=k, replace=False)] = 1
+            # variable-size local windows; the last size covers the tail
+            start = 0
+            sizes = list(self.local_window_blocks)
+            while start < n:
+                size = sizes.pop(0) if sizes else self.local_window_blocks[-1]
+                end = min(start + size, n)
+                for i in range(start, end):
+                    hi = (i + 1) if uni else end
+                    layout[h, i, start:hi] = 1
+                start = end
+            # global blocks: single indices or [start, end) ranges
+            ranges = ([(g, g + 1) for g in self.global_block_indices]
+                      if self.global_block_end_indices is None else
+                      list(zip(self.global_block_indices,
+                               self.global_block_end_indices)))
+            for s, e in ranges:
+                if s >= n:
+                    continue
+                e = min(e, n)
+                if self.horizontal_global_attention:
+                    layout[h, s:e, :] = 1
+                first_row = 0 if not uni else s
+                layout[h, first_row:, s:e] = 1
+            if uni:
+                layout[h] = np.tril(layout[h])
+        return self.check_and_propagate_first_head_layout(layout)
+
+
+@dataclasses.dataclass
 class BSLongformerSparsityConfig(SparsityConfig):
     """Block-sparse Longformer (reference :546): sliding window + global
     blocks at chosen indices."""

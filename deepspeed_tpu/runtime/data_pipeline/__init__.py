@@ -3,5 +3,7 @@ curriculum learning (scheduler + difficulty-indexed sampler) and random
 layerwise token dropping (random-LTD)."""
 
 from .curriculum_scheduler import CurriculumScheduler  # noqa: F401
+from .data_analyzer import (DataAnalyzer, load_difficulties,  # noqa: F401
+                            token_count_metric)
 from .data_sampler import CurriculumDataSampler  # noqa: F401
 from .random_ltd import RandomLTDScheduler, sample_token_subset  # noqa: F401

@@ -1,0 +1,141 @@
+"""Offline dataset analysis — produces the difficulty index the curriculum
+sampler consumes.
+
+Reference: ``runtime/data_pipeline/data_sampling/data_analyzer.py:20``
+(DataAnalyzer: map workers compute per-sample metric values, reduce merges
+them into sample_to_metric / metric_to_sample index files) backed by the
+binary ``indexed_dataset.py`` (617 LoC). The TPU build keeps the same
+map/reduce worker protocol and file-based handoff, with the storage rendered
+as a small memmap value store + JSON manifest instead of the Megatron binary
+format (our samples are arrays already; the variable-length token packing
+the reference's format exists for is handled by the dataset itself).
+
+Protocol (mirrors the reference's run_map/run_reduce):
+
+  analyzer = DataAnalyzer(dataset, {"seqlen": token_count_metric},
+                          save_path, num_workers=W, worker_id=i)
+  analyzer.run_map()                  # each worker: its shard's values
+  DataAnalyzer.run_reduce(save_path, "seqlen", num_workers=W)
+  difficulties = load_difficulties(save_path, "seqlen")   # -> sampler
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Callable, Dict, Optional, Sequence
+
+import numpy as np
+
+
+class MMapValueStore:
+    """Fixed-dtype per-sample value array: .bin (memmap) + .json manifest,
+    committed atomically (the indexed_dataset analog at our scale)."""
+
+    def __init__(self, path: str):
+        self.path = path
+
+    def write(self, values: np.ndarray) -> None:
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        values = np.ascontiguousarray(values)
+        with open(self.path + ".bin.tmp", "wb") as f:
+            f.write(values.tobytes())
+        manifest = {"dtype": str(values.dtype), "shape": list(values.shape)}
+        with open(self.path + ".json.tmp", "w") as f:
+            json.dump(manifest, f)
+        os.replace(self.path + ".bin.tmp", self.path + ".bin")
+        os.replace(self.path + ".json.tmp", self.path + ".json")
+
+    def read(self, mmap: bool = True) -> np.ndarray:
+        with open(self.path + ".json") as f:
+            manifest = json.load(f)
+        if mmap:
+            return np.memmap(self.path + ".bin", dtype=manifest["dtype"],
+                             mode="r", shape=tuple(manifest["shape"]))
+        return np.fromfile(self.path + ".bin",
+                           dtype=manifest["dtype"]).reshape(
+                               manifest["shape"])
+
+
+def token_count_metric(sample: Any) -> int:
+    """The reference's default curriculum metric: true sequence length."""
+    if isinstance(sample, dict):
+        ids = sample.get("input_ids", next(iter(sample.values())))
+    else:
+        ids = sample
+    arr = np.asarray(ids)
+    mask = sample.get("attention_mask") if isinstance(sample, dict) else None
+    if mask is not None:
+        return int(np.asarray(mask).sum())
+    return int(arr.shape[-1] if arr.ndim else 1)
+
+
+class DataAnalyzer:
+    """Map/reduce offline difficulty indexing (reference DataAnalyzer)."""
+
+    def __init__(self, dataset: Sequence[Any],
+                 metric_fns: Dict[str, Callable[[Any], float]],
+                 save_path: str, num_workers: int = 1, worker_id: int = 0):
+        if not 0 <= worker_id < num_workers:
+            raise ValueError(f"worker_id {worker_id} out of range "
+                             f"[0, {num_workers})")
+        self.dataset = dataset
+        self.metric_fns = dict(metric_fns)
+        self.save_path = save_path
+        self.num_workers = int(num_workers)
+        self.worker_id = int(worker_id)
+
+    def _worker_file(self, metric: str, worker: int) -> str:
+        return os.path.join(self.save_path, metric, f"worker{worker:04d}")
+
+    def run_map(self) -> None:
+        """Compute this worker's shard (samples [worker_id::num_workers])
+        for every metric; write (indices, values) stores."""
+        n = len(self.dataset)
+        idx = np.arange(self.worker_id, n, self.num_workers)
+        for metric, fn in self.metric_fns.items():
+            values = np.asarray([fn(self.dataset[int(i)]) for i in idx],
+                                np.float64)
+            base = self._worker_file(metric, self.worker_id)
+            MMapValueStore(base + ".indices").write(idx.astype(np.int64))
+            MMapValueStore(base + ".values").write(values)
+
+    @staticmethod
+    def run_reduce(save_path: str, metric: str, num_workers: int) -> None:
+        """Merge worker shards into the final index:
+        sample_to_metric (per-sample value, sample order) and
+        metric_to_sample (value -> sample ids, ascending difficulty)."""
+        all_idx, all_val = [], []
+        for w in range(num_workers):
+            base = os.path.join(save_path, metric, f"worker{w:04d}")
+            all_idx.append(MMapValueStore(base + ".indices").read(mmap=False))
+            all_val.append(MMapValueStore(base + ".values").read(mmap=False))
+        idx = np.concatenate(all_idx)
+        val = np.concatenate(all_val)
+        n = int(idx.max()) + 1 if len(idx) else 0
+        if len(np.unique(idx)) != len(idx):
+            raise ValueError("duplicate sample indices across workers — "
+                             "map shards overlap")
+        full = np.zeros((n,), np.float64)
+        full[idx] = val
+        if len(idx) != n:
+            raise ValueError(f"workers covered {len(idx)}/{n} samples — a "
+                             "map shard is missing")
+        out = os.path.join(save_path, metric)
+        MMapValueStore(os.path.join(out, "sample_to_metric")).write(full)
+        buckets = {}
+        for value in np.unique(full):
+            buckets[str(value)] = np.nonzero(full == value)[0]
+        np.savez(os.path.join(out, "metric_to_sample.npz"),
+                 **{k: v for k, v in buckets.items()})
+        with open(os.path.join(out, "index.json"), "w") as f:
+            json.dump({"metric": metric, "num_samples": n,
+                       "num_workers": num_workers,
+                       "values": sorted(float(v) for v in buckets)}, f)
+
+
+def load_difficulties(save_path: str, metric: str,
+                      mmap: bool = True) -> np.ndarray:
+    """The per-sample difficulty array CurriculumDataSampler consumes."""
+    return MMapValueStore(os.path.join(save_path, metric,
+                                       "sample_to_metric")).read(mmap=mmap)

@@ -99,19 +99,26 @@ class Eigenvalue:
         layers = params["layers"]
         L = int(jax.tree.leaves(layers)[0].shape[0])
 
-        def layer_hvp(p, b, blk, vec, l):
-            def layer_loss(one):
-                merged = jax.tree.map(
-                    lambda full, o: jax.lax.dynamic_update_index_in_dim(
-                        full, o.astype(full.dtype), l, 0),
-                    p["layers"], one)
-                return loss_fn({**p, "layers": merged}, b)
+        # the jitted HVP is cached PER loss_fn across calls — re-creating
+        # the wrapper would recompile the training-step-sized program at
+        # every MoQ eval
+        cache = getattr(self, "_layer_hvp_cache", None)
+        if cache is None or cache[0] is not loss_fn:
+            def layer_hvp(p, b, blk, vec, l):
+                def layer_loss(one):
+                    merged = jax.tree.map(
+                        lambda full, o: jax.lax.dynamic_update_index_in_dim(
+                            full, o.astype(full.dtype), l, 0),
+                        p["layers"], one)
+                    return loss_fn({**p, "layers": merged}, b)
 
-            g = jax.grad(layer_loss)
-            _, tangent = jax.jvp(g, (blk,), (vec,))
-            return tangent
+                g = jax.grad(layer_loss)
+                _, tangent = jax.jvp(g, (blk,), (vec,))
+                return tangent
 
-        hvp_j = jax.jit(layer_hvp)
+            cache = (loss_fn, jax.jit(layer_hvp))
+            self._layer_hvp_cache = cache
+        hvp_j = cache[1]
 
         def norm(tree):
             return jnp.sqrt(sum(jnp.sum(jnp.square(x.astype(jnp.float32)))

@@ -71,6 +71,12 @@ class TrainEngine:
                 raise ValueError(
                     f"{config.optimizer.type}: compressed allreduce is "
                     "data-parallel only (tp/sp/pp/ep must be 1)")
+            if opt_name == "zerooneadam" and config.fp16.enabled:
+                raise NotImplementedError(
+                    "zerooneadam + fp16 dynamic loss scaling is not "
+                    "supported: an overflow-skipped step would desynchronize "
+                    "the variance schedule (inner counter reverts) from the "
+                    "dense-comm schedule (outer counter advances) — use bf16")
         if opt_name == "cpuadam" and \
                 config.zero_optimization.offload_optimizer.device != "cpu":
             raise ValueError(
@@ -603,6 +609,13 @@ class TrainEngine:
         fp16 = self.fp16_enabled()
         W = self._dp_world
         freeze = int(self.config.optimizer.params.get("freeze_step", 100))
+        # 0/1 Adam (reference zoadam.py): DENSE allreduce on the exponential
+        # variance-update schedule, compressed on all other steps
+        is_zoadam = self.config.optimizer.type.lower() == "zerooneadam"
+        zo_scaler = int(self.config.optimizer.params.get(
+            "var_update_scaler", 16))
+        zo_freeze = int(self.config.optimizer.params.get(
+            "var_freeze_step", 100000))
         mesh = self.mesh
         from ..comm.compressed import (compressed_allreduce_flat,
                                        tree_flatten_pad, tree_unflatten_like)
@@ -640,7 +653,13 @@ class TrainEngine:
                 return compressed_allreduce_flat(flat, worker, server_res,
                                                  mesh_mod.DATA_AXIS)
 
-            flat_avg, w2, s2 = jax.lax.cond(count < freeze, dense, compressed)
+            use_dense = count < freeze
+            if is_zoadam:
+                from .optimizer import zero_one_var_step
+
+                use_dense = use_dense | zero_one_var_step(
+                    count, zo_scaler, zo_freeze)
+            flat_avg, w2, s2 = jax.lax.cond(use_dense, dense, compressed)
             grads_avg = tree_unflatten_like(flat_avg, grads)
             loss_avg = jax.lax.pmean(jnp.mean(losses.astype(jnp.float32)),
                                      mesh_mod.DATA_AXIS)
@@ -745,7 +764,10 @@ class TrainEngine:
             # differentiation path; the step is rebuilt when the scheduler's
             # active-method set changes (one recompile per boundary)
             base_loss_fn = lambda p, b: orig(
-                apply_compression(p, plan, active), b)
+                apply_compression(
+                    p, plan, active,
+                    handled_elsewhere=frozenset(
+                        {"activation_quantization"})), b)
 
         def micro_loss(params, mb, scale):
             loss = base_loss_fn(params, mb)

@@ -17,6 +17,7 @@ from __future__ import annotations
 from typing import Any, Optional
 
 import jax
+import jax.numpy as jnp
 
 from ..utils.logging import log_dist
 from .engine import TrainEngine
@@ -32,6 +33,66 @@ class HybridEngine(TrainEngine):
         self._inference_tp = inference_tp_size
         self._max_out_tokens = max_out_tokens
         self._infer = None
+        self._infer_params_step = -1
+        self._lora = None            # (adapters, scaling)
+        self._lora_fused = False
+
+    # -- LoRA (reference hybrid_engine.py:121-154 fuse/unfuse) ------------
+    def set_lora(self, adapters: Any, scaling: float = 1.0) -> None:
+        """Register LoRA adapters: {dotted layer-leaf path: (right, left)}
+        with right (L, in, r) and left (L, r, out) — the RLHF actor's
+        low-rank deltas. ``generate()`` serves W + scaling·right@left
+        (the reference fuses before generation and unfuses after; here the
+        fused view is a pure function of (params, adapters), so training
+        params are never mutated unless fuse_lora_weight() is called)."""
+        if self._lora_fused:
+            raise RuntimeError("unfuse_lora_weight() before replacing "
+                               "adapters — the fused deltas would leak")
+        self._lora = (adapters, float(scaling))
+        self._infer_params_step = -1      # force refresh
+
+    def _lora_delta_params(self, params: Any, sign: float) -> Any:
+        adapters, scaling = self._lora
+
+        def leaf(path: str):
+            node = params["layers"]
+            for part in path.split("/"):
+                node = node[part]
+            return node
+
+        out = jax.tree.map(lambda x: x, params)   # shallow functional copy
+        for path, (right, left) in adapters.items():
+            w = leaf(path)
+            delta = jnp.einsum("lir,lro->lio", right.astype(jnp.float32),
+                               left.astype(jnp.float32))
+            new = (w.astype(jnp.float32)
+                   + sign * scaling * delta).astype(w.dtype)
+            node = out["layers"]
+            parts = path.split("/")
+            for part in parts[:-1]:
+                node = node[part]
+            node[parts[-1]] = new
+        return out
+
+    def fuse_lora_weight(self) -> None:
+        """Fold the adapters into the TRAINING weights in place (reference
+        fuse_lora_weight) — pair with unfuse_lora_weight."""
+        if self._lora is None or self._lora_fused:
+            return
+        if self.model.pipelined:
+            raise NotImplementedError(
+                "in-place LoRA fuse with pipelined layers is not supported "
+                "(stage-split (P, Lp, ...) leaves) — generate() already "
+                "serves the fused view without mutating training params")
+        self.params = self._lora_delta_params(self.params, +1.0)
+        self._lora_fused = True
+        self._infer_params_step = -1
+
+    def unfuse_lora_weight(self) -> None:
+        if self._lora is None or not self._lora_fused:
+            return
+        self.params = self._lora_delta_params(self.params, -1.0)
+        self._lora_fused = False
         self._infer_params_step = -1
 
     def _inference_engine(self):
@@ -63,6 +124,10 @@ class HybridEngine(TrainEngine):
 
             params = dict(params)
             params["layers"] = _merge_stages(params["layers"])
+        if self._lora is not None and not self._lora_fused:
+            # generation serves the ADAPTED weights (reference fuses before
+            # generate); the training tree stays untouched
+            params = self._lora_delta_params(params, +1.0)
         return params
 
     def refresh_inference_params(self) -> None:
@@ -73,6 +138,16 @@ class HybridEngine(TrainEngine):
         infer.params = jax.tree.map(
             lambda x, s: jax.device_put(x, s), params, infer.param_shardings)
         self._infer_params_step = self.global_steps
+
+    def train_batch(self, *args, **kwargs):
+        if self._lora_fused:
+            raise RuntimeError(
+                "unfuse_lora_weight() before training: the fused deltas "
+                "exist only in the bf16/fp16 params — the optimizer rebuilds "
+                "params from the fp32 master, silently dropping them (the "
+                "reference trains unfused too; generate() does not need the "
+                "in-place fuse at all)")
+        return super().train_batch(*args, **kwargs)
 
     def generate(self, input_ids, **kwargs):
         infer = self._inference_engine()

@@ -25,6 +25,7 @@ from typing import Any, Callable, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 
 from ..config.config import Config, OptimizerConfig
@@ -64,6 +65,80 @@ def clip_by_global_norm(grads: Any, max_norm: float,
     return clipped, total_norm
 
 
+def _zero_one_phase_table(scaler: int, max_phases: int = 40):
+    """Last-hit step per phase of the 0/1 Adam variance schedule (reference
+    zoadam.py:270 state machine: a hit is ``step % interval == 0``; after
+    ``scaler`` hits the interval doubles). Phase k uses interval 2^k; its
+    hits are the first ``scaler`` multiples of 2^k after phase k-1's last
+    hit. Static table — exact, no float-log boundary hazards."""
+    last = [scaler]                          # phase 0: steps 1..scaler
+    for k in range(1, max_phases):
+        first = ((last[-1] // 2 ** k) + 1) * 2 ** k
+        last.append(first + (scaler - 1) * 2 ** k)
+    return np.asarray(last, np.int64)
+
+
+def zero_one_var_step(count, var_update_scaler: int,
+                      var_freeze_step: int):
+    """Is 0-based step ``count`` a VARIANCE-update step of 0/1 Adam? Frozen
+    entirely after ``var_freeze_step``. Pure function of the step count so
+    the engine's comm choice and the optimizer's gate agree without shared
+    counters."""
+    table = jnp.asarray(_zero_one_phase_table(int(var_update_scaler)))
+    s = (count + 1).astype(jnp.int64) if hasattr(count, "astype") \
+        else jnp.int64(count + 1)
+    k = jnp.searchsorted(table, s)           # phase: first k with last_k >= s
+    interval = jnp.int64(1) << k.astype(jnp.int64)
+    hit = jnp.mod(s, interval) == 0
+    return hit & (s <= var_freeze_step)
+
+
+def zero_one_adam_transform(b1: float, b2: float, eps: float,
+                            weight_decay: float, var_freeze_step: int,
+                            var_update_scaler: int
+                            ) -> optax.GradientTransformation:
+    """0/1 Adam inner update (reference zoadam.py): momentum every step,
+    VARIANCE only on the exponential ``zero_one_var_step`` schedule (frozen
+    after var_freeze_step), no bias correction (the reference applies
+    none). DEVIATION, stated prominently: the local-step policy (applying
+    rank-local updates between compressed syncs, zoadam.py:285) is NOT
+    implemented — SPMD keeps params replicated, so every step applies the
+    globally-reduced momentum; the communication pattern (dense on variance
+    steps, compressed otherwise) lives in the engine's compressed step."""
+
+    def init(params):
+        zeros = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32),
+                             params)
+        return {"count": jnp.zeros((), jnp.int32), "mu": zeros,
+                "nu": jax.tree.map(jnp.zeros_like, zeros)}
+
+    def update(grads, state, params=None):
+        count = state["count"]
+        var_hit = zero_one_var_step(count, var_update_scaler,
+                                    var_freeze_step)
+        mu = jax.tree.map(
+            lambda m, g: b1 * m + (1 - b1) * g.astype(jnp.float32),
+            state["mu"], grads)
+        nu = jax.tree.map(
+            lambda v, g: jnp.where(
+                var_hit, b2 * v + (1 - b2) * jnp.square(
+                    g.astype(jnp.float32)), v),
+            state["nu"], grads)
+        def upd(m, v, p):
+            u = m / (jnp.sqrt(v) + eps)
+            if weight_decay and params is not None:
+                u = u + weight_decay * p.astype(jnp.float32)
+            return -u
+        updates = (jax.tree.map(upd, mu, nu, params) if params is not None
+                   else jax.tree.map(lambda m, v: -(m / (jnp.sqrt(v) + eps)),
+                                     mu, nu))
+        return updates, {"count": count + 1, "mu": mu, "nu": nu}
+
+    # scale_by_learning_rate applies -lr; our updates are already negative
+    # directions, so chain with the standard optax convention
+    return optax.GradientTransformation(init, update)
+
+
 def build_optax_transform(opt_config: OptimizerConfig,
                           lr_schedule: Optional[Callable] = None) -> optax.GradientTransformation:
     """Config ``optimizer`` section → optax transform. Parameter names follow
@@ -76,7 +151,14 @@ def build_optax_transform(opt_config: OptimizerConfig,
     eps = params.get("eps", 1e-8)
     wd = params.get("weight_decay", 0.0)
 
-    if name in ("adam", "fusedadam", "cpuadam", "onebitadam", "zerooneadam"):
+    if name == "zerooneadam":
+        return optax.chain(
+            zero_one_adam_transform(
+                b1=betas[0], b2=betas[1], eps=eps, weight_decay=wd,
+                var_freeze_step=int(params.get("var_freeze_step", 100000)),
+                var_update_scaler=int(params.get("var_update_scaler", 16))),
+            optax.scale_by_schedule(lr))
+    if name in ("adam", "fusedadam", "cpuadam", "onebitadam"):
         # reference FusedAdam has adam_w_mode=True by default (ops/adam/fused_adam.py:18)
         adam_w_mode = params.get("adam_w_mode", name != "adam")
         if wd and adam_w_mode:

@@ -173,3 +173,68 @@ class TestRandomLTD:
         inv = ~np.asarray(mask)
         np.testing.assert_allclose(np.asarray(back[:, inv]),
                                    np.asarray(x[:, inv]))
+
+
+class TestDataAnalyzer:
+    """Reference data_sampling/data_analyzer.py map/reduce protocol: workers
+    index their shard offline, reduce merges into the difficulty index the
+    curriculum sampler consumes."""
+
+    def _dataset(self, n=20):
+        rng = np.random.RandomState(0)
+        return [{"input_ids": np.zeros(int(l), np.int32)}
+                for l in rng.randint(4, 64, n)]
+
+    def test_map_reduce_roundtrip(self, tmp_path):
+        from deepspeed_tpu.runtime.data_pipeline.data_analyzer import (
+            DataAnalyzer, load_difficulties, token_count_metric)
+
+        ds = self._dataset()
+        for w in range(3):
+            DataAnalyzer(ds, {"seqlen": token_count_metric}, str(tmp_path),
+                         num_workers=3, worker_id=w).run_map()
+        DataAnalyzer.run_reduce(str(tmp_path), "seqlen", num_workers=3)
+        diff = load_difficulties(str(tmp_path), "seqlen")
+        want = [len(s["input_ids"]) for s in ds]
+        np.testing.assert_array_equal(np.asarray(diff), want)
+        # metric_to_sample buckets are consistent
+        import json as _json
+        import os as _os
+        with open(_os.path.join(str(tmp_path), "seqlen", "index.json")) as f:
+            idx = _json.load(f)
+        assert idx["num_samples"] == len(ds)
+        buckets = np.load(_os.path.join(str(tmp_path), "seqlen",
+                                        "metric_to_sample.npz"))
+        for val, ids in buckets.items():
+            assert all(want[i] == float(val) for i in ids)
+
+    def test_feeds_curriculum_sampler(self, tmp_path):
+        from deepspeed_tpu.runtime.data_pipeline import (CurriculumScheduler,
+                                                         CurriculumDataSampler)
+        from deepspeed_tpu.runtime.data_pipeline.data_analyzer import (
+            DataAnalyzer, load_difficulties, token_count_metric)
+
+        ds = self._dataset(32)
+        DataAnalyzer(ds, {"seqlen": token_count_metric},
+                     str(tmp_path)).run_map()
+        DataAnalyzer.run_reduce(str(tmp_path), "seqlen", num_workers=1)
+        diff = load_difficulties(str(tmp_path), "seqlen")
+        sched = CurriculumScheduler({
+            "min_difficulty": 16, "max_difficulty": 64,
+            "schedule_type": "fixed_linear",
+            "schedule_config": {"total_curriculum_step": 10,
+                                "difficulty_step": 8}})
+        sampler = CurriculumDataSampler(diff, batch_size=4, scheduler=sched)
+        batch = sampler.sample_batch(global_step=0)
+        assert all(diff[i] <= 16 for i in batch)
+
+    def test_missing_shard_fails_loudly(self, tmp_path):
+        from deepspeed_tpu.runtime.data_pipeline.data_analyzer import (
+            DataAnalyzer, token_count_metric)
+
+        ds = self._dataset()
+        DataAnalyzer(ds, {"seqlen": token_count_metric}, str(tmp_path),
+                     num_workers=2, worker_id=0).run_map()
+        import pytest as _pytest
+        with _pytest.raises(FileNotFoundError):
+            DataAnalyzer.run_reduce(str(tmp_path), "seqlen", num_workers=2)

@@ -73,3 +73,48 @@ def test_pipelined_flip():
     assert np.asarray(out).shape == (1, 4)
     # stage-stacked layers were merged back to (L, ...) for inference
     assert engine._infer.params["layers"]["attn"]["wq"].ndim == 3
+
+
+def test_lora_fuse_unfuse_roundtrip():
+    """Reference hybrid_engine.py:121-154: W +-= scaling * right@left; fuse
+    then unfuse restores the originals, and generate() serves the ADAPTED
+    weights without touching the training tree."""
+    engine = _hybrid()
+
+    L, H = 2, 64
+    r = 4
+    k1, k2 = jax.random.split(jax.random.PRNGKey(0))
+    right = jax.random.normal(k1, (L, H, r), jnp.float32) * 0.1
+    left = jax.random.normal(k2, (L, r, H), jnp.float32) * 0.1
+    engine.set_lora({"attn/wq": (right, left)}, scaling=0.5)
+
+    w0 = np.asarray(engine.params["layers"]["attn"]["wq"])
+    # generate serves fused weights; training tree untouched
+    exported = engine._export_params()
+    want = w0 + 0.5 * np.einsum("lir,lro->lio", np.asarray(right),
+                                np.asarray(left))
+    np.testing.assert_allclose(np.asarray(exported["layers"]["attn"]["wq"]),
+                               want, rtol=1e-5, atol=1e-6)
+    np.testing.assert_array_equal(
+        np.asarray(engine.params["layers"]["attn"]["wq"]), w0)
+
+    prompt = np.arange(8)[None]
+    base_out = np.asarray(engine.generate(prompt, max_new_tokens=4))
+    engine.set_lora({"attn/wq": (right * 0, left * 0)}, scaling=0.5)
+    zero_out = np.asarray(engine.generate(prompt, max_new_tokens=4))
+    # zero adapters == no adapters; nonzero adapters changed generation
+    engine._lora = None
+    engine._infer_params_step = -1
+    none_out = np.asarray(engine.generate(prompt, max_new_tokens=4))
+    np.testing.assert_array_equal(zero_out, none_out)
+
+    # in-place fuse/unfuse roundtrip
+    engine.set_lora({"attn/wq": (right, left)}, scaling=0.5)
+    engine.fuse_lora_weight()
+    np.testing.assert_allclose(
+        np.asarray(engine.params["layers"]["attn"]["wq"]), want,
+        rtol=1e-5, atol=1e-6)
+    engine.unfuse_lora_weight()
+    np.testing.assert_allclose(
+        np.asarray(engine.params["layers"]["attn"]["wq"]), w0,
+        rtol=1e-5, atol=1e-6)

@@ -98,6 +98,49 @@ class TestMultinodeCommands:
         assert "export PYTHONPATH=/x;" in cmd[-1]
         assert "export DSTPU_NODE_NAME=%h;" in cmd[-1]
 
+    def test_openmpi_cmd(self):
+        from deepspeed_tpu.launcher.multinode import OpenMPIRunner
+
+        runner = OpenMPIRunner(exports={"PYTHONPATH": "/x"})
+        cmds = runner.get_cmd(["h0", "h1"],
+                              {h: ["python", "-m", "mod"] for h in ["h0", "h1"]})
+        assert len(cmds) == 1
+        cmd = cmds[0]
+        assert cmd[:5] == ["mpirun", "-n", "2", "-npernode", "1"]
+        assert cmd[cmd.index("-host") + 1] == "h0,h1"
+        assert "PYTHONPATH=/x" in cmd[cmd.index("-x") + 1:]
+        assert cmd[-3:-1] == ["bash", "-c"]
+        assert "DSTPU_NODE_NAME=$(hostname)" in cmd[-1]
+
+    def test_mpich_cmd(self):
+        from deepspeed_tpu.launcher.multinode import MPICHRunner
+
+        cmds = MPICHRunner(exports={"A": "1"}).get_cmd(
+            ["h0"], {"h0": ["python", "x.py"]})
+        cmd = cmds[0]
+        assert cmd[:5] == ["mpirun", "-n", "1", "-ppn", "1"]
+        i = cmd.index("-genv")
+        assert cmd[i + 1:i + 3] == ["A", "1"]
+
+    def test_slurm_cmd(self):
+        from deepspeed_tpu.launcher.multinode import SlurmRunner
+
+        cmds = SlurmRunner(exports={"A": "1"}).get_cmd(
+            ["h0", "h1"], {h: ["python", "x.py"] for h in ["h0", "h1"]})
+        cmd = cmds[0]
+        assert cmd[:3] == ["srun", "-n", "2"]
+        assert cmd[cmd.index("--nodelist") + 1] == "h0,h1"
+        assert any(a.startswith("--export=ALL,") and "A=1" in a for a in cmd)
+
+    def test_get_runner_names(self):
+        from deepspeed_tpu.launcher.multinode import get_runner
+
+        for name in ("pdsh", "ssh", "openmpi", "mpich", "slurm"):
+            assert get_runner(name).name == name
+        import pytest as _pytest
+        with _pytest.raises(ValueError, match="unknown launcher"):
+            get_runner("mvapich2")
+
     def test_ssh_cmd(self):
         runner = SSHRunner()
         cmds = runner.get_cmd(["h0", "h1"],

@@ -217,3 +217,75 @@ def test_dense_config_equals_causal_attention():
     out = sparse_self_attention(q, k, v, cfg)
     ref = dot_product_attention(q, k, v, None, causal=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-6)
+
+
+class TestVariableSparsityConfig:
+    """Reference sparsity_config.py:239 semantics: variable local windows,
+    optional random blocks, global indices or ranges."""
+
+    def test_variable_windows_and_tail(self):
+        from deepspeed_tpu.ops.sparse_attention import VariableSparsityConfig
+
+        cfg = VariableSparsityConfig(num_heads=1, block=16,
+                                     local_window_blocks=(2, 3),
+                                     global_block_indices=())
+        layout = cfg.make_layout(16 * 10)[0]     # 10 blocks
+        # windows: [0,2), [2,5), then the LAST size (3) repeats: [5,8), [8,10)
+        for (s, e) in ((0, 2), (2, 5), (5, 8), (8, 10)):
+            assert (layout[s:e, s:e] == 1).all(), (s, e)
+        assert layout[0, 2] == 0 and layout[4, 5] == 0 and layout[7, 8] == 0
+
+    def test_global_ranges_and_horizontal(self):
+        from deepspeed_tpu.ops.sparse_attention import VariableSparsityConfig
+
+        cfg = VariableSparsityConfig(num_heads=1, block=16,
+                                     local_window_blocks=(2,),
+                                     global_block_indices=(1, 4),
+                                     global_block_end_indices=(2, 6),
+                                     horizontal_global_attention=True)
+        layout = cfg.make_layout(16 * 8)[0]
+        assert (layout[:, 1] == 1).all() and (layout[:, 4:6] == 1).all()
+        assert (layout[1, :] == 1).all() and (layout[4:6, :] == 1).all()
+
+    def test_unidirectional_causal(self):
+        from deepspeed_tpu.ops.sparse_attention import VariableSparsityConfig
+
+        cfg = VariableSparsityConfig(num_heads=1, block=16,
+                                     local_window_blocks=(3,),
+                                     global_block_indices=(0,),
+                                     attention="unidirectional",
+                                     num_random_blocks=1)
+        layout = cfg.make_layout(16 * 8)[0]
+        assert (np.triu(layout, 1) == 0).all()
+        assert (np.diag(layout) == 1).all()
+        assert (layout[:, 0] == 1).all()         # global col, causal-masked
+
+    def test_validation(self):
+        from deepspeed_tpu.ops.sparse_attention import VariableSparsityConfig
+
+        with pytest.raises(ValueError, match="pair 1:1"):
+            VariableSparsityConfig(num_heads=1, global_block_indices=(0, 3),
+                                   global_block_end_indices=(1,))
+        with pytest.raises(ValueError, match="empty"):
+            VariableSparsityConfig(num_heads=1, global_block_indices=(3,),
+                                   global_block_end_indices=(3,))
+        with pytest.raises(ValueError, match="bidirectional"):
+            VariableSparsityConfig(num_heads=1, attention="unidirectional",
+                                   horizontal_global_attention=True)
+
+    def test_kernel_path_matches_dense_oracle(self):
+        from deepspeed_tpu.ops.sparse_attention import VariableSparsityConfig
+
+        cfg = VariableSparsityConfig(num_heads=2, block=32,
+                                     local_window_blocks=(2, 4),
+                                     global_block_indices=(0,),
+                                     num_random_blocks=1, seed=3)
+        ks = jax.random.split(jax.random.PRNGKey(5), 3)
+        q = jax.random.normal(ks[0], (1, 256, 2, 32))
+        k = jax.random.normal(ks[1], (1, 256, 2, 32))
+        v = jax.random.normal(ks[2], (1, 256, 2, 32))
+        out = sparse_self_attention(q, k, v, cfg, use_kernel=True,
+                                    interpret=True)
+        ref = sparse_self_attention(q, k, v, cfg, use_kernel=False)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)

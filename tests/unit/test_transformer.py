@@ -227,3 +227,17 @@ def test_init_layer_block_matches_init_slice(kw):
             np.testing.assert_array_equal(
                 np.asarray(a), np.asarray(b),
                 err_msg=f"{jax.tree_util.keystr(pa)} [{lo}:{lo + blen}]")
+
+
+def test_remat_policy_knobs():
+    """remat_policy surface incl. the cpu_checkpointing analog
+    ('offload-dots' — saved dots live in pinned host memory; functional
+    equivalence validated on real TPU, docs/offload_design.md)."""
+    from deepspeed_tpu.models.transformer import (TransformerConfig,
+                                                  resolve_remat_policy)
+
+    assert resolve_remat_policy(TransformerConfig(remat_policy="full")) is None
+    assert resolve_remat_policy(
+        TransformerConfig(remat_policy="dots")) is not None
+    assert resolve_remat_policy(
+        TransformerConfig(remat_policy="offload-dots")) is not None
