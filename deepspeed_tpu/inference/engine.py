@@ -144,17 +144,13 @@ class InferenceEngine:
 
         # TP-only sharding plan (no fsdp axis — reference inference shards
         # qkv/mlp across the mp group only, replicating the rest)
-        specs = resolve_param_specs(
-            jax.eval_shape(model.init, jax.random.PRNGKey(0)), model.axes)
+        self._param_shapes = jax.eval_shape(model.init,
+                                            jax.random.PRNGKey(0))
+        specs = resolve_param_specs(self._param_shapes, model.axes)
         self.param_shardings = jax.tree.map(
             lambda s: NamedSharding(self.mesh, s), specs,
             is_leaf=lambda x: isinstance(x, P))
 
-        if config.quantize_bits and tp > 1:
-            raise NotImplementedError(
-                "weight-only quantization with tensor_parallel > 1 is "
-                "not supported yet (the q8/scale leaves need their own "
-                "TP sharding rules)")
         if params is None:
             with self.mesh:
                 if config.quantize_bits:
@@ -164,10 +160,12 @@ class InferenceEngine:
                     # OOMs at 13B on a 16GB chip
                     from ..models.transformer import quantize_model_weights
 
+                    q_sh = (self._quantized_shardings() if tp > 1 else None)
                     params = jax.jit(lambda key: quantize_model_weights(
                         cast_floating(model.init(key), config.dtype),
                         bits=config.quantize_bits,
-                        group_size=config.quantize_groups))(
+                        group_size=config.quantize_groups),
+                        out_shardings=q_sh)(
                             jax.random.PRNGKey(config.seed))
                 else:
                     params = jax.jit(
@@ -186,7 +184,12 @@ class InferenceEngine:
                                             bits=config.quantize_bits,
                                             donate=True,
                                             group_size=config.quantize_groups)
-            params = jax.tree.map(jnp.asarray, params)  # remaining host leaves
+            if tp > 1:
+                params = jax.tree.map(
+                    lambda x, s: jax.device_put(jnp.asarray(x), s),
+                    params, self._quantized_shardings())
+            else:
+                params = jax.tree.map(jnp.asarray, params)  # host leaves
         else:
             params = cast_floating(params, config.dtype)
             params = jax.tree.map(
@@ -208,6 +211,40 @@ class InferenceEngine:
                  f"arena={config.max_out_tokens} tokens "
                  f"({kv_cache.cache_memory_bytes(cfg, 1, config.max_out_tokens, config.dtype) / 2**20:.0f}"
                  f" MiB/seq)")
+
+    def _quantized_shardings(self) -> Any:
+        """Sharding tree for the QUANTIZED params: each quantized site's
+        packed weight inherits the dense weight's TP spec (same axis
+        semantics; int4's packed K/2 keeps the K-axis placement) and its
+        scales shard on the output-channel axis only — the reference's
+        auto-TP slicing applied to the q8/scale pair."""
+        from ..models.transformer import quantize_model_weights
+
+        def one(spec_sh):
+            spec = spec_sh.spec
+            out_axis = spec[-1] if len(spec) else None
+            return {
+                "q8": spec_sh,            # placeholder keys; matched below
+                "s": NamedSharding(self.mesh, P(*([None] * max(
+                    len(spec) - 1, 1) + [out_axis]))),
+            }
+
+        # derive structure by quantizing the SHAPES already computed at init
+        q_shapes = jax.eval_shape(
+            lambda t: quantize_model_weights(
+                t, bits=self.config.quantize_bits,
+                group_size=self.config.quantize_groups), self._param_shapes)
+
+        def walk(qnode, dense_sh):
+            if isinstance(qnode, dict) and ("q8" in qnode or "q4" in qnode):
+                key = "q8" if "q8" in qnode else "q4"
+                built = one(dense_sh)
+                return {key: built["q8"], "s": built["s"]}
+            if isinstance(qnode, dict):
+                return {k: walk(v, dense_sh[k]) for k, v in qnode.items()}
+            return dense_sh
+
+        return walk(q_shapes, self.param_shardings)
 
     # -- plain forward (reference InferenceEngine.forward / module call) -----
     def forward(self, input_ids, attention_mask=None):

@@ -316,6 +316,28 @@ def _kernels_active() -> bool:
     return is_compatible("flash_attention")
 
 
+def _tp_world() -> int:
+    """Model-axis size of the AMBIENT mesh context at trace time — the
+    quantized-GEMM Pallas route is single-shard only (a pallas_call over
+    model-sharded weights would need a manual shard_map); TP runs take the
+    jnp dequant path, which XLA partitions. Reads the `with mesh:` context
+    (both engines trace inside one) — NOT the module-global mesh, which the
+    inference engine never sets (and whose lazy default would be a side
+    effect here)."""
+    try:
+        from jax.interpreters import pxla
+
+        m = pxla.thread_resources.env.physical_mesh
+        from ..parallel.mesh import MODEL_AXIS
+
+        shape = dict(getattr(m, "shape", {}) or {})
+        if MODEL_AXIS in shape:
+            return int(shape[MODEL_AXIS])
+    except Exception:
+        pass
+    return 1
+
+
 def default_attention_impl() -> Callable:
     """Platform-resolved attention: Pallas flash attention on TPU, plain-jnp
     elsewhere. This is what ``attention_impl=None`` means (the round-1 gap:
@@ -458,6 +480,7 @@ def _qeinsum(spec: str, x: jax.Array, w: Any, dtype: Any) -> jax.Array:
         q8, s = w["q8"], w["s"]
         B, S = x.shape[0], x.shape[1]
         if (S * B <= 8 and q8.ndim == 2 and _kernels_active()
+                and _tp_world() == 1
                 and q8.shape[0] % 128 == 0 and q8.shape[1] % 128 == 0):
             from ..ops.quant_matmul import int8_matmul
 
@@ -475,6 +498,7 @@ def _qeinsum(spec: str, x: jax.Array, w: Any, dtype: Any) -> jax.Array:
         G = s.shape[-2]
         gs = 2 * K2 // G
         if (S * B <= 8 and q4.ndim == 2 and _kernels_active()
+                and _tp_world() == 1
                 and K2 % 128 == 0 and N % 128 == 0
                 and (G == 1 or gs % 128 == 0)):
             from ..ops.quant_matmul import int4_matmul

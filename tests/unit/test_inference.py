@@ -560,10 +560,26 @@ class TestInt8WeightOnly:
             assert got[0, i] == best, f"step {i}"
             ids = jnp.concatenate([ids, jnp.asarray([[best]], jnp.int32)], 1)
 
-    def test_int8_tp_rejected(self, devices8):
-        with pytest.raises(NotImplementedError, match="tensor_parallel"):
-            init_inference("tiny-llama", dtype="int8", tensor_parallel=2,
-                           max_out_tokens=128)
+    @pytest.mark.slow
+    def test_int8_tp_matches_single(self, devices8):
+        """Quantized auto-TP: q8/scale leaves shard per the dense weight's
+        TP rules; tp=2 generation matches tp=1 (same quantized weights)."""
+        from deepspeed_tpu.parallel import mesh as mesh_mod
+
+        prompt = np.arange(10)[None]
+        e1 = init_inference("tiny-llama", dtype="int8", max_out_tokens=128)
+        t1 = np.asarray(e1.generate(prompt, max_new_tokens=6))
+        mesh_mod.reset_mesh()
+        e2 = init_inference("tiny-llama", dtype="int8", tensor_parallel=2,
+                            max_out_tokens=128)
+        e2.params = jax.tree.map(
+            lambda x, s: jax.device_put(np.asarray(x), s), e1.params,
+            e2._quantized_shardings())
+        t2 = np.asarray(e2.generate(prompt, max_new_tokens=6))
+        np.testing.assert_array_equal(t1, t2)
+        # the packed weight really is sharded over the model axis
+        wq = e2.params["layers"]["attn"]["wq"]["q8"]
+        assert "model" in str(wq.sharding.spec)
 
 
 @pytest.mark.slow
@@ -608,8 +624,40 @@ class TestInt4WeightOnly:
             assert got[0, i] == best, f"step {i}"
             ids = jnp.concatenate([ids, jnp.asarray([[best]], jnp.int32)], 1)
 
+    @pytest.mark.slow
+    def test_int4_tp_matches_single(self, devices8):
+        from deepspeed_tpu.parallel import mesh as mesh_mod
+
+        prompt = np.arange(10)[None]
+        e1 = init_inference("tiny-llama", dtype="int4", max_out_tokens=128)
+        t1 = np.asarray(e1.generate(prompt, max_new_tokens=6))
+        mesh_mod.reset_mesh()
+        e2 = init_inference("tiny-llama", dtype="int4", tensor_parallel=2,
+                            max_out_tokens=128)
+        e2.params = jax.tree.map(
+            lambda x, s: jax.device_put(np.asarray(x), s), e1.params,
+            e2._quantized_shardings())
+        t2 = np.asarray(e2.generate(prompt, max_new_tokens=6))
+        np.testing.assert_array_equal(t1, t2)
+
     def test_groups_require_int4(self):
         from deepspeed_tpu.inference.engine import InferenceConfig
 
         with pytest.raises(ValueError, match="int4"):
             InferenceConfig(dtype="int8", quantize_groups=64)
+
+
+def test_tp_world_reads_ambient_mesh(devices8):
+    """The quantized-GEMM kernel gate must see the `with mesh:` context the
+    engines trace under — NOT the module-global mesh the inference engine
+    never sets (regression: a global-mesh read returned 1 under tp=2)."""
+    import numpy as _np
+    from jax.sharding import Mesh
+
+    from deepspeed_tpu.models.transformer import _tp_world
+
+    assert _tp_world() == 1
+    mesh = Mesh(_np.array(jax.devices()).reshape(4, 2), ("data", "model"))
+    with mesh:
+        assert _tp_world() == 2
+    assert _tp_world() == 1
