@@ -180,14 +180,18 @@ class InferenceEngine:
             from ..models.transformer import quantize_model_weights
 
             params = cast_floating(params, config.dtype)
+            q_sh = self._quantized_shardings() if tp > 1 else None
             params = quantize_model_weights(params,
                                             bits=config.quantize_bits,
                                             donate=True,
-                                            group_size=config.quantize_groups)
+                                            group_size=config.quantize_groups,
+                                            shardings=q_sh)
             if tp > 1:
+                # quantized leaves already landed sharded; this put only
+                # moves the remaining dense leaves (and no-ops the rest)
                 params = jax.tree.map(
                     lambda x, s: jax.device_put(jnp.asarray(x), s),
-                    params, self._quantized_shardings())
+                    params, q_sh)
             else:
                 params = jax.tree.map(jnp.asarray, params)  # host leaves
         else:
@@ -278,7 +282,18 @@ class InferenceEngine:
         T_max = self.config.max_out_tokens
         from ..models.transformer import forward as model_forward
 
-        def decode(params, cache, valid, first_tok, lengths, rng):
+        # alibi models: the bias needs TRUE key positions — arena columns
+        # equal positions for the right-padded prompt part, but generated
+        # keys at column S+t sit at position len_b+t per row
+        use_kpos = cfg.position == "alibi"
+
+        def decode(params, cache, valid, first_tok, lengths, s_width, rng):
+            kpos = None
+            if use_kpos:
+                col = jnp.arange(T_max, dtype=jnp.float32)[None]
+                shift = (s_width - lengths.astype(jnp.float32))[:, None]
+                kpos = col - shift * (col >= s_width)
+
             def step(carry, rng):
                 cache, valid, tok, pos, done = carry
                 idx = cache["index"][0]
@@ -291,7 +306,7 @@ class InferenceEngine:
                 logits, cache, _ = model_forward(
                     params, tok[:, None], cfg,
                     attention_mask=valid, cache=cache, start_pos=idx,
-                    positions=pos[:, None])
+                    positions=pos[:, None], key_positions=kpos)
                 nxt = _sample(logits[:, -1], rng, temperature, top_k, top_p)
                 if eos_token_id is not None:
                     nxt = jnp.where(done, eos_token_id, nxt)
@@ -314,10 +329,9 @@ class InferenceEngine:
 
         Ragged prompts: pass ``attention_mask`` (B, S); prompts are treated
         as right-padded. Decoded tokens take each row's TRUE next positions
-        (len_b, len_b+1, ...) — batched ragged generation matches serving
-        each prompt alone. (alibi models: the per-KEY alibi bias still uses
-        arena columns, so ragged BLOOM batches remain approximate for the
-        generated-token keys of short rows.)
+        (len_b, len_b+1, ...) — and alibi models bias keys by their true
+        per-row positions too — so batched ragged generation matches
+        serving each prompt alone, BLOOM included.
         ``return_ttft``: also return wall seconds to first token (prefill)."""
         cfg = self.model.config
         ids = jnp.asarray(np.asarray(input_ids), jnp.int32)
@@ -379,7 +393,8 @@ class InferenceEngine:
                 out = first[:, None]
             else:
                 rest, cache = self._decode_cache[key_d](
-                    self.params, cache, valid, first, lengths, rng)
+                    self.params, cache, valid, first, lengths,
+                    jnp.float32(S), rng)
                 out = jnp.concatenate([first[:, None], rest], axis=1)
             self._arena[B] = cache
         return (out, ttft) if return_ttft else out

@@ -331,11 +331,12 @@ def _tp_world() -> int:
         from ..parallel.mesh import MODEL_AXIS
 
         shape = dict(getattr(m, "shape", {}) or {})
-        if MODEL_AXIS in shape:
-            return int(shape[MODEL_AXIS])
+        return int(shape.get(MODEL_AXIS, 1))
     except Exception:
-        pass
-    return 1
+        # fail UNSAFE-proof: if the mesh probe breaks (internal jax API
+        # moved), disable the single-shard kernel route rather than risk a
+        # pallas_call over sharded weights
+        return 1 << 30
 
 
 def default_attention_impl() -> Callable:
@@ -388,7 +389,9 @@ def resolve_remat_policy(cfg: "TransformerConfig"):
 
 def quantize_model_weights(params: Dict[str, Any], bits: int = 8,
                            donate: bool = False,
-                           group_size: Optional[int] = None) -> Dict[str, Any]:
+                           group_size: Optional[int] = None,
+                           shardings: Optional[Dict[str, Any]] = None
+                           ) -> Dict[str, Any]:
     """Weight-only quantization for inference (reference int8/int4
     kernel-injection mode, ``inference/quantization``,
     ``csrc/includes/quantization_utils.h:468`` 4-bit packing): matmul weights
@@ -423,10 +426,15 @@ def quantize_model_weights(params: Dict[str, Any], bits: int = 8,
     # source buffer alive until GC, which surfaces as a lazy OOM at the
     # first fence.
     if donate:
-        _jitted = jax.jit(_quant_math, donate_argnums=0)
-
-        def quant(w):
-            out = _jitted(w)
+        def quant(w, sh=None):
+            # out_shardings per leaf: under TP the quantized pair lands
+            # SHARDED directly — routing through the default device first
+            # would need the whole quantized tree resident on one chip,
+            # defeating TP's memory scaling at load (each leaf shape is a
+            # distinct compile anyway, so the per-leaf jit costs nothing)
+            fn = jax.jit(_quant_math, donate_argnums=0,
+                         out_shardings=sh)
+            out = fn(w)
             jax.block_until_ready(out)
             try:
                 w.delete()
@@ -434,23 +442,32 @@ def quantize_model_weights(params: Dict[str, Any], bits: int = 8,
                 pass                     # already consumed by donation
             return out
     else:
-        quant = _quant_math
+        def quant(w, sh=None):
+            return _quant_math(w)
+
+    def sh_of(*path):
+        node = shardings
+        if node is None:
+            return None
+        for p in path:
+            node = node[p]
+        return node
 
     params = dict(params)
     layers = dict(params["layers"])
     attn = dict(layers["attn"])
     for name in ("wq", "wk", "wv", "wo"):
-        attn[name] = quant(attn[name])
+        attn[name] = quant(attn[name], sh_of("layers", "attn", name))
     layers["attn"] = attn
     if "router" not in layers:           # dense MLP only (skip MoE banks)
         mlp = dict(layers["mlp"])
         for name in ("w_up", "w_gate", "w_down"):
             if name in mlp:
-                mlp[name] = quant(mlp[name])
+                mlp[name] = quant(mlp[name], sh_of("layers", "mlp", name))
         layers["mlp"] = mlp
     params["layers"] = layers
     if "lm_head" in params:
-        params["lm_head"] = quant(params["lm_head"])
+        params["lm_head"] = quant(params["lm_head"], sh_of("lm_head"))
     return params
 
 
@@ -580,11 +597,15 @@ def alibi_slopes(n_heads: int) -> jax.Array:
 
 def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                           mask: Optional[jax.Array], causal: bool = True,
-                          alibi: Optional[jax.Array] = None) -> jax.Array:
+                          alibi: Optional[jax.Array] = None,
+                          key_positions: Optional[jax.Array] = None
+                          ) -> jax.Array:
     """Plain-XLA reference attention. q: (B,S,N,D); k,v: (B,T,K,D) with GQA
     broadcast. Softmax in fp32 (reference softmax kernels are fp32-accum).
     ``alibi``: per-head slopes (N,) — the key-position-linear bias (the
-    query-position term is softmax-shift-invariant, so slope*k_pos suffices)."""
+    query-position term is softmax-shift-invariant, so slope*k_pos
+    suffices). ``key_positions`` (B, T): true per-row key positions for the
+    alibi bias (ragged decode — defaults to the column index)."""
     B, S, N, D = q.shape
     T, K = k.shape[1], k.shape[2]
     if K != N:
@@ -592,8 +613,10 @@ def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         v = jnp.repeat(v, N // K, axis=2)
     scores = jnp.einsum("bsnd,btnd->bnst", q, k).astype(jnp.float32) / (D ** 0.5)
     if alibi is not None:
-        scores = scores + (alibi[:, None, None]
-                           * jnp.arange(T, dtype=jnp.float32))[None]
+        kpos = (jnp.arange(T, dtype=jnp.float32)[None]
+                if key_positions is None
+                else key_positions.astype(jnp.float32))
+        scores = scores + alibi[None, :, None, None] * kpos[:, None, None, :]
     neg = jnp.finfo(jnp.float32).min
     if causal:
         # query at absolute position (T - S + s) attends to keys <= that position
@@ -630,7 +653,8 @@ def _layer_forward(cfg: TransformerConfig, x: jax.Array, layer: Dict[str, Any],
                    mask: Optional[jax.Array],
                    positions: jax.Array,
                    cache: Optional[Dict[str, jax.Array]] = None,
-                   static_prefill: bool = False
+                   static_prefill: bool = False,
+                   key_positions: Optional[jax.Array] = None
                    ) -> Tuple[jax.Array, Optional[Dict[str, jax.Array]]]:
     """One decoder block. ``layer`` holds this layer's (unstacked) params.
     ``cache`` (decode): dict with k/v of shape (B, T_max, K, D) and scalar
@@ -738,7 +762,8 @@ def _layer_forward(cfg: TransformerConfig, x: jax.Array, layer: Dict[str, Any],
                 valid = mask * causal_valid
             else:
                 valid = jnp.broadcast_to(causal_valid, (B, T))
-            attn = decode_attention(q[:, 0], ck, cv, valid, alibi=alibi)[:, None]
+            attn = decode_attention(q[:, 0], ck, cv, valid, alibi=alibi,
+                                    key_positions=key_positions)[:, None]
         elif (static_prefill and S > 1 and cfg.attention_impl is None
               and _kernels_active() and T % 128 == 0):
             # prefill from position 0: queries sit at absolute rows 0..S-1, so
@@ -766,6 +791,27 @@ def _layer_forward(cfg: TransformerConfig, x: jax.Array, layer: Dict[str, Any],
                 full = full * mask[:, None, :]
             if alibi is None:
                 attn = attn_fn(q, k, v, full, causal=False)
+            elif key_positions is not None:
+                if cfg.attention_impl is not None:
+                    import inspect
+
+                    sig = inspect.signature(cfg.attention_impl)
+                    if ("key_positions" not in sig.parameters
+                            and not any(
+                                p.kind is inspect.Parameter.VAR_KEYWORD
+                                for p in sig.parameters.values())):
+                        raise TypeError(
+                            "custom attention_impl must accept a "
+                            "key_positions= kwarg for ragged alibi decode "
+                            f"(signature is {sig}) — silently swapping in "
+                            "the reference attention would change the "
+                            "model's performance profile")
+                    attn = attn_fn(q, k, v, full, causal=False, alibi=alibi,
+                                   key_positions=key_positions)
+                else:
+                    attn = dot_product_attention(
+                        q, k, v, full, causal=False, alibi=alibi,
+                        key_positions=key_positions)
             else:
                 attn = attn_fn(q, k, v, full, causal=False, alibi=alibi)
     elif use_ring:
@@ -874,7 +920,8 @@ def forward(params: Dict[str, Any], input_ids: jax.Array,
             start_pos: Any = 0,
             pld_theta: Optional[jax.Array] = None,
             positions: Optional[jax.Array] = None,
-            token_type_ids: Optional[jax.Array] = None
+            token_type_ids: Optional[jax.Array] = None,
+            key_positions: Optional[jax.Array] = None
             ) -> Tuple[jax.Array, Optional[Dict[str, Any]], jax.Array]:
     """Token ids (B,S) → (logits (B,S,V), new_cache, moe_aux_loss). With
     ``cache``, runs in decode mode (cache is a per-layer stacked pytree; see
@@ -956,7 +1003,7 @@ def forward(params: Dict[str, Any], input_ids: jax.Array,
         else:
             h_new, new_cache, aux = _layer_forward(
                 cfg, h, layer, attention_mask, positions, layer_cache,
-                static_prefill=static_prefill)
+                static_prefill=static_prefill, key_positions=key_positions)
         if use_pld:
             # stochastic depth (reference progressive_layer_drop.py): layer i
             # keeps with p = 1 - (1-theta)(i+1)/L, deeper layers drop more;

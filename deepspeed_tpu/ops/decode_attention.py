@@ -35,7 +35,7 @@ NEG_INF = -1e30
 LANES = 128
 
 
-def _kernel(q_ref, k_ref, v_ref, valid_ref, alibi_ref, o_ref,
+def _kernel(q_ref, k_ref, v_ref, valid_ref, alibi_ref, kpos_ref, o_ref,
             acc, m_scr, l_scr, *, scale: float, bt: int,
             n_heads: int, kv_heads: int, has_alibi: bool):
     jt = pl.program_id(1)
@@ -63,9 +63,10 @@ def _kernel(q_ref, k_ref, v_ref, valid_ref, alibi_ref, o_ref,
         parts.append(s_kh)                             # (G, bt)
     s = jnp.concatenate(parts, axis=0)                 # (N, bt)
 
-    col = jax.lax.broadcasted_iota(jnp.int32, (n_heads, bt), 1) + jt * bt
     if has_alibi:
-        s = s + alibi_ref[0][:, None] * col.astype(jnp.float32)
+        # key POSITIONS ride as an operand (per-row — ragged batches give
+        # generated keys their true positions, not arena columns)
+        s = s + alibi_ref[0][:, None] * kpos_ref[0, 0][None, :]
     mask = (valid_ref[0, 0] != 0)[None, :]             # (1, bt)
     s = jnp.where(mask, s, NEG_INF)
 
@@ -93,10 +94,13 @@ def _kernel(q_ref, k_ref, v_ref, valid_ref, alibi_ref, o_ref,
 def decode_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
                      valid: jax.Array, alibi: Optional[jax.Array] = None,
                      scale: Optional[float] = None,
+                     key_positions: Optional[jax.Array] = None,
                      interpret: bool = False) -> jax.Array:
     """q (B, N, D) — one new token; k/v_cache (B, T, K, D); valid (B, T)
     marks live cache slots (causal + padding in one mask). Returns (B, N, D).
-    T must be a multiple of 128 (the arena is sized that way)."""
+    T must be a multiple of 128 (the arena is sized that way).
+    ``key_positions`` (B, T): true per-row key positions for the alibi bias
+    (ragged batches — defaults to the arena column index)."""
     B, N, D = q.shape
     T, K = k_cache.shape[1], k_cache.shape[2]
     if T % LANES != 0:
@@ -122,6 +126,10 @@ def decode_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
     alibi_arr = (alibi.astype(jnp.float32).reshape(1, N) if has_alibi
                  else jnp.zeros((1, N), jnp.float32))
     valid3 = valid.astype(jnp.float32)[:, None, :]     # (B, 1, T)
+    if key_positions is None:
+        key_positions = jnp.broadcast_to(
+            jnp.arange(T, dtype=jnp.float32)[None], (B, T))
+    kpos3 = key_positions.astype(jnp.float32)[:, None, :]   # (B, 1, T)
 
     kernel = functools.partial(_kernel, scale=scale, bt=bt, n_heads=N,
                                kv_heads=K, has_alibi=has_alibi)
@@ -134,6 +142,7 @@ def decode_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
             pl.BlockSpec((1, bt, K, D), lambda b, t: (b, t, 0, 0)),
             pl.BlockSpec((1, 1, bt), lambda b, t: (b, 0, t)),
             pl.BlockSpec((1, N), lambda b, t: (0, 0)),
+            pl.BlockSpec((1, 1, bt), lambda b, t: (b, 0, t)),
         ],
         out_specs=pl.BlockSpec((1, N, D), lambda b, t: (b, 0, 0)),
         out_shape=jax.ShapeDtypeStruct((B, N, D), q.dtype),
@@ -145,14 +154,16 @@ def decode_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "arbitrary")),
         interpret=interpret,
-    )(q, k_cache, v_cache, valid3, alibi_arr)
+    )(q, k_cache, v_cache, valid3, alibi_arr, kpos3)
     return out
 
 
 def reference_decode_attention(q: jax.Array, k_cache: jax.Array,
                                v_cache: jax.Array, valid: jax.Array,
                                alibi: Optional[jax.Array] = None,
-                               scale: Optional[float] = None) -> jax.Array:
+                               scale: Optional[float] = None,
+                               key_positions: Optional[jax.Array] = None
+                               ) -> jax.Array:
     """GQA-native jnp oracle (no KV expansion: batched over KV heads)."""
     B, N, D = q.shape
     T, K = k_cache.shape[1], k_cache.shape[2]
@@ -163,7 +174,10 @@ def reference_decode_attention(q: jax.Array, k_cache: jax.Array,
                    k_cache.astype(jnp.float32))        # (B, K, G, T)
     if alibi is not None:
         al = alibi.astype(jnp.float32).reshape(K, G)
-        s = s + al[None, :, :, None] * jnp.arange(T, dtype=jnp.float32)
+        kpos = (jnp.broadcast_to(jnp.arange(T, dtype=jnp.float32), (B, T))
+                if key_positions is None
+                else key_positions.astype(jnp.float32))
+        s = s + al[None, :, :, None] * kpos[:, None, None, :]
     s = jnp.where((valid != 0)[:, None, None, :], s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
     o = jnp.einsum("bkgt,btkd->bkgd", p, v_cache.astype(jnp.float32))

@@ -197,6 +197,28 @@ class TestDecodeAttention:
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    atol=2e-5, rtol=2e-5)
 
+    def test_alibi_key_positions(self):
+        """Ragged-batch alibi: per-row key positions override the arena
+        column index in the bias (and default to it when omitted)."""
+        q, kc, vc, valid = self._setup(n=8, b=2)
+        al = alibi_slopes(8)
+        col = jnp.arange(256, dtype=jnp.float32)
+        # row 1: shift only a SUBSET of the valid keys (a row-constant shift
+        # would be softmax-invariant and prove nothing)
+        kpos = jnp.stack([col, col - 30.0 * (col >= 50)])
+        out = decode_attention(q, kc, vc, valid, alibi=al,
+                               key_positions=kpos, interpret=INTERPRET)
+        ref = reference_decode_attention(q, kc, vc, valid, alibi=al,
+                                         key_positions=kpos)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+        # row 0 uses identity positions == the no-kpos default
+        base = decode_attention(q, kc, vc, valid, alibi=al,
+                                interpret=INTERPRET)
+        np.testing.assert_allclose(np.asarray(out[0]), np.asarray(base[0]),
+                                   atol=2e-5, rtol=2e-5)
+        assert np.abs(np.asarray(out[1] - base[1])).max() > 1e-4
+
     def test_matches_full_attention_oracle(self):
         # decode over a cache == last-row of full causal attention
         b, t, n, d, length = 1, 128, 4, 64, 77

@@ -124,7 +124,8 @@ def test_generate_ragged_prompts_right_padded():
     np.testing.assert_array_equal(np.asarray(got[1:2]), np.asarray(short))
 
 
-@pytest.mark.parametrize("preset", ["tiny", "tiny-llama"])  # learned + rope
+@pytest.mark.parametrize("preset", ["tiny", "tiny-llama", "tiny-bloom"])
+# learned + rope + alibi (per-row key positions in the bias)
 def test_generate_ragged_matches_solo_prompt(preset):
     """Exact ragged positions: a short row in a ragged batch must generate
     the SAME tokens as serving that prompt alone at its true width — decode
