@@ -277,15 +277,18 @@ class InferenceEngine:
         return jax.jit(prefill, donate_argnums=(3,))
 
     def _decode_fn(self, n_new: int, temperature: float, top_k: int,
-                   top_p: float, eos_token_id: Optional[int]):
+                   top_p: float, eos_token_id: Optional[int],
+                   ragged: bool = False):
         cfg = self.model.config
         T_max = self.config.max_out_tokens
         from ..models.transformer import forward as model_forward
 
-        # alibi models: the bias needs TRUE key positions — arena columns
-        # equal positions for the right-padded prompt part, but generated
-        # keys at column S+t sit at position len_b+t per row
-        use_kpos = cfg.position == "alibi"
+        # RAGGED alibi batches need TRUE key positions in the bias — arena
+        # columns equal positions for the right-padded prompt part, but
+        # generated keys at column S+t sit at position len_b+t per row.
+        # Uniform batches keep kpos=None (the column default is exact and
+        # custom attention_impls without the kwarg keep working).
+        use_kpos = ragged and cfg.position == "alibi"
 
         def decode(params, cache, valid, first_tok, lengths, s_width, rng):
             kpos = None
@@ -355,11 +358,14 @@ class InferenceEngine:
         if key_p not in self._prefill_cache:
             self._prefill_cache[key_p] = self._prefill_fn(S_pad)
         n_rest = max_new_tokens - 1
+        ragged = attention_mask is not None and bool(
+            np.any(np.asarray(mask).sum(-1) != S))
         key_d = (B, n_rest, float(temperature), int(top_k), float(top_p),
-                 eos_token_id)
+                 eos_token_id, ragged)
         if n_rest > 0 and key_d not in self._decode_cache:
             self._decode_cache[key_d] = self._decode_fn(
-                n_rest, temperature, top_k, top_p, eos_token_id)
+                n_rest, temperature, top_k, top_p, eos_token_id,
+                ragged=ragged)
 
         with self.mesh:
             cache = self._arena.pop(B, None)

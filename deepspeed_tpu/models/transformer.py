@@ -339,6 +339,21 @@ def _tp_world() -> int:
         return 1 << 30
 
 
+def _require_impl_kwarg(impl: Callable, kwarg: str, why: str) -> None:
+    """A custom attention_impl must DECLARE every kwarg a model feature
+    needs — failing loud beats silently dropping a bias or swapping in the
+    reference implementation."""
+    import inspect
+
+    sig = inspect.signature(impl)
+    if (kwarg not in sig.parameters
+            and not any(p.kind is inspect.Parameter.VAR_KEYWORD
+                        for p in sig.parameters.values())):
+        raise TypeError(
+            f"custom attention_impl must accept a {kwarg}= kwarg for {why} "
+            f"(signature is {sig})")
+
+
 def default_attention_impl() -> Callable:
     """Platform-resolved attention: Pallas flash attention on TPU, plain-jnp
     elsewhere. This is what ``attention_impl=None`` means (the round-1 gap:
@@ -426,15 +441,20 @@ def quantize_model_weights(params: Dict[str, Any], bits: int = 8,
     # source buffer alive until GC, which surfaces as a lazy OOM at the
     # first fence.
     if donate:
+        # out_shardings per leaf: under TP the quantized pair lands SHARDED
+        # directly — routing through the default device first would need
+        # the whole quantized tree resident on one chip, defeating TP's
+        # memory scaling at load. One jit wrapper per distinct sharding so
+        # same-shape leaves (wq/wk/wv) still share a compile.
+        jits: Dict[Any, Any] = {}
+
         def quant(w, sh=None):
-            # out_shardings per leaf: under TP the quantized pair lands
-            # SHARDED directly — routing through the default device first
-            # would need the whole quantized tree resident on one chip,
-            # defeating TP's memory scaling at load (each leaf shape is a
-            # distinct compile anyway, so the per-leaf jit costs nothing)
-            fn = jax.jit(_quant_math, donate_argnums=0,
-                         out_shardings=sh)
-            out = fn(w)
+            key = (None if sh is None
+                   else tuple(sorted((k, v) for k, v in sh.items())))
+            if key not in jits:
+                jits[key] = jax.jit(_quant_math, donate_argnums=0,
+                                    out_shardings=sh)
+            out = jits[key](w)
             jax.block_until_ready(out)
             try:
                 w.delete()
@@ -730,19 +750,10 @@ def _layer_forward(cfg: TransformerConfig, x: jax.Array, layer: Dict[str, Any],
 
     attn_fn = cfg.attention_impl or default_attention_impl()
     alibi = alibi_slopes(N) if cfg.position == "alibi" else None
-    if alibi is not None:
-        if cfg.attention_impl is not None:
-            import inspect
-
-            sig = inspect.signature(cfg.attention_impl)
-            if ("alibi" not in sig.parameters
-                    and not any(p.kind is inspect.Parameter.VAR_KEYWORD
-                                for p in sig.parameters.values())):
-                raise TypeError(
-                    "custom attention_impl must accept an alibi= kwarg for "
-                    "position='alibi' models (BLOOM); signature is "
-                    f"{sig} — silently dropping the alibi bias would change "
-                    "the model")
+    if alibi is not None and cfg.attention_impl is not None:
+        _require_impl_kwarg(cfg.attention_impl, "alibi",
+                            "position='alibi' models (BLOOM) — silently "
+                            "dropping the alibi bias would change the model")
     new_cache = None
     if cache is not None:
         idx = cache["index"]
@@ -793,19 +804,11 @@ def _layer_forward(cfg: TransformerConfig, x: jax.Array, layer: Dict[str, Any],
                 attn = attn_fn(q, k, v, full, causal=False)
             elif key_positions is not None:
                 if cfg.attention_impl is not None:
-                    import inspect
-
-                    sig = inspect.signature(cfg.attention_impl)
-                    if ("key_positions" not in sig.parameters
-                            and not any(
-                                p.kind is inspect.Parameter.VAR_KEYWORD
-                                for p in sig.parameters.values())):
-                        raise TypeError(
-                            "custom attention_impl must accept a "
-                            "key_positions= kwarg for ragged alibi decode "
-                            f"(signature is {sig}) — silently swapping in "
-                            "the reference attention would change the "
-                            "model's performance profile")
+                    _require_impl_kwarg(
+                        cfg.attention_impl, "key_positions",
+                        "ragged alibi decode — silently swapping in the "
+                        "reference attention would change the model's "
+                        "performance profile")
                     attn = attn_fn(q, k, v, full, causal=False, alibi=alibi,
                                    key_positions=key_positions)
                 else:

@@ -126,10 +126,18 @@ def decode_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
     alibi_arr = (alibi.astype(jnp.float32).reshape(1, N) if has_alibi
                  else jnp.zeros((1, N), jnp.float32))
     valid3 = valid.astype(jnp.float32)[:, None, :]     # (B, 1, T)
-    if key_positions is None:
-        key_positions = jnp.broadcast_to(
-            jnp.arange(T, dtype=jnp.float32)[None], (B, T))
-    kpos3 = key_positions.astype(jnp.float32)[:, None, :]   # (B, 1, T)
+    # kpos rides per-ROW only for ragged alibi; otherwise a shared (1,1,T)
+    # arange (alibi) or a never-read dummy (no alibi) with a b-ignoring
+    # index map — no per-step (B,T) materialisation on non-alibi models
+    per_row = key_positions is not None
+    if per_row:
+        kpos3 = key_positions.astype(jnp.float32)[:, None, :]  # (B, 1, T)
+    elif has_alibi:
+        kpos3 = jnp.arange(T, dtype=jnp.float32)[None, None, :]
+    else:
+        kpos3 = jnp.zeros((1, 1, T), jnp.float32)
+    kpos_map = ((lambda b, t: (b, 0, t)) if per_row
+                else (lambda b, t: (0, 0, t)))
 
     kernel = functools.partial(_kernel, scale=scale, bt=bt, n_heads=N,
                                kv_heads=K, has_alibi=has_alibi)
@@ -142,7 +150,7 @@ def decode_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
             pl.BlockSpec((1, bt, K, D), lambda b, t: (b, t, 0, 0)),
             pl.BlockSpec((1, 1, bt), lambda b, t: (b, 0, t)),
             pl.BlockSpec((1, N), lambda b, t: (0, 0)),
-            pl.BlockSpec((1, 1, bt), lambda b, t: (b, 0, t)),
+            pl.BlockSpec((1, 1, bt), kpos_map),
         ],
         out_specs=pl.BlockSpec((1, N, D), lambda b, t: (b, 0, 0)),
         out_shape=jax.ShapeDtypeStruct((B, N, D), q.dtype),
