@@ -152,7 +152,7 @@ class InferenceEngine:
             is_leaf=lambda x: isinstance(x, P))
 
         if params is None:
-            with self.mesh:
+            with mesh_mod.ambient(self.mesh):
                 if config.quantize_bits:
                     # init + quantize in ONE program: XLA liveness frees each
                     # full-precision weight as its int8 replacement is
@@ -258,7 +258,7 @@ class InferenceEngine:
         batch = {"input_ids": jnp.asarray(input_ids)}
         if attention_mask is not None:
             batch["attention_mask"] = jnp.asarray(attention_mask)
-        with self.mesh:
+        with mesh_mod.ambient(self.mesh):
             return self._fwd(self.params, batch)
 
     __call__ = forward
@@ -367,7 +367,7 @@ class InferenceEngine:
                 n_rest, temperature, top_k, top_p, eos_token_id,
                 ragged=ragged)
 
-        with self.mesh:
+        with mesh_mod.ambient(self.mesh):
             cache = self._arena.pop(B, None)
             # single-workspace policy (reference InferenceContext): a batch
             # size change frees the old arena instead of pinning one arena

@@ -320,23 +320,28 @@ def _tp_world() -> int:
     """Model-axis size of the AMBIENT mesh context at trace time — the
     quantized-GEMM Pallas route is single-shard only (a pallas_call over
     model-sharded weights would need a manual shard_map); TP runs take the
-    jnp dequant path, which XLA partitions. Reads the `with mesh:` context
-    (both engines trace inside one) — NOT the module-global mesh, which the
-    inference engine never sets (and whose lazy default would be a side
-    effect here)."""
+    jnp dequant path, which XLA partitions. Reads the framework's ambient
+    mesh (``parallel.mesh.ambient`` — every engine trace site enters the
+    mesh through it), falling back to the public
+    ``jax.sharding.get_abstract_mesh`` for ``use_mesh`` users. NOT the
+    module-global mesh, which the inference engine never sets (and whose
+    lazy default would be a side effect here)."""
+    from ..parallel.mesh import MODEL_AXIS, ambient_mesh
+
+    m = ambient_mesh()
+    if m is not None:
+        return int(dict(m.shape).get(MODEL_AXIS, 1))
     try:
-        from jax.interpreters import pxla
-
-        m = pxla.thread_resources.env.physical_mesh
-        from ..parallel.mesh import MODEL_AXIS
-
-        shape = dict(getattr(m, "shape", {}) or {})
-        return int(shape.get(MODEL_AXIS, 1))
+        am = jax.sharding.get_abstract_mesh()
+        shape = dict(getattr(am, "shape", {}) or {})
+        if shape:
+            return int(shape.get(MODEL_AXIS, 1))
     except Exception:
-        # fail UNSAFE-proof: if the mesh probe breaks (internal jax API
-        # moved), disable the single-shard kernel route rather than risk a
-        # pallas_call over sharded weights
-        return 1 << 30
+        pass
+    # fail UNSAFE-proof: outside any framework mesh context we cannot rule
+    # out sharded weights (e.g. a bare `with mesh:` trace) — disable the
+    # single-shard kernel route rather than risk a pallas_call over them
+    return 1 << 30
 
 
 def _require_impl_kwarg(impl: Callable, kwarg: str, why: str) -> None:
@@ -1120,7 +1125,13 @@ def build_model(cfg: TransformerConfig, name: str = "transformer") -> Model:
     def init_layer_block(rng, lo, blen):
         return init_layer_params(jax.random.split(rng, 16)[2], cfg, lo, blen)
 
+    def eval_loss_fn(params, batch):
+        # derive the eval copy at TRACE time so live-config mutations the
+        # engine makes at compression boundaries (act_quant_bits) reach
+        # eval on the next retrace — a build-time copy would freeze them
+        return make_loss(eval_config(cfg))(params, batch)
+
     return Model(init=init, apply=apply, loss_fn=make_loss(cfg),
-                 eval_loss_fn=make_loss(eval_config(cfg)),
+                 eval_loss_fn=eval_loss_fn,
                  init_layer_block=init_layer_block,
                  axes=param_axes(cfg), config=cfg, name=name)

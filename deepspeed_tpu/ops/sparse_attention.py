@@ -169,7 +169,14 @@ class VariableSparsityConfig(SparsityConfig):
     """Variable layout (reference :239): Fixed extended with per-window
     local block sizes (``local_window_blocks`` — the last entry repeats for
     the remaining windows), optional random blocks per row, and global
-    blocks given as indices or [start, end) ranges."""
+    blocks given as indices or [start, end) ranges.
+
+    Intentional deviation from the reference: for unidirectional attention
+    the final ``np.tril`` also removes ABOVE-diagonal random blocks, which
+    the reference's ``set_random_layout`` keeps. Keeping them would let a
+    causal model attend to future blocks (the kernel's per-element causal
+    mask applies only on diagonal tiles) — tril is the safe causal
+    behavior and matches every other unidirectional config here."""
 
     num_random_blocks: int = 0
     local_window_blocks: tuple = (4,)

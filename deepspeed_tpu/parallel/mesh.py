@@ -27,6 +27,7 @@ expert-DATA-parallel group structure (groups.py:108/156) with ep <= dp.
 from __future__ import annotations
 
 import os
+import threading
 from contextlib import contextmanager
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -108,13 +109,44 @@ def reset_mesh() -> None:
     _GLOBAL_MESH = None
 
 
+_TLS = threading.local()
+
+
+@contextmanager
+def ambient(mesh: Mesh):
+    """Enter ``mesh`` as the jit mesh context AND register it on a
+    framework-owned thread-local stack readable via :func:`ambient_mesh`.
+
+    This replaces reading ``jax.interpreters.pxla.thread_resources`` (a JAX
+    internal, deprecated since 0.8.2) as the way trace-time code discovers
+    the mesh it is being traced under — e.g. the quantized-GEMM kernel gate
+    in ``models/transformer.py`` needs the model-axis world size at trace
+    time. Every engine trace site enters the mesh through here."""
+    stack = getattr(_TLS, "stack", None)
+    if stack is None:
+        stack = _TLS.stack = []
+    stack.append(mesh)
+    try:
+        with mesh:
+            yield mesh
+    finally:
+        stack.pop()
+
+
+def ambient_mesh() -> Optional[Mesh]:
+    """The mesh of the innermost active :func:`ambient` context on this
+    thread, or None outside any framework mesh context."""
+    stack = getattr(_TLS, "stack", None)
+    return stack[-1] if stack else None
+
+
 @contextmanager
 def mesh_context(mesh: Mesh):
     global _GLOBAL_MESH
     prev = _GLOBAL_MESH
     _GLOBAL_MESH = mesh
     try:
-        with mesh:
+        with ambient(mesh):
             yield mesh
     finally:
         _GLOBAL_MESH = prev

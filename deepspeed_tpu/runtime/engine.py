@@ -268,7 +268,7 @@ class TrainEngine:
             self._n_params = self._param_offload.n_params
             self.params = None
         else:
-            with self.mesh:
+            with mesh_mod.ambient(self.mesh):
                 self.params = jax.jit(_init_cast,
                                       out_shardings=self.param_shardings)(rng)
 
@@ -301,7 +301,7 @@ class TrainEngine:
             self.opt_state = None     # the executor owns all optimizer state
         else:
             master_shardings_tree = self._opt_state_shardings()
-            with self.mesh:
+            with mesh_mod.ambient(self.mesh):
                 self.opt_state = jax.jit(
                     self.optimizer.init,
                     out_shardings=master_shardings_tree)(self.params)
@@ -313,7 +313,7 @@ class TrainEngine:
         if self._onebit:
             n_total = sum(int(p.size) for p in jax.tree.leaves(self.params))
             npad = n_total + ((-n_total) % dp_world)
-            with self.mesh:
+            with mesh_mod.ambient(self.mesh):
                 self._comp_state = {
                     "worker": jax.device_put(
                         jnp.zeros((dp_world, npad), jnp.float32),
@@ -753,21 +753,10 @@ class TrainEngine:
 
         pipelined = model.pipelined
 
-        base_loss_fn = model.loss_fn
-        if self._compression_plan is not None and self._compression_active:
-            from ..compression import apply_compression
-
-            plan = self._compression_plan
-            active = self._compression_active
-            orig = base_loss_fn
-            # QAT straight-through: compression transform inside the
-            # differentiation path; the step is rebuilt when the scheduler's
-            # active-method set changes (one recompile per boundary)
-            base_loss_fn = lambda p, b: orig(
-                apply_compression(
-                    p, plan, active,
-                    handled_elsewhere=frozenset(
-                        {"activation_quantization"})), b)
+        # QAT straight-through: compression transform inside the
+        # differentiation path; the step is rebuilt when the scheduler's
+        # active-method set changes (one recompile per boundary)
+        base_loss_fn = self._compression_wrap(model.loss_fn)
 
         def micro_loss(params, mb, scale):
             loss = base_loss_fn(params, mb)
@@ -919,6 +908,7 @@ class TrainEngine:
             if act != self._compression_active:
                 self._compression_active = act
                 self._compiled_step = None    # re-specialise at the boundary
+                self._eval_step = None        # eval sees the same boundary
                 self._apply_act_quant(act)
             if (self._moq_eigenvalue is not None
                     and "weight_quantization" in act
@@ -938,7 +928,7 @@ class TrainEngine:
         breakdown = self.wall_clock_breakdown()
         if breakdown:
             self.timers(TRAIN_BATCH_TIMER).start(synchronize=True)
-        with self.mesh:
+        with mesh_mod.ambient(self.mesh):
             batch = self._globalize_batch(batch, leading_gas=True)
             if self._param_offload is not None:
                 # host-driven segmented step: params stream through HBM per
@@ -995,6 +985,21 @@ class TrainEngine:
         self._tput_window_start = self._tput_window_start or time.time()
         return loss
 
+    def _compression_wrap(self, fn):
+        """Wrap a loss fn with the ACTIVE compression transform (QAT
+        straight-through). The single site both the train-step builder and
+        eval_loss use — so train and eval can never diverge on which
+        methods apply; callers re-jit at schedule boundaries."""
+        if self._compression_plan is None or not self._compression_active:
+            return fn
+        from ..compression import apply_compression
+
+        plan, active = self._compression_plan, self._compression_active
+        return lambda p, b: fn(
+            apply_compression(p, plan, active,
+                              handled_elsewhere=frozenset(
+                                  {"activation_quantization"})), b)
+
     def _apply_act_quant(self, active) -> None:
         """Activation QAT toggles through the model config (the quantizer
         sits on layer INPUTS inside the scan; one re-jit per boundary)."""
@@ -1037,6 +1042,7 @@ class TrainEngine:
         if wq.get("layer_bits") != bits:
             wq["layer_bits"] = bits
             self._compiled_step = None
+            self._eval_step = None
             log_dist(f"MoQ eigenvalue schedule: layer bits -> {bits}")
 
     def _sync_step_stats(self, stats: StepStats) -> None:
@@ -1088,6 +1094,12 @@ class TrainEngine:
                 "random_ltd is driven by train_batch (per-step kept-token "
                 "schedule + step re-specialisation); the staged "
                 "forward/backward/step protocol would silently skip it")
+        if self._compression_plan is not None:
+            raise RuntimeError(
+                "compression_training is driven by train_batch (the schedule "
+                "advances on its step counter and the QAT transform is "
+                "rebuilt at boundaries); the staged forward/backward/step "
+                "protocol would silently train uncompressed")
         if self._compiled_micro is None:
             model, gas, fp16 = self.model, self.gradient_accumulation_steps(), self.fp16_enabled()
 
@@ -1098,7 +1110,7 @@ class TrainEngine:
             self._compiled_micro = jax.jit(jax.value_and_grad(micro, has_aux=True))
         self._pending_batch = self._globalize_batch(batch, leading_gas=False)
         scale = self.scaler_state.scale if self.fp16_enabled() else jnp.float32(1.0)
-        with self.mesh:
+        with mesh_mod.ambient(self.mesh):
             (scaled_loss, loss), grads = self._compiled_micro(
                 self.params, self._pending_batch, scale)
         self._pending_grads = grads
@@ -1134,7 +1146,7 @@ class TrainEngine:
             overflow = has_overflow(grads)
         else:
             overflow = jnp.asarray(False)
-        with self.mesh:
+        with mesh_mod.ambient(self.mesh):
             self.params, self.opt_state, stats = self.optimizer.apply(
                 self.params, grads, self.opt_state, skip_update=overflow)
         self.scaler_state = self.loss_scaler.update(self.scaler_state, overflow)
@@ -1150,7 +1162,7 @@ class TrainEngine:
     def eval_loss(self, batch: Any) -> jax.Array:
         self.mark_step_boundary()
         if self._param_offload is not None:
-            with self.mesh:
+            with mesh_mod.ambient(self.mesh):
                 batch = self._globalize_batch(batch, leading_gas=False)
                 return self._param_offload.eval_forward(batch)
         if self.model.pipelined:
@@ -1158,11 +1170,14 @@ class TrainEngine:
             # eval microbatch wrap it as a single-microbatch stack
             batch = jax.tree.map(lambda x: x[None], batch)
         if self._eval_step is None:
-            # eval_loss_fn closes over an eval-mode config COPY (regularisers
-            # off) — no shared-config mutation, and the jitted step is cached
-            # so repeated eval calls don't retrace
+            # eval_loss_fn derives an eval-mode config (regularisers off) at
+            # trace time — no shared-config mutation, and the jitted step is
+            # cached so repeated eval calls don't retrace; the cache is
+            # invalidated at compression boundaries so eval evaluates the
+            # SAME compressed module the train step differentiates
             if self.model.eval_loss_fn is not None:
-                self._eval_step = jax.jit(self.model.eval_loss_fn)
+                self._eval_step = jax.jit(
+                    self._compression_wrap(self.model.eval_loss_fn))
             else:
                 cfg = self.model.config
                 loss_fn = self.model.loss_fn
@@ -1182,10 +1197,10 @@ class TrainEngine:
                         finally:
                             cfg.ltd_keep, cfg.dropout_enabled = keep, drop
 
-                    self._eval_step = jax.jit(eval_fn)
+                    self._eval_step = jax.jit(self._compression_wrap(eval_fn))
                 else:
-                    self._eval_step = jax.jit(loss_fn)
-        with self.mesh:
+                    self._eval_step = jax.jit(self._compression_wrap(loss_fn))
+        with mesh_mod.ambient(self.mesh):
             return self._eval_step(self.params, batch)
 
     # -- profiling (reference flops_profiler engine hooks + NVTX ranges) --
@@ -1285,7 +1300,7 @@ class TrainEngine:
                        "res_m": po._res_shardings,
                        "res_v": po._res_shardings}
                 opt_tpl = (ost, osh)
-            with self.mesh:
+            with mesh_mod.ambient(self.mesh):
                 result = _load(load_dir, tag,
                                params_template=(ptree, psh),
                                opt_template=opt_tpl)
@@ -1313,7 +1328,7 @@ class TrainEngine:
         load_resident_opt = (load_optimizer_states
                              and self._nvme_swapper is None)
         opt_shardings = self._opt_state_shardings() if load_resident_opt else None
-        with self.mesh:
+        with mesh_mod.ambient(self.mesh):
             result = _load(load_dir, tag,
                            params_template=(self.params, self.param_shardings),
                            opt_template=((self.opt_state, opt_shardings)

@@ -149,6 +149,23 @@ class HybridEngine(TrainEngine):
                 "in-place fuse at all)")
         return super().train_batch(*args, **kwargs)
 
+    def _guard_fused_save(self, what: str) -> None:
+        if self._lora_fused:
+            raise RuntimeError(
+                f"unfuse_lora_weight() before {what}: the fused bf16 params "
+                "are inconsistent with the unfused fp32 master in opt_state "
+                "— resuming such a checkpoint would either double-subtract "
+                "the deltas (resume+unfuse) or silently drop them via the "
+                "master rebuild (resume+train)")
+
+    def save_checkpoint(self, *args, **kwargs):
+        self._guard_fused_save("save_checkpoint")
+        return super().save_checkpoint(*args, **kwargs)
+
+    def save_16bit_model(self, *args, **kwargs):
+        self._guard_fused_save("save_16bit_model")
+        return super().save_16bit_model(*args, **kwargs)
+
     def generate(self, input_ids, **kwargs):
         infer = self._inference_engine()
         if self._infer_params_step != self.global_steps:

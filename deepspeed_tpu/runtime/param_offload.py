@@ -51,6 +51,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from ..parallel import mesh as mesh_mod
 from ..utils.logging import logger
 
 
@@ -261,7 +262,7 @@ class ParamOffloadExecutor:
                 return resident, res_master
 
             pin = list(self._pinned_shardings)
-            with mesh:
+            with mesh_mod.ambient(mesh):
                 self.resident, self._res_master = jax.jit(
                     init_res,
                     out_shardings=(self._res_shardings,
@@ -325,7 +326,7 @@ class ParamOffloadExecutor:
             # numpy backend (CPU tests / nvme file tier)
             if jax.default_backend() == "cpu":
                 # CPU: a plain jit is host-resident already
-                with mesh:
+                with mesh_mod.ambient(mesh):
                     params = jax.jit(init_fn)(rng)
                 kv, _ = _tree_leaves_with_path(params["layers"])
                 # np.array (copy): np views over jax buffers are read-only,
@@ -340,7 +341,7 @@ class ParamOffloadExecutor:
                     params = init_fn(key)
                     return {k: v for k, v in params.items() if k != "layers"}
 
-                with mesh:
+                with mesh_mod.ambient(mesh):
                     resident_dev = jax.jit(
                         res_only, out_shardings=self._res_shardings)(rng)
                     fn = jax.jit(_block_leaves_fn(), static_argnums=(2,))
@@ -363,7 +364,7 @@ class ParamOffloadExecutor:
                         [_safe_sharding(mesh, s, tuple(l.shape))
                          for s, l in zip(layer_specs, layer_shapes)]),
                      **self._res_shardings})
-                with mesh:
+                with mesh_mod.ambient(mesh):
                     params = jax.jit(init_fn, out_shardings=host_sh)(rng)
                 kv, _ = _tree_leaves_with_path(params["layers"])
                 layer_leaves = [np.array(l) for _, l in kv]
@@ -421,6 +422,12 @@ class ParamOffloadExecutor:
                 positions = jnp.arange(S)
                 if c.position == "learned":
                     x = x + resident["pos"][positions].astype(c.dtype)
+                if c.type_vocab_size > 0:
+                    # segment-0 embedding, matching the resident forward with
+                    # token_type_ids=None (models/transformer.py); keeps the
+                    # type_embed grad flowing to row 0 instead of silently
+                    # zero (ADVICE r3 medium finding)
+                    x = x + resident["type_embed"][0].astype(c.dtype)
                 if c.embed_norm:
                     x = _norm(x, resident["embed_norm"]["scale"],
                               resident["embed_norm"].get("bias"), "layernorm",

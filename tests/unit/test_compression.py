@@ -160,6 +160,42 @@ def test_activation_quantization_schedule_drives_config():
     assert all(np.isfinite(losses))
 
 
+def test_eval_sees_compression_boundary():
+    """ADVICE r3: eval must evaluate the COMPRESSED module after a schedule
+    boundary, like the reference (and like the train step, which
+    re-specialises at every boundary) — not a stale pre-boundary trace."""
+    import deepspeed_tpu
+    from deepspeed_tpu.compression import apply_compression
+    from deepspeed_tpu.models import create_model
+
+    model = create_model("tiny")
+    engine, *_ = deepspeed_tpu.initialize(model=model, config={
+        "train_micro_batch_size_per_gpu": 2, "steps_per_print": 1000,
+        "optimizer": {"type": "adamw", "params": {"lr": 0.0}},
+        "compression_training": {
+            "weight_quantization": {
+                "shared_parameters": {"enabled": True, "schedule_offset": 2},
+                "different_groups": {
+                    "g0": {"params": {"start_bits": 3, "target_bits": 3},
+                           "modules": ["layers"]}}}}})
+    ids = np.random.RandomState(0).randint(0, 256, (1, 16, 16))
+    mb = {"input_ids": ids[0]}
+    ev_before = float(engine.eval_loss(mb))      # caches the eval step
+    for _ in range(4):
+        engine.train_batch(batch={"input_ids": ids})  # crosses offset=2
+    assert "weight_quantization" in engine._compression_active
+    ev_after = float(engine.eval_loss(mb))
+    # oracle: eval loss on the explicitly compressed params (lr=0 so the
+    # raw params never moved — any difference is the quantization)
+    want = float(engine.model.eval_loss_fn(
+        apply_compression(engine.params, engine._compression_plan,
+                          engine._compression_active,
+                          handled_elsewhere=frozenset(
+                              {"activation_quantization"})), mb))
+    assert abs(ev_after - want) < 1e-5
+    assert abs(ev_after - ev_before) > 1e-6      # 3-bit quant moved the loss
+
+
 @__import__('pytest').mark.slow
 def test_moq_eigenvalue_layer_bits():
     """MoQ: the weight-quantization schedule responds to per-layer Hessian

@@ -118,3 +118,24 @@ def test_lora_fuse_unfuse_roundtrip():
     np.testing.assert_allclose(
         np.asarray(engine.params["layers"]["attn"]["wq"]), w0,
         rtol=1e-5, atol=1e-6)
+
+
+def test_fused_save_guard(tmp_path):
+    """ADVICE r3: saving while LoRA is fused would persist fused bf16
+    params alongside the UNFUSED fp32 master — an internally inconsistent
+    checkpoint. Both save paths must refuse, mirroring train_batch."""
+    import pytest
+
+    engine = _hybrid()
+    L, H, r = 2, 64, 4
+    k1, k2 = jax.random.split(jax.random.PRNGKey(0))
+    engine.set_lora({"attn/wq": (
+        jax.random.normal(k1, (L, H, r), jnp.float32) * 0.1,
+        jax.random.normal(k2, (L, r, H), jnp.float32) * 0.1)})
+    engine.fuse_lora_weight()
+    with pytest.raises(RuntimeError, match="unfuse"):
+        engine.save_checkpoint(str(tmp_path))
+    with pytest.raises(RuntimeError, match="unfuse"):
+        engine.save_16bit_model(str(tmp_path))
+    engine.unfuse_lora_weight()
+    engine.save_checkpoint(str(tmp_path))   # unfused saves fine

@@ -649,16 +649,23 @@ class TestInt4WeightOnly:
 
 
 def test_tp_world_reads_ambient_mesh(devices8):
-    """The quantized-GEMM kernel gate must see the `with mesh:` context the
-    engines trace under — NOT the module-global mesh the inference engine
-    never sets (regression: a global-mesh read returned 1 under tp=2)."""
+    """The quantized-GEMM kernel gate must see the mesh context the engines
+    trace under — NOT the module-global mesh the inference engine never sets
+    (regression: a global-mesh read returned 1 under tp=2). The probe reads
+    the framework's ambient tracker (public API — the deprecated
+    pxla.thread_resources read is gone); outside any framework mesh context
+    it must fail SAFE by disabling the single-shard kernel route."""
     import numpy as _np
     from jax.sharding import Mesh
 
     from deepspeed_tpu.models.transformer import _tp_world
+    from deepspeed_tpu.parallel import mesh as mesh_mod
 
-    assert _tp_world() == 1
+    assert _tp_world() > 1  # no ambient mesh: kernel route disabled (safe)
     mesh = Mesh(_np.array(jax.devices()).reshape(4, 2), ("data", "model"))
-    with mesh:
+    with mesh_mod.ambient(mesh):
         assert _tp_world() == 2
-    assert _tp_world() == 1
+    tp1 = Mesh(_np.array(jax.devices()).reshape(8, 1), ("data", "model"))
+    with mesh_mod.ambient(tp1):
+        assert _tp_world() == 1
+    assert _tp_world() > 1

@@ -104,6 +104,34 @@ class TestParamOffloadCPU:
         eng, off = _run(cfg_off, steps=3)
         np.testing.assert_allclose(off, base, rtol=2e-4, atol=2e-5)
 
+    def test_type_embed_trajectory_and_grads(self):
+        """ADVICE r3 (medium): segment embeddings (type_vocab_size>0) must
+        flow through the offload executor's embed segment — same trajectory
+        as the resident engine, and type_embed row 0 actually updates."""
+        def m():
+            return build_model(TransformerConfig(
+                vocab_size=128, hidden_size=32, num_layers=2, num_heads=2,
+                max_seq_len=32, dtype=jnp.float32, type_vocab_size=2))
+
+        def run(config, steps=3):
+            mesh_mod.reset_mesh()
+            eng, *_ = ds.initialize(model=m(), config=config,
+                                    rng=jax.random.PRNGKey(7))
+            ls = [float(eng.train_batch(batch=_batch(seed=i)))
+                  for i in range(steps)]
+            return eng, ls
+
+        eng_base, base = run(_cfg())
+        eng, off = run(_cfg(extra_zero={
+            "offload_param": {"device": "cpu", "buffer_size": 1}}))
+        np.testing.assert_allclose(off, base, rtol=2e-4, atol=2e-5)
+        te_base = np.asarray(eng_base.params["type_embed"], np.float32)
+        te_off = np.asarray(eng._param_offload.resident["type_embed"],
+                            np.float32)
+        np.testing.assert_allclose(te_off, te_base, rtol=1e-4, atol=1e-5)
+        init_te = np.asarray(m().init(jax.random.PRNGKey(7))["type_embed"])
+        assert np.abs(te_off[0] - init_te[0]).max() > 1e-5  # row 0 trained
+
     def test_eval_matches_resident(self):
         mesh_mod.reset_mesh()
         e1, _ = _run(_cfg(), steps=1)
