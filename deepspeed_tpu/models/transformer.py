@@ -93,6 +93,8 @@ class TransformerConfig:
     moe_aux_loss_coef: float = 0.01
     moe_drop_tokens: bool = True      # False => infinite capacity (C = T)
     moe_use_rts: bool = False         # random token selection (top-1 only)
+    moe_dispatch: str = "sparse"      # 'sparse' scatter/gather dispatch or
+    #   'einsum' dense one-hot (the GShard/reference formulation; fallback)
     moe_use_residual: bool = False    # PR-MoE: dense residual MLP + learned
     #   2-way coefficient mix (reference moe/layer.py use_residual)
 
@@ -883,7 +885,8 @@ def _layer_forward(cfg: TransformerConfig, x: jax.Array, layer: Dict[str, Any],
                                capacity_factor=cfg.moe_capacity_factor,
                                min_capacity=cfg.moe_min_capacity,
                                drop_tokens=cfg.moe_drop_tokens,
-                               use_rts=cfg.moe_use_rts, rng=rts_rng)
+                               use_rts=cfg.moe_use_rts, rng=rts_rng,
+                               dispatch_impl=cfg.moe_dispatch)
         if cfg.moe_use_residual:
             # PR-MoE (reference moe/layer.py:120): dense MLP in parallel,
             # mixed by a learned softmax coefficient over (moe, dense)

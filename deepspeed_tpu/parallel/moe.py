@@ -36,6 +36,23 @@ class GateOutput(NamedTuple):
     expert_counts: jax.Array  # (E,) tokens routed per expert (pre-drop)
 
 
+class GatePlan(NamedTuple):
+    """Index-form gating decision: each token's K (expert, queue-slot)
+    assignments. This is what the sparse dispatch consumes DIRECTLY —
+    dispatch cost scales with routed tokens (O(T·K·H) gathers), not with
+    the dense (T, E·C) one-hot contraction whose FLOPs dominate the step
+    at realistic E/capacity (the reference pays that einsum too,
+    sharded_moe.py:90 — this is where we beat it)."""
+
+    expert_idx: jax.Array     # (T, K) int32 — chosen expert per assignment
+    slot_pos: jax.Array       # (T, K) int32 — 0-based slot in expert queue
+    weight: jax.Array         # (T, K) f32 — combine weight, 0 where dropped
+    valid: jax.Array          # (T, K) bool — kept within capacity
+    capacity: int             # static C
+    aux_loss: jax.Array       # scalar load-balancing loss
+    expert_counts: jax.Array  # (E,) tokens routed per expert (pre-drop)
+
+
 def _capacity(num_tokens: int, num_experts: int, capacity_factor: float,
               min_capacity: int = 4) -> int:
     """Reference sharded_moe.py:157 _capacity."""
@@ -47,11 +64,11 @@ def _one_hot(x: jax.Array, n: int) -> jax.Array:
     return jax.nn.one_hot(x, n, dtype=jnp.float32)
 
 
-def top1gating(logits: jax.Array, capacity_factor: float = 1.0,
-               min_capacity: int = 4, noisy_gate_policy: Optional[str] = None,
-               rng: Optional[jax.Array] = None, drop_tokens: bool = True,
-               use_rts: bool = False) -> GateOutput:
-    """Switch-style top-1 gating (reference sharded_moe.py:179).
+def top1_plan(logits: jax.Array, capacity_factor: float = 1.0,
+              min_capacity: int = 4, noisy_gate_policy: Optional[str] = None,
+              rng: Optional[jax.Array] = None, drop_tokens: bool = True,
+              use_rts: bool = False) -> GatePlan:
+    """Switch-style top-1 gating (reference sharded_moe.py:179), index form.
 
     ``drop_tokens=False`` — infinite capacity (C=T; the reference computes a
     dynamic max-count capacity, which jit cannot — C=T is the static-shape
@@ -68,7 +85,8 @@ def top1gating(logits: jax.Array, capacity_factor: float = 1.0,
     expert_idx = jnp.argmax(logits_for_choice, axis=-1)              # (T,)
     mask = _one_hot(expert_idx, E)                                   # (T, E)
 
-    # aux loss: E * mean_e(frac_tokens_e * mean_gate_e)  (GShard eq.)
+    # aux loss: E * mean_e(frac_tokens_e * mean_gate_e)  (GShard eq.) —
+    # computed on the PRE-RTS mask, as in the reference
     me = gates.mean(axis=0)
     ce = mask.mean(axis=0)
     aux = jnp.sum(me * ce) * E
@@ -85,20 +103,20 @@ def top1gating(logits: jax.Array, capacity_factor: float = 1.0,
     # capacity assignment: position of each token within its expert queue
     pos_in_expert = jnp.cumsum(mask, axis=0) * mask                  # 1-based
     keep = (pos_in_expert <= C) & (mask > 0)
-    pos = (pos_in_expert - 1.0) * mask                               # 0-based
-    gate_val = (gates * mask).sum(axis=-1, keepdims=True)            # (T,1)
-    dispatch = keep[..., None] & (  # (T,E,C)
-        _one_hot(pos.sum(axis=-1).astype(jnp.int32), C)[:, None, :] > 0)
-    dispatch = dispatch & (mask[..., None] > 0)
-    combine = gate_val[:, :, None] * dispatch.astype(jnp.float32)
-    return GateOutput(combine=combine, dispatch=dispatch, aux_loss=aux,
-                      expert_counts=mask.sum(axis=0))
+    pos = ((pos_in_expert - 1.0) * mask).sum(axis=-1).astype(jnp.int32)
+    valid = keep.any(axis=-1)                                        # (T,)
+    gate_val = (gates * mask).sum(axis=-1)                           # (T,)
+    weight = jnp.where(valid, gate_val, 0.0)
+    return GatePlan(expert_idx=expert_idx.astype(jnp.int32)[:, None],
+                    slot_pos=pos[:, None], weight=weight[:, None],
+                    valid=valid[:, None], capacity=C, aux_loss=aux,
+                    expert_counts=mask.sum(axis=0))
 
 
-def top2gating(logits: jax.Array, capacity_factor: float = 1.0,
-               min_capacity: int = 4, drop_tokens: bool = True) -> GateOutput:
-    """GShard top-2 gating (reference sharded_moe.py:277): second expert
-    weighted by renormalised gate, both capacity-limited."""
+def top2_plan(logits: jax.Array, capacity_factor: float = 1.0,
+              min_capacity: int = 4, drop_tokens: bool = True) -> GatePlan:
+    """GShard top-2 gating (reference sharded_moe.py:277), index form:
+    second expert weighted by renormalised gate, both capacity-limited."""
     T, E = logits.shape
     C = T if not drop_tokens else _capacity(T, E, 2 * capacity_factor,
                                             min_capacity)
@@ -125,17 +143,54 @@ def top2gating(logits: jax.Array, capacity_factor: float = 1.0,
     denom = jnp.maximum(g1 + g2, 1e-9)
     g1, g2 = g1 / denom, g2 / denom
 
-    def slots(pos, keep):
-        return keep[..., None] & (
-            _one_hot((pos.sum(-1) - 1.0).clip(0).astype(jnp.int32), C)[:, None, :] > 0)
+    def pos0(pos):
+        return (pos.sum(-1) - 1.0).clip(0).astype(jnp.int32)
 
-    d1 = slots(pos1, keep1) & (mask1[..., None] > 0)
-    d2 = slots(pos2, keep2) & (mask2[..., None] > 0)
-    combine = (g1[:, None, None] * d1.astype(jnp.float32)
-               + g2[:, None, None] * d2.astype(jnp.float32))
-    dispatch = d1 | d2
-    return GateOutput(combine=combine, dispatch=dispatch, aux_loss=aux,
-                      expert_counts=(mask1 + mask2).sum(axis=0))
+    valid1, valid2 = keep1.any(axis=-1), keep2.any(axis=-1)
+    return GatePlan(
+        expert_idx=jnp.stack([idx1, idx2], axis=1).astype(jnp.int32),
+        slot_pos=jnp.stack([pos0(pos1), pos0(pos2)], axis=1),
+        weight=jnp.stack([jnp.where(valid1, g1, 0.0),
+                          jnp.where(valid2, g2, 0.0)], axis=1),
+        valid=jnp.stack([valid1, valid2], axis=1),
+        capacity=C, aux_loss=aux,
+        expert_counts=(mask1 + mask2).sum(axis=0))
+
+
+def _densify(plan: GatePlan, num_experts: int) -> GateOutput:
+    """(T, K) index form → (T, E, C) dense combine/dispatch (the GShard
+    einsum formulation; kept as the fallback path + for gating tests)."""
+    E, C = num_experts, plan.capacity
+    K = plan.expert_idx.shape[1]
+    combine = jnp.zeros((), jnp.float32)
+    dispatch = None
+    for kk in range(K):   # K<=2; keeps peak at (T,E,C), not (T,K,E,C)
+        e_oh = _one_hot(plan.expert_idx[:, kk], E) > 0          # (T, E)
+        c_oh = _one_hot(plan.slot_pos[:, kk], C) > 0            # (T, C)
+        d = (e_oh[:, :, None] & c_oh[:, None, :]
+             & plan.valid[:, kk, None, None])                   # (T, E, C)
+        combine = combine + plan.weight[:, kk, None, None] * d
+        dispatch = d if dispatch is None else (dispatch | d)
+    return GateOutput(combine=combine, dispatch=dispatch,
+                      aux_loss=plan.aux_loss,
+                      expert_counts=plan.expert_counts)
+
+
+def top1gating(logits: jax.Array, capacity_factor: float = 1.0,
+               min_capacity: int = 4, noisy_gate_policy: Optional[str] = None,
+               rng: Optional[jax.Array] = None, drop_tokens: bool = True,
+               use_rts: bool = False) -> GateOutput:
+    """Dense (T, E, C) rendering of :func:`top1_plan` (same semantics)."""
+    return _densify(top1_plan(logits, capacity_factor, min_capacity,
+                              noisy_gate_policy, rng, drop_tokens, use_rts),
+                    logits.shape[1])
+
+
+def top2gating(logits: jax.Array, capacity_factor: float = 1.0,
+               min_capacity: int = 4, drop_tokens: bool = True) -> GateOutput:
+    """Dense (T, E, C) rendering of :func:`top2_plan` (same semantics)."""
+    return _densify(top2_plan(logits, capacity_factor, min_capacity,
+                              drop_tokens), logits.shape[1])
 
 
 def _ep_active(num_experts: int) -> bool:
@@ -146,33 +201,12 @@ def _ep_active(num_experts: int) -> bool:
     return ep > 1 and num_experts % ep == 0
 
 
-def moe_mlp(x: jax.Array, router_w: jax.Array, experts: Dict[str, jax.Array],
-            activation: str, top_k: int = 2, capacity_factor: float = 1.25,
-            min_capacity: int = 4, drop_tokens: bool = True,
-            use_rts: bool = False,
-            rng: Optional[jax.Array] = None) -> Tuple[jax.Array, jax.Array]:
-    """MoE FFN for one layer. x (B, S, H); router_w (H, E); experts:
-    w_up/w_down (+w_gate for swiglu) with leading expert dim E.
-    Returns (out (B,S,H), aux_loss scalar)."""
-    B, S, H = x.shape
-    E = router_w.shape[-1]
-    T = B * S
-    xt = x.reshape(T, H)
-    logits = xt.astype(jnp.float32) @ router_w.astype(jnp.float32)
-    if top_k == 2 and use_rts:
-        raise ValueError("use_rts (Random Token Selection) is top-1 only, "
-                         "as in the reference (sharded_moe.py top1gating)")
-    gate = (top2gating(logits, capacity_factor, min_capacity,
-                       drop_tokens=drop_tokens) if top_k == 2 else
-            top1gating(logits, capacity_factor, min_capacity,
-                       drop_tokens=drop_tokens, use_rts=use_rts, rng=rng))
-
-    dispatch = gate.dispatch.astype(x.dtype)                  # (T, E, C)
-    dispatched = jnp.einsum("tec,th->ech", dispatch, xt)      # (E, C, H)
+def _expert_ffn(dispatched: jax.Array, experts: Dict[str, jax.Array],
+                activation: str, E: int) -> jax.Array:
+    """(E, C, H) → (E, C, H) batched expert MLPs, EP-constrained."""
     if _ep_active(E):
         # EP: expert dim sharded over 'data' — XLA inserts the all-to-all here
         dispatched = constrain(dispatched, P(EXPERT_AXIS, None, None))
-
     if activation == "swiglu":
         g = jnp.einsum("ech,ehf->ecf", dispatched, experts["w_gate"])
         u = jnp.einsum("ech,ehf->ecf", dispatched, experts["w_up"])
@@ -184,6 +218,71 @@ def moe_mlp(x: jax.Array, router_w: jax.Array, experts: Dict[str, jax.Array],
     expert_out = jnp.einsum("ecf,efh->ech", inner, experts["w_down"])
     if _ep_active(E):
         expert_out = constrain(expert_out, P(EXPERT_AXIS, None, None))
+    return expert_out
 
-    out = jnp.einsum("tec,ech->th", gate.combine.astype(x.dtype), expert_out)
-    return out.reshape(B, S, H), gate.aux_loss
+
+def moe_mlp(x: jax.Array, router_w: jax.Array, experts: Dict[str, jax.Array],
+            activation: str, top_k: int = 2, capacity_factor: float = 1.25,
+            min_capacity: int = 4, drop_tokens: bool = True,
+            use_rts: bool = False, rng: Optional[jax.Array] = None,
+            dispatch_impl: str = "sparse") -> Tuple[jax.Array, jax.Array]:
+    """MoE FFN for one layer. x (B, S, H); router_w (H, E); experts:
+    w_up/w_down (+w_gate for swiglu) with leading expert dim E.
+    Returns (out (B,S,H), aux_loss scalar).
+
+    ``dispatch_impl``:
+      * ``"sparse"`` (default) — scatter/gather dispatch: a (E·C,) int32
+        token-of-slot map is built by scatter, tokens reach their expert
+        queue by GATHER (O(E·C·H) bytes, no FLOPs) and return by a (T, K)
+        gather + weighted sum (O(T·K·H) FLOPs). Dispatch cost scales with
+        the routed tokens — at E=8/top-2/cap 1.25 the dense formulation
+        burns ~4x the expert compute in the one-hot contraction alone.
+      * ``"einsum"`` — the GShard (T,E,C) one-hot einsum formulation (what
+        the reference computes, sharded_moe.py:90); equivalence-tested
+        against sparse."""
+    B, S, H = x.shape
+    E = router_w.shape[-1]
+    T = B * S
+    xt = x.reshape(T, H)
+    logits = xt.astype(jnp.float32) @ router_w.astype(jnp.float32)
+    if top_k == 2 and use_rts:
+        raise ValueError("use_rts (Random Token Selection) is top-1 only, "
+                         "as in the reference (sharded_moe.py top1gating)")
+    if dispatch_impl not in ("sparse", "einsum"):
+        raise ValueError(f"unknown moe dispatch_impl {dispatch_impl!r} "
+                         "(expected 'sparse' or 'einsum')")
+    plan = (top2_plan(logits, capacity_factor, min_capacity,
+                      drop_tokens=drop_tokens) if top_k == 2 else
+            top1_plan(logits, capacity_factor, min_capacity,
+                      drop_tokens=drop_tokens, use_rts=use_rts, rng=rng))
+    C = plan.capacity
+
+    if dispatch_impl == "einsum":
+        gate = _densify(plan, E)
+        dispatch = gate.dispatch.astype(x.dtype)                  # (T, E, C)
+        dispatched = jnp.einsum("tec,th->ech", dispatch, xt)      # (E, C, H)
+        expert_out = _expert_ffn(dispatched, experts, activation, E)
+        out = jnp.einsum("tec,ech->th", gate.combine.astype(x.dtype),
+                         expert_out)
+        return out.reshape(B, S, H), plan.aux_loss
+
+    # ---- sparse dispatch -------------------------------------------------
+    # flat slot id per (token, assignment); dropped tokens write to a dump
+    # slot that is sliced off, so every in-range slot has EXACTLY one writer
+    # (queue positions are unique per expert by construction)
+    slot = plan.expert_idx * C + plan.slot_pos                    # (T, K)
+    slot = jnp.where(plan.valid, slot, E * C)
+    tok = jnp.broadcast_to(
+        jnp.arange(T, dtype=jnp.int32)[:, None], slot.shape)
+    token_of_slot = jnp.zeros((E * C + 1,), jnp.int32).at[
+        slot.reshape(-1)].set(tok.reshape(-1))[:E * C]            # (E·C,)
+    # unfilled slots read token 0 — their values never reach the output
+    # (combine only gathers valid slots) and their grads are zero
+    dispatched = xt[token_of_slot].reshape(E, C, H)
+    expert_out = _expert_ffn(dispatched, experts, activation, E)
+
+    y = expert_out.reshape(E * C, H)
+    take = jnp.where(plan.valid, slot, 0)                         # in-range
+    out = (plan.weight.astype(x.dtype)[..., None]
+           * y[take]).sum(axis=1)                                 # (T, H)
+    return out.reshape(B, S, H), plan.aux_loss
