@@ -365,6 +365,24 @@ class CompressionConfig(ConfigModel):
 
 
 @dataclass
+class CompileCacheConfig(ConfigModel):
+    """Persistent XLA compilation cache (jax_compilation_cache_dir).
+
+    The analog of the reference's JIT-extension build cache (op_builder
+    caches compiled .so files under TORCH_EXTENSIONS_DIR): compiled step
+    programs survive process restarts. Essential at the >10B offload tier,
+    where the segment programs can take minutes to compile — with the cache
+    they compile ONCE (optionally incrementally, see
+    ``ParamOffloadExecutor.compile_step_programs``) and every later run
+    loads them in milliseconds. Default on; dir overridable via env
+    ``DSTPU_COMPILE_CACHE``."""
+
+    enabled: bool = True
+    dir: str = ""          # "" => $DSTPU_COMPILE_CACHE or ~/.cache/deepspeed_tpu/xla
+    min_compile_time_secs: float = 1.0
+
+
+@dataclass
 class Config(ConfigModel):
     """Root config — analog of ``DeepSpeedConfig`` (runtime/config.py:674)."""
 
@@ -401,6 +419,7 @@ class Config(ConfigModel):
     compression_training: CompressionConfig = field(default_factory=CompressionConfig)
     aio: AIOConfig = field(default_factory=AIOConfig)
     checkpoint: CheckpointConfig = field(default_factory=CheckpointConfig)
+    compile_cache: CompileCacheConfig = field(default_factory=CompileCacheConfig)
 
     # monitor sections may also appear at top level (reference accepts both)
     tensorboard: Optional[TensorboardConfig] = None

@@ -57,6 +57,8 @@ class InferenceConfig:
     #   dtype='int8'/'int4' sets this.
     quantize_groups: Optional[int] = None  # int4 group size along K (None =>
     #   one group per output channel; reference quantization_settings groups)
+    compile_cache: bool = True         # persistent XLA compile cache
+    #   (utils/compile_cache.py); DSTPU_COMPILE_CACHE overrides dir/disables
 
     def __post_init__(self):
         # dtype='int8' is storage quantization, not a compute dtype — the
@@ -122,6 +124,10 @@ class InferenceEngine:
 
     def __init__(self, model: Model, config: InferenceConfig,
                  params: Optional[Any] = None, mesh: Optional[Mesh] = None):
+        if config.compile_cache:
+            from ..utils.compile_cache import enable_compile_cache
+
+            enable_compile_cache()
         self.model = model
         self.config = config
         if mesh is None:

@@ -54,6 +54,11 @@ class TrainEngine:
                  optimizer: Optional[MixedPrecisionOptimizer] = None,
                  lr_scheduler=None, training_data=None, collate_fn=None,
                  rng: Optional[jax.Array] = None):
+        if config.compile_cache.enabled:
+            from ..utils.compile_cache import enable_compile_cache
+
+            enable_compile_cache(config.compile_cache.dir,
+                                 config.compile_cache.min_compile_time_secs)
         opt_name = config.optimizer.type.lower()
         self._onebit = opt_name in ("onebitadam", "onebitlamb", "zerooneadam")
         if self._onebit:
@@ -97,10 +102,9 @@ class TrainEngine:
                 raise NotImplementedError(
                     "nvme offload + fp16 dynamic loss scaling is not "
                     "supported (overflow-skip needs resident state); use bf16")
-            if jax.process_count() > 1:
-                raise NotImplementedError(
-                    "nvme offload is single-process for now (each process "
-                    "would need its own swap dir over addressable shards)")
+            # multi-process: the swapper partitions state by ADDRESSABLE
+            # region of the grad sharding, so each process's swap dir holds
+            # only its shards (the reference's per-dp-rank partition swap)
             if config.parallel.pipeline_parallel_size > 1:
                 raise NotImplementedError("nvme offload + pipeline "
                                           "parallelism is not supported")
@@ -136,10 +140,10 @@ class TrainEngine:
                 raise ValueError(
                     "offload_param subsumes optimizer-state offload — leave "
                     "offload_optimizer.device='none'")
-            if jax.process_count() > 1:
-                raise NotImplementedError(
-                    "offload_param is single-process for now (each process "
-                    "would stream its addressable shard)")
+            # multi-process: each process streams only its addressable
+            # shards (runtime/param_offload.py _put_leaves/_writeback_shards
+            # — the reference's per-dp-rank partition swap); the executor
+            # gates the combinations it cannot honour per-process
             if config.parallel.pipeline_parallel_size > 1:
                 raise NotImplementedError(
                     "offload_param + pipeline parallelism is not supported "
@@ -295,7 +299,9 @@ class TrainEngine:
                 aio_config={"block_size": self.config.aio.block_size,
                             "queue_depth": self.config.aio.queue_depth,
                             "thread_count": self.config.aio.thread_count})
-            self._nvme_swapper.init_from_params(self.params)
+            self._nvme_swapper.init_from_params(
+                self.params,
+                grad_shardings=as_named(self.plan.grad_specs, self.mesh))
             self.opt_state = None
         elif self._param_offload is not None:
             self.opt_state = None     # the executor owns all optimizer state
@@ -1273,8 +1279,11 @@ class TrainEngine:
                      async_save=async_save)
         if self._nvme_swapper is not None:
             # the swap files ARE the optimizer state — snapshot them into the
-            # checkpoint (reference use_node_local_storage semantics)
-            self._nvme_swapper.snapshot_to(os.path.join(path, "nvme_state"))
+            # checkpoint (reference use_node_local_storage semantics); one
+            # dir per process, since each swap dir holds only that process's
+            # addressable state regions
+            self._nvme_swapper.snapshot_to(
+                os.path.join(path, f"nvme_state_p{jax.process_index()}"))
         log_dist(f"saved checkpoint {path}")
         return path
 
@@ -1340,20 +1349,23 @@ class TrainEngine:
         if opt_state is not None:
             self.opt_state = opt_state
         if load_optimizer_states and self._nvme_swapper is not None:
+            snap = f"nvme_state_p{jax.process_index()}"
             src = os.path.join(load_dir, tag or client_state.get("tag", ""),
-                               "nvme_state")
+                               snap)
             if not os.path.isdir(src):
                 # resolve via 'latest' the same way _load did
                 latest = os.path.join(load_dir, "latest")
                 if os.path.exists(latest):
                     with open(latest) as f:
-                        src = os.path.join(load_dir, f.read().strip(),
-                                           "nvme_state")
+                        src = os.path.join(load_dir, f.read().strip(), snap)
             if not os.path.isdir(src):
                 raise RuntimeError(
-                    f"checkpoint has no nvme_state snapshot at {src} — "
+                    f"checkpoint has no {snap} snapshot at {src} — "
                     "cannot restore NVMe optimizer state (pass "
-                    "load_optimizer_states=False to restore params only)")
+                    "load_optimizer_states=False to restore params only; "
+                    "note the snapshot is per-process — resuming under a "
+                    "different process topology needs the universal "
+                    "checkpoint path)")
             self._nvme_swapper.restore_snapshot(
                 src, client_state.get("global_steps", 0))
         self.global_steps = client_state.get("global_steps", 0)

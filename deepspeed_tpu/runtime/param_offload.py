@@ -190,6 +190,15 @@ class ParamOffloadExecutor:
         # pinned-host storage whenever the backend has the memory kind; the
         # nvme tier needs numpy buffers for the aio files
         self._pinned = (self.device_tier == "cpu" and pinned_host_supported())
+        if (jax.process_count() > 1 and not self._pinned
+                and (self.gas > 1 or self.grad_clip > 0.0)):
+            raise NotImplementedError(
+                "multi-process offload_param on the numpy/nvme tier "
+                "supports the fused step only (gas=1, no grad clipping): "
+                "the host-side grad accumulators are process-local and "
+                "their norm would miss other processes' shards; the pinned "
+                "tier (TPU backends) accumulates in global arrays and has "
+                "no such restriction")
 
         # -- shapes / block split (no materialisation yet) -----------------
         shapes = jax.eval_shape(init_fn, rng)
@@ -209,6 +218,13 @@ class ParamOffloadExecutor:
                         for g in range(self.num_blocks)]
         self.n_params = sum(int(np.prod(l.shape))
                             for l in jax.tree.leaves(shapes))
+
+        # per-leaf tails/dtypes (post compute-dtype cast) — the abstract
+        # block signature compile_step_programs lowers against
+        self._leaf_tails = [tuple(l.shape[1:]) for l in layer_shapes]
+        self._leaf_dtypes = [
+            self.compute_dtype if jnp.issubdtype(l.dtype, jnp.floating)
+            else l.dtype for l in layer_shapes]
 
         # resident / block shardings
         res_shapes = {k: v for k, v in shapes.items() if k != "layers"}
@@ -555,6 +571,29 @@ class ParamOffloadExecutor:
         self._eval_block = jax.jit(e_block)
         self._eval_head = jax.jit(e_head)
 
+    # -- multi-process host<->device helpers -------------------------------
+    # Each process moves ONLY its addressable shards — the reference's
+    # per-dp-rank partition swap (partitioned_param_swapper.py:36,
+    # stage3.py _configure_offloading). Host buffers stay full-shaped per
+    # process; regions owned by other processes go stale and are never
+    # read (make_array_from_callback queries owned index regions only).
+    def _put_leaves(self, host_leaves: List[np.ndarray],
+                    shardings) -> List[jax.Array]:
+        if jax.process_count() == 1:
+            # single dispatch for the whole block (a per-leaf loop costs a
+            # host round-trip per leaf over remote tunnels)
+            return jax.device_put(host_leaves, shardings)
+        return [jax.make_array_from_callback(tuple(h.shape), s,
+                                             lambda idx, h=h: h[idx])
+                for h, s in zip(host_leaves, shardings)]
+
+    @staticmethod
+    def _writeback_shards(dsts: List[np.ndarray],
+                          arrs: List[jax.Array]) -> None:
+        for dst, arr in zip(dsts, arrs):
+            for s in arr.addressable_shards:
+                dst[s.index] = np.asarray(s.data)
+
     # -- block fetch/store -------------------------------------------------
     def _block_host_leaves(self, g: int) -> List[np.ndarray]:
         """NUMPY leaves of block g (np backends; pinned uses device_get)."""
@@ -566,12 +605,12 @@ class ParamOffloadExecutor:
         return [l[lo:hi] for l in self._host_layers]
 
     def _fetch_block(self, g: int) -> List[jax.Array]:
-        # single device_put call for the whole block (one dispatch — the
-        # per-leaf loop costs a host round-trip per leaf)
         if self._pinned:
+            # pinned blocks are GLOBAL jax arrays already — device_put is a
+            # pure memory-space reshard and is multi-process-safe as is
             return jax.device_put(self._pblocks[g], self._block_shardings)
-        return jax.device_put(self._block_host_leaves(g),
-                              self._block_shardings)
+        return self._put_leaves(self._block_host_leaves(g),
+                                self._block_shardings)
 
     def _prefetch(self, g: int) -> None:
         if self._store is not None and 0 <= g < self.num_blocks:
@@ -583,11 +622,22 @@ class ParamOffloadExecutor:
             # out_shardings) — just rebind
             self._pblocks[g] = dev_leaves
             return
+        lo, hi = self._bounds[g]
+        if jax.process_count() > 1:
+            if self._store is not None:
+                blen = hi - lo
+                host = [np.empty((blen,) + t, jnp.dtype(d))
+                        for t, d in zip(self._leaf_tails, self._leaf_dtypes)]
+                self._writeback_shards(host, dev_leaves)
+                self._store.write_block(g, host, wait=False)
+            else:
+                self._writeback_shards(
+                    [l[lo:hi] for l in self._host_layers], dev_leaves)
+            return
         host = [np.asarray(x) for x in jax.device_get(dev_leaves)]
         if self._store is not None:
             self._store.write_block(g, host, wait=False)
         else:
-            lo, hi = self._bounds[g]
             for dst, src in zip(self._host_layers, host):
                 dst[lo:hi] = src
 
@@ -599,6 +649,11 @@ class ParamOffloadExecutor:
                 (self._pmaster[g], self._pm[g], self._pv[g]),
                 (self._block_shardings,) * 3)
         lo, hi = self._bounds[g]
+        if jax.process_count() > 1:
+            return tuple(
+                self._put_leaves([x[lo:hi] for x in xs],
+                                 self._block_shardings)
+                for xs in (self._master, self._m, self._v))
         return jax.device_put(
             tuple([x[lo:hi] for x in xs]
                   for xs in (self._master, self._m, self._v)),
@@ -611,12 +666,127 @@ class ParamOffloadExecutor:
             self._pv[g] = new_v
             return
         lo, hi = self._bounds[g]
+        if jax.process_count() > 1:
+            for dsts, arrs in ((self._master, new_ma), (self._m, new_m),
+                               (self._v, new_v)):
+                self._writeback_shards([x[lo:hi] for x in dsts], arrs)
+            return
         for dst, src in zip(self._master, jax.device_get(new_ma)):
             dst[lo:hi] = src
         for dst, src in zip(self._m, jax.device_get(new_m)):
             dst[lo:hi] = src
         for dst, src in zip(self._v, jax.device_get(new_v)):
             dst[lo:hi] = src
+
+    # -- AOT warm-compile --------------------------------------------------
+    def compile_step_programs(self, micro_batch_shape: Tuple[int, int],
+                              *, budget_s: Optional[float] = None,
+                              ids_dtype=jnp.int32) -> Dict[str, float]:
+        """AOT-compile the shared per-block step programs into the
+        persistent XLA compile cache, one program at a time.
+
+        Why this exists: at the >10B tier the first train_batch compiles
+        every segment program back-to-back — minutes each, which can blow
+        any per-command wall-clock budget (the recorded llama-13b blocker,
+        docs/offload_design.md). With ``budget_s`` the method compiles
+        programs in a FIXED order and stops before starting a program once
+        the budget is spent; re-running resumes instantly (persistent-cache
+        hits take ~ms) and picks up where it left off, so arbitrarily large
+        models warm up under any command time limit. After warming, the
+        first real step's trace hits the cache for every program.
+
+        Returns {program_name: seconds} for programs compiled in THIS call
+        (cache hits come back in milliseconds and are included).
+
+        Shardings: block/resident/optimizer-state signatures carry their
+        exact runtime shardings; batch ids/labels carry the engine's batch
+        sharding. Boundary activations (x/dy) are jit OUTPUTS whose layout
+        the compiler picks — on a single-device mesh (the >HBM scale tier
+        this targets) every layout is trivially identical, so the warm is
+        exact; on multi-device meshes the block programs may still retrace
+        once at the first step."""
+        import time as _time
+
+        from ..parallel.mesh import batch_spec
+
+        B, S = micro_batch_shape
+        mesh = self.mesh
+        cdt = self.cfg.dtype
+        H = self.cfg.hidden_size
+        fused = (self.gas == 1 and self.grad_clip == 0.0)
+
+        def sds(shape, dtype, sharding=None):
+            return jax.ShapeDtypeStruct(tuple(shape), dtype,
+                                        sharding=sharding)
+
+        def block_sig(blen, dtype_override=None):
+            return [sds((blen,) + t,
+                        dtype_override or d, sh)
+                    for t, d, sh in zip(self._leaf_tails, self._leaf_dtypes,
+                                        self._block_shardings)]
+
+        def from_arrays(tree):
+            return jax.tree.map(
+                lambda a: sds(a.shape, a.dtype,
+                              getattr(a, "sharding", None)), tree)
+
+        resident = from_arrays(self.resident)
+        res_f32 = from_arrays(self._res_master)
+        batch_sh = _safe_sharding(mesh, batch_spec(), (B, S))
+        ids = sds((B, S), ids_dtype, batch_sh)
+        x = sds((B, S, H), cdt)
+        labels = sds((B, S), ids_dtype, batch_sh)
+
+        blens = sorted({hi - lo for lo, hi in self._bounds}, reverse=True)
+        jobs: List[Tuple[str, Any, Tuple]] = []
+        for blen in blens:
+            blk = block_sig(blen)
+            gblk = block_sig(blen)          # vjp cotangents share leaf dtype
+            f32b = block_sig(blen, jnp.float32)
+            tag = f"@L{blen}" if len(blens) > 1 else ""
+            # the non-fused (gas/clip) path feeds fp32 ACCUMULATED grads to
+            # the update; the fused path feeds raw compute-dtype cotangents
+            upd_grads = gblk if fused else f32b
+            jobs += [
+                (f"block_fwd{tag}", self._block_fwd, (blk, x, None)),
+                (f"block_vjp{tag}", self._block_vjp, (blk, x, None, x)),
+                (f"block_update{tag}", self._block_update,
+                 (blk, upd_grads, f32b, f32b, f32b, 2, 1e-4, 1.0)),
+                (f"sqnorm{tag}", self._sqnorm, (gblk,)),
+            ]
+            if self.gas > 1 or self.grad_clip > 0.0:
+                if self._pinned:
+                    jobs.append((f"acc_add{tag}", self._acc_add,
+                                 ([sds(s.shape, jnp.float32,
+                                       s.sharding.with_memory_kind(
+                                           "pinned_host"))
+                                   for s in f32b], gblk, 1.0 / self.gas)))
+        jobs += [
+            ("head_vjp", self._head_vjp, (resident, x, labels, None)),
+            ("embed_fwd", self._embed_fwd, (resident, ids)),
+            ("embed_vjp", self._embed_vjp, (resident, ids, x)),
+            ("sqnorm_res", self._sqnorm,
+             (jax.tree.leaves(res_f32),)),
+            ("res_update", self._res_update,
+             (resident, res_f32, res_f32, res_f32, res_f32, 2, 1e-4, 1.0)),
+        ]
+
+        done: Dict[str, float] = {}
+        t_start = _time.perf_counter()
+        with mesh_mod.ambient(mesh):
+            for name, fn, args in jobs:
+                if (budget_s is not None
+                        and _time.perf_counter() - t_start > budget_s):
+                    logger.info(
+                        f"compile_step_programs: budget {budget_s:.0f}s "
+                        f"spent after {len(done)}/{len(jobs)} programs — "
+                        "re-run to resume (persistent cache)")
+                    break
+                t0 = _time.perf_counter()
+                fn.lower(*args).compile()
+                done[name] = round(_time.perf_counter() - t0, 3)
+                logger.info(f"compiled {name}: {done[name]:.1f}s")
+        return done
 
     # -- the train step ----------------------------------------------------
     def _labels_of(self, mb):
@@ -777,6 +947,13 @@ class ParamOffloadExecutor:
     def params_for_checkpoint(self) -> Any:
         """Full params tree: resident device leaves + assembled host layer
         leaves (np, (L, ...))."""
+        if jax.process_count() > 1:
+            raise NotImplementedError(
+                "checkpointing multi-process offloaded params is not wired "
+                "up yet: each process holds only its addressable shard "
+                "regions, and the full-tree assembly here would persist "
+                "stale bytes for the rest — needs per-region shard files "
+                "(the sharded checkpoint format already supports them)")
         if self._pinned or self._store is not None:
             first = self._block_host_leaves(0)
             full = [np.empty((self.num_layers,) + tuple(l.shape[1:]), l.dtype)

@@ -19,6 +19,16 @@ step streams sub-groups through a 3-stage software pipeline:
 Only the bf16 params (device) and one step's grads leave the device; peak
 host residency is ~3 sub-groups of state, not the full optimizer state.
 
+Partitioning is by ADDRESSABLE REGION of the grad sharding, not by whole
+leaf: each process's swap dir holds only the state for the grad shards its
+devices own — the reference's per-dp-rank partitioned swap
+(``partitioned_param_swapper.py:36``; each rank swaps only its partition).
+Single-process/unsharded degenerates to one full-leaf region. After the
+host update, each leaf is reassembled as a global array from the local
+regions (``make_array_from_callback``) and resharded onto the param
+sharding on device — the reference's post-step partition allgather,
+expressed as an XLA transfer.
+
 The update math is explicit AdamW here rather than optax because the optax
 transform is a whole-tree function — the reference has the same restriction
 (NVMe offload requires its swap-aware optimizer, not arbitrary torch optim).
@@ -58,10 +68,25 @@ def _adamw_flat(master: np.ndarray, grad: np.ndarray, m: np.ndarray,
     master -= lr * update
 
 
+def _ser_index(idx: Tuple[slice, ...], shape: Tuple[int, ...]) -> Tuple:
+    """Normalise an addressable-shard index (tuple of slices) to a hashable,
+    JSON-able ((start, stop), ...) key."""
+    out = []
+    for sl, dim in zip(idx, shape):
+        out.append((int(sl.start or 0),
+                    int(sl.stop if sl.stop is not None else dim)))
+    return tuple(out)
+
+
+def _deser_index(key) -> Tuple[slice, ...]:
+    return tuple(slice(a, b) for a, b in key)
+
+
 class NVMeOptimizerSwapper:
     """Streams Adam/AdamW state through NVMe files, one flat file per
     (sub-group, state kind). ``sub_group_bytes`` bounds host residency
-    (reference ``sub_group_size``)."""
+    (reference ``sub_group_size``). Sub-group entries are (leaf,
+    addressable-region) pairs — multi-process runs swap disjoint state."""
 
     def __init__(self, swap_dir: str, lr: float = 1e-3,
                  betas: Tuple[float, float] = (0.9, 0.999), eps: float = 1e-8,
@@ -81,8 +106,12 @@ class NVMeOptimizerSwapper:
             queue_depth=aio.get("queue_depth", 8),
             num_threads=aio.get("thread_count", 2))
         self._read_pool, self._write_pool = mk(), mk()
-        # groups: list of [(leaf_path_str, shape, size)]; set by init_from_params
-        self.groups: List[List[Tuple[str, Tuple[int, ...], int]]] = []
+        # groups: list of [(leaf_path, region_key, region_shape, size)]
+        self.groups: List[List[Tuple[str, Tuple, Tuple[int, ...], int]]] = []
+        # leaf path -> sharding the regions were derived from (grad layout)
+        self._region_shardings: Dict[str, Any] = {}
+        # leaf path -> GLOBAL leaf shape (authoritative for state_arrays)
+        self._leaf_shapes: Dict[str, Tuple[int, ...]] = {}
         self.step_count = 0
 
     # -- layout -----------------------------------------------------------
@@ -90,34 +119,85 @@ class NVMeOptimizerSwapper:
         return os.path.join(self.swap_dir, f"group{gi:04d}.{kind}.bin")
 
     def _group_size(self, gi: int) -> int:
-        return sum(size for _, _, size in self.groups[gi])
+        return sum(size for _, _, _, size in self.groups[gi])
 
-    def init_from_params(self, params: Any) -> None:
-        """Partition param leaves into byte-bounded sub-groups; seed NVMe with
-        fp32 masters (from the current params) and zero moments."""
+    @staticmethod
+    def _local_regions(arr: jax.Array) -> List[Tuple[Tuple, np.ndarray]]:
+        """Deduplicated (region_key, data) pairs for the shards THIS process
+        holds (replicated leaves present the same region once)."""
+        seen: Dict[Tuple, np.ndarray] = {}
+        for s in arr.addressable_shards:
+            key = _ser_index(s.index, arr.shape)
+            if key not in seen:
+                seen[key] = None  # lazy — only materialise once below
+                seen[key] = np.asarray(s.data)
+        return list(seen.items())
+
+    def init_from_params(self, params: Any,
+                         grad_shardings: Optional[Any] = None) -> None:
+        """Partition the ADDRESSABLE state regions into byte-bounded
+        sub-groups; seed NVMe with fp32 masters (from the current params)
+        and zero moments. ``grad_shardings`` (a tree of NamedShardings
+        matching ``params``) defines the region layout — the partition each
+        process owns; params are resharded onto it once here so regions can
+        be read locally regardless of the param layout."""
         leaves = jax.tree_util.tree_flatten_with_path(params)[0]
-        group: List[Tuple[str, Tuple[int, ...], int]] = []
+        flat_params = {jax.tree_util.keystr(p): l for p, l in leaves}
+        flat_gsh = None
+        if grad_shardings is not None:
+            flat_gsh = {jax.tree_util.keystr(p): s for p, s in
+                        jax.tree_util.tree_flatten_with_path(
+                            grad_shardings)[0]}
+
+        # pass 1: LAYOUT only (shard indices — no data materialisation, so
+        # host residency stays bounded by one sub-group below)
+        group: List[Tuple[str, Tuple, Tuple[int, ...], int]] = []
         used = 0
         self.groups = []
+        shard_src: Dict[str, jax.Array] = {}
+        last_group_of: Dict[str, int] = {}
         for path, leaf in leaves:
-            size = int(np.prod(leaf.shape)) if leaf.ndim else 1
-            if group and used + size * 12 > self.sub_group_bytes:
-                self.groups.append(group)
-                group, used = [], 0
-            group.append((jax.tree_util.keystr(path), tuple(leaf.shape), size))
-            used += size * 12
+            key = jax.tree_util.keystr(path)
+            src = leaf
+            if flat_gsh is not None and flat_gsh[key] != getattr(
+                    leaf, "sharding", None):
+                src = jax.device_put(leaf, flat_gsh[key])
+            self._region_shardings[key] = getattr(src, "sharding", None)
+            self._leaf_shapes[key] = tuple(leaf.shape)
+            shard_src[key] = src
+            seen = set()
+            for s in src.addressable_shards:
+                rkey = _ser_index(s.index, src.shape)
+                if rkey in seen:
+                    continue
+                seen.add(rkey)
+                shape = tuple(b - a for a, b in rkey)
+                size = int(np.prod(shape)) if shape else 1
+                if group and used + size * 12 > self.sub_group_bytes:
+                    self.groups.append(group)
+                    group, used = [], 0
+                group.append((key, rkey, shape, size))
+                used += size * 12
+                last_group_of[key] = len(self.groups)
         if group:
             self.groups.append(group)
 
-        flat_params = {jax.tree_util.keystr(p): l for p, l in leaves}
+        # pass 2: seed masters one sub-group at a time (peak host RAM = one
+        # group's flat buffer), releasing reshard copies once consumed
         for gi, g in enumerate(self.groups):
             n = self._group_size(gi)
             master = np.empty((n,), np.float32)
             off = 0
-            for key, _shape, size in g:
+            for key, rkey, _shape, size in g:
+                src = shard_src[key]
+                shard = next(s for s in src.addressable_shards
+                             if _ser_index(s.index, src.shape) == rkey)
                 master[off:off + size] = np.asarray(
-                    jax.device_get(flat_params[key]), np.float32).ravel()
+                    shard.data, np.float32).ravel()
                 off += size
+            for key in {k for k, _, _, _ in g
+                        if last_group_of.get(k) == gi}:
+                del shard_src[key]         # drop any reshard copy early
             self._write_pool.async_pwrite(master, self._file(gi, "master"))
             zeros = np.zeros((n,), np.float32)
             self._write_pool.async_pwrite(zeros, self._file(gi, "exp_avg"))
@@ -128,12 +208,13 @@ class NVMeOptimizerSwapper:
         state_gb = sum(self._group_size(i) for i in range(len(self.groups))
                        ) * 12 / 1e9
         logger.info(f"NVMe swapper: {len(self.groups)} sub-groups, "
-                    f"{state_gb:.2f} GB optimizer state on {self.swap_dir}")
+                    f"{state_gb:.2f} GB optimizer state on {self.swap_dir} "
+                    f"(process {jax.process_index()}/{jax.process_count()})")
 
     def _write_manifest(self) -> None:
-        manifest = {"step": self.step_count,
-                    "groups": [[(k, list(s), n) for k, s, n in g]
-                               for g in self.groups]}
+        manifest = {"step": self.step_count, "format": 2,
+                    "groups": [[(k, [list(ab) for ab in r], list(s), n)
+                                for k, r, s, n in g] for g in self.groups]}
         path = os.path.join(self.swap_dir, "manifest.json")
         tmp = path + ".tmp"
         with open(tmp, "w") as f:
@@ -150,18 +231,29 @@ class NVMeOptimizerSwapper:
 
     def step_update(self, params: Any, grads: Any,
                     grad_scale: float = 1.0) -> Any:
-        """One optimizer step: returns new params (device, original dtype and
-        sharding). ``grad_scale`` multiplies grads before the update (the
-        engine passes its global-norm clip coefficient)."""
+        """One optimizer step: returns new params (device, original dtype
+        and sharding). ``grad_scale`` multiplies grads before the update
+        (the engine passes its global-norm clip coefficient)."""
         self.step_count += 1
         flat_params = {jax.tree_util.keystr(p): l for p, l in
                        jax.tree_util.tree_flatten_with_path(params)[0]}
         flat_grads = {jax.tree_util.keystr(p): l for p, l in
                       jax.tree_util.tree_flatten_with_path(grads)[0]}
 
+        # local grad regions, resharding once per leaf if the produced grad
+        # layout differs from the region layout the state was built on
+        grad_regions: Dict[Tuple[str, Tuple], np.ndarray] = {}
+        for key, leaf in flat_grads.items():
+            src = leaf
+            rsh = self._region_shardings.get(key)
+            if rsh is not None and getattr(leaf, "sharding", None) != rsh:
+                src = jax.device_put(leaf, rsh)
+            for rkey, data in self._local_regions(src):
+                grad_regions[(key, rkey)] = data
+
         pending_read = self._read_group(0)
         self._read_pool.wait()
-        new_leaves: Dict[str, jax.Array] = {}
+        new_regions: Dict[str, Dict[Tuple, np.ndarray]] = {}
         for gi, g in enumerate(self.groups):
             bufs = pending_read
             if gi + 1 < len(self.groups):
@@ -169,9 +261,9 @@ class NVMeOptimizerSwapper:
             # assemble this group's flat grad on host
             grad = np.empty((self._group_size(gi),), np.float32)
             off = 0
-            for key, _shape, size in g:
+            for key, rkey, _shape, size in g:
                 grad[off:off + size] = np.asarray(
-                    jax.device_get(flat_grads[key]), np.float32).ravel()
+                    grad_regions[(key, rkey)], np.float32).ravel()
                 off += size
             if grad_scale != 1.0:
                 grad *= grad_scale
@@ -179,13 +271,10 @@ class NVMeOptimizerSwapper:
                         bufs["exp_avg_sq"], self.step_count, self.lr,
                         self.betas[0], self.betas[1], self.eps,
                         self.weight_decay, self.adam_w_mode)
-            # scatter updated masters back to device leaves (bf16 cast at put)
             off = 0
-            for key, shape, size in g:
-                ref = flat_params[key]
-                host = bufs["master"][off:off + size].reshape(shape)
-                new_leaves[key] = jax.device_put(
-                    host.astype(ref.dtype), ref.sharding)
+            for key, rkey, shape, size in g:
+                new_regions.setdefault(key, {})[rkey] = (
+                    bufs["master"][off:off + size].reshape(shape))
                 off += size
             if gi + 1 < len(self.groups):
                 self._read_pool.wait()                    # fence next read
@@ -194,23 +283,47 @@ class NVMeOptimizerSwapper:
         self._write_pool.wait()
         self._write_manifest()
 
-        paths, treedef = jax.tree_util.tree_flatten_with_path(params)
+        # reassemble each leaf from the local master regions and reshard
+        # onto the param layout (device-side allgather when sharded — the
+        # reference's post-step partition allgather)
+        new_leaves: Dict[str, jax.Array] = {}
+        for key, ref in flat_params.items():
+            regions = new_regions.get(key, {})
+            rsh = self._region_shardings.get(key)
+            dt = ref.dtype
+
+            def cb(idx, _r=regions, _shape=ref.shape, _dt=dt):
+                return np.ascontiguousarray(
+                    _r[_ser_index(idx, _shape)].astype(_dt))
+
+            gathered = jax.make_array_from_callback(
+                tuple(ref.shape), rsh, cb)
+            new_leaves[key] = (gathered if gathered.sharding == ref.sharding
+                               else jax.device_put(gathered, ref.sharding))
+
+        paths, _ = jax.tree_util.tree_flatten_with_path(params)
         return jax.tree_util.tree_unflatten(
             jax.tree_util.tree_structure(params),
             [new_leaves[jax.tree_util.keystr(p)] for p, _ in paths])
 
     # -- checkpoint integration ------------------------------------------
     def state_arrays(self) -> Dict[str, Dict[str, np.ndarray]]:
-        """Materialise the full state (for checkpoint save): kind → {leaf
-        path → array}. Reads one group at a time."""
+        """Materialise the LOCAL state regions (for checkpoint save): kind →
+        {leaf path → full-shape array with owned regions filled}. Reads one
+        group at a time. Multi-process callers must save per-process (the
+        sharded checkpoint format) — unowned regions are zero here."""
         out: Dict[str, Dict[str, np.ndarray]] = {k: {} for k in _KINDS}
+        shapes = self._leaf_shapes     # authoritative GLOBAL leaf shapes
         for gi, g in enumerate(self.groups):
             bufs = self._read_group(gi)
             self._read_pool.wait()
             off = 0
-            for key, shape, size in g:
+            for key, rkey, shape, size in g:
                 for kind in _KINDS:
-                    out[kind][key] = bufs[kind][off:off + size].reshape(shape).copy()
+                    dst = out[kind].setdefault(
+                        key, np.zeros(shapes[key], np.float32))
+                    dst[_deser_index(rkey)] = (
+                        bufs[kind][off:off + size].reshape(shape))
                 off += size
         return out
 
@@ -222,10 +335,11 @@ class NVMeOptimizerSwapper:
             n = self._group_size(gi)
             bufs = {k: np.empty((n,), np.float32) for k in _KINDS}
             off = 0
-            for key, shape, size in g:
+            for key, rkey, shape, size in g:
                 for kind in _KINDS:
                     bufs[kind][off:off + size] = np.asarray(
-                        state[kind][key], np.float32).ravel()
+                        state[kind][key][_deser_index(rkey)],
+                        np.float32).ravel()
                 off += size
             for kind in _KINDS:
                 self._write_pool.async_pwrite(bufs[kind], self._file(gi, kind))
@@ -242,8 +356,9 @@ class NVMeOptimizerSwapper:
     def restore_snapshot(self, src_dir: str, step: int) -> None:
         """Restore swap files from a checkpoint snapshot. The snapshot's
         manifest must describe the SAME sub-group partitioning this swapper
-        built from the live params — a changed sub_group_size or param tree
-        would leave mis-sized group files that read back as garbage."""
+        built from the live params — a changed sub_group_size, param tree,
+        or process topology would leave mis-sized group files that read
+        back as garbage."""
         import shutil
 
         manifest_path = os.path.join(src_dir, "manifest.json")
@@ -251,16 +366,26 @@ class NVMeOptimizerSwapper:
             raise RuntimeError(f"no manifest.json in {src_dir}")
         with open(manifest_path) as f:
             manifest = json.load(f)
-        saved = [[(k, tuple(s), n) for k, s, n in g]
-                 for g in manifest["groups"]]
-        live = [[(k, tuple(s), n) for k, s, n in g] for g in self.groups]
+        if manifest.get("format", 1) < 2:
+            # format-1 (pre region-partitioning) entries are (key, shape,
+            # size) whole-leaf triples; in the single-process unsharded case
+            # the group .bin files are byte-identical, so migrate the
+            # entries to full-leaf regions instead of refusing
+            manifest["groups"] = [
+                [(k, [[0, int(d)] for d in s], s, n) for k, s, n in g]
+                for g in manifest["groups"]]
+        saved = [[(k, tuple(tuple(ab) for ab in r), tuple(s), n)
+                  for k, r, s, n in g] for g in manifest["groups"]]
+        live = [[(k, r, tuple(s), n) for k, r, s, n in g]
+                for g in self.groups]
         if saved != live:
             raise RuntimeError(
                 "NVMe snapshot layout mismatch: the checkpoint was saved "
                 f"with {len(saved)} sub-groups that do not match the "
                 f"{len(live)} groups built from the current params/config "
-                "(changed sub_group_size or model tree?) — refusing to "
-                "restore mis-partitioned optimizer state")
+                "(changed sub_group_size, model tree, or process "
+                "topology?) — refusing to restore mis-partitioned "
+                "optimizer state")
         shutil.copytree(src_dir, self.swap_dir, dirs_exist_ok=True)
         self.step_count = step
 
