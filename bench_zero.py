@@ -77,7 +77,10 @@ def main() -> None:
     ids = jax.random.randint(jax.random.PRNGKey(0), (1, batch, seq), 0,
                              model.config.vocab_size)
     batch_tree = {"input_ids": ids}
-    for _ in range(2):
+    # BENCH_WARMUP: compile/stream warmup steps before timing (at the >10B
+    # offload tier each step is minutes over the dev tunnel — 1 suffices
+    # once the compile cache is warm)
+    for _ in range(int(os.environ.get("BENCH_WARMUP", 2))):
         loss = engine.train_batch(batch=batch_tree)
     float(loss)
 

@@ -124,10 +124,6 @@ class TrainEngine:
                     f"offload_param supports the Adam family only, got "
                     f"'{config.optimizer.type}' (the streamed update is "
                     "swap-aware AdamW, the reference's restriction too)")
-            if config.fp16.enabled:
-                raise NotImplementedError(
-                    "offload_param + fp16 dynamic loss scaling is not "
-                    "supported (overflow-skip needs resident grads); use bf16")
             if self._onebit:
                 raise ValueError(
                     "offload_param is incompatible with 1-bit optimizers")
@@ -268,7 +264,9 @@ class TrainEngine:
                 model, self.mesh, self.plan, self.config,
                 lr_schedule=self.optimizer.lr_schedule,
                 init_fn=_init_cast, rng=rng,
-                compute_dtype=self.compute_dtype)
+                compute_dtype=self.compute_dtype,
+                loss_scaler=(self.loss_scaler if self.fp16_enabled()
+                             else None))
             self._n_params = self._param_offload.n_params
             self.params = None
         else:
@@ -939,10 +937,15 @@ class TrainEngine:
             if self._param_offload is not None:
                 # host-driven segmented step: params stream through HBM per
                 # layer block (runtime/param_offload.py)
-                loss, grad_norm = self._param_offload.train_step(batch)
+                loss, grad_norm, skipped = (
+                    self._param_offload.train_step(batch))
+                if self._param_offload.scaler_state is not None:
+                    # the executor owns the fp16 scale across its deferred
+                    # updates; mirror it for introspection/checkpointing
+                    self.scaler_state = self._param_offload.scaler_state
                 lr = float(self.optimizer.lr_schedule(self.global_steps))
                 stats = StepStats(grad_norm=jnp.float32(grad_norm),
-                                  skipped=jnp.asarray(False),
+                                  skipped=jnp.asarray(skipped),
                                   lr=jnp.float32(lr))
             elif self._nvme_swapper is not None:
                 # device: loss+grads; host: pipelined NVMe swap + Adam. The
@@ -1350,14 +1353,20 @@ class TrainEngine:
             self.opt_state = opt_state
         if load_optimizer_states and self._nvme_swapper is not None:
             snap = f"nvme_state_p{jax.process_index()}"
-            src = os.path.join(load_dir, tag or client_state.get("tag", ""),
-                               snap)
-            if not os.path.isdir(src):
+            base = os.path.join(load_dir, tag or client_state.get("tag", ""))
+            if not os.path.isdir(os.path.join(base, snap)):
                 # resolve via 'latest' the same way _load did
                 latest = os.path.join(load_dir, "latest")
                 if os.path.exists(latest):
                     with open(latest) as f:
-                        src = os.path.join(load_dir, f.read().strip(), snap)
+                        base = os.path.join(load_dir, f.read().strip())
+            src = os.path.join(base, snap)
+            if not os.path.isdir(src) and jax.process_count() == 1:
+                # pre-per-process checkpoints used a single 'nvme_state'
+                # dir; restore_snapshot migrates their format-1 manifest
+                legacy = os.path.join(base, "nvme_state")
+                if os.path.isdir(legacy):
+                    src = legacy
             if not os.path.isdir(src):
                 raise RuntimeError(
                     f"checkpoint has no {snap} snapshot at {src} — "
@@ -1374,6 +1383,9 @@ class TrainEngine:
         if "loss_scale" in client_state:
             self.scaler_state = self.scaler_state._replace(
                 scale=jnp.float32(client_state["loss_scale"]))
+            if (self._param_offload is not None
+                    and self._param_offload.scaler_state is not None):
+                self._param_offload.scaler_state = self.scaler_state
         if (load_lr_scheduler_states and self.lr_scheduler is not None
                 and client_state.get("lr_scheduler") is not None
                 and hasattr(self.lr_scheduler, "load_state_dict")):

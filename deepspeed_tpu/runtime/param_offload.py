@@ -162,12 +162,10 @@ class ParamOffloadExecutor:
     the engine delegates train/eval/checkpoint to it."""
 
     def __init__(self, model, mesh, plan, config, *, lr_schedule: Callable,
-                 init_fn: Callable, rng, compute_dtype):
+                 init_fn: Callable, rng, compute_dtype, loss_scaler=None):
         cfg = model.config
         if cfg is None:
             raise ValueError("offload_param requires a transformer Model")
-        if getattr(cfg, "moe_num_experts", 0):
-            raise NotImplementedError("offload_param + MoE is not supported")
         if getattr(cfg, "pld_enabled", False) or getattr(cfg, "ltd_enabled", False):
             raise NotImplementedError(
                 "offload_param + progressive_layer_drop/random_ltd is not "
@@ -187,11 +185,32 @@ class ParamOffloadExecutor:
         self.grad_clip = float(config.gradient_clipping or 0.0)
         self.gas = config.gradient_accumulation_steps
         self.step_count = 0
+        # fp16 dynamic loss scaling: the scaled backward seeds flow through
+        # every block vjp; overflow is detected on the ACCUMULATED grad
+        # norms before any update commits (the reference's CheckOverflow-
+        # before-step pattern), so an overflow step skips cleanly — this
+        # forces the deferred-update (non-fused) path
+        self.loss_scaler = loss_scaler
+        self.scaler_state = loss_scaler.init() if loss_scaler else None
+        # DSTPU_OFFLOAD_FENCE=1: block on each block's update before moving
+        # on. The async dispatch queue otherwise admits many in-flight
+        # block fetches/updates; at the >10B tier the transient HBM+pinned
+        # copies can outrun deallocation and crash the worker — fencing
+        # bounds residency to ~one block at some pipelining cost
+        self._fence = os.environ.get("DSTPU_OFFLOAD_FENCE", "0") == "1"
         # pinned-host storage whenever the backend has the memory kind; the
         # nvme tier needs numpy buffers for the aio files
         self._pinned = (self.device_tier == "cpu" and pinned_host_supported())
+        if jax.process_count() > 1:
+            # surfaced at INIT so a long run doesn't discover it at the
+            # first save (params_for_checkpoint raises with the details)
+            logger.warning(
+                "multi-process offload_param: checkpoint save/load is not "
+                "yet supported (per-region shard files pending) — "
+                "save_checkpoint will raise")
         if (jax.process_count() > 1 and not self._pinned
-                and (self.gas > 1 or self.grad_clip > 0.0)):
+                and (self.gas > 1 or self.grad_clip > 0.0
+                     or loss_scaler is not None)):
             raise NotImplementedError(
                 "multi-process offload_param on the numpy/nvme tier "
                 "supports the fused step only (gas=1, no grad clipping): "
@@ -451,28 +470,37 @@ class ParamOffloadExecutor:
                 return _dropout(x, c, salt=29)
 
             def block_fwd(block_leaves, x, mask):
+                """(x, moe_aux_sum) for one layer block — aux threads the
+                MoE load-balancing loss through the segmented step (the
+                resident loss adds coef*aux/L; non-MoE models carry a DCE'd
+                zero)."""
                 block = jax.tree_util.tree_unflatten(self._layers_treedef,
                                                      block_leaves)
                 S = x.shape[1]
                 positions = jnp.arange(S)
 
-                def body(h, layer):
-                    h2, _, _ = _layer_forward(c, h, layer, mask, positions,
+                def body(carry, layer):
+                    h, aux = carry
+                    h2, _, a = _layer_forward(c, h, layer, mask, positions,
                                               None)
-                    return h2, None
+                    return (h2, aux + a), None
 
                 fn = body
                 if c.remat:
                     fn = jax.checkpoint(body, prevent_cse=False,
                                         policy=resolve_remat_policy(c))
-                x, _ = jax.lax.scan(fn, x, block)
-                return x
+                (x, aux), _ = jax.lax.scan(fn, (x, jnp.float32(0.0)), block)
+                return x, aux
 
-            def head_loss(resident, x, labels, mask):
+            def head_loss(resident, x, labels, mask, scale):
+                """(scaled ce loss, unscaled loss). ``scale`` is the fp16
+                loss scale — seeds the whole backward sweep (the cotangents
+                this vjp emits feed every block_vjp)."""
                 from ..models.transformer import head_logits
 
-                return cross_entropy_loss(head_logits(resident, x, c),
+                loss = cross_entropy_loss(head_logits(resident, x, c),
                                           labels, mask)
+                return loss * scale, loss
 
             return embed_fwd, block_fwd, head_loss
 
@@ -480,12 +508,12 @@ class ParamOffloadExecutor:
         self._embed_fwd = jax.jit(embed_fwd)
         self._block_fwd = jax.jit(block_fwd)
         self._head_vjp = jax.jit(
-            jax.value_and_grad(head_loss, argnums=(0, 1)))
+            jax.value_and_grad(head_loss, argnums=(0, 1), has_aux=True))
 
-        def block_vjp(block_leaves, x_in, mask, dy):
+        def block_vjp(block_leaves, x_in, mask, dy, daux):
             _, pull = jax.vjp(lambda bl, xx: block_fwd(bl, xx, mask),
                               block_leaves, x_in)
-            dbl, dx = pull(dy)
+            dbl, dx = pull((dy, daux))
             return dx, dbl
 
         self._block_vjp = jax.jit(block_vjp)
@@ -713,7 +741,8 @@ class ParamOffloadExecutor:
         mesh = self.mesh
         cdt = self.cfg.dtype
         H = self.cfg.hidden_size
-        fused = (self.gas == 1 and self.grad_clip == 0.0)
+        fused = (self.gas == 1 and self.grad_clip == 0.0
+                 and self.loss_scaler is None)   # must mirror train_step
 
         def sds(shape, dtype, sharding=None):
             return jax.ShapeDtypeStruct(tuple(shape), dtype,
@@ -749,12 +778,12 @@ class ParamOffloadExecutor:
             upd_grads = gblk if fused else f32b
             jobs += [
                 (f"block_fwd{tag}", self._block_fwd, (blk, x, None)),
-                (f"block_vjp{tag}", self._block_vjp, (blk, x, None, x)),
+                (f"block_vjp{tag}", self._block_vjp, (blk, x, None, x, 0.0)),
                 (f"block_update{tag}", self._block_update,
                  (blk, upd_grads, f32b, f32b, f32b, 2, 1e-4, 1.0)),
                 (f"sqnorm{tag}", self._sqnorm, (gblk,)),
             ]
-            if self.gas > 1 or self.grad_clip > 0.0:
+            if not fused:
                 if self._pinned:
                     jobs.append((f"acc_add{tag}", self._acc_add,
                                  ([sds(s.shape, jnp.float32,
@@ -762,7 +791,7 @@ class ParamOffloadExecutor:
                                            "pinned_host"))
                                    for s in f32b], gblk, 1.0 / self.gas)))
         jobs += [
-            ("head_vjp", self._head_vjp, (resident, x, labels, None)),
+            ("head_vjp", self._head_vjp, (resident, x, labels, None, 1.0)),
             ("embed_fwd", self._embed_fwd, (resident, ids)),
             ("embed_vjp", self._embed_vjp, (resident, ids, x)),
             ("sqnorm_res", self._sqnorm,
@@ -806,14 +835,23 @@ class ParamOffloadExecutor:
         else:
             self._acc = [np.zeros(m.shape, np.float32) for m in self._master]
 
-    def train_step(self, batch_stack: Any) -> Tuple[jax.Array, float]:
+    def train_step(self, batch_stack: Any) -> Tuple[jax.Array, float, bool]:
         """One full step over (gas, mb, ...) microbatches. Returns
-        (mean_loss, grad_norm)."""
+        (mean_loss, grad_norm, skipped) — ``skipped`` is True for an fp16
+        overflow step (no state was touched; scale backed off)."""
         self.step_count += 1
         step = self.step_count
         lr = float(self.lr_schedule(step - 1))
         G, gas = self.num_blocks, self.gas
-        fused = (gas == 1 and self.grad_clip == 0.0)
+        fused = (gas == 1 and self.grad_clip == 0.0
+                 and self.loss_scaler is None)
+        scale = (float(jax.device_get(self.scaler_state.scale))
+                 if self.scaler_state is not None else 1.0)
+        # MoE aux loss: coef/L per accumulated aux unit; its gradient enters
+        # each block vjp as the aux output's cotangent
+        aux_coef = (float(self.cfg.moe_aux_loss_coef)
+                    / max(self.cfg.num_layers, 1)
+                    if getattr(self.cfg, "moe_num_experts", 0) else 0.0)
 
         if not fused:
             self._init_acc()
@@ -831,28 +869,34 @@ class ParamOffloadExecutor:
             # ---- forward: stream blocks, stash boundary activations ----
             x = self._embed_fwd(self.resident, ids)
             acts = [x]
+            aux_total = None
             self._prefetch(0)
             dev_block = self._fetch_block(0)
             for g in range(G):
                 self._prefetch(g + 1)
                 nxt = self._fetch_block(g + 1) if g + 1 < G else None
-                x = self._block_fwd(dev_block, x, mask)
+                x, aux_g = self._block_fwd(dev_block, x, mask)
                 acts.append(x)
+                aux_total = aux_g if aux_total is None else aux_total + aux_g
                 # keep only the LAST block resident (bwd starts there);
                 # earlier blocks are dropped and re-fetched in the sweep
                 dev_block = nxt if nxt is not None else dev_block
 
             # ---- head + backward sweep ----
-            loss, (dres, dx) = self._head_vjp(self.resident, acts[G],
-                                              labels, mask)
+            (_, loss), (dres, dx) = self._head_vjp(self.resident, acts[G],
+                                                   labels, mask, scale)
+            if aux_coef:
+                loss = loss + aux_coef * aux_total
             losses.append(loss)
+            daux = scale * aux_coef
             inv_gas = 1.0 / gas
             for g in range(G - 1, -1, -1):
                 self._prefetch(g - 1)
                 if dev_block is None:
                     dev_block = self._fetch_block(g)
                 nxt = self._fetch_block(g - 1) if g > 0 else None
-                dx, dblock = self._block_vjp(dev_block, acts[g], mask, dx)
+                dx, dblock = self._block_vjp(dev_block, acts[g], mask, dx,
+                                             daux)
                 if fused:
                     # separate vjp/norm/update dispatches measured FASTER
                     # than one fused program here: the fused program puts
@@ -864,6 +908,8 @@ class ParamOffloadExecutor:
                         dev_block, dblock, master, m, v, step, lr, 1.0)
                     self._store_block(g, new_p)
                     self._writeback_opt(g, new_ma, new_m, new_v)
+                    if self._fence:
+                        jax.block_until_ready(new_v)
                 elif self._pinned:
                     self._acc[g], acc_sq[g] = self._acc_add(
                         self._acc[g], dblock, inv_gas)
@@ -896,9 +942,26 @@ class ParamOffloadExecutor:
             else:
                 sq = sum(float(np.vdot(a, a)) for a in self._acc)
             sq += float(self._sqnorm(jax.tree.leaves(res_grads_total)))
-            grad_norm = float(np.sqrt(sq))
+            grad_norm = float(np.sqrt(sq)) / scale   # true (unscaled) norm
+            if self.loss_scaler is not None:
+                overflow = not np.isfinite(grad_norm)
+                self.scaler_state = self.loss_scaler.update(
+                    self.scaler_state, jnp.asarray(overflow))
+                if overflow:
+                    # skip BEFORE any state commits (reference
+                    # CheckOverflow-then-step); scale already backed off
+                    mean_loss = jnp.mean(jnp.stack(
+                        [l.astype(jnp.float32) for l in losses]))
+                    if self._pinned:
+                        self._acc = None
+                    else:
+                        for a in self._acc:
+                            a[...] = 0.0
+                    self.step_count -= 1   # Adam bias correction untouched
+                    return mean_loss, 0.0, True
+            gscale = 1.0 / scale
             if self.grad_clip > 0.0 and grad_norm > self.grad_clip:
-                gscale = self.grad_clip / (grad_norm + 1e-6)
+                gscale = self.grad_clip / (grad_norm + 1e-6) / scale
             for g in range(G):
                 self._prefetch(g + 1)
                 dev_block = self._fetch_block(g)
@@ -914,6 +977,8 @@ class ParamOffloadExecutor:
                     dev_block, acc_dev, master, m, v, step, lr, gscale)
                 self._store_block(g, new_p)
                 self._writeback_opt(g, new_ma, new_m, new_v)
+                if self._fence:
+                    jax.block_until_ready(new_v)
             # zero the accumulators for the next step
             if self._pinned:
                 self._acc = None
@@ -929,7 +994,7 @@ class ParamOffloadExecutor:
             self._store.flush()
         mean_loss = jnp.mean(jnp.stack([l.astype(jnp.float32)
                                         for l in losses]))
-        return mean_loss, grad_norm
+        return mean_loss, grad_norm, False
 
     # -- eval --------------------------------------------------------------
     def eval_forward(self, mb: Any) -> jax.Array:
@@ -937,11 +1002,17 @@ class ParamOffloadExecutor:
         mask = mb.get("attention_mask")
         labels = self._labels_of(mb)
         x = self._eval_embed(self.resident, ids)
+        aux_total = None
         self._prefetch(0)
         for g in range(self.num_blocks):
             self._prefetch(g + 1)
-            x = self._eval_block(self._fetch_block(g), x, mask)
-        return self._eval_head(self.resident, x, labels, mask)
+            x, aux_g = self._eval_block(self._fetch_block(g), x, mask)
+            aux_total = aux_g if aux_total is None else aux_total + aux_g
+        _, loss = self._eval_head(self.resident, x, labels, mask, 1.0)
+        if getattr(self.cfg, "moe_num_experts", 0):
+            loss = loss + (float(self.cfg.moe_aux_loss_coef)
+                           / max(self.cfg.num_layers, 1)) * aux_total
+        return loss
 
     # -- checkpoint integration -------------------------------------------
     def params_for_checkpoint(self) -> Any:

@@ -124,12 +124,12 @@ class NVMeOptimizerSwapper:
     @staticmethod
     def _local_regions(arr: jax.Array) -> List[Tuple[Tuple, np.ndarray]]:
         """Deduplicated (region_key, data) pairs for the shards THIS process
-        holds (replicated leaves present the same region once)."""
+        holds (replicated leaves present the same region once); each
+        region is materialised to numpy exactly once."""
         seen: Dict[Tuple, np.ndarray] = {}
         for s in arr.addressable_shards:
             key = _ser_index(s.index, arr.shape)
             if key not in seen:
-                seen[key] = None  # lazy — only materialise once below
                 seen[key] = np.asarray(s.data)
         return list(seen.items())
 

@@ -24,10 +24,14 @@ _APPLIED: Optional[str] = None
 
 
 def default_cache_dir() -> str:
-    return os.environ.get(
-        "DSTPU_COMPILE_CACHE",
-        os.path.join(os.path.expanduser("~"), ".cache", "deepspeed_tpu",
-                     "xla"))
+    env = os.environ.get("DSTPU_COMPILE_CACHE")
+    if env:
+        return env
+    # per-backend dirs: a process attached to a remote TPU also AOT-compiles
+    # XLA:CPU host executables against the REMOTE host's CPU features (AMX
+    # etc.) — sharing those entries with local CPU runs risks SIGILL
+    return os.path.join(os.path.expanduser("~"), ".cache", "deepspeed_tpu",
+                        f"xla-{jax.default_backend()}")
 
 
 def enable_compile_cache(cache_dir: str = "",
