@@ -463,5 +463,15 @@ def init_inference(model=None, config=None, tensor_parallel: Optional[int] = Non
         params = import_hf_state_dict(hf_state_dict, model.config,
                                       family or model.name)
     elif cfg.checkpoint is not None:
-        params = load_flat_weights_tree(cfg.checkpoint)
+        if cfg.checkpoint.startswith("megatron:"):
+            # Megatron-LM mp_rank_XX checkpoint dir: TP shards merged into
+            # the logical layout (the MegatronSDLoader analog,
+            # inference/megatron_import.py); target TP resharding then
+            # falls out of device_put like every other load
+            from .megatron_import import load_megatron_checkpoint
+
+            params = load_megatron_checkpoint(
+                cfg.checkpoint[len("megatron:"):], model.config)
+        else:
+            params = load_flat_weights_tree(cfg.checkpoint)
     return InferenceEngine(model, cfg, params=params, mesh=mesh)

@@ -54,6 +54,7 @@ def import_hf_state_dict(state_dict: Dict[str, Any], cfg, family: str
         "mistral": _import_llama,
         "bloom": _import_bloom,
         "gptj": _import_gptj,
+        "gptneo": _import_gptneo,
         "gptneox": _import_gptneox,
         "bert": _import_bert,
         "distilbert": _import_distilbert,
@@ -61,7 +62,7 @@ def import_hf_state_dict(state_dict: Dict[str, Any], cfg, family: str
     if mapper is None:
         raise ValueError(f"no HF import mapping for family '{family}' "
                          "(have: gpt2, opt, llama, mistral, bloom, gptj, "
-                         "gptneox, bert, distilbert)")
+                         "gptneo, gptneox, bert, distilbert)")
     return mapper(sd, cfg)
 
 
@@ -259,6 +260,51 @@ def _import_gptj(sd, cfg):
                        "bias": _a(sd["transformer.ln_f.bias"])},
         "lm_head": _t(sd["lm_head.weight"]),
         "lm_head_b": _a(sd["lm_head.bias"]),
+    }
+
+
+def _import_gptneo(sd, cfg):
+    """GPT-Neo (reference containers/gptneo.py HFGPTNEOLayerPolicy):
+    separate UNBIASED q/k/v Linears, biased out_proj, Linear (out,in) MLP
+    (unlike gpt2's Conv1D); alternating global/local attention and the
+    unscaled-score convention live in the gptneo preset config."""
+    layers = []
+    for i in range(cfg.num_layers):
+        p = f"transformer.h.{i}."
+        a = p + "attn.attention."
+        layers.append({
+            "ln1": {"scale": _a(sd[p + "ln_1.weight"]),
+                    "bias": _a(sd[p + "ln_1.bias"])},
+            "ln2": {"scale": _a(sd[p + "ln_2.weight"]),
+                    "bias": _a(sd[p + "ln_2.bias"])},
+            "attn": {
+                "wq": _t(sd[a + "q_proj.weight"]),
+                "wk": _t(sd[a + "k_proj.weight"]),
+                "wv": _t(sd[a + "v_proj.weight"]),
+                # HF GPT-Neo q/k/v Linears carry no bias; the model tree
+                # does (layernorm-family init) — zeros are identical
+                "bq": np.zeros((sd[a + "q_proj.weight"].shape[0],),
+                               np.float32),
+                "bk": np.zeros((sd[a + "k_proj.weight"].shape[0],),
+                               np.float32),
+                "bv": np.zeros((sd[a + "v_proj.weight"].shape[0],),
+                               np.float32),
+                "wo": _t(sd[a + "out_proj.weight"]),
+                "bo": _a(sd[a + "out_proj.bias"]),
+            },
+            "mlp": {
+                "w_up": _t(sd[p + "mlp.c_fc.weight"]),
+                "b_up": _a(sd[p + "mlp.c_fc.bias"]),
+                "w_down": _t(sd[p + "mlp.c_proj.weight"]),
+                "b_down": _a(sd[p + "mlp.c_proj.bias"]),
+            },
+        })
+    return {
+        "embed": {"tokens": _a(sd["transformer.wte.weight"])},
+        "pos": _a(sd["transformer.wpe.weight"]),
+        "layers": _stack(layers),
+        "final_norm": {"scale": _a(sd["transformer.ln_f.weight"]),
+                       "bias": _a(sd["transformer.ln_f.bias"])},
     }
 
 

@@ -24,6 +24,12 @@ _FAMILIES: Dict[str, Dict[str, Any]] = {
                  lm_head_bias=True),
     "gptneox": dict(norm="layernorm", position="rope", activation="gelu",
                     tie_embeddings=False, parallel_residual=True),
+    # GPT-Neo: alternating global/local (sliding-window 256) attention,
+    # UNSCALED attention scores (HF GPTNeoSelfAttention has no 1/sqrt(d))
+    "gptneo": dict(norm="layernorm", position="learned", activation="gelu",
+                   tie_embeddings=True, attention_scale=1.0,
+                   attention_layers=("global", "local"),
+                   attention_window=256),
     "bert": dict(norm="layernorm", norm_position="post", position="learned",
                  activation="gelu-exact", tie_embeddings=True, causal=False,
                  embed_norm=True, type_vocab_size=2, final_norm=False,
@@ -61,6 +67,10 @@ _SIZES: Dict[str, Dict[str, Any]] = {
     "gptj-6b": dict(family="gptj", hidden_size=4096, num_layers=28,
                     num_heads=16, vocab_size=50400, max_seq_len=2048,
                     rotary_dim=64),
+    "gptneo-1.3b": dict(family="gptneo", hidden_size=2048, num_layers=24,
+                        num_heads=16, vocab_size=50257, max_seq_len=2048),
+    "gptneo-2.7b": dict(family="gptneo", hidden_size=2560, num_layers=32,
+                        num_heads=20, vocab_size=50257, max_seq_len=2048),
     "gptneox-20b": dict(family="gptneox", hidden_size=6144, num_layers=44,
                         num_heads=64, vocab_size=50432, max_seq_len=2048,
                         rotary_dim=24),    # rotary_pct 0.25 of head_dim 96
@@ -87,6 +97,9 @@ _SIZES: Dict[str, Dict[str, Any]] = {
     "tiny-gptneox": dict(family="gptneox", hidden_size=64, num_layers=2,
                          num_heads=4, vocab_size=256, max_seq_len=128,
                          rotary_dim=4),
+    "tiny-gptneo": dict(family="gptneo", hidden_size=64, num_layers=2,
+                        num_heads=4, vocab_size=256, max_seq_len=128,
+                        attention_window=8),
     "tiny-bert": dict(family="bert", hidden_size=64, num_layers=2,
                       num_heads=4, vocab_size=256, max_seq_len=128),
     "tiny-distilbert": dict(family="distilbert", hidden_size=64,

@@ -49,6 +49,14 @@ class TransformerConfig:
     tie_embeddings: bool = True
     causal: bool = True                     # False: bidirectional encoder
     parallel_residual: bool = False         # x + attn(ln1(x)) + mlp(ln2(x))
+    # GPT-Neo family: per-layer attention pattern ('global'|'local', cycled
+    # over layers) with a sliding window for local layers; non-empty routes
+    # attention through the windowed jnp path (the flash kernel has no
+    # window operand). attention_scale: None => 1/sqrt(head_dim); GPT-Neo
+    # uses unscaled scores (1.0).
+    attention_layers: tuple = ()
+    attention_window: int = 256
+    attention_scale: Optional[float] = None
     #   (GPT-J/GPT-NeoX; GPT-J shares one LN — its import aliases ln2=ln1)
     rotary_dim: Optional[int] = None        # partial rotary: rope on the
     #   first rotary_dim dims of each head (GPT-J/NeoX), None => full head
@@ -625,31 +633,40 @@ def alibi_slopes(n_heads: int) -> jax.Array:
 def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                           mask: Optional[jax.Array], causal: bool = True,
                           alibi: Optional[jax.Array] = None,
-                          key_positions: Optional[jax.Array] = None
-                          ) -> jax.Array:
+                          key_positions: Optional[jax.Array] = None,
+                          window: Optional[jax.Array] = None,
+                          scale: Optional[float] = None) -> jax.Array:
     """Plain-XLA reference attention. q: (B,S,N,D); k,v: (B,T,K,D) with GQA
     broadcast. Softmax in fp32 (reference softmax kernels are fp32-accum).
     ``alibi``: per-head slopes (N,) — the key-position-linear bias (the
     query-position term is softmax-shift-invariant, so slope*k_pos
     suffices). ``key_positions`` (B, T): true per-row key positions for the
-    alibi bias (ragged decode — defaults to the column index)."""
+    alibi bias (ragged decode — defaults to the column index). ``window``:
+    sliding-window width as a (traced) scalar — queries attend only to
+    keys within ``window`` positions back; <=0 means unlimited (so a
+    per-layer mix of global/local layers scans with one program).
+    ``scale``: score multiplier, default 1/sqrt(D)."""
     B, S, N, D = q.shape
     T, K = k.shape[1], k.shape[2]
     if K != N:
         k = jnp.repeat(k, N // K, axis=2)
         v = jnp.repeat(v, N // K, axis=2)
-    scores = jnp.einsum("bsnd,btnd->bnst", q, k).astype(jnp.float32) / (D ** 0.5)
+    scale = (D ** -0.5) if scale is None else scale
+    scores = jnp.einsum("bsnd,btnd->bnst", q, k).astype(jnp.float32) * scale
     if alibi is not None:
         kpos = (jnp.arange(T, dtype=jnp.float32)[None]
                 if key_positions is None
                 else key_positions.astype(jnp.float32))
         scores = scores + alibi[None, :, None, None] * kpos[:, None, None, :]
     neg = jnp.finfo(jnp.float32).min
-    if causal:
+    if causal or window is not None:
         # query at absolute position (T - S + s) attends to keys <= that position
         q_pos = jnp.arange(S)[:, None] + (T - S)
         k_pos = jnp.arange(T)[None, :]
-        scores = jnp.where((k_pos <= q_pos)[None, None], scores, neg)
+        keep = (k_pos <= q_pos) if causal else jnp.bool_(True)
+        if window is not None:
+            keep = keep & ((window <= 0) | (q_pos - k_pos < window))
+        scores = jnp.where(keep[None, None], scores, neg)
     if mask is not None:
         # (B,T) key-padding mask or (B,S,T) full attention mask
         if mask.ndim == 2:
@@ -681,11 +698,15 @@ def _layer_forward(cfg: TransformerConfig, x: jax.Array, layer: Dict[str, Any],
                    positions: jax.Array,
                    cache: Optional[Dict[str, jax.Array]] = None,
                    static_prefill: bool = False,
-                   key_positions: Optional[jax.Array] = None
+                   key_positions: Optional[jax.Array] = None,
+                   window: Optional[jax.Array] = None
                    ) -> Tuple[jax.Array, Optional[Dict[str, jax.Array]]]:
     """One decoder block. ``layer`` holds this layer's (unstacked) params.
     ``cache`` (decode): dict with k/v of shape (B, T_max, K, D) and scalar
-    ``index`` — returns the updated cache."""
+    ``index`` — returns the updated cache. ``window``: this layer's
+    sliding-window width (traced scalar, <=0 = global) — present only for
+    attention_layers models (GPT-Neo), which take the windowed jnp
+    attention path throughout."""
     B, S, H = x.shape
     N, K, D = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
 
@@ -756,6 +777,13 @@ def _layer_forward(cfg: TransformerConfig, x: jax.Array, layer: Dict[str, Any],
             k = apply_rope(k, cos, sin)
 
     attn_fn = cfg.attention_impl or default_attention_impl()
+    if window is not None or cfg.attention_scale is not None:
+        # windowed / custom-scale attention routes through the jnp path
+        # (the flash kernel has neither operand); window is applied at the
+        # call sites below — the decode fallback needs TRUE positions, not
+        # the end-aligned convention inside dot_product_attention
+        attn_fn = _functools.partial(dot_product_attention,
+                                     scale=cfg.attention_scale)
     alibi = alibi_slopes(N) if cfg.position == "alibi" else None
     if alibi is not None and cfg.attention_impl is not None:
         _require_impl_kwarg(cfg.attention_impl, "alibi",
@@ -768,7 +796,9 @@ def _layer_forward(cfg: TransformerConfig, x: jax.Array, layer: Dict[str, Any],
         cv = lax.dynamic_update_slice(cache["v"], v, (0, idx, 0, 0))
         new_cache = {"k": ck, "v": cv, "index": idx + S}
         T = ck.shape[1]
-        if S == 1 and cfg.attention_impl is None and _kernels_active() and T % 128 == 0:
+        if (S == 1 and cfg.attention_impl is None and _kernels_active()
+                and T % 128 == 0 and window is None
+                and cfg.attention_scale is None):
             # single-token decode → Pallas decode kernel (GQA-native, reads
             # the arena without head expansion; alibi in-kernel)
             from ..ops.decode_attention import decode_attention
@@ -783,7 +813,8 @@ def _layer_forward(cfg: TransformerConfig, x: jax.Array, layer: Dict[str, Any],
             attn = decode_attention(q[:, 0], ck, cv, valid, alibi=alibi,
                                     key_positions=key_positions)[:, None]
         elif (static_prefill and S > 1 and cfg.attention_impl is None
-              and _kernels_active() and T % 128 == 0):
+              and _kernels_active() and T % 128 == 0 and window is None
+              and cfg.attention_scale is None):
             # prefill from position 0: queries sit at absolute rows 0..S-1, so
             # the flash kernel's 0-based causal col<=row over the arena is
             # exact and the (B, T_max) validity mask covers padding +
@@ -803,7 +834,13 @@ def _layer_forward(cfg: TransformerConfig, x: jax.Array, layer: Dict[str, Any],
             # causal over absolute positions: query s sits at idx+s, keys valid <= that
             q_pos = idx + jnp.arange(S)
             k_pos = jnp.arange(T)
-            causal_mask = (k_pos[None, :] <= q_pos[:, None]).astype(jnp.int32)  # (S,T)
+            causal_mask = (k_pos[None, :] <= q_pos[:, None])            # (S,T)
+            if window is not None:
+                # sliding window over TRUE positions (decode: q at idx+s)
+                causal_mask = causal_mask & (
+                    (window <= 0)
+                    | (q_pos[:, None] - k_pos[None, :] < window))
+            causal_mask = causal_mask.astype(jnp.int32)
             full = jnp.broadcast_to(causal_mask[None], (B, S, T))
             if mask is not None:  # (B, T_prompt) padding mask padded to T by caller
                 full = full * mask[:, None, :]
@@ -833,10 +870,12 @@ def _layer_forward(cfg: TransformerConfig, x: jax.Array, layer: Dict[str, Any],
                 "sequence_parallel_impl='ulysses' for BLOOM-family models")
         attn = ring_attention(q, k, v, mask=mask, causal=True)
     else:
+        wkw = {} if window is None else {"window": window}
         if alibi is None:
-            attn = attn_fn(q, k, v, mask, causal=cfg.causal)
+            attn = attn_fn(q, k, v, mask, causal=cfg.causal, **wkw)
         else:
-            attn = attn_fn(q, k, v, mask, causal=cfg.causal, alibi=alibi)
+            attn = attn_fn(q, k, v, mask, causal=cfg.causal, alibi=alibi,
+                           **wkw)
 
     if cache is None and not use_ring:
         from ..parallel.sequence import attn_out_spec, constrain
@@ -963,6 +1002,21 @@ def forward(params: Dict[str, Any], input_ids: jax.Array,
     use_pld = (cfg.pld_enabled and cache is None and pld_theta is not None)
     use_ltd = (cfg.ltd_enabled and cache is None and 0 < cfg.ltd_keep < S)
     L = cfg.num_layers
+    use_win = bool(cfg.attention_layers)
+    if use_win:
+        # per-layer sliding window (GPT-Neo): 'local' layers get the
+        # window, 'global' layers 0 (= unlimited); the pattern cycles over
+        # layers like HF's attention_types expansion
+        pat = cfg.attention_layers
+        win_table = jnp.array(
+            [cfg.attention_window if pat[i % len(pat)] == "local" else 0
+             for i in range(L)], jnp.int32)
+        from ..parallel.ring import ring_attention_enabled
+
+        if cache is None and ring_attention_enabled():
+            raise NotImplementedError(
+                "attention_layers (sliding-window) models + ring attention "
+                "are not supported — use sequence_parallel_impl='ulysses'")
     if use_ltd:
         # default mirrors the engine (engine.py random-LTD init): all but the
         # first and last layer; degenerate depths keep at least one layer
@@ -977,11 +1031,12 @@ def forward(params: Dict[str, Any], input_ids: jax.Array,
         ltd_flag = None
         if use_ltd:
             (layer, layer_cache), idx, ltd_flag = layer_and_cache
-        elif use_pld:
+        elif use_pld or use_win:
             (layer, layer_cache), idx = layer_and_cache
         else:
             layer, layer_cache = layer_and_cache
             idx = None
+        window = (win_table[idx.astype(jnp.int32)] if use_win else None)
         if use_ltd:
             # gather a random sorted token subset, run the layer on it,
             # scatter back — dropped tokens keep their input activations
@@ -1001,12 +1056,13 @@ def forward(params: Dict[str, Any], input_ids: jax.Array,
                 msk = (None if attention_mask is None
                        else jnp.take(attention_mask, kept, axis=1))
                 out, _, aux = _layer_forward(cfg, part, layer, msk,
-                                             jnp.take(positions, kept), None)
+                                             jnp.take(positions, kept), None,
+                                             window=window)
                 return scatter_tokens(hh, out, kept), aux
 
             def full_branch(hh):
                 out, _, aux = _layer_forward(cfg, hh, layer, attention_mask,
-                                             positions, None)
+                                             positions, None, window=window)
                 return out, aux
 
             h_new, aux = lax.cond(ltd_flag > 0, ltd_branch, full_branch, h)
@@ -1014,7 +1070,8 @@ def forward(params: Dict[str, Any], input_ids: jax.Array,
         else:
             h_new, new_cache, aux = _layer_forward(
                 cfg, h, layer, attention_mask, positions, layer_cache,
-                static_prefill=static_prefill, key_positions=key_positions)
+                static_prefill=static_prefill, key_positions=key_positions,
+                window=window)
         if use_pld:
             # stochastic depth (reference progressive_layer_drop.py): layer i
             # keeps with p = 1 - (1-theta)(i+1)/L, deeper layers drop more;
@@ -1046,7 +1103,7 @@ def forward(params: Dict[str, Any], input_ids: jax.Array,
         if use_ltd:
             xs = ((params["layers"], None), jnp.arange(L, dtype=jnp.float32),
                   ltd_flags)
-        elif use_pld:
+        elif use_pld or use_win:
             xs = ((params["layers"], None), jnp.arange(L, dtype=jnp.float32))
         else:
             xs = (params["layers"], None)
@@ -1054,8 +1111,10 @@ def forward(params: Dict[str, Any], input_ids: jax.Array,
                                      unroll=cfg.scan_unroll)
         new_cache = None
     else:
+        xs = ((params["layers"], cache) if not use_win else
+              ((params["layers"], cache), jnp.arange(L, dtype=jnp.float32)))
         (x, aux_total), new_cache = lax.scan(block_fn, (x, jnp.float32(0.0)),
-                                             (params["layers"], cache))
+                                             xs)
 
     logits = head_logits(params, x, cfg)
     return logits, new_cache, aux_total

@@ -161,6 +161,11 @@ def _stage_helpers(cfg):
 
     aux_coef = (cfg.moe_aux_loss_coef / max(cfg.num_layers, 1)
                 if cfg.moe_num_experts > 0 else 0.0)
+    if getattr(cfg, "attention_layers", ()):
+        raise NotImplementedError(
+            "pipeline parallelism + attention_layers (sliding-window, "
+            "GPT-Neo) is not supported: stage loops have no global layer "
+            "index, so local layers would silently run global")
 
     def embed_fn(et, token_ids, positions, dtype):
         x = et["embed"]["tokens"][token_ids].astype(dtype)

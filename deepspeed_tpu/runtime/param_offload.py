@@ -170,6 +170,11 @@ class ParamOffloadExecutor:
             raise NotImplementedError(
                 "offload_param + progressive_layer_drop/random_ltd is not "
                 "supported (the segmented step has no theta/LTD plumbing)")
+        if getattr(cfg, "attention_layers", ()):
+            raise NotImplementedError(
+                "offload_param + attention_layers (sliding-window, GPT-Neo) "
+                "is not supported: the shared block program has no global "
+                "layer index, so local layers would silently run global")
         self.cfg = cfg
         self.mesh = mesh
         self.config = config

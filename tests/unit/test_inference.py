@@ -345,6 +345,34 @@ def test_hf_import_gptj():
 
 
 @pytest.mark.slow
+def test_hf_import_gptneo():
+    """GPT-Neo: alternating global/LOCAL (sliding-window) attention, and
+    UNSCALED attention scores — seq 16 > window 8 so the local mask
+    actually binds in this test."""
+    transformers = pytest.importorskip("transformers")
+    __import__("torch").manual_seed(17)
+    cfg = transformers.GPTNeoConfig(
+        vocab_size=256, max_position_embeddings=128, hidden_size=64,
+        num_layers=2, num_heads=4, intermediate_size=256,
+        attention_types=[[["global", "local"], 1]], window_size=8,
+        attention_dropout=0.0, embed_dropout=0.0, resid_dropout=0.0)
+    hf = transformers.GPTNeoForCausalLM(cfg).eval()
+    ids = np.random.RandomState(6).randint(0, 256, (2, 16))
+    np.testing.assert_allclose(_ours_logits("tiny-gptneo", hf, ids),
+                               _hf_logits(hf, ids), atol=2e-3, rtol=2e-3)
+    # generation parity (decode path windows over true positions)
+    engine = init_inference("tiny-gptneo", dtype=jnp.float32,
+                            max_out_tokens=128, hf_model=hf)
+    import torch
+
+    with torch.no_grad():
+        want = hf.generate(torch.tensor(ids[:1, :12]), max_new_tokens=6,
+                           do_sample=False).numpy()[:, 12:]
+    got = np.asarray(engine.generate(ids[:1, :12], max_new_tokens=6))
+    np.testing.assert_array_equal(got[:, :6], want)
+
+
+@pytest.mark.slow
 def test_hf_import_gptneox():
     """GPT-NeoX: fused per-head qkv interleave + parallel residual with its
     own post-attention LN + 25% rotate-half rotary."""
