@@ -1,0 +1,110 @@
+#!/usr/bin/env python
+"""RLHF workload benchmark — the DS-Chat step-3 shape on the hybrid engine.
+
+Reference workload (blogs/deepspeed-chat/README.md:57 benchmark setting):
+each RLHF iteration GENERATES a rollout (prompt 256 → 256 new tokens with
+the inference engine's KV arena + decode kernel, LoRA adapters applied)
+and then TRAINS on the concatenated (prompt+response) sequence — the
+hybrid engine flips ONE weight set between the two layouts. The reference's
+headline claim is end-to-end RLHF throughput (its e2e figure mixes both
+phases); this bench reports each phase plus the flip overhead so
+regressions in either layout or in the reshard path are visible.
+
+Prints ONE JSON line: e2e tokens/s (generated+trained tokens per wall
+second, the DS-Chat e2e metric shape) plus per-phase rates and flip cost.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def main() -> None:
+    from deepspeed_tpu.config.config import load_config
+    from deepspeed_tpu.models import create_model
+    from deepspeed_tpu.runtime.hybrid_engine import HybridEngine
+
+    preset = os.environ.get("BENCH_RLHF_MODEL", "gpt2-125m")
+    batch = int(os.environ.get("BENCH_RLHF_BATCH", 8))
+    prompt_len = int(os.environ.get("BENCH_RLHF_PROMPT", 256))
+    gen_len = int(os.environ.get("BENCH_RLHF_GEN", 256))
+    iters = int(os.environ.get("BENCH_RLHF_ITERS", 4))
+    lora_rank = int(os.environ.get("BENCH_RLHF_LORA_RANK", 8))
+
+    seq = prompt_len + gen_len
+    model = create_model(preset, dtype=jnp.bfloat16, remat=True,
+                         remat_policy="dots", max_seq_len=seq)
+    cfg = load_config({
+        "train_micro_batch_size_per_gpu": batch,
+        "steps_per_print": 1000,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-5}},
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 0},
+    })
+    engine = HybridEngine(model=model, config=cfg, max_out_tokens=seq)
+
+    # LoRA adapters on the attention out-projections (the DS-Chat actor
+    # trains LoRA deltas; generation serves W + scaling*right@left)
+    mcfg = model.config
+    L, H = mcfg.num_layers, mcfg.hidden_size
+    k1, k2 = jax.random.split(jax.random.PRNGKey(0))
+    engine.set_lora({"attn/wo": (
+        (jax.random.normal(k1, (L, H, lora_rank), jnp.float32)
+         * 0.01).astype(jnp.bfloat16),
+        jnp.zeros((L, lora_rank, H), jnp.bfloat16))}, scaling=1.0)
+
+    rng = np.random.RandomState(0)
+    prompts = rng.randint(0, mcfg.vocab_size, (batch, prompt_len))
+
+    def one_iter(i):
+        t0 = time.perf_counter()
+        rollout = np.asarray(engine.generate(
+            jnp.asarray(prompts), max_new_tokens=gen_len))
+        jax.block_until_ready(rollout)
+        t1 = time.perf_counter()
+        full = np.concatenate([prompts, rollout[:, :gen_len]], axis=1)
+        loss = engine.train_batch(batch={
+            "input_ids": jnp.asarray(full[None])})
+        float(loss)
+        t2 = time.perf_counter()
+        return t1 - t0, t2 - t1
+
+    one_iter(0)                      # compile both phases + first flip
+    # measure the steady-state flip (train step happened => params stale)
+    engine.train_batch(batch={"input_ids": jnp.asarray(
+        np.concatenate([prompts, prompts[:, :gen_len]], axis=1)[None])})
+    tf = time.perf_counter()
+    engine.refresh_inference_params()
+    jax.block_until_ready(jax.tree.leaves(engine._infer.params)[0])
+    flip_s = time.perf_counter() - tf
+
+    gen_s = train_s = 0.0
+    for i in range(iters):
+        g, t = one_iter(i + 1)
+        gen_s += g
+        train_s += t
+
+    gen_tok = batch * gen_len * iters
+    train_tok = batch * seq * iters
+    e2e = (gen_tok + train_tok) / (gen_s + train_s)
+    print(json.dumps({
+        "metric": f"{preset}_rlhf_e2e_tokens_per_sec_per_chip",
+        "value": round(e2e, 1),
+        "unit": "tokens/s",
+        "generate_tokens_per_sec": round(gen_tok / gen_s, 1),
+        "train_tokens_per_sec": round(train_tok / train_s, 1),
+        "flip_seconds": round(flip_s, 4),
+        "prompt_len": prompt_len, "gen_len": gen_len, "batch": batch,
+        "lora_rank": lora_rank, "iters": iters,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -39,8 +39,11 @@ def main() -> None:
     # one 16 GB chip; bf16 params alone are 13.5 GB). Forces stage 3 and
     # takes over the optimizer-state placement (host fp32).
     param_offload = os.environ.get("BENCH_ZERO_PARAM_OFFLOAD", "none")
+    kw = {}
+    if os.environ.get("BENCH_ZERO_LAYERS"):     # depth override: scale probes
+        kw["num_layers"] = int(os.environ["BENCH_ZERO_LAYERS"])
     model = create_model(preset, dtype=jnp.bfloat16, remat=True,
-                         remat_policy="dots", max_seq_len=seq)
+                         remat_policy="dots", max_seq_len=seq, **kw)
     if param_offload != "none":
         stage, offload = 3, "none"
         zero_cfg = {"stage": 3,
@@ -81,8 +84,7 @@ def main() -> None:
     # offload tier each step is minutes over the dev tunnel — 1 suffices
     # once the compile cache is warm)
     for _ in range(int(os.environ.get("BENCH_WARMUP", 2))):
-        loss = engine.train_batch(batch=batch_tree)
-    float(loss)
+        float(engine.train_batch(batch=batch_tree))
 
     steps = int(os.environ.get("BENCH_STEPS", 5))
     t0 = time.perf_counter()

@@ -1,0 +1,85 @@
+"""Model-convergence sanity tier — the ``tests/model/run_sanity_check.py``
+analog (SURVEY §4: the reference keeps end-to-end convergence checks like
+Megatron_GPT2 run_sanity_check / BingBertSquad alongside its unit tiers).
+
+Trains a small byte-level LM on REAL text (the repo's own prose — no
+network, fully deterministic) for a few hundred steps under the flagship
+config shape (ZeRO-3 + remat; the flash kernels engage on TPU, the jnp
+path on the CPU mesh) and asserts the loss CURVE: large initial drop,
+smoothed-monotone decrease, and a final level far below the random-init
+entropy. This is the tier that catches "mathematically consistent but
+learns nothing" bugs that trajectory-equivalence tests cannot."""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.models.transformer import TransformerConfig, build_model
+
+pytestmark = pytest.mark.slow
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _corpus() -> np.ndarray:
+    """Byte tokens of the repo's prose documents (~80 KB of real English +
+    code text). Committed files only — deterministic across machines."""
+    buf = []
+    for name in ("README.md", "SURVEY.md", "docs/offload_design.md"):
+        with open(os.path.join(_REPO, name), "rb") as f:
+            buf.append(f.read())
+    data = b"\n".join(buf)
+    assert len(data) > 40_000, "corpus unexpectedly small"
+    return np.frombuffer(data, np.uint8).astype(np.int32)
+
+
+def _batches(data: np.ndarray, steps: int, batch: int, seq: int):
+    rng = np.random.default_rng(0)
+    for _ in range(steps):
+        starts = rng.integers(0, len(data) - seq - 1, size=batch)
+        yield np.stack([data[s:s + seq] for s in starts])[None]
+
+
+def test_byte_lm_convergence():
+    steps, batch, seq = 300, 8, 128
+    model = build_model(TransformerConfig(
+        vocab_size=256, hidden_size=128, num_layers=4, num_heads=4,
+        max_seq_len=seq, dtype=jnp.float32, remat=True,
+        tie_embeddings=True))
+    engine, *_ = ds.initialize(model=model, config={
+        "train_micro_batch_size_per_gpu": max(1, batch // 8),
+        "gradient_accumulation_steps": 1,
+        "steps_per_print": 10_000,
+        "optimizer": {"type": "adamw",
+                      "params": {"lr": 1e-3, "weight_decay": 0.01}},
+        "scheduler": {"type": "WarmupLR",
+                      "params": {"warmup_num_steps": 30}},
+        "zero_optimization": {"stage": 3}},
+        rng=jax.random.PRNGKey(0))
+
+    data = _corpus()
+    losses = [float(engine.train_batch(batch={"input_ids": jnp.asarray(b)}))
+              for b in _batches(data, steps, batch, seq)]
+    losses = np.asarray(losses)
+
+    first, last = losses[:20].mean(), losses[-20:].mean()
+    # random-init byte entropy is ~ln(256)=5.55; English bytes compress far
+    # below that even for a tiny model in 300 steps (measured on this
+    # config: 4.60 -> 2.77)
+    assert first > 4.0, f"suspicious init loss {first}"
+    assert last < 3.0, f"did not learn: final avg loss {last} (from {first})"
+    # smoothed curve decreases monotonically-ish: every 50-step mean is
+    # below the previous one
+    win = losses.reshape(-1, 50).mean(axis=1)
+    assert all(b < a for a, b in zip(win, win[1:])), f"non-monotone: {win}"
+
+
+if __name__ == "__main__":
+    test_byte_lm_convergence()
+    print("CONVERGENCE-OK")
