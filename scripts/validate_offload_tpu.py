@@ -44,7 +44,11 @@ l_off, k_off, hbm_off = run(True)
 print("offload:   ", [round(l,4) for l in l_off], k_off, f"{hbm_off/2**30:.2f} GiB")
 assert k_off == {"pinned_host"}, k_off
 for a, b in zip(l_no, l_off):
-    assert abs(a - b) < 1e-3, (a, b)
+    # bf16 model: the pinned-in/out update program fuses differently from
+    # the resident one, so step-3+ losses drift at bf16 rounding scale
+    # (measured 2.1e-3 absolute at loss ~10.75, i.e. 2e-4 relative; exact
+    # equivalence at fp32 is covered by tests/unit/test_offload.py)
+    assert abs(a - b) < 5e-3, (a, b)
 
 # compiled-step memory accounting: device args must shrink by ~master+moments
 def arg_bytes(offload):

@@ -108,6 +108,7 @@ def test_head_pruning():
     assert head_zero.sum() == 2  # half the heads pruned whole
 
 
+@__import__('pytest').mark.slow
 def test_activation_quantization_forward():
     """Activation QAT (reference QuantAct): cfg.act_quant_bits fake-quants
     layer-input activations with straight-through gradients."""
@@ -160,6 +161,7 @@ def test_activation_quantization_schedule_drives_config():
     assert all(np.isfinite(losses))
 
 
+@__import__('pytest').mark.slow
 def test_eval_sees_compression_boundary():
     """ADVICE r3: eval must evaluate the COMPRESSED module after a schedule
     boundary, like the reference (and like the train step, which

@@ -267,6 +267,7 @@ class TestElasticAgent:
         assert steps_by_restart[1][0] == 3, steps_by_restart
         assert steps_by_restart[1][-1] == 4
 
+    @__import__('pytest').mark.slow
     def test_membership_shrink_recomputes_micro(self, tmp_path):
         from deepspeed_tpu.launcher.elastic_agent import (ElasticAgent,
                                                           ElasticAgentConfig)
