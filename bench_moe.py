@@ -80,7 +80,8 @@ def main() -> None:
     # scanned on v5e) and the unrolled 8-expert program OOMs compile
     unroll = int(os.environ.get("BENCH_UNROLL", 1))
     dispatch = os.environ.get("BENCH_MOE_DISPATCH", "sparse")
-    model = create_model(preset, dtype=jnp.bfloat16, remat=True,
+    remat = os.environ.get("BENCH_REMAT", "1") == "1"
+    model = create_model(preset, dtype=jnp.bfloat16, remat=remat,
                          remat_policy="dots", scan_unroll=unroll,
                          max_seq_len=seq, moe_dispatch=dispatch)
     cfg = {
