@@ -693,6 +693,30 @@ def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 # ---------------------------------------------------------------------------
 
 
+def pld_gate(cfg: TransformerConfig, h: jax.Array, h_new: jax.Array,
+             aux: jax.Array, idx: jax.Array, pld_theta: jax.Array):
+    """Stochastic depth (reference progressive_layer_drop.py): layer i
+    keeps with p = 1 - (1-theta)(i+1)/L, deeper layers drop more; kept
+    outputs scaled 1/p for an unbiased expectation. The draw derives from
+    the activations (loss_fn has no rng argument) so it varies across
+    steps/batches but stays deterministic. ONE implementation shared by
+    the resident layer scan and the param-offload block programs — the
+    gate math diverging between engines would silently change the model.
+    Returns (mixed h, rescaled aux)."""
+    L = cfg.num_layers
+    # floor keeps the 1/keep_p rescale finite even when theta has decayed
+    # to ~0 for the deepest layer (0/0 NaN otherwise)
+    keep_p = jnp.maximum(1.0 - (1.0 - pld_theta) * (idx + 1.0) / L, 0.01)
+    key = jax.random.fold_in(_activation_derived_key(h, 17),
+                             idx.astype(jnp.int32))
+    gate = jax.random.bernoulli(key, keep_p).astype(jnp.float32)
+    h_mixed = h + ((gate / keep_p)
+                   * (h_new - h).astype(jnp.float32)).astype(h.dtype)
+    # same 1/keep_p rescale as the residual — otherwise deep layers'
+    # router balancing term is down-weighted in expectation
+    return h_mixed, aux * gate / keep_p
+
+
 def _layer_forward(cfg: TransformerConfig, x: jax.Array, layer: Dict[str, Any],
                    mask: Optional[jax.Array],
                    positions: jax.Array,
@@ -1073,23 +1097,7 @@ def forward(params: Dict[str, Any], input_ids: jax.Array,
                 static_prefill=static_prefill, key_positions=key_positions,
                 window=window)
         if use_pld:
-            # stochastic depth (reference progressive_layer_drop.py): layer i
-            # keeps with p = 1 - (1-theta)(i+1)/L, deeper layers drop more;
-            # kept outputs scaled 1/p for an unbiased expectation. The draw
-            # derives from the activations (loss_fn has no rng argument) so
-            # it varies across steps/batches but stays deterministic.
-            # floor keeps the 1/keep_p rescale finite even when theta has
-            # decayed to ~0 for the deepest layer (0/0 NaN otherwise)
-            keep_p = jnp.maximum(1.0 - (1.0 - pld_theta) * (idx + 1.0) / L,
-                                 0.01)
-            key = jax.random.fold_in(_activation_derived_key(h, 17),
-                                     idx.astype(jnp.int32))
-            gate = jax.random.bernoulli(key, keep_p).astype(jnp.float32)
-            h_new = h + ((gate / keep_p)
-                         * (h_new - h).astype(jnp.float32)).astype(h.dtype)
-            # same 1/keep_p rescale as the residual — otherwise deep layers'
-            # router balancing term is down-weighted in expectation
-            aux = aux * gate / keep_p
+            h_new, aux = pld_gate(cfg, h, h_new, aux, idx, pld_theta)
         return (h_new, aux_acc + aux), new_cache
 
     block_fn = block

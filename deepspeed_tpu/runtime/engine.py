@@ -145,11 +145,10 @@ class TrainEngine:
                     "offload_param + pipeline parallelism is not supported "
                     "(the segmented step IS a pipeline over layer blocks)")
             # these gates must read the CONFIG (the engine only sets the
-            # model-config flags later, after the executor is built)
-            if config.progressive_layer_drop.enabled:
-                raise NotImplementedError(
-                    "offload_param + progressive_layer_drop is not supported "
-                    "(the segmented step has no theta plumbing)")
+            # model-config flags later, after the executor is built).
+            # progressive_layer_drop composes: the executor's block
+            # programs take the block's global base layer index + theta
+            # and apply the SAME pld_gate as the resident scan
             de = config.data_efficiency
             if (de.enabled and isinstance(de.data_routing, dict)
                     and de.data_routing.get("random_ltd", {}).get("enabled")):

@@ -166,15 +166,11 @@ class ParamOffloadExecutor:
         cfg = model.config
         if cfg is None:
             raise ValueError("offload_param requires a transformer Model")
-        if getattr(cfg, "pld_enabled", False) or getattr(cfg, "ltd_enabled", False):
+        if getattr(cfg, "ltd_enabled", False):
             raise NotImplementedError(
-                "offload_param + progressive_layer_drop/random_ltd is not "
-                "supported (the segmented step has no theta/LTD plumbing)")
-        if getattr(cfg, "attention_layers", ()):
-            raise NotImplementedError(
-                "offload_param + attention_layers (sliding-window, GPT-Neo) "
-                "is not supported: the shared block program has no global "
-                "layer index, so local layers would silently run global")
+                "offload_param + random_ltd is not supported (the "
+                "kept-token gather/scatter changes activation shapes "
+                "inside the shared block program)")
         self.cfg = cfg
         self.mesh = mesh
         self.config = config
@@ -481,27 +477,49 @@ class ParamOffloadExecutor:
                               c.norm_eps)
                 return _dropout(x, c, salt=29)
 
-            def block_fwd(block_leaves, x, mask):
+            win_table = None
+            if c.attention_layers:
+                pat = c.attention_layers
+                win_table = jnp.array(
+                    [c.attention_window if pat[i % len(pat)] == "local"
+                     else 0 for i in range(c.num_layers)], jnp.int32)
+
+            def block_fwd(block_leaves, x, mask, lo, theta):
                 """(x, moe_aux_sum) for one layer block — aux threads the
                 MoE load-balancing loss through the segmented step (the
                 resident loss adds coef*aux/L; non-MoE models carry a DCE'd
-                zero)."""
+                zero). ``lo``: the block's GLOBAL base layer index (traced,
+                so one program serves every block) — per-layer features
+                (PLD stochastic depth, GPT-Neo sliding windows) index their
+                schedules with lo+i exactly like the resident scan.
+                ``theta``: PLD survival parameter (None when disabled)."""
+                from ..models.transformer import pld_gate
+
                 block = jax.tree_util.tree_unflatten(self._layers_treedef,
                                                      block_leaves)
                 S = x.shape[1]
                 positions = jnp.arange(S)
+                blen = jax.tree.leaves(block)[0].shape[0]
 
-                def body(carry, layer):
+                def body(carry, layer_i):
+                    layer, i = layer_i
                     h, aux = carry
+                    idx = (lo + i).astype(jnp.float32)
+                    window = (win_table[(lo + i).astype(jnp.int32)]
+                              if win_table is not None else None)
                     h2, _, a = _layer_forward(c, h, layer, mask, positions,
-                                              None)
+                                              None, window=window)
+                    if c.pld_enabled and theta is not None:
+                        h2, a = pld_gate(c, h, h2, a, idx, theta)
                     return (h2, aux + a), None
 
                 fn = body
                 if c.remat:
                     fn = jax.checkpoint(body, prevent_cse=False,
                                         policy=resolve_remat_policy(c))
-                (x, aux), _ = jax.lax.scan(fn, (x, jnp.float32(0.0)), block)
+                (x, aux), _ = jax.lax.scan(
+                    fn, (x, jnp.float32(0.0)),
+                    (block, jnp.arange(blen, dtype=jnp.int32)))
                 return x, aux
 
             def head_loss(resident, x, labels, mask, scale):
@@ -522,9 +540,10 @@ class ParamOffloadExecutor:
         self._head_vjp = jax.jit(
             jax.value_and_grad(head_loss, argnums=(0, 1), has_aux=True))
 
-        def block_vjp(block_leaves, x_in, mask, dy, daux):
-            _, pull = jax.vjp(lambda bl, xx: block_fwd(bl, xx, mask),
-                              block_leaves, x_in)
+        def block_vjp(block_leaves, x_in, mask, dy, daux, lo, theta):
+            _, pull = jax.vjp(
+                lambda bl, xx: block_fwd(bl, xx, mask, lo, theta),
+                block_leaves, x_in)
             dbl, dx = pull((dy, daux))
             return dx, dbl
 
@@ -794,9 +813,12 @@ class ParamOffloadExecutor:
             # the non-fused (gas/clip) path feeds fp32 ACCUMULATED grads to
             # the update; the fused path feeds raw compute-dtype cotangents
             upd_grads = gblk if fused else f32b
+            theta = 0.5 if getattr(self.cfg, "pld_enabled", False) else None
             jobs += [
-                (f"block_fwd{tag}", self._block_fwd, (blk, x, None)),
-                (f"block_vjp{tag}", self._block_vjp, (blk, x, None, x, 0.0)),
+                (f"block_fwd{tag}", self._block_fwd, (blk, x, None, 0,
+                                                      theta)),
+                (f"block_vjp{tag}", self._block_vjp, (blk, x, None, x, 0.0,
+                                                      0, theta)),
                 (f"block_update{tag}", self._block_update,
                  (blk, upd_grads, f32b, f32b, f32b, 2, 1e-4, 1.0)),
                 (f"sqnorm{tag}", self._sqnorm, (gblk,)),
@@ -922,6 +944,7 @@ class ParamOffloadExecutor:
             ids = mb["input_ids"]
             mask = mb.get("attention_mask")
             labels = self._labels_of(mb)
+            theta = mb.get("pld_theta")   # engine injects per step when PLD
 
             # ---- forward: stream blocks, stash boundary activations ----
             x = self._embed_fwd(self.resident, ids)
@@ -932,7 +955,8 @@ class ParamOffloadExecutor:
             for g in range(G):
                 self._prefetch(g + 1)
                 nxt = self._fetch_block(g + 1) if g + 1 < G else None
-                x, aux_g = self._block_fwd(dev_block, x, mask)
+                x, aux_g = self._block_fwd(dev_block, x, mask,
+                                           self._bounds[g][0], theta)
                 acts.append(x)
                 aux_total = aux_g if aux_total is None else aux_total + aux_g
                 # keep only the LAST block resident (bwd starts there);
@@ -953,7 +977,8 @@ class ParamOffloadExecutor:
                     dev_block = self._fetch_block(g)
                 nxt = self._fetch_block(g - 1) if g > 0 else None
                 dx, dblock = self._block_vjp(dev_block, acts[g], mask, dx,
-                                             daux)
+                                             daux, self._bounds[g][0],
+                                             theta)
                 if fused:
                     # separate vjp/norm/update dispatches measured FASTER
                     # than one fused program here: the fused program puts
@@ -1053,7 +1078,8 @@ class ParamOffloadExecutor:
         self._prefetch(0)
         for g in range(self.num_blocks):
             self._prefetch(g + 1)
-            x, aux_g = self._eval_block(self._fetch_block(g), x, mask)
+            x, aux_g = self._eval_block(self._fetch_block(g), x, mask,
+                                        self._bounds[g][0], None)
             aux_total = aux_g if aux_total is None else aux_total + aux_g
         _, loss = self._eval_head(self.resident, x, labels, mask, 1.0)
         if getattr(self.cfg, "moe_num_experts", 0):

@@ -252,11 +252,59 @@ class TestParamOffloadCPU:
             ds.initialize(model=_model(), config=_cfg(extra_zero={
                 "offload_param": {"device": "cpu"},
                 "offload_optimizer": {"device": "cpu"}}))
+    def test_pld_trajectory_matches_resident(self):
+        """offload_param x progressive_layer_drop: the block programs apply
+        the SAME activation-derived stochastic-depth gate at the global
+        layer index, so the trajectory matches the resident engine."""
+        def run(offload, steps=3):
+            mesh_mod.reset_mesh()
+            cfg = {**_cfg(extra_zero=(
+                {"offload_param": {"device": "cpu", "buffer_size": 1}}
+                if offload else {})),
+                "progressive_layer_drop": {"enabled": True, "theta": 0.5,
+                                           "gamma": 0.01}}
+            eng, *_ = ds.initialize(model=_model(), config=cfg,
+                                    rng=jax.random.PRNGKey(7))
+            return [float(eng.train_batch(batch=_batch(seed=i)))
+                    for i in range(steps)]
+
+        base = run(offload=False)
+        off = run(offload=True)
+        np.testing.assert_allclose(off, base, rtol=2e-4, atol=2e-5)
+
+    def test_gptneo_window_trajectory_matches_resident(self):
+        """offload_param x attention_layers (GPT-Neo sliding windows): the
+        traced global layer base keeps local layers LOCAL inside the
+        shared block program."""
+        def m():
+            return build_model(TransformerConfig(
+                vocab_size=128, hidden_size=32, num_layers=4, num_heads=2,
+                max_seq_len=32, dtype=jnp.float32,
+                attention_layers=("global", "local"), attention_window=8,
+                attention_scale=1.0))
+
+        def run(offload, steps=3):
+            mesh_mod.reset_mesh()
+            eng, *_ = ds.initialize(
+                model=m(), config=_cfg(extra_zero=(
+                    {"offload_param": {"device": "cpu", "buffer_size": 1}}
+                    if offload else {})), rng=jax.random.PRNGKey(7))
+            return [float(eng.train_batch(batch=_batch(seed=i)))
+                    for i in range(steps)]
+
+        base = run(offload=False)
+        off = run(offload=True)
+        np.testing.assert_allclose(off, base, rtol=2e-4, atol=2e-5)
+        # windows actually bind: an all-global config diverges
         mesh_mod.reset_mesh()
-        with pytest.raises(NotImplementedError, match="progressive_layer_drop"):
-            ds.initialize(model=_model(), config={
-                **_cfg(extra_zero={"offload_param": {"device": "cpu"}}),
-                "progressive_layer_drop": {"enabled": True}})
+        allg = build_model(TransformerConfig(
+            vocab_size=128, hidden_size=32, num_layers=4, num_heads=2,
+            max_seq_len=32, dtype=jnp.float32, attention_scale=1.0))
+        eng, *_ = ds.initialize(model=allg, config=_cfg(extra_zero={
+            "offload_param": {"device": "cpu", "buffer_size": 1}}),
+            rng=jax.random.PRNGKey(7))
+        g0 = float(eng.train_batch(batch=_batch(seed=0)))
+        assert abs(g0 - off[0]) > 1e-6
 
 
 class TestMultiProcessOffload:
