@@ -56,13 +56,14 @@ def import_hf_state_dict(state_dict: Dict[str, Any], cfg, family: str
         "gptj": _import_gptj,
         "gptneo": _import_gptneo,
         "gptneox": _import_gptneox,
+        "clip": _import_clip,
         "bert": _import_bert,
         "distilbert": _import_distilbert,
     }.get(fam)
     if mapper is None:
         raise ValueError(f"no HF import mapping for family '{family}' "
                          "(have: gpt2, opt, llama, mistral, bloom, gptj, "
-                         "gptneo, gptneox, bert, distilbert)")
+                         "gptneo, gptneox, clip, bert, distilbert)")
     return mapper(sd, cfg)
 
 
@@ -260,6 +261,46 @@ def _import_gptj(sd, cfg):
                        "bias": _a(sd["transformer.ln_f.bias"])},
         "lm_head": _t(sd["lm_head.weight"]),
         "lm_head_b": _a(sd["lm_head.bias"]),
+    }
+
+
+def _import_clip(sd, cfg):
+    """CLIP text encoder (reference containers/clip.py HFCLIPLayerPolicy —
+    the Stable Diffusion text tower): pre-LN causal transformer with
+    quick_gelu; torch Linear (out, in) → transpose."""
+    sd = _strip_prefix(sd, "text_model.")
+    layers = []
+    for i in range(cfg.num_layers):
+        p = f"encoder.layers.{i}."
+        a = p + "self_attn."
+        layers.append({
+            "ln1": {"scale": _a(sd[p + "layer_norm1.weight"]),
+                    "bias": _a(sd[p + "layer_norm1.bias"])},
+            "ln2": {"scale": _a(sd[p + "layer_norm2.weight"]),
+                    "bias": _a(sd[p + "layer_norm2.bias"])},
+            "attn": {
+                "wq": _t(sd[a + "q_proj.weight"]),
+                "wk": _t(sd[a + "k_proj.weight"]),
+                "wv": _t(sd[a + "v_proj.weight"]),
+                "bq": _a(sd[a + "q_proj.bias"]),
+                "bk": _a(sd[a + "k_proj.bias"]),
+                "bv": _a(sd[a + "v_proj.bias"]),
+                "wo": _t(sd[a + "out_proj.weight"]),
+                "bo": _a(sd[a + "out_proj.bias"]),
+            },
+            "mlp": {
+                "w_up": _t(sd[p + "mlp.fc1.weight"]),
+                "b_up": _a(sd[p + "mlp.fc1.bias"]),
+                "w_down": _t(sd[p + "mlp.fc2.weight"]),
+                "b_down": _a(sd[p + "mlp.fc2.bias"]),
+            },
+        })
+    return {
+        "embed": {"tokens": _a(sd["embeddings.token_embedding.weight"])},
+        "pos": _a(sd["embeddings.position_embedding.weight"]),
+        "layers": _stack(layers),
+        "final_norm": {"scale": _a(sd["final_layer_norm.weight"]),
+                       "bias": _a(sd["final_layer_norm.bias"])},
     }
 
 

@@ -30,6 +30,12 @@ _FAMILIES: Dict[str, Dict[str, Any]] = {
                    tie_embeddings=True, attention_scale=1.0,
                    attention_layers=("global", "local"),
                    attention_window=256),
+    # CLIP text encoder (reference containers/clip.py HFCLIPLayerPolicy —
+    # the Stable Diffusion text tower): pre-LN, CAUSAL attention,
+    # quick_gelu; tie_embeddings so logits = hidden @ E^T (the encoder
+    # surface — parity tests invert it)
+    "clip": dict(norm="layernorm", position="learned",
+                 activation="quick_gelu", tie_embeddings=True, causal=True),
     "bert": dict(norm="layernorm", norm_position="post", position="learned",
                  activation="gelu-exact", tie_embeddings=True, causal=False,
                  embed_norm=True, type_vocab_size=2, final_norm=False,
@@ -100,6 +106,11 @@ _SIZES: Dict[str, Dict[str, Any]] = {
     "tiny-gptneo": dict(family="gptneo", hidden_size=64, num_layers=2,
                         num_heads=4, vocab_size=256, max_seq_len=128,
                         attention_window=8),
+    "tiny-clip": dict(family="clip", hidden_size=64, num_layers=2,
+                      num_heads=4, vocab_size=256, max_seq_len=77),
+    "clip-vit-l-text": dict(family="clip", hidden_size=768, num_layers=12,
+                            num_heads=12, ffn_hidden_size=3072,
+                            vocab_size=49408, max_seq_len=77),
     "tiny-bert": dict(family="bert", hidden_size=64, num_layers=2,
                       num_heads=4, vocab_size=256, max_seq_len=128),
     "tiny-distilbert": dict(family="distilbert", hidden_size=64,

@@ -971,9 +971,14 @@ def _layer_forward(cfg: TransformerConfig, x: jax.Array, layer: Dict[str, Any],
         mlp_out = _qeinsum("bsf,fh->bsh", inner, layer["mlp"]["w_down"], cfg.dtype)
     else:
         inner = _qeinsum("bsh,hf->bsf", h, layer["mlp"]["w_up"], cfg.dtype) + layer["mlp"]["b_up"]
-        inner = (jax.nn.relu(inner) if cfg.activation == "relu"
-                 else jax.nn.gelu(inner,
-                                  approximate=cfg.activation != "gelu-exact"))
+        if cfg.activation == "relu":
+            inner = jax.nn.relu(inner)
+        elif cfg.activation == "quick_gelu":
+            # CLIP's x*sigmoid(1.702x) (HF QuickGELUActivation)
+            inner = inner * jax.nn.sigmoid(1.702 * inner)
+        else:
+            inner = jax.nn.gelu(inner,
+                                approximate=cfg.activation != "gelu-exact")
         mlp_out = _qeinsum("bsf,fh->bsh", inner, layer["mlp"]["w_down"], cfg.dtype) + layer["mlp"]["b_down"]
     if cache is None:
         mlp_out = _dropout(mlp_out, cfg, salt=37)

@@ -373,6 +373,25 @@ def test_hf_import_gptneo():
 
 
 @pytest.mark.slow
+def test_hf_import_clip_text():
+    """CLIP text encoder (the Stable Diffusion text tower the reference's
+    clip container injects): pre-LN CAUSAL encoder with quick_gelu.
+    Hidden-state parity via the tied-embedding inversion (bert pattern)."""
+    transformers = pytest.importorskip("transformers")
+    __import__("torch").manual_seed(18)
+    cfg = transformers.CLIPTextConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=256,
+        num_hidden_layers=2, num_attention_heads=4,
+        max_position_embeddings=77, attention_dropout=0.0,
+        hidden_act="quick_gelu")
+    hf = transformers.CLIPTextModel(cfg).eval()
+    ids = np.random.RandomState(7).randint(0, 256, (2, 16))
+    np.testing.assert_allclose(_ours_logits("tiny-clip", hf, ids),
+                               _encoder_expected(hf, ids),
+                               atol=2e-3, rtol=2e-3)
+
+
+@pytest.mark.slow
 def test_hf_import_gptneox():
     """GPT-NeoX: fused per-head qkv interleave + parallel residual with its
     own post-attention LN + 25% rotate-half rotary."""
