@@ -177,13 +177,25 @@ def apply_compression(params: Any, plan: CompressionPlan,
         w = leaf
         if leaf is not None and hasattr(leaf, "ndim") and leaf.ndim >= 2:
             if ("weight_quantization" in active
-                    and plan.matches("weight_quantization", key)):
+                    and plan.matches("weight_quantization", key)
+                    and not (key.startswith("layers/") and leaf.ndim == 2)):
+                # stacked (L, H) leaves under layers/ are BIASES — the
+                # reference quantizes module weights only
                 wq = plan.methods["weight_quantization"]
                 layer_bits = wq.get("layer_bits")
                 if (layer_bits is not None and key.startswith("layers/")
                         and leaf.shape[0] == len(layer_bits)):
                     # MoQ: per-layer bit widths from the eigenvalue schedule
                     w = _fake_quant_ste_layered(w, layer_bits)
+                elif key.startswith("layers/"):
+                    # stacked (L, ...) weights: PER-LAYER scales — the
+                    # reference quantizes each module separately, and
+                    # per-layer scales keep the transform identical whether
+                    # applied to the full stack or to a streamed layer
+                    # block (param-offload composition)
+                    bits = int(wq["params"].get(
+                        "target_bits", wq["params"].get("start_bits", 8)))
+                    w = jax.vmap(lambda x: _fake_quant_ste(x, bits))(w)
                 else:
                     bits = int(wq["params"].get(
                         "target_bits", wq["params"].get("start_bits", 8)))

@@ -155,11 +155,22 @@ class TrainEngine:
                 raise NotImplementedError(
                     "offload_param + random_ltd is not supported")
             ct = config.compression_training
-            if any((ct.weight_quantization, ct.activation_quantization,
-                    ct.sparse_pruning, ct.row_pruning, ct.head_pruning)):
+            # weight/activation quantization COMPOSE (the block programs
+            # apply the same transform with per-layer scales; boundaries
+            # rebuild via set_compression). Pruning and the MoQ eigenvalue
+            # schedule cannot:
+            if any((ct.sparse_pruning, ct.row_pruning, ct.head_pruning)):
                 raise NotImplementedError(
-                    "offload_param + compression_training is not supported "
-                    "(the segmented step does not apply the QAT transform)")
+                    "offload_param + pruning compression is not supported "
+                    "(magnitude thresholds couple across the full layer "
+                    "stack, which a streamed block cannot reproduce)")
+            wq_sp = ((ct.weight_quantization or {})
+                     .get("shared_parameters", {}))
+            if wq_sp.get("eigenvalue", {}).get("enabled"):
+                raise NotImplementedError(
+                    "offload_param + MoQ eigenvalue scheduling is not "
+                    "supported (the HVP power iteration needs resident "
+                    "params)")
         if (config.zero_optimization.offload_optimizer.device == "cpu"
                 and jax.default_backend() not in ("tpu", "gpu")):
             raise ValueError(
@@ -443,6 +454,9 @@ class TrainEngine:
                 # schedule_offset=0: active from the very first step — the
                 # boundary check below only fires on CHANGES
                 self._apply_act_quant(self._compression_active)
+            if self._param_offload is not None and self._compression_active:
+                self._param_offload.set_compression(
+                    self._compression_plan, self._compression_active)
         # MoQ: eigenvalue-driven per-layer quantization bits (reference
         # engine.py:1479 block_eigenvalue -> quantizer.different_precision)
         self._moq_eigenvalue = None
@@ -913,6 +927,12 @@ class TrainEngine:
                 self._compiled_step = None    # re-specialise at the boundary
                 self._eval_step = None        # eval sees the same boundary
                 self._apply_act_quant(act)
+                if self._param_offload is not None:
+                    # streamed analog of the re-specialisation: rebuild the
+                    # segment programs with the new active set (also picks
+                    # up the act_quant_bits config change at retrace)
+                    self._param_offload.set_compression(
+                        self._compression_plan, act)
             if (self._moq_eigenvalue is not None
                     and "weight_quantization" in act
                     and self.global_steps % self._moq_eval_step == 0):

@@ -174,6 +174,8 @@ class ParamOffloadExecutor:
         self.cfg = cfg
         self.mesh = mesh
         self.config = config
+        self._model = model
+        self._compression = None      # (plan, active) — set_compression
         self.lr_schedule = lr_schedule
         self.compute_dtype = compute_dtype
         zo = config.zero_optimization
@@ -450,6 +452,28 @@ class ParamOffloadExecutor:
             f"({bytes_per_layer * self.layers_per_block / 1e6:.0f} MB/block "
             f"in HBM; ~{state_gb:.2f} GB params+state off-device)")
 
+    def set_compression(self, plan, active) -> None:
+        """(Re)bind the QAT compression transform and rebuild the segment
+        programs — the engine calls this at every schedule boundary, the
+        streamed analog of its _compiled_step re-specialisation. Per-layer
+        quantization scales (compression/compress.py) make the block-wise
+        application identical to the resident full-stack one."""
+        self._compression = (plan, frozenset(active)) if active else None
+        self._build_step_fns(self._model)
+
+    def _compression_wrap(self, tree):
+        """Apply the active QAT transform inside a traced segment program.
+        ``tree`` is either the resident params or {'layers': block} — the
+        same dotted paths the resident engine's transform sees."""
+        if self._compression is None:
+            return tree
+        from ..compression import apply_compression
+
+        plan, active = self._compression
+        return apply_compression(tree, plan, active,
+                                 handled_elsewhere=frozenset(
+                                     {"activation_quantization"}))
+
     # -- compiled segments (shared across blocks) --------------------------
     def _build_step_fns(self, model) -> None:
         from ..models.transformer import (_dropout, _layer_forward, _norm,
@@ -460,6 +484,7 @@ class ParamOffloadExecutor:
 
         def make_fns(c):
             def embed_fwd(resident, ids):
+                resident = self._compression_wrap(resident)
                 B, S = ids.shape
                 x = resident["embed"]["tokens"][ids].astype(c.dtype)
                 positions = jnp.arange(S)
@@ -497,6 +522,7 @@ class ParamOffloadExecutor:
 
                 block = jax.tree_util.tree_unflatten(self._layers_treedef,
                                                      block_leaves)
+                block = self._compression_wrap({"layers": block})["layers"]
                 S = x.shape[1]
                 positions = jnp.arange(S)
                 blen = jax.tree.leaves(block)[0].shape[0]
@@ -528,6 +554,7 @@ class ParamOffloadExecutor:
                 this vjp emits feed every block_vjp)."""
                 from ..models.transformer import head_logits
 
+                resident = self._compression_wrap(resident)
                 loss = cross_entropy_loss(head_logits(resident, x, c),
                                           labels, mask)
                 return loss * scale, loss

@@ -42,7 +42,11 @@ def test_plan_and_schedule():
 
 def test_fake_quant_straight_through():
     plan = init_compression(CFG)
-    params = {"layers": {"attn": {"wq": jnp.linspace(-1, 1, 64).reshape(8, 8)}}}
+    # stacked (L, in, out) layer leaf — quantized with PER-LAYER scales
+    # (the reference quantizes each module separately; per-layer scales
+    # also make the transform block-streaming-invariant)
+    w0 = jnp.linspace(-1, 1, 64).reshape(8, 8)
+    params = {"layers": {"attn": {"wq": jnp.stack([w0, 3.0 * w0])}}}
 
     def loss(p):
         q = apply_compression(p, plan, frozenset({"weight_quantization"}))
@@ -51,12 +55,21 @@ def test_fake_quant_straight_through():
     q = apply_compression(params, plan, frozenset({"weight_quantization"}))
     w = np.asarray(params["layers"]["attn"]["wq"])
     wq = np.asarray(q["layers"]["attn"]["wq"])
-    # 4-bit: few distinct levels, bounded error
-    assert len(np.unique(wq)) <= 16
-    assert np.abs(wq - w).max() <= np.abs(w).max() / 7 + 1e-6
+    # 4-bit: few distinct levels PER LAYER, bounded error per layer
+    for li in range(2):
+        assert len(np.unique(wq[li])) <= 16
+        assert (np.abs(wq[li] - w[li]).max()
+                <= np.abs(w[li]).max() / 7 + 1e-6)
+    # per-layer scales: layer 1 (3x magnitude) uses 3x the step size
+    np.testing.assert_allclose(wq[1], 3.0 * wq[0], rtol=1e-6)
     # straight-through: grads flow as if identity-ish (non-zero everywhere)
     g = jax.grad(loss)(params)["layers"]["attn"]["wq"]
     assert float(jnp.abs(g).sum()) > 0
+    # stacked biases under layers/ are never quantized (reference scope)
+    bias_tree = {"layers": {"attn": {"bq": jnp.ones((4, 8))}}}
+    out = apply_compression(bias_tree, plan,
+                            frozenset({"weight_quantization"}))
+    assert out["layers"]["attn"]["bq"] is bias_tree["layers"]["attn"]["bq"]
 
 
 def test_sparse_pruning_mask():
