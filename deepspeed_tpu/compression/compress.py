@@ -180,7 +180,13 @@ def apply_compression(params: Any, plan: CompressionPlan,
                     and plan.matches("weight_quantization", key)
                     and not (key.startswith("layers/") and leaf.ndim == 2)):
                 # stacked (L, H) leaves under layers/ are BIASES — the
-                # reference quantizes module weights only
+                # reference quantizes module weights only. The ndim
+                # heuristic is safe because every engine path reaching
+                # here uses the stacked layer layout: pipeline's
+                # stage-stacked trees are excluded by the engine's
+                # compression×PP gate, and custom non-stacked trees with a
+                # genuine 2D weight under 'layers/' fall outside the
+                # transform's supported layout (documented scope)
                 wq = plan.methods["weight_quantization"]
                 layer_bits = wq.get("layer_bits")
                 if (layer_bits is not None and key.startswith("layers/")

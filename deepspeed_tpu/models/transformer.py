@@ -693,6 +693,18 @@ def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 # ---------------------------------------------------------------------------
 
 
+def window_table(cfg: TransformerConfig) -> jax.Array:
+    """(L,) int32 per-layer sliding-window widths from the cycled
+    ``attention_layers`` pattern (0 = global/unlimited). ONE builder shared
+    by the resident scan and the param-offload block programs — the
+    pattern expansion diverging between engines would silently change
+    which layers are local."""
+    pat = cfg.attention_layers
+    return jnp.array(
+        [cfg.attention_window if pat[i % len(pat)] == "local" else 0
+         for i in range(cfg.num_layers)], jnp.int32)
+
+
 def pld_gate(cfg: TransformerConfig, h: jax.Array, h_new: jax.Array,
              aux: jax.Array, idx: jax.Array, pld_theta: jax.Array):
     """Stochastic depth (reference progressive_layer_drop.py): layer i
@@ -1036,10 +1048,7 @@ def forward(params: Dict[str, Any], input_ids: jax.Array,
         # per-layer sliding window (GPT-Neo): 'local' layers get the
         # window, 'global' layers 0 (= unlimited); the pattern cycles over
         # layers like HF's attention_types expansion
-        pat = cfg.attention_layers
-        win_table = jnp.array(
-            [cfg.attention_window if pat[i % len(pat)] == "local" else 0
-             for i in range(L)], jnp.int32)
+        win_table = window_table(cfg)
         from ..parallel.ring import ring_attention_enabled
 
         if cache is None and ring_attention_enabled():

@@ -504,10 +504,9 @@ class ParamOffloadExecutor:
 
             win_table = None
             if c.attention_layers:
-                pat = c.attention_layers
-                win_table = jnp.array(
-                    [c.attention_window if pat[i % len(pat)] == "local"
-                     else 0 for i in range(c.num_layers)], jnp.int32)
+                from ..models.transformer import window_table
+
+                win_table = window_table(c)
 
             def block_fwd(block_leaves, x, mask, lo, theta):
                 """(x, moe_aux_sum) for one layer block — aux threads the
@@ -840,7 +839,11 @@ class ParamOffloadExecutor:
             # the non-fused (gas/clip) path feeds fp32 ACCUMULATED grads to
             # the update; the fused path feeds raw compute-dtype cotangents
             upd_grads = gblk if fused else f32b
-            theta = 0.5 if getattr(self.cfg, "pld_enabled", False) else None
+            # strong-typed scalar: the runtime theta is batch['pld_theta'][mi]
+            # (strong f32) — a Python float would lower weak-typed and the
+            # warmed executables would never be reused
+            theta = (jnp.float32(0.5)
+                     if getattr(self.cfg, "pld_enabled", False) else None)
             jobs += [
                 (f"block_fwd{tag}", self._block_fwd, (blk, x, None, 0,
                                                       theta)),

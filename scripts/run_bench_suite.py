@@ -46,13 +46,33 @@ def main() -> None:
     filt = sys.argv[2] if len(sys.argv) > 2 else ""
     outdir = os.path.join(REPO, "bench_results", tag)
     os.makedirs(outdir, exist_ok=True)
+
+    import jax
+
+    on_accel = jax.default_backend() != "cpu"
     for name, cmd, env in SUITE:
         if filt and filt not in name:
             continue
+        if not on_accel:
+            record = {"name": name, "skipped":
+                      "needs a real accelerator (backend is cpu)"}
+            with open(os.path.join(outdir, f"{name}.json"), "w") as f:
+                json.dump(record, f, indent=1)
+            print(f"[skip] {name}: cpu backend", flush=True)
+            continue
         t0 = time.time()
-        proc = subprocess.run(cmd, cwd=REPO, capture_output=True, text=True,
-                              env={**os.environ, **env},
-                              timeout=60 * 30)
+        try:
+            proc = subprocess.run(cmd, cwd=REPO, capture_output=True,
+                                  text=True, env={**os.environ, **env},
+                                  timeout=60 * 30)
+        except subprocess.TimeoutExpired:
+            record = {"name": name, "cmd": cmd, "env_overrides": env,
+                      "wall_seconds": round(time.time() - t0, 1),
+                      "returncode": "timeout(30m)"}
+            with open(os.path.join(outdir, f"{name}.json"), "w") as f:
+                json.dump(record, f, indent=1)
+            print(f"[TIMEOUT] {name}", flush=True)
+            continue
         dt = round(time.time() - t0, 1)
         record = {"name": name, "cmd": cmd, "env_overrides": env,
                   "wall_seconds": dt, "returncode": proc.returncode}
