@@ -824,10 +824,13 @@ class ParamOffloadExecutor:
 
         resident = from_arrays(self.resident)
         res_f32 = from_arrays(self._res_master)
-        batch_sh = _safe_sharding(mesh, batch_spec(), (B, S))
-        ids = sds((B, S), ids_dtype, batch_sh)
+        # no explicit shardings on batch/activation avals: the runtime
+        # passes computed values whose (single-device) shardings normalise
+        # to the default — attaching a NamedSharding here changes the jit
+        # cache key and the warmed executable is never reused
+        ids = sds((B, S), ids_dtype)
         x = sds((B, S, H), cdt)
-        labels = sds((B, S), ids_dtype, batch_sh)
+        labels = sds((B, S), ids_dtype)
 
         blens = sorted({hi - lo for lo, hi in self._bounds}, reverse=True)
         jobs: List[Tuple[str, Any, Tuple]] = []
