@@ -85,23 +85,30 @@ def _index_to_bounds(index: Tuple[slice, ...], shape) -> List[List[int]]:
     return out
 
 
-def _shard_plan(leaf) -> List[Tuple[Any, List[List[int]]]]:
-    """Deterministic (device, bounds) list with one entry per UNIQUE shard
-    (replicas collapse to the lowest-id device — its process writes)."""
-    if not hasattr(leaf, "sharding"):
-        shape = np.shape(leaf)
-        return [(None, [[0, d] for d in shape])]
-    imap = leaf.sharding.devices_indices_map(leaf.shape)
+def unique_shards(sharding, shape) -> List[Tuple[Any, Tuple[slice, ...]]]:
+    """Deterministic (device, index) list with one entry per UNIQUE shard
+    (replicas collapse to the lowest-id device — its process writes). THE
+    replica-collapse convention: the normal writer and the param-offload
+    region writer both derive ownership from this one walk."""
+    imap = sharding.devices_indices_map(tuple(shape))
     seen = set()
-    plan: List[Tuple[Any, List[List[int]]]] = []
+    plan: List[Tuple[Any, Tuple[slice, ...]]] = []
     for dev in sorted(imap, key=lambda d: d.id):
-        bounds = _index_to_bounds(imap[dev], leaf.shape)
-        key = tuple(map(tuple, bounds))
+        key = tuple(map(tuple, _index_to_bounds(imap[dev], shape)))
         if key in seen:
             continue
         seen.add(key)
-        plan.append((dev, bounds))
+        plan.append((dev, imap[dev]))
     return plan
+
+
+def _shard_plan(leaf) -> List[Tuple[Any, List[List[int]]]]:
+    """(device, bounds) per unique shard of a (possibly unsharded) leaf."""
+    if not hasattr(leaf, "sharding"):
+        shape = np.shape(leaf)
+        return [(None, [[0, d] for d in shape])]
+    return [(dev, _index_to_bounds(idx, leaf.shape))
+            for dev, idx in unique_shards(leaf.sharding, leaf.shape)]
 
 
 def _fname(full_key: str, shard_id: int) -> str:
@@ -121,10 +128,21 @@ def wait_pending() -> None:
 def save_checkpoint(save_dir: str, tag: str, params: Any, opt_state: Any = None,
                     client_state: Optional[Dict] = None, save_latest: bool = True,
                     tag_validation: str = "Warn",
-                    async_save: bool = False) -> str:
+                    async_save: bool = False,
+                    extra_arrays: Optional[Dict[str, Dict]] = None,
+                    extra_writes: Optional[List[Tuple[str, np.ndarray]]] = None
+                    ) -> str:
     """Write a checkpoint. D2H copies happen synchronously (the arrays may be
     donated by the next train step); file writes go to a background thread
-    when ``async_save`` — ``latest`` is only committed once they all land."""
+    when ``async_save`` — ``latest`` is only committed once they all land.
+
+    ``extra_arrays``/``extra_writes``: pre-sharded entries from callers that
+    own non-jax storage (the multi-process param-offload executor): every
+    process passes the SAME deterministic ``extra_arrays`` metadata
+    ({full_key: {shape, dtype, shards:[{file, bounds}...]}}) but only its
+    OWN region files in ``extra_writes`` ([(fname, np_data)]) — the commit
+    barrier below already makes the metadata wait for every process's
+    files."""
     wait_pending()
     _validate_tag(tag, tag_validation)
     ckpt_dir = os.path.join(save_dir, tag)
@@ -135,6 +153,10 @@ def save_checkpoint(save_dir: str, tag: str, params: Any, opt_state: Any = None,
     meta: Dict[str, Any] = {"format": 2, "tag": tag,
                             "client_state": client_state or {}, "arrays": {}}
     writes: List[Tuple[str, np.ndarray]] = []
+    if extra_arrays:
+        meta["arrays"].update(extra_arrays)
+    for fname, data in (extra_writes or []):
+        writes.append((os.path.join(arrays_dir, fname), data))
 
     trees = {"params": params}
     if opt_state is not None:
@@ -165,23 +187,41 @@ def save_checkpoint(save_dir: str, tag: str, params: Any, opt_state: Any = None,
             }
 
     n_proc = jax.process_count()
+    # stamp this save so STALE done-markers from an earlier save into the
+    # same tag dir can never satisfy the barrier; every process computes
+    # the same stamp from the shared client_state
+    cs = client_state or {}
+    stamp = f"{cs.get('global_steps', '')}:{cs.get('micro_steps', '')}"
+    try:
+        os.remove(os.path.join(ckpt_dir, f".done.{proc}"))
+    except FileNotFoundError:
+        pass
 
     def commit():
         for path, data in writes:
             np.save(path, data, allow_pickle=False)
         # cross-process commit barrier over the shared filesystem: every
         # process drops a done-marker; process 0 publishes `latest` only
-        # once ALL markers exist, so a crash mid-save can never leave
-        # `latest` pointing at a tag with missing shards
+        # once ALL markers (with THIS save's stamp) exist, so a crash
+        # mid-save can never leave `latest` pointing at a tag with
+        # missing shards
         with open(os.path.join(ckpt_dir, f".done.{proc}"), "w") as fh:
-            fh.write("ok")
+            fh.write(stamp)
+
+        def marker_ok(p):
+            path = os.path.join(ckpt_dir, f".done.{p}")
+            try:
+                with open(path) as fh:
+                    return fh.read() == stamp
+            except OSError:
+                return False
+
         if proc == 0:
             import time as _time
 
             deadline = _time.time() + 600
             while _time.time() < deadline:
-                if all(os.path.exists(os.path.join(ckpt_dir, f".done.{p}"))
-                       for p in range(n_proc)):
+                if all(marker_ok(p) for p in range(n_proc)):
                     break
                 _time.sleep(0.2)
             else:

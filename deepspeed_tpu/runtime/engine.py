@@ -1284,9 +1284,17 @@ class TrainEngine:
         })
         params = self.params
         opt_state = self.opt_state
+        extra_arrays = extra_writes = None
         if self._param_offload is not None:
-            params = self._param_offload.params_for_checkpoint()
-            opt_state = self._param_offload.opt_state_arrays()
+            if jax.process_count() > 1:
+                # layer params + state go as per-region shard files (each
+                # process writes only its addressable regions); resident
+                # trees ride the normal writer as global arrays
+                (params, opt_state, extra_arrays,
+                 extra_writes) = self._param_offload.region_checkpoint()
+            else:
+                params = self._param_offload.params_for_checkpoint()
+                opt_state = self._param_offload.opt_state_arrays()
             if async_save:
                 # the executor updates its host numpy storage IN PLACE every
                 # step — snapshot before handing to the background writer or
@@ -1295,10 +1303,13 @@ class TrainEngine:
                                      else x)
                 params = jax.tree.map(copy_np, params)
                 opt_state = jax.tree.map(copy_np, opt_state)
+                if extra_writes:
+                    extra_writes = [(f, np.array(d)) for f, d in extra_writes]
         path = _save(save_dir, tag, params=params, opt_state=opt_state,
                      client_state=client_state, save_latest=save_latest,
                      tag_validation=self.config.checkpoint.tag_validation,
-                     async_save=async_save)
+                     async_save=async_save, extra_arrays=extra_arrays,
+                     extra_writes=extra_writes)
         if self._nvme_swapper is not None:
             # the swap files ARE the optimizer state — snapshot them into the
             # checkpoint (reference use_node_local_storage semantics); one
@@ -1316,12 +1327,14 @@ class TrainEngine:
 
         if self._param_offload is not None:
             po = self._param_offload
-            ptree = po.params_for_checkpoint()
+            # shape-skeleton templates — the loader reads only shapes/dtypes
+            # from them, so nothing is materialised (multi-process safe)
+            ptree = po.checkpoint_template()
             psh = dict(po._res_shardings)
             psh["layers"] = jax.tree.map(lambda _: "host", ptree["layers"])
             opt_tpl = None
             if load_optimizer_states:
-                ost = po.opt_state_arrays()
+                ost = po.opt_state_template()
                 host_of = lambda t: jax.tree.map(lambda _: "host", t)
                 osh = {"step": "host",
                        "layer_master": host_of(ost["layer_master"]),
@@ -1349,6 +1362,11 @@ class TrainEngine:
             self.global_steps = client_state.get("global_steps", 0)
             self.micro_steps = client_state.get("micro_steps", 0)
             self.skipped_steps = client_state.get("skipped_steps", 0)
+            if "loss_scale" in client_state:
+                self.scaler_state = self.scaler_state._replace(
+                    scale=jnp.float32(client_state["loss_scale"]))
+                if po.scaler_state is not None:
+                    po.scaler_state = self.scaler_state
             if (load_lr_scheduler_states and self.lr_scheduler is not None
                     and client_state.get("lr_scheduler") is not None
                     and hasattr(self.lr_scheduler, "load_state_dict")):
@@ -1400,11 +1418,9 @@ class TrainEngine:
         self.micro_steps = client_state.get("micro_steps", 0)
         self.skipped_steps = client_state.get("skipped_steps", 0)
         if "loss_scale" in client_state:
+            # (offload runs restore their scaler in the branch above)
             self.scaler_state = self.scaler_state._replace(
                 scale=jnp.float32(client_state["loss_scale"]))
-            if (self._param_offload is not None
-                    and self._param_offload.scaler_state is not None):
-                self._param_offload.scaler_state = self.scaler_state
         if (load_lr_scheduler_states and self.lr_scheduler is not None
                 and client_state.get("lr_scheduler") is not None
                 and hasattr(self.lr_scheduler, "load_state_dict")):

@@ -375,13 +375,27 @@ cfg = {"train_micro_batch_size_per_gpu": 1,
            "device": "cpu", "buffer_size": 1}}}
 engine, *_ = ds.initialize(model=model, config=cfg,
                            rng=jax.random.PRNGKey(7))
-losses = []
-for i in range(3):
-    rng = np.random.default_rng(i)
-    ids = rng.integers(0, 128, (1, 8, 32))          # GLOBAL batch
-    local = ids[:, 4 * idx:4 * idx + 4]             # this process's share
-    losses.append(float(engine.train_batch(batch={"input_ids": local})))
-print("MP-OFFLOAD-LOSSES", losses, flush=True)
+import sys as _s
+mode = _s.argv[3] if len(_s.argv) > 3 else "train"
+if mode == "resume":
+    tag, _cs = engine.load_checkpoint(_s.argv[2])
+    assert tag is not None
+    losses = []
+    for i in range(3, 5):
+        ids = np.random.default_rng(i).integers(0, 128, (1, 8, 32))
+        local = ids[:, 4 * idx:4 * idx + 4]
+        losses.append(float(engine.train_batch(batch={"input_ids": local})))
+    print("MP-RESUME-LOSSES", losses, flush=True)
+else:
+    losses = []
+    for i in range(3):
+        rng = np.random.default_rng(i)
+        ids = rng.integers(0, 128, (1, 8, 32))      # GLOBAL batch
+        local = ids[:, 4 * idx:4 * idx + 4]         # this process's share
+        losses.append(float(engine.train_batch(batch={"input_ids": local})))
+    if len(_s.argv) > 2:
+        engine.save_checkpoint(_s.argv[2])          # per-region shard files
+    print("MP-OFFLOAD-LOSSES", losses, flush=True)
 """
 
     def test_two_process_matches_single(self, tmp_path):
@@ -397,7 +411,9 @@ print("MP-OFFLOAD-LOSSES", losses, flush=True)
                     "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
                     "PALLAS_AXON_POOL_IPS": "",
                     "PYTHONPATH": os.getcwd()})
-        procs = [subprocess.Popen([sys.executable, str(script), str(i)],
+        ckpt = str(tmp_path / "mp_ckpt")
+        procs = [subprocess.Popen([sys.executable, str(script), str(i),
+                                   ckpt],
                                   env=env, stdout=subprocess.PIPE,
                                   stderr=subprocess.STDOUT, text=True)
                  for i in range(2)]
@@ -417,10 +433,41 @@ print("MP-OFFLOAD-LOSSES", losses, flush=True)
             "offload_param": {"device": "cpu", "buffer_size": 1}}),
             rng=jax.random.PRNGKey(7))
         oracle = []
-        for i in range(3):
+        for i in range(5):
             ids = np.random.default_rng(i).integers(0, 128, (1, 8, 32))
             oracle.append(float(engine.train_batch(batch={"input_ids": ids})))
-        np.testing.assert_allclose(mp_losses[0], oracle, rtol=2e-4,
+        np.testing.assert_allclose(mp_losses[0], oracle[:3], rtol=2e-4,
+                                   atol=2e-5)
+
+        # SAME-topology resume: a second 2-process wave loads the region
+        # checkpoint and continues — trajectory matches the oracle
+        procs = [subprocess.Popen([sys.executable, str(script), str(i),
+                                   ckpt, "resume"],
+                                  env=env, stdout=subprocess.PIPE,
+                                  stderr=subprocess.STDOUT, text=True)
+                 for i in range(2)]
+        outs = [p.communicate(timeout=600)[0] for p in procs]
+        assert all(p.returncode == 0 for p in procs), outs[0] + outs[1]
+        m = re.search(r"MP-RESUME-LOSSES \[([^\]]*)\]", outs[0])
+        assert m, outs[0]
+        mp_resumed = [float(x) for x in m.group(1).split(",")]
+        np.testing.assert_allclose(mp_resumed, oracle[3:], rtol=2e-4,
+                                   atol=2e-5)
+
+        # cross-topology resume: the 2-process checkpoint (per-region
+        # shard files) loads into THIS single-process engine and the
+        # continued trajectory matches the uninterrupted oracle
+        mesh_mod.reset_mesh()
+        eng2, *_ = ds.initialize(model=_model(), config=_cfg(extra_zero={
+            "offload_param": {"device": "cpu", "buffer_size": 1}}),
+            rng=jax.random.PRNGKey(11))   # different init — load overwrites
+        tag, _ = eng2.load_checkpoint(ckpt)
+        assert tag is not None
+        resumed = []
+        for i in range(3, 5):
+            ids = np.random.default_rng(i).integers(0, 128, (1, 8, 32))
+            resumed.append(float(eng2.train_batch(batch={"input_ids": ids})))
+        np.testing.assert_allclose(resumed, oracle[3:], rtol=2e-4,
                                    atol=2e-5)
 
 
