@@ -317,12 +317,16 @@ class TestSparseDispatch:
             jax.tree.map(lambda a, b: np.testing.assert_allclose(
                 np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-5), gs, ge)
 
-    def test_engine_trajectory_sparse_vs_einsum(self):
+    @pytest.mark.parametrize("ep", [1, 2])
+    def test_engine_trajectory_sparse_vs_einsum(self, ep):
         """Full engine: an MoE model trains identically under either
-        dispatch (same losses), sparse being the default."""
+        dispatch (same losses), sparse being the default — including under
+        REAL expert parallelism, where the gather/scatter dispatch must
+        produce the same cross-device exchange as the einsum's
+        constraint-lowered all-to-all."""
         losses = {}
         for impl in ("sparse", "einsum"):
-            eng = _engine(preset="moe-tiny", ep=1, moe_dispatch=impl)
+            eng = _engine(preset="moe-tiny", ep=ep, moe_dispatch=impl)
             losses[impl] = [float(eng.train_batch(batch=_token_batch(eng)))
                             for _ in range(3)]
         np.testing.assert_allclose(losses["sparse"], losses["einsum"],
