@@ -814,6 +814,12 @@ def _layer_forward(cfg: TransformerConfig, x: jax.Array, layer: Dict[str, Any],
 
     attn_fn = cfg.attention_impl or default_attention_impl()
     if window is not None or cfg.attention_scale is not None:
+        if cfg.attention_impl is not None:
+            raise NotImplementedError(
+                "custom attention_impl + sliding-window/custom-scale "
+                "attention (GPT-Neo family) is not supported — silently "
+                "replacing the custom impl with the windowed jnp path "
+                "would change the model")
         # windowed / custom-scale attention routes through the jnp path
         # (the flash kernel has neither operand); window is applied at the
         # call sites below — the decode fallback needs TRUE positions, not
