@@ -8,22 +8,146 @@ Benchmark: GPT-2 125M causal-LM training throughput on one chip, bf16,
 tokens/sec (BASELINE.json tracked config #1). ``vs_baseline`` reports
 MFU / 0.5 — the fraction of the driver's north-star (≥50% MFU) achieved,
 so 1.0 == target reached.
+
+Outage handling: the TPU arrives over a tunnel that can be transiently
+unavailable (round 4's official record was a bare ``UNAVAILABLE``
+traceback). The parent runs the measurement in a watchdogged child
+immediately (no extra backend init when the tunnel is healthy); only when
+the child fails with a backend-down signature does it fall back to a
+bounded probe/retry ladder (~7.5 min worst case) and one re-run. If the
+backend never comes up — or the child hangs past the watchdog — it prints
+a parseable skip record
+    {"metric": ..., "value": null, "unit": ..., "vs_baseline": null,
+     "skipped": true, "reason": ...}
+and exits 0 so the round still has a structured result. Genuine bench
+bugs (non-backend failures) still exit non-zero with the child's stderr.
 """
 
 import json
 import os
+import subprocess
 import sys
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-import jax
-import jax.numpy as jnp
-import numpy as np
+METRIC = "gpt2_125m_bf16_train_tokens_per_sec_per_chip"
+UNIT = "tokens/s"
+
+# Substrings marking "the backend/tunnel is down", as opposed to a bug in
+# the bench itself. Matched against child stderr.
+_BACKEND_DOWN_MARKERS = (
+    "UNAVAILABLE",
+    "Unable to initialize backend",
+    "TPU backend setup",
+    "DEADLINE_EXCEEDED",
+    "connection dropped",
+    "Socket closed",
+    "failed to connect",
+)
+
+
+def _skip(reason: str) -> None:
+    print(json.dumps({
+        "metric": METRIC, "value": None, "unit": UNIT,
+        "vs_baseline": None, "skipped": True, "reason": reason[-500:],
+    }))
+    sys.exit(0)
+
+
+def _probe_backend(attempts: int = 5, probe_timeout: int = 75) -> str | None:
+    """Try to bring up the jax backend in a throwaway subprocess.
+
+    Returns None on success, else the last failure reason. Backend init on
+    the tunnel can HANG as well as raise, so every attempt gets its own
+    process + timeout. Worst case ~7.5 min: 5 x 75 s timeouts plus
+    8+16+24+32 s of backoff sleeps.
+    """
+    last = "unknown"
+    for i in range(attempts):
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax; jax.devices(); print(jax.default_backend())"],
+                timeout=probe_timeout, capture_output=True, text=True,
+                cwd=os.path.dirname(os.path.abspath(__file__)))
+            if r.returncode == 0:
+                return None
+            last = (r.stderr or r.stdout or "probe failed").strip()[-500:]
+        except subprocess.TimeoutExpired:
+            last = f"backend-init probe timed out after {probe_timeout}s"
+        if i < attempts - 1:
+            time.sleep(8 * (i + 1))
+    return last
+
+
+def _run_child(timeout_s: float):
+    """Run the BENCH_CHILD measurement in its own process GROUP so a
+    watchdog kill cannot orphan a hung grandchild holding the TPU.
+    Returns (returncode|None, stdout, stderr); None = timed out+killed."""
+    import signal
+
+    env = dict(os.environ, BENCH_CHILD="1")
+    proc = subprocess.Popen([sys.executable, os.path.abspath(__file__)],
+                            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                            text=True, env=env, start_new_session=True)
+    try:
+        out, err = proc.communicate(timeout=timeout_s)
+        return proc.returncode, out, err
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except ProcessLookupError:
+            pass
+        proc.wait()
+        return None, "", ""
+
+
+def _run_watchdogged() -> None:
+    """Parent mode: run the measurement child immediately; probe/retry only
+    after a backend-down failure (a healthy tunnel pays zero extra init).
+
+    The WHOLE parent is bounded by BENCH_TOTAL_BUDGET (default 1500 s) so
+    the structured skip record always lands before any outer runner's
+    timeout — run_bench_suite.py gives each entry 30 min."""
+    start = time.monotonic()
+    budget = float(os.environ.get("BENCH_TOTAL_BUDGET", 1500))
+
+    def remaining() -> float:
+        return budget - (time.monotonic() - start)
+
+    first_timeout = float(os.environ.get("BENCH_WATCHDOG_TIMEOUT",
+                                         budget * 0.6))
+    err = ""
+    for attempt in range(2):  # one mid-run tunnel drop gets one retry
+        timeout_s = (min(first_timeout, remaining()) if attempt == 0
+                     else max(remaining(), 60))
+        rc, out, errtxt = _run_child(timeout_s)
+        if rc is None:
+            _skip(f"bench run exceeded {timeout_s:.0f}s watchdog "
+                  "(tunnel hang suspected)")
+        if rc == 0:
+            sys.stdout.write(out)
+            return
+        err = (errtxt or "")[-2000:]
+        if not any(m in err for m in _BACKEND_DOWN_MARKERS):
+            sys.stderr.write(errtxt or "")
+            sys.exit(rc)  # real bug: surface it
+        if attempt == 0:
+            # probe ladder capped at 3 attempts (~4.3 min worst case) to
+            # stay inside the budget
+            down = _probe_backend(attempts=3)
+            if down is not None:
+                _skip(f"TPU backend unavailable after bounded retries: {down}")
+            if remaining() < 120:
+                _skip("TPU backend recovered but the run budget is spent; "
+                      f"first failure: {err[-300:]}")
+    _skip(f"TPU backend dropped twice despite a healthy probe: {err[-400:]}")
 
 
 def peak_flops_per_chip() -> float:
     """bf16 peak for the attached chip generation."""
+    import jax
     kind = jax.devices()[0].device_kind.lower()
     table = {
         "v5 lite": 197e12, "v5litepod": 197e12, "v5e": 197e12,
@@ -38,6 +162,9 @@ def peak_flops_per_chip() -> float:
 
 
 def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
     import deepspeed_tpu
     from deepspeed_tpu.models import create_model
 
@@ -95,12 +222,15 @@ def main() -> None:
     mfu = tokens_per_sec * flops_per_token / peak_flops_per_chip()
 
     print(json.dumps({
-        "metric": "gpt2_125m_bf16_train_tokens_per_sec_per_chip",
+        "metric": METRIC,
         "value": round(tokens_per_sec, 1),
-        "unit": "tokens/s",
+        "unit": UNIT,
         "vs_baseline": round(mfu / 0.5, 4),
     }))
 
 
 if __name__ == "__main__":
-    main()
+    if os.environ.get("BENCH_CHILD") == "1":
+        main()
+    else:
+        _run_watchdogged()
