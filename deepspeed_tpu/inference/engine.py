@@ -45,6 +45,11 @@ class InferenceConfig:
 
     dtype: Any = jnp.bfloat16
     tensor_parallel: int = 1           # tp_size
+    expert_parallel: int = 1           # ep_size — MoE models: expert banks
+    #   sharded over the mesh 'expert' axis; gate+dispatch run in the decode
+    #   path and XLA lowers the (E, C, H) exchange to the all-to-all the
+    #   reference's DeepSpeedMoEInference issues explicitly
+    #   (moe_inference.py:160, inference/engine.py:274 _create_ep_parallel_group)
     max_out_tokens: int = 1024         # KV arena length (prompt + generated)
     replace_with_kernel_inject: bool = True   # platform Pallas kernels
     checkpoint: Optional[str] = None   # flat-npz path (save_16bit_model output)
@@ -134,12 +139,15 @@ class InferenceEngine:
             from ..config.config import ParallelConfig
 
             tp_req = max(1, config.tensor_parallel)
+            ep_req = max(1, config.expert_parallel)
             mesh = mesh_mod.build_mesh(
                 ParallelConfig(tensor_parallel_size=tp_req,
-                               data_parallel_size=1),
-                devices=jax.devices()[:tp_req])
+                               expert_parallel_size=ep_req,
+                               data_parallel_size=ep_req),
+                devices=jax.devices()[:tp_req * ep_req])
         self.mesh = mesh
         tp = int(self.mesh.shape[mesh_mod.MODEL_AXIS])
+        ep = int(self.mesh.shape.get(mesh_mod.EXPERT_AXIS, 1))
         cfg = model.config
         if cfg is None:
             raise ValueError("model.config is required for inference (the "
@@ -147,12 +155,25 @@ class InferenceEngine:
         if cfg.num_kv_heads % max(tp, 1) != 0:
             raise ValueError(f"tensor_parallel={tp} must divide "
                              f"num_kv_heads={cfg.num_kv_heads}")
+        if ep > 1:
+            if cfg.moe_num_experts <= 0:
+                raise ValueError(f"expert_parallel={ep} requires an MoE "
+                                 "model (moe_num_experts > 0)")
+            if cfg.moe_num_experts % ep != 0:
+                raise ValueError(
+                    f"expert_parallel={ep} must divide "
+                    f"moe_num_experts={cfg.moe_num_experts}")
 
-        # TP-only sharding plan (no fsdp axis — reference inference shards
-        # qkv/mlp across the mp group only, replicating the rest)
+        # TP sharding plan (no fsdp axis — reference inference shards
+        # qkv/mlp across the mp group only, replicating the rest); MoE
+        # expert banks additionally shard their leading E dim over 'expert'
         self._param_shapes = jax.eval_shape(model.init,
                                             jax.random.PRNGKey(0))
-        specs = resolve_param_specs(self._param_shapes, model.axes)
+        from ..models.core import DEFAULT_TP_RULES, EXPERT
+
+        specs = resolve_param_specs(
+            self._param_shapes, model.axes,
+            rules={**DEFAULT_TP_RULES, EXPERT: mesh_mod.EXPERT_AXIS})
         self.param_shardings = jax.tree.map(
             lambda s: NamedSharding(self.mesh, s), specs,
             is_leaf=lambda x: isinstance(x, P))
@@ -217,6 +238,7 @@ class InferenceEngine:
         self._fwd = None
         n = sum(int(p.size) for p in jax.tree.leaves(self.params))
         log_dist(f"inference engine ready: {n / 1e6:.1f}M params, tp={tp}, "
+                 f"ep={ep}, "
                  f"dtype={jnp.dtype(config.dtype).name}, "
                  f"arena={config.max_out_tokens} tokens "
                  f"({kv_cache.cache_memory_bytes(cfg, 1, config.max_out_tokens, config.dtype) / 2**20:.0f}"
@@ -420,6 +442,7 @@ def init_inference(model=None, config=None, tensor_parallel: Optional[int] = Non
                    checkpoint: Optional[str] = None, hf_model=None,
                    hf_state_dict=None, mesh: Optional[Mesh] = None,
                    replace_with_kernel_inject: bool = True,
+                   expert_parallel: Optional[int] = None,
                    **model_overrides) -> InferenceEngine:
     """Analog of ``deepspeed.init_inference`` (reference __init__.py:260).
 
@@ -433,6 +456,8 @@ def init_inference(model=None, config=None, tensor_parallel: Optional[int] = Non
     cfg = config or InferenceConfig()
     if tensor_parallel is not None:
         cfg.tensor_parallel = int(tensor_parallel)
+    if expert_parallel is not None:
+        cfg.expert_parallel = int(expert_parallel)
     if dtype is not None:
         # normalisation (incl. 'int8' → weight-only quantization) happens in
         # InferenceConfig.__post_init__ — rebuild so it applies

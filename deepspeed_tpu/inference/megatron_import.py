@@ -153,7 +153,14 @@ def _find_rank_files(ckpt_dir: str) -> List[str]:
     (reference get_checkpoint_files glob order)."""
     out = []
     for name in sorted(os.listdir(ckpt_dir)):
-        m = re.match(r"mp_rank_(\d+)", name)
+        if re.fullmatch(r"mp_rank_\d+_\d+", name):
+            # mp_rank_XX_YYY = pipeline-parallel layout; collecting these as
+            # duplicate TP ranks would die later on an opaque qkv assertion
+            raise NotImplementedError(
+                f"'{name}': pipeline-parallel Megatron checkpoints "
+                "(mp_rank_XX_YYY) are not supported — merge the pipeline "
+                "stages with Megatron's checkpoint tools first")
+        m = re.fullmatch(r"mp_rank_(\d+)", name)
         if not m:
             continue
         for fname in ("model_optim_rng.pt", "model_rng.pt"):

@@ -959,14 +959,21 @@ def _layer_forward(cfg: TransformerConfig, x: jax.Array, layer: Dict[str, Any],
     if cfg.moe_num_experts > 0:
         from ..parallel.moe import moe_mlp
 
+        # cache mode == inference: exact routing, no capacity drops and no
+        # RTS — dropping a decode token would silently zero its MLP output,
+        # and right-padded prefill junk tokens must not steal capacity from
+        # real ones (the reference's DeepSpeedMoEInference routes without
+        # training-time capacity limits, moe_inference.py:160)
+        infer = cache is not None
         rts_rng = (_activation_derived_key(h, 0)
-                   if cfg.moe_use_rts else None)
+                   if (cfg.moe_use_rts and not infer) else None)
         mlp_out, aux = moe_mlp(h, layer["router"], layer["mlp"], cfg.activation,
                                top_k=cfg.moe_top_k,
                                capacity_factor=cfg.moe_capacity_factor,
                                min_capacity=cfg.moe_min_capacity,
-                               drop_tokens=cfg.moe_drop_tokens,
-                               use_rts=cfg.moe_use_rts, rng=rts_rng,
+                               drop_tokens=cfg.moe_drop_tokens and not infer,
+                               use_rts=cfg.moe_use_rts and not infer,
+                               rng=rts_rng,
                                dispatch_impl=cfg.moe_dispatch)
         if cfg.moe_use_residual:
             # PR-MoE (reference moe/layer.py:120): dense MLP in parallel,
@@ -1061,6 +1068,15 @@ def forward(params: Dict[str, Any], input_ids: jax.Array,
             raise NotImplementedError(
                 "attention_layers (sliding-window) models + ring attention "
                 "are not supported — use sequence_parallel_impl='ulysses'")
+    if cfg.attention_scale is not None and cache is None:
+        from ..parallel.ring import ring_attention_enabled
+
+        if ring_attention_enabled():
+            # ring_attention hardcodes 1/sqrt(head_dim); a custom scale
+            # (GPT-Neo uses 1.0) would be silently dropped
+            raise NotImplementedError(
+                "custom attention_scale models + ring attention are not "
+                "supported — use sequence_parallel_impl='ulysses'")
     if use_ltd:
         # default mirrors the engine (engine.py random-LTD init): all but the
         # first and last layer; degenerate depths keep at least one layer

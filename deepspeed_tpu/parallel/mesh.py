@@ -157,7 +157,10 @@ def mesh_context(mesh: Mesh):
 # ---------------------------------------------------------------------------
 
 def _axis_size(axis: str, mesh: Optional[Mesh] = None) -> int:
-    mesh = mesh or get_mesh()
+    # ambient first: an engine tracing under its own mesh (inference EP/TP)
+    # must see THAT mesh's degrees, not a stale global default — identical
+    # in training, where every trace site enters ambient(global mesh)
+    mesh = mesh or ambient_mesh() or get_mesh()
     return int(mesh.shape.get(axis, 1))
 
 

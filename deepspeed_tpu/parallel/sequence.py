@@ -30,7 +30,9 @@ from .mesh import DATA_SHARD, MODEL_AXIS, SEQ_AXIS, get_mesh
 
 def _active_mesh():
     try:
-        mesh = get_mesh()
+        from .mesh import ambient_mesh
+
+        mesh = ambient_mesh() or get_mesh()
         if not mesh.shape:
             return None
         return mesh

@@ -188,10 +188,23 @@ def save_checkpoint(save_dir: str, tag: str, params: Any, opt_state: Any = None,
 
     n_proc = jax.process_count()
     # stamp this save so STALE done-markers from an earlier save into the
-    # same tag dir can never satisfy the barrier; every process computes
-    # the same stamp from the shared client_state
+    # same tag dir can never satisfy the barrier. Step counters alone are
+    # not enough (direct save_checkpoint calls may omit them, and two saves
+    # to the same tag at the same step would collide), so a per-save nonce
+    # drawn by process 0 and agreed across processes is always appended.
     cs = client_state or {}
-    stamp = f"{cs.get('global_steps', '')}:{cs.get('micro_steps', '')}"
+    # os.urandom, NOT the global np.random stream: a seeded deterministic
+    # crash-resume would replay the same np.random nonce (and every save
+    # would perturb the user's seeded stream)
+    local_nonce = int.from_bytes(os.urandom(8), "big") >> 2
+    if n_proc > 1:
+        from jax.experimental import multihost_utils
+
+        nonce = int(multihost_utils.broadcast_one_to_all(
+            np.int64(local_nonce)))
+    else:
+        nonce = local_nonce
+    stamp = f"{cs.get('global_steps', '')}:{cs.get('micro_steps', '')}:{nonce}"
     try:
         os.remove(os.path.join(ckpt_dir, f".done.{proc}"))
     except FileNotFoundError:

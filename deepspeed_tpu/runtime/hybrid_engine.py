@@ -28,9 +28,15 @@ class HybridEngine(TrainEngine):
     hybrid_engine=True)`` or directly."""
 
     def __init__(self, *args, inference_tp_size: int = 1,
+                 inference_ep_size: Optional[int] = None,
                  max_out_tokens: int = 1024, **kwargs):
         super().__init__(*args, **kwargs)
         self._inference_tp = inference_tp_size
+        # MoE policies: default the generation-side expert parallelism to
+        # the TRAINING mesh's expert degree, so an ep-trained actor serves
+        # with the same expert placement (reference _create_ep_parallel_group,
+        # inference/engine.py:274)
+        self._inference_ep = inference_ep_size
         self._max_out_tokens = max_out_tokens
         self._infer = None
         self._infer_params_step = -1
@@ -106,14 +112,21 @@ class HybridEngine(TrainEngine):
                 from ..models.transformer import build_model
 
                 base = build_model(cfg, name=base.name + "-infer")
+            from ..parallel import mesh as mesh_mod
+
+            ep = self._inference_ep
+            if ep is None:
+                ep = (int(self.mesh.shape.get(mesh_mod.EXPERT_AXIS, 1))
+                      if cfg is not None and cfg.moe_num_experts > 0 else 1)
             icfg = InferenceConfig(dtype=self.compute_dtype,
                                    tensor_parallel=self._inference_tp,
+                                   expert_parallel=ep,
                                    max_out_tokens=self._max_out_tokens)
             self._infer = InferenceEngine(base, icfg,
                                           params=self._export_params())
             self._infer_params_step = self.global_steps
             log_dist("hybrid engine: inference side ready "
-                     f"(tp={self._inference_tp}, "
+                     f"(tp={self._inference_tp}, ep={ep}, "
                      f"arena={self._max_out_tokens})")
         return self._infer
 

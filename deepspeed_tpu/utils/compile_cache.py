@@ -43,7 +43,9 @@ def enable_compile_cache(cache_dir: str = "",
     env = os.environ.get("DSTPU_COMPILE_CACHE")
     if env == "0":
         return None
-    path = cache_dir or default_cache_dir()
+    # env var wins over the configured dir (documented contract in
+    # inference/engine.py and config.py)
+    path = env or cache_dir or default_cache_dir()
     if _APPLIED is not None:
         return _APPLIED
     os.makedirs(path, exist_ok=True)

@@ -441,3 +441,28 @@ class TestRingAttention:
         q = jnp.zeros((1, 32, 2, 16))
         with pytest.raises(NotImplementedError, match="padding masks"):
             ring_attention(q, q, q, mask=jnp.ones((1, 32)))
+
+    def test_ring_rejects_custom_attention_scale(self):
+        """A model with cfg.attention_scale (GPT-Neo uses 1.0) must refuse
+        ring SP instead of silently falling back to 1/sqrt(head_dim)."""
+        from deepspeed_tpu.config.config import ParallelConfig
+        from deepspeed_tpu.models.transformer import (TransformerConfig,
+                                                      forward, init_params)
+        from deepspeed_tpu.parallel import mesh as mesh_mod
+        from deepspeed_tpu.parallel.ring import set_ring_attention
+
+        mesh = mesh_mod.build_mesh(ParallelConfig(sequence_parallel_size=2,
+                                                  data_parallel_size=4))
+        mesh_mod.set_mesh(mesh)
+        set_ring_attention(True)
+        try:
+            cfg = TransformerConfig(vocab_size=64, hidden_size=32,
+                                    num_layers=2, num_heads=2, max_seq_len=32,
+                                    attention_scale=1.0)
+            params = init_params(jax.random.PRNGKey(0), cfg)
+            ids = jnp.zeros((1, 32), jnp.int32)
+            with pytest.raises(NotImplementedError,
+                               match="custom attention_scale"):
+                forward(params, ids, cfg)
+        finally:
+            set_ring_attention(False)

@@ -260,6 +260,42 @@ class TestVariableSparsityConfig:
         assert (np.diag(layout) == 1).all()
         assert (layout[:, 0] == 1).all()         # global col, causal-masked
 
+    def test_unidirectional_matches_reference_oracle_modulo_tril(self):
+        """Pin the documented deviation from the reference's
+        set_random_layout (sparsity_config.py:303): our unidirectional
+        layout equals a reference-structured oracle (random -> local ->
+        global, random blocks NOT causal-restricted) with np.tril applied —
+        i.e. the ONLY difference is that above-diagonal random blocks are
+        dropped, which the kernel could never attend causally anyway."""
+        from deepspeed_tpu.ops.sparse_attention import VariableSparsityConfig
+
+        cfg = VariableSparsityConfig(num_heads=1, block=16,
+                                     local_window_blocks=(2, 3),
+                                     global_block_indices=(1,),
+                                     attention="unidirectional",
+                                     num_random_blocks=2, seed=7)
+        n = 10
+        layout = cfg.make_layout(16 * n)[0]
+
+        # reference-derived oracle: same rng stream as our implementation,
+        # reference structure (random rows unrestricted by causality)
+        oracle = np.zeros((n, n), dtype=np.int64)
+        rng = np.random.RandomState(cfg.seed)
+        for i in range(n):                                   # set_random_layout
+            oracle[i, rng.choice(n, size=2, replace=False)] = 1
+        start, sizes = 0, [2, 3]                             # set_local_layout
+        while start < n:
+            size = sizes.pop(0) if sizes else 3
+            end = min(start + size, n)
+            for i in range(start, end):
+                oracle[i, start:i + 1] = 1                   # unidirectional
+            start = end
+        oracle[1:, 1] = 1                                    # set_global_layout
+
+        assert (layout == np.tril(oracle)).all()
+        # non-vacuous: the oracle really had above-diagonal random blocks
+        assert (np.triu(oracle, 1) == 1).any()
+
     def test_validation(self):
         from deepspeed_tpu.ops.sparse_attention import VariableSparsityConfig
 
