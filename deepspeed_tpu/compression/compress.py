@@ -20,6 +20,7 @@ Config schema mirrors the reference sections:
       sparse_pruning:      {..., params: {dense_ratio}, modules: [...]}
       row_pruning:         {..., params: {dense_ratio}, modules: [...]}
       head_pruning:        {..., params: {dense_ratio, num_heads}, modules: [...]}
+      channel_pruning:     {..., params: {dense_ratio, method: l1}, modules: [...]}
       layer_reduction:     {enabled, keep_number_layer, teacher_layer: [...]}
 """
 
@@ -53,7 +54,8 @@ def init_compression(config: Dict[str, Any]) -> CompressionPlan:
     section = config.get("compression_training", config)
     methods: Dict[str, Dict[str, Any]] = {}
     for name in ("weight_quantization", "activation_quantization",
-                 "sparse_pruning", "row_pruning", "head_pruning"):
+                 "sparse_pruning", "row_pruning", "head_pruning",
+                 "channel_pruning"):
         spec = section.get(name)
         if not spec:
             continue
@@ -215,6 +217,27 @@ def apply_compression(params: Any, plan: CompressionPlan,
                               .get("dense_ratio", 0.5))
                 w = w * jax.lax.stop_gradient(
                     _magnitude_mask(w, ratio, axis=w.ndim - 1))
+            if ("channel_pruning" in active
+                    and plan.matches("channel_pruning", key)
+                    and leaf.ndim >= 4):
+                # conv weights only, as in the reference (basic_layer.py:461
+                # enable_channel_pruning norms each kernel over its last
+                # three torch-OIHW dims). Our convs are HWIO (spatial.py:69)
+                # — output channels live on the LAST axis, so the mask is
+                # the per-output-channel L1 top-k over (kh, kw, Cin)
+                cp = plan.methods["channel_pruning"]["params"]
+                method = cp.get("method", "l1")
+                if method != "l1":
+                    raise NotImplementedError(
+                        f"channel_pruning method '{method}': only 'l1' is "
+                        "supported (the reference's 'topk' variant learns "
+                        "mask scores as extra parameters — out of scope)")
+                ratio = float(cp.get("dense_ratio", 0.5))
+                mask_fn = lambda x: _magnitude_mask(x, ratio, axis=x.ndim - 1)
+                if w.ndim > 4:   # stacked (L, ...) convs: per-layer scores
+                    w = w * jax.lax.stop_gradient(jax.vmap(mask_fn)(w))
+                else:
+                    w = w * jax.lax.stop_gradient(mask_fn(w))
             if "head_pruning" in active and plan.matches("head_pruning", key):
                 hp = plan.methods["head_pruning"]["params"]
                 ratio = float(hp.get("dense_ratio", 0.5))

@@ -1,6 +1,7 @@
 """Profiling — analog of ``deepspeed/profiling`` (flops profiler) plus the
 jax-profiler trace hook (the NVTX/nsys analog)."""
 
-from .flops_profiler import (FlopsProfile, compiled_cost, duration_string,
-                             flops_string, get_model_profile, number_string,
+from .flops_profiler import (FlopsProfile, MeasuredProfile, compiled_cost,
+                             duration_string, flops_string, get_model_profile,
+                             measured_model_profile, number_string,
                              params_string, transformer_breakdown)  # noqa: F401

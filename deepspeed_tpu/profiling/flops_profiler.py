@@ -141,9 +141,204 @@ def transformer_breakdown(cfg, batch_size: int, seq_len: int) -> FlopsProfile:
 
 
 def get_model_profile(model, batch_size: int, seq_len: int,
-                      print_profile: bool = False) -> Tuple[float, float, int]:
-    """Reference get_model_profile parity: returns (flops, macs, params)."""
+                      print_profile: bool = False,
+                      measured: bool = False,
+                      output_file: Optional[str] = None
+                      ) -> Tuple[float, float, int]:
+    """Reference get_model_profile parity: returns (flops, macs, params).
+
+    ``measured=True`` additionally RUNS the model and prints the
+    ``print_model_profile`` analog (reference profiler.py:239): a depth tree
+    with measured wall latency, XLA-counted GFLOPs, params, and achieved
+    FLOPS per module — depth 0 model, depth 1 embedding/layers/head, depth
+    2 every individual layer block."""
     prof = transformer_breakdown(model.config, batch_size, seq_len)
+    if measured:
+        mp = measured_model_profile(model, batch_size, seq_len)
+        text = mp.table()
+        if output_file:
+            with open(output_file, "w") as fh:
+                fh.write(text + "\n")
+        elif print_profile:
+            print(text)
+        return mp.total_flops, mp.total_flops / 2, prof.total_params
     if print_profile:
         print(prof.table())
     return prof.total_flops, prof.total_flops / 2, prof.total_params
+
+
+# -- measured per-module tree (print_model_profile analog) -------------------
+
+
+@dataclasses.dataclass
+class ModuleMeasurement:
+    name: str
+    depth: int
+    latency_s: float              # measured median wall time
+    flops: float                  # XLA cost analysis (analytic fallback)
+    params: int
+
+    def achieved_flops_per_s(self) -> float:
+        return self.flops / self.latency_s if self.latency_s > 0 else 0.0
+
+
+@dataclasses.dataclass
+class MeasuredProfile:
+    """The measured module tree. ``modules`` is depth-first: the depth-0
+    root, then each depth-1 group with its depth-2 children."""
+
+    modules: list
+    total_flops: float
+    total_latency_s: float
+    batch_size: int
+    seq_len: int
+
+    def table(self) -> str:
+        head = (f"{'module':<24}{'params':>10}{'latency':>12}"
+                f"{'GFLOPs':>10}{'FLOPS':>15}{'% time':>8}")
+        lines = ["-" * 28 + " measured model profile " + "-" * 28,
+                 f"batch {self.batch_size} x seq {self.seq_len} "
+                 f"(forward; segment-jitted measurement)", head, "-" * 80]
+        for m in self.modules:
+            pct = (m.latency_s / self.total_latency_s
+                   if self.total_latency_s else 0.0)
+            lines.append(
+                f"{'  ' * m.depth + m.name:<24}{params_string(m.params):>10}"
+                f"{duration_string(m.latency_s):>12}"
+                f"{m.flops / 1e9:>10.3f}"
+                f"{flops_string(m.achieved_flops_per_s(), 1):>15}"
+                f"{pct:>7.1%}")
+        lines.append("-" * 80)
+        return "\n".join(lines)
+
+
+def _median_time(fn, args, repeats: int, warmup: int) -> float:
+    import time as _time
+
+    import jax
+
+    for _ in range(warmup):
+        jax.block_until_ready(fn(*args))
+    ts = []
+    for _ in range(repeats):
+        t0 = _time.perf_counter()
+        jax.block_until_ready(fn(*args))
+        ts.append(_time.perf_counter() - t0)
+    return sorted(ts)[len(ts) // 2]
+
+
+def _segment_flops(jitted, args, fallback: float) -> float:
+    try:
+        cost = compiled_cost(jitted.lower(*args).compile())
+    except Exception:
+        return fallback
+    return cost.get("flops") or fallback
+
+
+def measured_model_profile(model, batch_size: int, seq_len: int,
+                           repeats: int = 5, warmup: int = 2
+                           ) -> MeasuredProfile:
+    """Measure the forward pass module-by-module (reference
+    print_model_profile, profiler.py:239 — there via module hooks; under
+    jit, each stage becomes its own compiled segment timed with a device
+    fence). Segment boundaries follow the model's real stages — embedding,
+    every layer block (`_layer_forward`, the SAME function the full forward
+    scans), final norm + lm_head — so per-layer numbers are the truth of
+    the layer program, modulo cross-stage fusion the monolithic jit would
+    additionally do (the reference's hooks perturb timing the same way)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..models.transformer import (_layer_forward, _norm, eval_config,
+                                      head_logits, window_table)
+
+    cfg = eval_config(model.config)
+    # per-layer sliding windows (GPT-Neo attention_layers): each timed layer
+    # must see ITS window, exactly as forward()'s scan passes it — else
+    # 'local' layers would be profiled as all-global attention
+    win_table = window_table(cfg) if cfg.attention_layers else None
+    params = jax.jit(model.init)(jax.random.PRNGKey(0))
+    ids = jnp.asarray(
+        np.random.RandomState(0).randint(0, cfg.vocab_size,
+                                         (batch_size, seq_len)), jnp.int32)
+    positions = jnp.arange(seq_len)
+
+    # --- embedding segment (mirrors forward()'s embed stage) ---
+    def embed_fn(p, i):
+        x = p["embed"]["tokens"][i].astype(cfg.dtype)
+        if cfg.position == "learned":
+            x = x + p["pos"][positions].astype(cfg.dtype)
+        if cfg.type_vocab_size > 0:
+            x = x + p["type_embed"][
+                jnp.zeros_like(i)].astype(cfg.dtype)
+        if cfg.embed_norm:
+            x = _norm(x, p["embed_norm"]["scale"],
+                      p["embed_norm"].get("bias"), "layernorm", cfg.norm_eps)
+        return x
+
+    embed_jit = jax.jit(embed_fn)
+    x = embed_jit(params, ids)
+
+    # --- one compiled layer program, timed per layer's weights ---
+    def layer_fn(layer, h, window):
+        return _layer_forward(cfg, h, layer, None, positions,
+                              window=window)[0]
+
+    layer_jit = jax.jit(layer_fn)
+
+    def win(i: int):
+        return win_table[i] if win_table is not None else None
+    head_jit = jax.jit(lambda p, h: head_logits(p, h, cfg))
+
+    analytic = transformer_breakdown(cfg, batch_size, seq_len)
+    L = max(cfg.num_layers, 1)
+    per_layer_analytic = (analytic.per_module["attention"]["flops"]
+                          + analytic.per_module["mlp"]["flops"]
+                          + analytic.per_module["norms"]["flops"]) / L
+
+    def leaf_params(tree):
+        return sum(int(p.size) for p in jax.tree.leaves(tree))
+
+    layer0 = jax.tree.map(lambda p: p[0], params["layers"])
+    embed_flops = _segment_flops(embed_jit, (params, ids), 0.0)
+    layer_flops = _segment_flops(layer_jit, (layer0, x, win(0)),
+                                 per_layer_analytic)
+    head_flops = _segment_flops(head_jit, (params, x),
+                                analytic.per_module["lm_head"]["flops"])
+
+    t_embed = _median_time(embed_jit, (params, ids), repeats, warmup)
+    layer_meas = []
+    h = x
+    for i in range(cfg.num_layers):
+        layer_i = jax.tree.map(lambda p: p[i], params["layers"])
+        t_i = _median_time(layer_jit, (layer_i, h, win(i)), repeats, warmup)
+        layer_meas.append(t_i)
+        h = layer_jit(layer_i, h, win(i))
+    t_head = _median_time(head_jit, (params, h), repeats, warmup)
+
+    layer_params = leaf_params(params["layers"]) // max(cfg.num_layers, 1)
+    embed_params = leaf_params({k: v for k, v in params.items()
+                                if k in ("embed", "pos", "type_embed",
+                                         "embed_norm")})
+    head_params = leaf_params({k: v for k, v in params.items()
+                               if k in ("final_norm", "lm_head", "lm_head_b")})
+
+    total_lat = t_embed + sum(layer_meas) + t_head
+    total_flops = embed_flops + layer_flops * cfg.num_layers + head_flops
+    modules = [
+        ModuleMeasurement("model", 0, total_lat, total_flops,
+                          leaf_params(params)),
+        ModuleMeasurement("embedding", 1, t_embed, embed_flops, embed_params),
+        ModuleMeasurement(f"layers (x{cfg.num_layers})", 1, sum(layer_meas),
+                          layer_flops * cfg.num_layers,
+                          layer_params * cfg.num_layers),
+    ]
+    for i, t_i in enumerate(layer_meas):
+        modules.append(ModuleMeasurement(f"layer.{i}", 2, t_i, layer_flops,
+                                         layer_params))
+    modules.append(ModuleMeasurement("final_norm+lm_head", 1, t_head,
+                                     head_flops, head_params))
+    return MeasuredProfile(modules=modules, total_flops=total_flops,
+                           total_latency_s=total_lat, batch_size=batch_size,
+                           seq_len=seq_len)

@@ -6,4 +6,6 @@ from .curriculum_scheduler import CurriculumScheduler  # noqa: F401
 from .data_analyzer import (DataAnalyzer, load_difficulties,  # noqa: F401
                             token_count_metric)
 from .data_sampler import CurriculumDataSampler  # noqa: F401
+from .indexed_dataset import (MMapIndexedDataset,  # noqa: F401
+                              MMapIndexedDatasetBuilder)
 from .random_ltd import RandomLTDScheduler, sample_token_subset  # noqa: F401

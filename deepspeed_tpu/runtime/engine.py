@@ -159,7 +159,8 @@ class TrainEngine:
             # apply the same transform with per-layer scales; boundaries
             # rebuild via set_compression). Pruning and the MoQ eigenvalue
             # schedule cannot:
-            if any((ct.sparse_pruning, ct.row_pruning, ct.head_pruning)):
+            if any((ct.sparse_pruning, ct.row_pruning, ct.head_pruning,
+                    ct.channel_pruning)):
                 raise NotImplementedError(
                     "offload_param + pruning compression is not supported "
                     "(magnitude thresholds couple across the full layer "
@@ -434,6 +435,7 @@ class TrainEngine:
             "sparse_pruning": self.config.compression_training.sparse_pruning,
             "row_pruning": self.config.compression_training.row_pruning,
             "head_pruning": self.config.compression_training.head_pruning,
+            "channel_pruning": self.config.compression_training.channel_pruning,
         }.items() if v}
         if comp_cfg:
             from ..compression import CompressionScheduler, init_compression

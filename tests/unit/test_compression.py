@@ -121,6 +121,144 @@ def test_head_pruning():
     assert head_zero.sum() == 2  # half the heads pruned whole
 
 
+CHANNEL_CFG = {"compression_training": {
+    "channel_pruning": {
+        "shared_parameters": {"enabled": True, "schedule_offset": 5},
+        "different_groups": {
+            "g0": {"params": {"dense_ratio": 0.5, "method": "l1"},
+                   "modules": ["conv"]}}}}}
+
+
+def test_channel_pruning_mask():
+    """Reference channel pruning (constants.py:160, basic_layer.py:461):
+    whole OUTPUT channels of conv kernels pruned by L1 norm — our HWIO
+    layout puts channels on the last axis. Dense (2D) weights are never
+    channel-pruned, matching the reference's Conv2d-only scope."""
+    plan = init_compression(CHANNEL_CFG)
+    rng = np.random.RandomState(0)
+    params = {"conv1": {"w": jnp.asarray(rng.randn(3, 3, 8, 16), jnp.float32)},
+              "conv_proj": {"w": jnp.asarray(rng.randn(16, 16), jnp.float32)}}
+    out = apply_compression(params, plan, frozenset({"channel_pruning"}))
+    w = np.asarray(out["conv1"]["w"])
+    chan_zero = (w == 0).all(axis=(0, 1, 2))
+    assert chan_zero.sum() == 8                       # half the channels gone
+    # surviving channels untouched
+    orig = np.asarray(params["conv1"]["w"])
+    np.testing.assert_array_equal(w[..., ~chan_zero], orig[..., ~chan_zero])
+    # kept channels are the largest by L1
+    l1 = np.abs(orig).sum(axis=(0, 1, 2))
+    assert l1[~chan_zero].min() >= l1[chan_zero].max()
+    # 2D (non-conv) weight untouched even though the module regex matches
+    np.testing.assert_array_equal(np.asarray(out["conv_proj"]["w"]),
+                                  np.asarray(params["conv_proj"]["w"]))
+
+
+def test_channel_pruning_schedule_and_topk_rejected():
+    import pytest
+
+    from deepspeed_tpu.compression import CompressionScheduler
+
+    plan = init_compression(CHANNEL_CFG)
+    sched = CompressionScheduler(plan)
+    assert sched.active_methods(0) == frozenset()
+    assert sched.active_methods(5) == {"channel_pruning"}
+    bad = {"compression_training": {"channel_pruning": {
+        "shared_parameters": {"enabled": True, "schedule_offset": 0},
+        "different_groups": {"g0": {
+            "params": {"dense_ratio": 0.5, "method": "topk"},
+            "modules": ["conv"]}}}}}
+    with pytest.raises(NotImplementedError, match="topk"):
+        apply_compression(
+            {"conv": {"w": jnp.ones((3, 3, 4, 8))}},
+            init_compression(bad), frozenset({"channel_pruning"}))
+
+
+def test_channel_pruning_composes_with_qat():
+    """channel_pruning + weight_quantization on the same conv leaf: the
+    kept channels carry fake-quantized values, pruned channels stay zero."""
+    cfg = {"compression_training": {
+        "weight_quantization": {
+            "shared_parameters": {"enabled": True, "schedule_offset": 0},
+            "different_groups": {"g0": {"params": {"target_bits": 4},
+                                        "modules": ["conv"]}}},
+        "channel_pruning": {
+            "shared_parameters": {"enabled": True, "schedule_offset": 0},
+            "different_groups": {"g0": {"params": {"dense_ratio": 0.5},
+                                        "modules": ["conv"]}}}}}
+    plan = init_compression(cfg)
+    w0 = jnp.asarray(np.random.RandomState(1).randn(3, 3, 4, 8), jnp.float32)
+    params = {"conv": {"w": w0}}
+    both = apply_compression(params, plan,
+                             frozenset({"weight_quantization",
+                                        "channel_pruning"}))
+    qonly = apply_compression(params, plan,
+                              frozenset({"weight_quantization"}))
+    wb = np.asarray(both["conv"]["w"])
+    chan_zero = (wb == 0).all(axis=(0, 1, 2))
+    assert chan_zero.sum() == 4
+    # grads flow straight-through the composition to surviving channels
+    g = jax.grad(lambda p: jnp.sum(apply_compression(
+        p, plan, frozenset({"weight_quantization", "channel_pruning"})
+    )["conv"]["w"] ** 2))(params)["conv"]["w"]
+    assert np.isfinite(np.asarray(g)).all() and np.abs(np.asarray(g)).max() > 0
+    # kept channels equal the quantize-only values (pruning masks AFTER
+    # quantization, reference fix_channel_pruning order)
+    np.testing.assert_allclose(wb[..., ~chan_zero],
+                               np.asarray(qonly["conv"]["w"])[..., ~chan_zero])
+
+
+@__import__('pytest').mark.slow
+def test_channel_pruning_engine_trajectory():
+    """Engine integration: a conv model trains under a scheduled
+    channel_pruning config; after the schedule offset the effective conv
+    weights are channel-sparse and the loss keeps improving."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models.core import Model
+
+    rng = np.random.RandomState(2)
+    x_np = rng.randn(8, 8, 8, 4).astype(np.float32)
+    y_np = rng.randn(8, 8, 8, 8).astype(np.float32)
+
+    def init(key):
+        k1, k2 = jax.random.split(key)
+        return {"conv": {"w": jax.random.normal(k1, (3, 3, 4, 8)) * 0.3},
+                "out": {"w": jax.random.normal(k2, (8, 8)) * 0.3}}
+
+    def apply_fn(params, batch):
+        from deepspeed_tpu.models.spatial import conv2d
+
+        h = conv2d(batch["x"], params["conv"]["w"])
+        return jnp.einsum("bhwc,cd->bhwd", jax.nn.relu(h),
+                          params["out"]["w"]), None
+
+    def loss_fn(params, batch):
+        pred, _ = apply_fn(params, batch)
+        return jnp.mean((pred - batch["y"]) ** 2)
+
+    model = Model(init=init, apply=apply_fn, loss_fn=loss_fn,
+                  axes={"conv": {"w": None}, "out": {"w": None}})
+    engine, *_ = deepspeed_tpu.initialize(model=model, config={
+        "train_micro_batch_size_per_gpu": 8,
+        "steps_per_print": 1000,
+        "optimizer": {"type": "adamw", "params": {"lr": 3e-3}},
+        "compression_training": {
+            "channel_pruning": {
+                "shared_parameters": {"enabled": True, "schedule_offset": 3},
+                "different_groups": {"g0": {
+                    "params": {"dense_ratio": 0.5}, "modules": ["conv"]}}}},
+    })
+    batch = {"x": jnp.asarray(x_np)[None], "y": jnp.asarray(y_np)[None]}
+    losses = [float(engine.train_batch(batch={**batch})) for _ in range(10)]
+    assert losses[-1] < losses[0]
+    # the EFFECTIVE (compressed) weights are channel-sparse post-offset
+    from deepspeed_tpu.compression import apply_compression as ac
+
+    eff = ac(engine.params, engine._compression_plan,
+             engine._compression_active)
+    chan_zero = (np.asarray(eff["conv"]["w"]) == 0).all(axis=(0, 1, 2))
+    assert chan_zero.sum() == 4
+
+
 @__import__('pytest').mark.slow
 def test_activation_quantization_forward():
     """Activation QAT (reference QuantAct): cfg.act_quant_bits fake-quants

@@ -3,6 +3,7 @@ test_data_efficiency.py semantics), sampler eligibility/resume, random-LTD
 subset mechanics."""
 
 import jax
+import os
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -238,3 +239,131 @@ class TestDataAnalyzer:
         import pytest as _pytest
         with _pytest.raises(FileNotFoundError):
             DataAnalyzer.run_reduce(str(tmp_path), "seqlen", num_workers=2)
+
+
+class TestMMapIndexedDataset:
+    """Megatron .bin/.idx mmap format (reference
+    data_sampling/indexed_dataset.py:369): byte-level layout oracle,
+    round-trip, sub-range reads, and the analyzer->sampler workflow over a
+    production-format corpus."""
+
+    def _build(self, prefix, dtype=np.int32):
+        from deepspeed_tpu.runtime.data_pipeline import (
+            MMapIndexedDatasetBuilder)
+
+        rng = np.random.RandomState(0)
+        seqs = [rng.randint(0, 50000, size=n).astype(dtype)
+                for n in (5, 17, 3, 64, 1, 30)]
+        b = MMapIndexedDatasetBuilder(prefix, dtype=dtype)
+        for i, s in enumerate(seqs):
+            b.add_item(s)
+            if i in (1, 4):          # documents: [0,1], [2,3,4], [5]
+                b.end_document()
+        b.end_document()
+        b.finalize()
+        return seqs
+
+    def test_roundtrip_and_layout_oracle(self, tmp_path):
+        import struct
+
+        from deepspeed_tpu.runtime.data_pipeline import MMapIndexedDataset
+
+        prefix = str(tmp_path / "corpus")
+        seqs = self._build(prefix)
+        ds = MMapIndexedDataset(prefix)
+        assert len(ds) == len(seqs)
+        for i, s in enumerate(seqs):
+            np.testing.assert_array_equal(ds[i], s)
+        np.testing.assert_array_equal(ds.sizes,
+                                      [len(s) for s in seqs])
+        np.testing.assert_array_equal(ds.doc_idx, [0, 2, 5, 6])
+        # byte-level oracle: independent struct parse of the header
+        raw = open(prefix + ".idx", "rb").read()
+        assert raw[:9] == b"MMIDIDX\x00\x00"
+        version, = struct.unpack("<Q", raw[9:17])
+        code = raw[17]
+        count, doc_count = struct.unpack("<QQ", raw[18:34])
+        assert (version, code, count, doc_count) == (1, 4, 6, 4)
+        sizes = np.frombuffer(raw, np.int32, count, offset=34)
+        pointers = np.frombuffer(raw, np.int64, count,
+                                 offset=34 + sizes.nbytes)
+        assert pointers[0] == 0
+        np.testing.assert_array_equal(
+            np.diff(pointers), (sizes[:-1] * 4).astype(np.int64))
+        # .bin holds exactly the tokens, back to back
+        assert (os.path.getsize(prefix + ".bin")
+                == sum(len(s) for s in seqs) * 4)
+
+    def test_subrange_get_and_slice(self, tmp_path):
+        from deepspeed_tpu.runtime.data_pipeline import MMapIndexedDataset
+
+        prefix = str(tmp_path / "corpus")
+        seqs = self._build(prefix)
+        ds = MMapIndexedDataset(prefix)
+        np.testing.assert_array_equal(ds.get(3, offset=10, length=20),
+                                      seqs[3][10:30])
+        got = ds[1:3]
+        assert len(got) == 2
+        np.testing.assert_array_equal(got[0], seqs[1])
+        with pytest.raises(IndexError):
+            ds.get(0, offset=2, length=10)   # past the end of seq 0 (len 5)
+        assert MMapIndexedDataset.exists(prefix)
+        assert not MMapIndexedDataset.exists(prefix + "-nope")
+
+    def test_uint16_dtype(self, tmp_path):
+        from deepspeed_tpu.runtime.data_pipeline import (
+            MMapIndexedDataset, MMapIndexedDatasetBuilder)
+
+        prefix = str(tmp_path / "c16")
+        b = MMapIndexedDatasetBuilder(prefix, dtype=np.uint16)
+        b.add_item(np.asarray([1, 2, 65000], np.uint16))
+        b.finalize()
+        ds = MMapIndexedDataset(prefix)
+        assert ds.dtype == np.uint16
+        np.testing.assert_array_equal(ds[0], [1, 2, 65000])
+
+    def test_bad_magic_rejected(self, tmp_path):
+        from deepspeed_tpu.runtime.data_pipeline import MMapIndexedDataset
+
+        prefix = str(tmp_path / "bad")
+        open(prefix + ".idx", "wb").write(b"TNTIDX\x00\x00X" + b"\x00" * 32)
+        open(prefix + ".bin", "wb").write(b"")
+        with pytest.raises(ValueError, match="magic"):
+            MMapIndexedDataset(prefix)
+
+    def test_analyzer_curriculum_over_mmap_corpus(self, tmp_path):
+        """The production workflow (VERDICT r4 #9): mmap corpus -> 2-worker
+        map/reduce difficulty index -> curriculum sampler batches easy
+        samples first."""
+        from deepspeed_tpu.runtime.data_pipeline import (
+            CurriculumDataSampler, CurriculumScheduler, DataAnalyzer,
+            MMapIndexedDataset, MMapIndexedDatasetBuilder,
+            load_difficulties, token_count_metric)
+
+        prefix = str(tmp_path / "corpus")
+        rng = np.random.RandomState(1)
+        lens = rng.randint(4, 100, size=32)
+        b = MMapIndexedDatasetBuilder(prefix, dtype=np.uint16)
+        for n in lens:
+            b.add_item(rng.randint(0, 1000, size=n).astype(np.uint16))
+        b.finalize()
+
+        ds = MMapIndexedDataset(prefix)
+        save = str(tmp_path / "index")
+        for w in range(2):
+            DataAnalyzer(ds, {"seqlen": token_count_metric}, save,
+                         num_workers=2, worker_id=w).run_map()
+        DataAnalyzer.run_reduce(save, "seqlen", num_workers=2)
+        diff = load_difficulties(save, "seqlen")
+        np.testing.assert_array_equal(np.asarray(diff, np.int64), lens)
+
+        sched = CurriculumScheduler({
+            "min_difficulty": 20, "max_difficulty": 100,
+            "schedule_type": "fixed_linear",
+            "schedule_config": {"total_curriculum_step": 10,
+                                "difficulty_step": 8}})
+        sampler = CurriculumDataSampler(diff, batch_size=4, scheduler=sched)
+        batch = sampler.sample_batch(global_step=0)
+        assert all(lens[i] <= 20 for i in batch)
+        # the sampled ids read straight back out of the mmap corpus
+        assert all(len(ds[int(i)]) == lens[i] for i in batch)

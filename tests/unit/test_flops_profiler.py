@@ -56,3 +56,42 @@ def test_table_renders():
 def test_format_helpers():
     assert number_string(1.5e9) == "1.50 G"
     assert flops_string(2e12) == "2.00 TFLOPs"
+
+
+def test_measured_profile_tree():
+    """print_model_profile analog (reference profiler.py:239): measured
+    per-module latency tree — every layer block appears at depth 2 with a
+    positive measured latency and flops; group totals add up."""
+    from deepspeed_tpu.profiling import measured_model_profile
+
+    model = create_model("tiny", dtype=jnp.float32, num_layers=3)
+    mp = measured_model_profile(model, batch_size=2, seq_len=32,
+                                repeats=3, warmup=1)
+    names = [m.name for m in mp.modules]
+    assert names[0] == "model" and "embedding" in names
+    layer_rows = [m for m in mp.modules if m.name.startswith("layer.")]
+    assert len(layer_rows) == 3
+    assert all(m.depth == 2 and m.latency_s > 0 for m in layer_rows)
+    assert all(m.flops > 0 for m in layer_rows)
+    root = mp.modules[0]
+    parts = [m for m in mp.modules if m.depth == 1]
+    assert abs(sum(m.latency_s for m in parts) - root.latency_s) < 1e-9
+    table = mp.table()
+    assert "layer.2" in table and "% time" in table
+    # the get_model_profile(measured=True) path returns flops computed from
+    # the XLA-counted segments
+    flops, macs, params = get_model_profile(model, 2, 32, measured=True)
+    assert flops > 0 and macs == flops / 2 and params > 0
+
+
+def test_measured_profile_moe_model():
+    """The measured tree must also run MoE layer blocks (gate+dispatch in
+    the segment program)."""
+    from deepspeed_tpu.profiling import measured_model_profile
+
+    model = create_model("moe-tiny", dtype=jnp.float32)
+    mp = measured_model_profile(model, batch_size=2, seq_len=32,
+                                repeats=2, warmup=1)
+    layer_rows = [m for m in mp.modules if m.name.startswith("layer.")]
+    assert len(layer_rows) == model.config.num_layers
+    assert all(m.latency_s > 0 for m in layer_rows)
