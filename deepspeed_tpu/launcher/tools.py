@@ -1,0 +1,76 @@
+"""Operator utility CLIs.
+
+``ssh_cli_main`` — the ``bin/ds_ssh`` analog (reference bin/ds_ssh:1): run
+one command on every host of a hostfile, pdsh when present (one fan-out
+process), plain ssh otherwise (sequential, output prefixed per host).
+"""
+
+from __future__ import annotations
+
+import shlex
+import subprocess
+import sys
+from typing import List, Optional
+
+from .runner import fetch_hostfile
+
+DEFAULT_HOSTFILE = "/job/hostfile"
+
+
+def run_on_all_hosts(command: List[str], hostfile: Optional[str] = None,
+                     dry_run: bool = False) -> int:
+    """Run ``command`` on every hostfile host. Returns the worst exit code."""
+    import os
+
+    path = hostfile or DEFAULT_HOSTFILE
+    if not os.path.exists(path):
+        # the reference's exact failure mode (bin/ds_ssh:31)
+        print(f"Missing hostfile at {path}, unable to proceed",
+              file=sys.stderr)
+        return 1
+    hosts = list(fetch_hostfile(path).keys())
+    remote = " ".join(shlex.quote(c) for c in command)
+    import shutil
+
+    if shutil.which("pdsh"):
+        cmd = ["pdsh", "-S", "-R", "ssh", "-w", ",".join(hosts), remote]
+        if dry_run:
+            print(" ".join(shlex.quote(c) for c in cmd))
+            return 0
+        return subprocess.run(cmd).returncode
+    worst = 0
+    for host in hosts:
+        cmd = ["ssh", "-o", "StrictHostKeyChecking=no", host, remote]
+        if dry_run:
+            print(" ".join(shlex.quote(c) for c in cmd))
+            continue
+        # stream line-by-line with a host prefix (pdsh behavior) — a
+        # buffered capture would show nothing until the remote command
+        # exits and grow unboundedly for long-running ones
+        proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                                stderr=subprocess.STDOUT, text=True)
+        assert proc.stdout is not None
+        for line in proc.stdout:
+            print(f"{host}: {line.rstrip()}", flush=True)
+        worst = max(worst, proc.wait())
+    return worst
+
+
+def ssh_cli_main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(
+        prog="ds-tpu-ssh",
+        description="Run a command on all hostfile hosts (the ds_ssh analog).")
+    p.add_argument("-f", "--hostfile", default=None,
+                   help=f"hostfile path (default {DEFAULT_HOSTFILE})")
+    p.add_argument("--dry-run", action="store_true",
+                   help="print the fan-out command instead of running it")
+    p.add_argument("command", nargs=argparse.REMAINDER,
+                   help="command to run on every host")
+    args = p.parse_args(argv)
+    if not args.command:
+        p.error("no command given")
+    print(f"hostfile={args.hostfile or DEFAULT_HOSTFILE}")
+    return run_on_all_hosts(args.command, hostfile=args.hostfile,
+                            dry_run=args.dry_run)
