@@ -73,8 +73,11 @@ def _build(op: str, axis: str, mesh, elems: int, dtype):
         in_spec, out_spec = P(axis), P()      # (elems,) per dev -> replicated
         global_shape = (n * elems,)
     elif op == "reduce_scatter":
-        in_spec, out_spec = P(), P(axis)      # replicated in -> (elems/n,) out
-        global_shape = (elems,)
+        # replicated (n*elems,) in -> (elems,) shard out, so the per-rank
+        # RESULT shard is `elems` and calc_bw_log's size*n convention (the
+        # NCCL-tests recvcount basis) matches all_gather's accounting
+        in_spec, out_spec = P(), P(axis)
+        global_shape = (n * elems,)
     elif op == "all_to_all":
         in_spec, out_spec = P(axis), P(axis)  # exchange along dim 0
         global_shape = (n * elems,)

@@ -51,6 +51,12 @@ SUITE = [
     ("bench_rlhf", ["python", "bench_rlhf.py"], {}),
     ("validate_kernels", ["python", "scripts/validate_kernels_tpu.py"], {}),
     ("validate_offload", ["python", "scripts/validate_offload_tpu.py"], {}),
+    # VERDICT r4 #5: fetch-vs-compute overlap + h2d utilization evidence
+    ("validate_offload_overlap",
+     ["python", "scripts/validate_offload_overlap.py"], {}),
+    ("validate_offload_overlap_1.3b",
+     ["python", "scripts/validate_offload_overlap.py"],
+     {"BENCH_OVERLAP_MODEL": "opt-1.3b", "BENCH_OVERLAP_BATCH": "4"}),
 ]
 
 

@@ -67,6 +67,31 @@ class TestParamOffloadCPU:
             _, gn, _ = eng._param_offload.train_step(batch)
         assert gn > 0.0
 
+    def test_stream_stats_and_overlap_report(self):
+        """VERDICT r4 #5 instrumentation: every step records streamed bytes
+        + achieved bandwidth, and overlap_report produces the fetch/compute/
+        step decomposition with sane bounds."""
+        eng, _ = _run(_cfg(extra_zero={
+            "offload_param": {"device": "cpu", "buffer_size": 1}}), steps=2)
+        ex = eng._param_offload
+        stats = ex.last_step_stats
+        assert stats is not None and stats["wall_s"] > 0
+        # fused path: fwd fetches all blocks, bwd all but the last
+        P = sum(ex._block_bytes)
+        elems = sum(ex._block_elems)
+        assert stats["h2d_bytes"] == 2 * P - ex._block_bytes[-1] + 12 * elems
+        assert stats["d2h_bytes"] == P + 12 * elems
+        assert stats["achieved_h2d_gbps"] > 0
+        with eng.mesh:
+            peak = ex.measure_stream_peak(sweeps=1)
+            assert peak > 0
+            batch = eng._globalize_batch(_batch(seed=3), leading_gas=True)
+            rep = ex.overlap_report(batch)
+        assert 0.0 <= rep["overlap_efficiency"] <= 1.0
+        assert rep["t_fetch_s"] > 0 and rep["t_compute_s"] > 0
+        assert rep["h2d_utilization"] > 0
+        assert rep["t_step_s"] >= 0
+
     def test_multi_layer_blocks_and_remainder(self):
         eng, off = _run(_cfg(extra_zero={
             "offload_param": {"device": "cpu", "buffer_size": 10**9}}),
