@@ -53,9 +53,11 @@ def _op_fn(op: str, axis: str):
 def _build(op: str, axis: str, mesh, elems: int, dtype):
     """Jitted program + per-device input for one (op, size) cell.
 
-    Input/output shardings mirror each op's natural layout: the *input*
-    message of ``elems`` elements lives per device (NCCL-tests convention —
-    msg size is the per-rank buffer)."""
+    Input/output shardings mirror each op's natural layout; ``elems`` is
+    the per-rank MESSAGE buffer (NCCL-tests convention): the per-device
+    input for all_reduce/all_gather/all_to_all/broadcast/pt2pt, the
+    per-rank result shard for reduce_scatter (whose input is the
+    replicated (n*elems,) buffer)."""
     n = int(mesh.shape.get(axis, 0))
     if n < 2:
         raise ValueError(

@@ -17,7 +17,7 @@ BENCH_OVERLAP_SEQ (1024), BENCH_OVERLAP_BUFFER (offload block bytes).
 import json
 import os
 import sys
-import time
+
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
