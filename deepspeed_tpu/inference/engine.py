@@ -62,6 +62,11 @@ class InferenceConfig:
     #   dtype='int8'/'int4' sets this.
     quantize_groups: Optional[int] = None  # int4 group size along K (None =>
     #   one group per output channel; reference quantization_settings groups)
+    quantize_activations: bool = False  # W8A8 decode: per-row dynamic int8
+    #   activation quantization feeds the MXU's native s8xs8 path — removes
+    #   the weight-convert VPU bottleneck of the weight-only kernel (the
+    #   reference's int8 path also quantizes activations,
+    #   pt_binding.cpp quantize_activation). dtype='w8a8' sets this.
     compile_cache: bool = True         # persistent XLA compile cache
     #   (utils/compile_cache.py); DSTPU_COMPILE_CACHE overrides dir/disables
 
@@ -72,6 +77,10 @@ class InferenceConfig:
         # the weights to int8 and silently destroy them)
         if self.dtype in ("int8", jnp.int8):
             self.quantize_bits = 8
+            self.dtype = jnp.bfloat16
+        elif self.dtype in ("w8a8",):
+            self.quantize_bits = 8
+            self.quantize_activations = True
             self.dtype = jnp.bfloat16
         elif self.dtype in ("int4",):
             self.quantize_bits = 4
@@ -87,6 +96,9 @@ class InferenceConfig:
                 "4 (nibble-packed, groupwise) are supported")
         if self.quantize_groups is not None and self.quantize_bits != 4:
             raise ValueError("quantize_groups applies to int4 only")
+        if self.quantize_activations and self.quantize_bits != 8:
+            raise ValueError("quantize_activations (W8A8) requires int8 "
+                             "weights (quantize_bits=8 / dtype='w8a8')")
 
 
 def _reject_dtype(name: str):
@@ -163,6 +175,23 @@ class InferenceEngine:
                 raise ValueError(
                     f"expert_parallel={ep} must divide "
                     f"moe_num_experts={cfg.moe_num_experts}")
+        if config.quantize_activations:
+            # W8A8 engages through the decode-kernel gate; a config where
+            # the gate can never pass must not silently publish weight-only
+            # numbers under the w8a8 label
+            if tp > 1:
+                raise NotImplementedError(
+                    "quantize_activations (W8A8) + tensor_parallel > 1 is "
+                    "not supported — the s8xs8 decode kernel is single-"
+                    "device (weight-only int8 supports TP)")
+            dims = (cfg.hidden_size, cfg.num_heads * cfg.head_dim,
+                    cfg.ffn_hidden_size)
+            if any(d % 128 for d in dims):
+                logger.warning(
+                    f"w8a8: model dims {dims} are not all multiples of 128 "
+                    "— the s8xs8 kernel gate will not engage and decode "
+                    "serves the weight-only int8 path")
+            cfg.a8_decode = True
 
         # TP sharding plan (no fsdp axis — reference inference shards
         # qkv/mlp across the mp group only, replicating the rest); MoE

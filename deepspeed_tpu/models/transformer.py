@@ -105,6 +105,10 @@ class TransformerConfig:
     #   'einsum' dense one-hot (the GShard/reference formulation; fallback)
     moe_use_residual: bool = False    # PR-MoE: dense residual MLP + learned
     #   2-way coefficient mix (reference moe/layer.py use_residual)
+    a8_decode: bool = False           # W8A8: decode-shaped int8 weight sites
+    #   quantize the activation row too and ride the MXU's s8xs8 path
+    #   (set by InferenceEngine from InferenceConfig.quantize_activations;
+    #   docs/quant_decode_analysis.md)
 
     def __post_init__(self):
         if self.num_kv_heads is None:
@@ -517,7 +521,8 @@ def _dense(w: Any, dtype: Any) -> jax.Array:
     return w
 
 
-def _qeinsum(spec: str, x: jax.Array, w: Any, dtype: Any) -> jax.Array:
+def _qeinsum(spec: str, x: jax.Array, w: Any, dtype: Any,
+             a8: bool = False) -> jax.Array:
     """Weight-site einsum with on-the-fly int8 dequant.
 
     Decode-shaped calls (few tokens) route through the Pallas int8 matmul
@@ -534,9 +539,10 @@ def _qeinsum(spec: str, x: jax.Array, w: Any, dtype: Any) -> jax.Array:
         if (S * B <= 8 and q8.ndim == 2 and _kernels_active()
                 and _tp_world() == 1
                 and q8.shape[0] % 128 == 0 and q8.shape[1] % 128 == 0):
-            from ..ops.quant_matmul import int8_matmul
+            from ..ops.quant_matmul import int8_a8_matmul, int8_matmul
 
-            out = int8_matmul(x.reshape(B * S, -1), q8, s, out_dtype=dtype)
+            fn = int8_a8_matmul if a8 else int8_matmul
+            out = fn(x.reshape(B * S, -1), q8, s, out_dtype=dtype)
             return out.reshape(x.shape[:-1] + (q8.shape[1],))
         x, q8 = lax.optimization_barrier((x, q8))
         out = jnp.einsum(spec, x, q8.astype(dtype))
@@ -758,9 +764,9 @@ def _layer_forward(cfg: TransformerConfig, x: jax.Array, layer: Dict[str, Any],
         from ..compression.compress import fake_quant_activation
 
         h = fake_quant_activation(h, cfg.act_quant_bits)
-    q = _qeinsum("bsh,hd->bsd", h, layer["attn"]["wq"], cfg.dtype)
-    k = _qeinsum("bsh,hd->bsd", h, layer["attn"]["wk"], cfg.dtype)
-    v = _qeinsum("bsh,hd->bsd", h, layer["attn"]["wv"], cfg.dtype)
+    q = _qeinsum("bsh,hd->bsd", h, layer["attn"]["wq"], cfg.dtype, a8=cfg.a8_decode)
+    k = _qeinsum("bsh,hd->bsd", h, layer["attn"]["wk"], cfg.dtype, a8=cfg.a8_decode)
+    v = _qeinsum("bsh,hd->bsd", h, layer["attn"]["wv"], cfg.dtype, a8=cfg.a8_decode)
     if "bq" in layer["attn"]:
         q = q + layer["attn"]["bq"]
         k = k + layer["attn"]["bk"]
@@ -927,7 +933,7 @@ def _layer_forward(cfg: TransformerConfig, x: jax.Array, layer: Dict[str, Any],
             # Ulysses inverse all-to-all on the 4D tensor (see attn_out_spec)
             attn = constrain(attn, out_spec)
     attn = attn.reshape(B, S, N * D)
-    attn_out = _qeinsum("bsd,dh->bsh", attn, layer["attn"]["wo"], cfg.dtype)
+    attn_out = _qeinsum("bsd,dh->bsh", attn, layer["attn"]["wo"], cfg.dtype, a8=cfg.a8_decode)
     if "bo" in layer["attn"]:
         attn_out = attn_out + layer["attn"]["bo"]
     if cache is None:
@@ -990,12 +996,12 @@ def _layer_forward(cfg: TransformerConfig, x: jax.Array, layer: Dict[str, Any],
             ).astype(h.dtype)
             mlp_out = mlp_out * coef[..., 0:1] + res_out * coef[..., 1:2]
     elif cfg.activation == "swiglu":
-        gate = _qeinsum("bsh,hf->bsf", h, layer["mlp"]["w_gate"], cfg.dtype)
-        up = _qeinsum("bsh,hf->bsf", h, layer["mlp"]["w_up"], cfg.dtype)
+        gate = _qeinsum("bsh,hf->bsf", h, layer["mlp"]["w_gate"], cfg.dtype, a8=cfg.a8_decode)
+        up = _qeinsum("bsh,hf->bsf", h, layer["mlp"]["w_up"], cfg.dtype, a8=cfg.a8_decode)
         inner = jax.nn.silu(gate) * up
-        mlp_out = _qeinsum("bsf,fh->bsh", inner, layer["mlp"]["w_down"], cfg.dtype)
+        mlp_out = _qeinsum("bsf,fh->bsh", inner, layer["mlp"]["w_down"], cfg.dtype, a8=cfg.a8_decode)
     else:
-        inner = _qeinsum("bsh,hf->bsf", h, layer["mlp"]["w_up"], cfg.dtype) + layer["mlp"]["b_up"]
+        inner = _qeinsum("bsh,hf->bsf", h, layer["mlp"]["w_up"], cfg.dtype, a8=cfg.a8_decode) + layer["mlp"]["b_up"]
         if cfg.activation == "relu":
             inner = jax.nn.relu(inner)
         elif cfg.activation == "quick_gelu":
@@ -1004,7 +1010,7 @@ def _layer_forward(cfg: TransformerConfig, x: jax.Array, layer: Dict[str, Any],
         else:
             inner = jax.nn.gelu(inner,
                                 approximate=cfg.activation != "gelu-exact")
-        mlp_out = _qeinsum("bsf,fh->bsh", inner, layer["mlp"]["w_down"], cfg.dtype) + layer["mlp"]["b_down"]
+        mlp_out = _qeinsum("bsf,fh->bsh", inner, layer["mlp"]["w_down"], cfg.dtype, a8=cfg.a8_decode) + layer["mlp"]["b_down"]
     if cache is None:
         mlp_out = _dropout(mlp_out, cfg, salt=37)
     if cfg.parallel_residual:
@@ -1175,7 +1181,7 @@ def head_logits(params: Dict[str, Any], x: jax.Array,
     if cfg.tie_embeddings:
         logits = jnp.einsum("bsh,vh->bsv", x, params["embed"]["tokens"])
     else:
-        logits = _qeinsum("bsh,hv->bsv", x, params["lm_head"], cfg.dtype)
+        logits = _qeinsum("bsh,hv->bsv", x, params["lm_head"], cfg.dtype, a8=cfg.a8_decode)
         if "lm_head_b" in params:
             logits = logits + params["lm_head_b"]
     return logits

@@ -102,6 +102,97 @@ def reference_int8_matmul(x, q8, scale, out_dtype=None):
 
 
 # ---------------------------------------------------------------------------
+# W8A8 decode GEMM: s8 x s8 on the MXU (dynamic activation quantization)
+# ---------------------------------------------------------------------------
+#
+# The weight-only kernel above is VPU-BOUND, not DMA-bound: converting a
+# (1024, 1024) int8 tile to bf16 costs ~1M VPU lane-ops (~2 us) while its
+# DMA takes ~1.3 us at v5e HBM rate — the convert cannot hide, capping the
+# kernel near ~60% of the int8 bandwidth roofline (exactly the r04
+# bench_infer_int8 deficit). Feeding the MXU s8 x s8 removes the weight
+# convert entirely: only the (M<=8, K) ACTIVATION row quantizes per call
+# (K elements, trivial). Per-token absmax scaling keeps the decode GEMV's
+# numerics within int8 rounding of the weight-only path (the reference's
+# int8 path quantizes activations too — quantize_activation in
+# csrc/transformer/inference/csrc/pt_binding.cpp).
+
+
+def quantize_activation_rows(x: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """(M, K) float -> (int8 values, (M, 1) fp32 per-row scales)."""
+    absmax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    s = jnp.where(absmax == 0.0, 1.0, absmax / 127.0)
+    q = jnp.clip(jnp.round(x.astype(jnp.float32) / s), -127, 127)
+    return q.astype(jnp.int8), s
+
+
+def _kernel_a8(x_ref, sx_ref, q_ref, s_ref, o_ref, acc, *, nk: int):
+    k = pl.program_id(1)
+
+    @pl.when(k == 0)
+    def _init():
+        acc[:] = jnp.zeros_like(acc)
+
+    # s8 x s8 -> s32 rides the MXU's native 8-bit path — no weight convert
+    acc[:] += jax.lax.dot_general(
+        x_ref[:], q_ref[:], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)
+
+    @pl.when(k == nk - 1)
+    def _finalize():
+        o_ref[:] = (acc[:].astype(jnp.float32)
+                    * sx_ref[:].astype(jnp.float32)
+                    * s_ref[0].astype(jnp.float32)[None, :]
+                    ).astype(o_ref.dtype)
+
+
+def int8_a8_matmul(x: jax.Array, q8: jax.Array, scale: jax.Array,
+                   out_dtype=None, interpret: bool = False) -> jax.Array:
+    """W8A8: x (M, K) float is row-quantized to int8 on the fly, then
+    s8 x s8 -> s32 MXU GEMM with the product of row/channel scales applied
+    at the end. Decode-phase drop-in for :func:`int8_matmul` when dynamic
+    activation quantization is acceptable."""
+    M, K = x.shape
+    N = q8.shape[1]
+    if K % 128 or N % 128:
+        raise ValueError(f"int8_a8_matmul needs K,N % 128 == 0, got {K}x{N}")
+    out_dtype = out_dtype or x.dtype
+    xq, sx = quantize_activation_rows(x)
+    mpad = (-M) % 8
+    if mpad:
+        xq = jnp.pad(xq, ((0, mpad), (0, 0)))
+        sx = jnp.pad(sx, ((0, mpad), (0, 0)))
+    Mp = xq.shape[0]
+    bk, bn = _tile(K, BK), _tile(N, BN)
+    nk = K // bk
+    out = pl.pallas_call(
+        functools.partial(_kernel_a8, nk=nk),
+        grid=(N // bn, nk),
+        in_specs=[
+            pl.BlockSpec((Mp, bk), lambda n, k: (0, k)),
+            pl.BlockSpec((Mp, 1), lambda n, k: (0, 0)),
+            pl.BlockSpec((bk, bn), lambda n, k: (k, n)),
+            pl.BlockSpec((1, bn), lambda n, k: (0, n)),
+        ],
+        out_specs=pl.BlockSpec((Mp, bn), lambda n, k: (0, n)),
+        out_shape=jax.ShapeDtypeStruct((Mp, N), out_dtype),
+        scratch_shapes=[pltpu.VMEM((Mp, bn), jnp.int32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=interpret,
+    )(xq, sx, q8, scale)
+    return out[:M]
+
+
+def reference_int8_a8_matmul(x, q8, scale, out_dtype=None):
+    """Oracle: explicit activation quantization + integer matmul."""
+    out_dtype = out_dtype or x.dtype
+    xq, sx = quantize_activation_rows(x)
+    acc = xq.astype(jnp.int32) @ q8.astype(jnp.int32)
+    return (acc.astype(jnp.float32) * sx * scale.astype(jnp.float32)
+            ).astype(out_dtype)
+
+
+# ---------------------------------------------------------------------------
 # int4: nibble-packed weights + per-group scales
 # ---------------------------------------------------------------------------
 #

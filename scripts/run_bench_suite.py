@@ -25,6 +25,10 @@ SUITE = [
      {"BENCH_INFER_DTYPE": "int8"}),
     ("bench_infer_int4", ["python", "bench_infer.py"],
      {"BENCH_INFER_DTYPE": "int4"}),
+    # W8A8: s8xs8 MXU decode (VERDICT r4 #3 — the weight-only kernel is
+    # VPU-convert-bound; this removes the convert entirely)
+    ("bench_infer_w8a8", ["python", "bench_infer.py"],
+     {"BENCH_INFER_DTYPE": "w8a8"}),
     # MoE expert-parallel inference (VERDICT r4 #2) + BLOOM-7B kernel-
     # injected inference as tracked config #5 names it (VERDICT r4 #6)
     ("bench_infer_moe8e", ["python", "bench_infer.py"],

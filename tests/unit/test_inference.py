@@ -703,6 +703,42 @@ class TestMoEInference:
             init_inference("moe-tiny", expert_parallel=3)
 
 
+class TestW8A8:
+    """dtype='w8a8': int8 weights + dynamic int8 activation quantization on
+    decode-shaped GEMMs (s8xs8 MXU). Storage identical to int8 weight-only;
+    only the decode compute path differs."""
+
+    def test_config_normalisation_and_validation(self):
+        from deepspeed_tpu.inference.engine import InferenceConfig
+
+        cfg = InferenceConfig(dtype="w8a8")
+        assert cfg.quantize_bits == 8 and cfg.quantize_activations
+        assert cfg.dtype == jnp.bfloat16
+        with pytest.raises(ValueError, match="W8A8"):
+            InferenceConfig(dtype="int4", quantize_activations=True)
+
+    @pytest.mark.slow
+    def test_generate_engine_path(self):
+        """Same weights served w8a8 vs int8 weight-only through the engine.
+        On CPU the s8 kernel gate never engages (kernel numerics are pinned
+        in tests/kernels TestInt8A8Matmul), so the two engines must produce
+        IDENTICAL tokens here — this checks the engine plumbing (config
+        threading, per-engine isolation), not the kernel."""
+        e_int8 = init_inference("tiny", dtype="int8", max_out_tokens=128)
+        e_a8 = init_inference("tiny", dtype="w8a8", max_out_tokens=128)
+        assert e_a8.model.config.a8_decode is True
+        assert e_int8.model.config.a8_decode is False   # per-engine config
+        e_a8.params = e_int8.params
+        prompt = np.random.RandomState(0).randint(0, 250, (1, 12))
+        out8 = np.asarray(e_int8.generate(prompt, max_new_tokens=4))
+        outa = np.asarray(e_a8.generate(prompt, max_new_tokens=4))
+        np.testing.assert_array_equal(out8, outa)
+
+    def test_w8a8_tp_rejected(self, devices8):
+        with pytest.raises(NotImplementedError, match="W8A8"):
+            init_inference("tiny-llama", dtype="w8a8", tensor_parallel=2)
+
+
 @pytest.mark.slow
 class TestInt8WeightOnly:
     """Weight-only quantized inference (reference init_inference dtype=int8
