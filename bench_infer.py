@@ -57,13 +57,27 @@ def main() -> None:
                          "mislabelled benchmark")
     dtype = jnp.bfloat16 if dtype_name == "bf16" else dtype_name
 
-    engine = init_inference(model_name, dtype=dtype, max_out_tokens=arena)
-    cfg = engine.model.config
-    rng = np.random.RandomState(0)
-    prompt = rng.randint(0, cfg.vocab_size, (1, prompt_len))
+    try:
+        engine = init_inference(model_name, dtype=dtype, max_out_tokens=arena)
+        cfg = engine.model.config
+        rng = np.random.RandomState(0)
+        prompt = rng.randint(0, cfg.vocab_size, (1, prompt_len))
 
-    # warmup (compiles prefill + decode)
-    engine.generate(prompt, max_new_tokens=n_new)
+        # warmup (compiles prefill + decode)
+        engine.generate(prompt, max_new_tokens=n_new)
+    except Exception as e:  # noqa: BLE001 — structured OOM record below
+        msg = str(e)
+        if "RESOURCE_EXHAUSTED" in msg or "out of memory" in msg.lower():
+            print(json.dumps({
+                "metric": f"{model_name}_{dtype_name}_p50_ttft_ms",
+                "value": None, "unit": "ms", "vs_baseline": None,
+                "oom": True,
+                "single_chip_caveat": (
+                    f"{model_name} at {dtype_name} exceeds one chip's HBM "
+                    "(use int8/int4 weight storage or TP>1)"),
+                "reason": msg[-300:],
+            }))
+        raise
 
     ttfts = []
     t_all = []

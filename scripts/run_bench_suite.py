@@ -35,6 +35,10 @@ SUITE = [
      {"BENCH_INFER_MODEL": "moe-gpt-125m-8e"}),
     ("bench_infer_bloom7b", ["python", "bench_infer.py"],
      {"BENCH_INFER_MODEL": "bloom-7b"}),
+    # bf16 bloom-7b (14.1 GB weights + 250k-vocab logits) is borderline on
+    # 16 GB — the int8 variant is the reference's kernel-injected headline
+    ("bench_infer_bloom7b_int8", ["python", "bench_infer.py"],
+     {"BENCH_INFER_MODEL": "bloom-7b", "BENCH_INFER_DTYPE": "int8"}),
     # tracked config #2 as specified: resident (no-offload) partitioned-Adam
     # ZeRO — 1.3B records the honest single-chip OOM caveat, 125m the number
     ("bench_zero2_resident_opt1.3b", ["python", "bench_zero.py"],
