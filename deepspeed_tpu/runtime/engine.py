@@ -1246,6 +1246,24 @@ class TrainEngine:
             cfg, self.train_micro_batch_size_per_gpu(), cfg.max_seq_len)
         return {"profile": prof, "table": prof.table()}
 
+    def print_model_profile(self, batch_size: Optional[int] = None,
+                            seq_len: Optional[int] = None,
+                            output_file: Optional[str] = None) -> None:
+        """MEASURED per-module latency/GFLOPs tree (reference
+        FlopsProfiler.print_model_profile, profiler.py:239): runs the
+        engine's model segment-by-segment and prints depth-0/1/2 rows with
+        median wall ms, XLA-counted GFLOPs, params and achieved FLOPS."""
+        from ..profiling import get_model_profile
+
+        cfg = self.model.config
+        if cfg is None:
+            raise ValueError("flops profile needs a transformer Model")
+        get_model_profile(
+            self.model,
+            batch_size or self.train_micro_batch_size_per_gpu(),
+            seq_len or min(cfg.max_seq_len, 512),
+            print_profile=True, measured=True, output_file=output_file)
+
     def start_profile(self, log_dir: str = "/tmp/dstpu_trace") -> None:
         """jax profiler trace (the nsys/NVTX analog; view in XProf)."""
         jax.profiler.start_trace(log_dir)

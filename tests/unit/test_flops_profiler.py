@@ -95,3 +95,18 @@ def test_measured_profile_moe_model():
     layer_rows = [m for m in mp.modules if m.name.startswith("layer.")]
     assert len(layer_rows) == model.config.num_layers
     assert all(m.latency_s > 0 for m in layer_rows)
+
+
+def test_engine_print_model_profile(capsys):
+    """Engine-level print_model_profile (reference FlopsProfiler hook)."""
+    import deepspeed_tpu
+
+    model = create_model("tiny", dtype=jnp.float32, num_layers=2)
+    engine, *_ = deepspeed_tpu.initialize(model=model, config={
+        "train_micro_batch_size_per_gpu": 2,
+        "steps_per_print": 1000,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+    })
+    engine.print_model_profile(batch_size=2, seq_len=32)
+    out = capsys.readouterr().out
+    assert "measured model profile" in out and "layer.1" in out
