@@ -371,14 +371,24 @@ def load_checkpoint(load_dir: str, tag: Optional[str] = None,
     return params, opt_state, meta.get("client_state", {})
 
 
-def save_flat_weights(params: Any, path: str) -> None:
+def _write_flat_npz(path: str, flat: Dict[str, np.ndarray],
+                    dtypes: Dict[str, str]) -> str:
+    """The ONE flat-npz writer; returns the REAL on-disk path (np.savez
+    appends '.npz' silently when the suffix is missing)."""
+    if not path.endswith(".npz"):
+        path = path + ".npz"
+    np.savez(path, __dtypes__=json.dumps(dtypes), **flat)
+    return path
+
+
+def save_flat_weights(params: Any, path: str) -> str:
     """Consolidated single-file export (reference save_16bit_model /
     zero_to_fp32 output shape). Gathers full arrays — use for model export,
-    not for training checkpoints."""
+    not for training checkpoints. Returns the real on-disk path."""
     flat = {k: _to_numpy(jax.device_get(v))
             for k, v in _flatten_with_keys(params).items()}
     dtypes = {k: str(v.dtype) for k, v in _flatten_with_keys(params).items()}
-    np.savez(path, __dtypes__=json.dumps(dtypes), **flat)
+    return _write_flat_npz(path, flat, dtypes)
 
 
 def load_flat_weights(path: str) -> Dict[str, np.ndarray]:
@@ -386,6 +396,84 @@ def load_flat_weights(path: str) -> Dict[str, np.ndarray]:
     dtypes = json.loads(str(data["__dtypes__"]))
     return {k: _from_numpy(data[k], dtypes[k]) for k in data.files
             if k != "__dtypes__"}
+
+
+def consolidate_checkpoint(load_dir: str, out_path: str,
+                           tag: Optional[str] = None,
+                           prefer_master: bool = True) -> str:
+    """OFFLINE sharded-checkpoint → consolidated fp32 flat file — the
+    ``zero_to_fp32.py`` analog (reference utils/zero_to_fp32.py:198
+    ``_get_fp32_state_dict_from_zero_checkpoint``; the reference copies that
+    script into every checkpoint dir, engine.py:3126). Needs NO engine, NO
+    devices and NO live model: shards are assembled straight from the
+    format-2 metadata via memory-mapped reads.
+
+    ``prefer_master``: take each param's fp32 MASTER copy from the saved
+    optimizer state when present (the reference's semantics — the fp32
+    master is the truth under mixed precision), falling back to the
+    compute-dtype param cast to fp32. Output loads with
+    :func:`load_flat_weights` / ``init_inference(checkpoint=...)``."""
+    tag = tag or read_latest_tag(load_dir)
+    if tag is None:
+        raise FileNotFoundError(f"no 'latest' file in {load_dir} and no "
+                                "tag given")
+    ckpt_dir = os.path.join(load_dir, tag)
+    arrays_dir = os.path.join(ckpt_dir, "arrays")
+    meta_path = os.path.join(ckpt_dir, "metadata.json")
+    if not os.path.exists(meta_path):
+        raise FileNotFoundError(f"{ckpt_dir}: no metadata.json — not a "
+                                "deepspeed_tpu checkpoint dir")
+    with open(meta_path) as fh:
+        meta = json.load(fh)
+    if meta.get("format") != 2:
+        raise ValueError(f"{ckpt_dir}: checkpoint format "
+                         f"{meta.get('format')!r} is not supported — "
+                         "re-save with this version (format 2)")
+    arrays = meta["arrays"]
+
+    # map each param key to its fp32 master source. Two layouts exist:
+    #  * standard engines:      opt##master##<param path>
+    #  * the param-offload tier saves layer masters as a LIST in the layers
+    #    tree's flatten order (opt##layer_master##<i>) plus resident
+    #    masters under opt##res_master##<resident path>
+    layers_prefix = _SEP.join(("params", "layers")) + _SEP
+    layer_keys = [k for k in arrays if k.startswith(layers_prefix)]
+    master_of: Dict[str, str] = {}
+    for full_key in arrays:
+        if not full_key.startswith("params" + _SEP):
+            continue
+        pkey = full_key[len("params" + _SEP):]
+        for cand in (_SEP.join(("opt", "master", pkey)),
+                     _SEP.join(("opt", "res_master", pkey))):
+            if cand in arrays:
+                master_of[full_key] = cand
+    for i, k in enumerate(layer_keys):
+        cand = _SEP.join(("opt", "layer_master", str(i)))
+        if cand in arrays:
+            master_of[k] = cand
+
+    flat: Dict[str, np.ndarray] = {}
+    used_master = 0
+    for full_key in arrays:
+        if not full_key.startswith("params" + _SEP):
+            continue
+        pkey = full_key[len("params" + _SEP):]
+        src = full_key
+        if prefer_master and full_key in master_of:
+            src = master_of[full_key]
+            used_master += 1
+        src_info = arrays[src]
+        flat[pkey] = _assemble_slice(
+            arrays_dir, src_info,
+            [[0, d] for d in src_info["shape"]], np.float32)
+    if not flat:
+        raise ValueError(f"{ckpt_dir}: no params arrays in metadata.json")
+    if prefer_master and used_master == 0:
+        logger.warning(
+            f"{ckpt_dir}: no fp32 master arrays found in the saved "
+            "optimizer state — exporting compute-dtype params cast to fp32")
+    dtypes = {k: "float32" for k in flat}
+    return _write_flat_npz(out_path, flat, dtypes)
 
 
 def _validate_tag(tag: str, mode: str) -> None:

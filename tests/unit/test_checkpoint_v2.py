@@ -117,3 +117,36 @@ def test_partial_coverage_rejected(tmp_path, mesh8):
             str(tmp_path), "t1",
             params_template=({"w": jnp.zeros((8, 8))},
                              {"w": NamedSharding(mesh8, P("data", None))}))
+
+
+def test_consolidate_zero_to_fp32(tmp_path, mesh8):
+    """Offline zero_to_fp32 analog: sharded checkpoint -> consolidated
+    fp32 flat file preferring the optimizer's fp32 master, no engine or
+    devices needed at conversion time."""
+    from deepspeed_tpu.runtime.checkpoint import (consolidate_checkpoint,
+                                                  load_flat_weights)
+
+    rng = np.random.RandomState(0)
+    master = rng.randn(8, 8).astype(np.float32)
+    params = {"w": _sharded(mesh8, jnp.asarray(master, jnp.bfloat16),
+                            P("data", None)),
+              "b": _sharded(mesh8, jnp.ones((4,), jnp.bfloat16), P())}
+    opt = {"master": {"w": _sharded(mesh8, jnp.asarray(master),
+                                    P("data", None)),
+                      "b": _sharded(mesh8, jnp.ones((4,), jnp.float32), P())},
+           "count": jnp.int32(3)}
+    save_checkpoint(str(tmp_path), "t1", params, opt_state=opt)
+    out = consolidate_checkpoint(str(tmp_path), str(tmp_path / "fp32.npz"))
+    flat = load_flat_weights(out)
+    assert set(flat) == {"w", "b"}
+    assert flat["w"].dtype == np.float32
+    # EXACT fp32 master, not the bf16-rounded param
+    np.testing.assert_array_equal(flat["w"], master)
+    assert np.abs(np.asarray(flat["w"], np.float32)
+                  - np.asarray(params["w"], np.float32)).max() > 0
+    # --no-master: bf16 params cast to fp32
+    out2 = consolidate_checkpoint(str(tmp_path), str(tmp_path / "p.npz"),
+                                  prefer_master=False)
+    flat2 = load_flat_weights(out2)
+    np.testing.assert_array_equal(
+        flat2["w"], np.asarray(params["w"], np.float32))

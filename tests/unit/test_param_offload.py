@@ -67,6 +67,45 @@ class TestParamOffloadCPU:
             _, gn, _ = eng._param_offload.train_step(batch)
         assert gn > 0.0
 
+    def test_zero_to_fp32_consolidation_uses_offload_masters(self):
+        """ds-tpu-zero-to-fp32 over an OFFLOAD checkpoint: the offline
+        consolidator must pick the fp32 masters from the layer_master/
+        res_master layout, not fall back to bf16-rounded params."""
+        import tempfile
+
+        from deepspeed_tpu.runtime.checkpoint import (consolidate_checkpoint,
+                                                      load_flat_weights)
+
+        cfg = _cfg(extra_zero={
+            "offload_param": {"device": "cpu", "buffer_size": 1}})
+        cfg["bf16"] = {"enabled": True}
+        mesh_mod.reset_mesh()
+        model = build_model(TransformerConfig(
+            vocab_size=128, hidden_size=32, num_layers=4, num_heads=2,
+            max_seq_len=32, dtype=jnp.bfloat16, tie_embeddings=True))
+        engine, *_ = ds.initialize(model=model, config=cfg,
+                                   rng=jax.random.PRNGKey(7))
+        engine.train_batch(batch=_batch())
+        d = tempfile.mkdtemp()
+        engine.save_checkpoint(d, tag="t1")
+        out = consolidate_checkpoint(d, f"{d}/fp32")   # no .npz on purpose
+        assert out.endswith(".npz")
+        flat = load_flat_weights(out)
+        ex = engine._param_offload
+        # resident master exact
+        np.testing.assert_array_equal(
+            flat["embed##tokens"],
+            np.asarray(jax.device_get(ex._res_master["embed"]["tokens"]),
+                       np.float32))
+        # a layer master exact (flatten-order list layout)
+        masters = ex._opt_leaves_np("master")
+        lkeys = [k for k in flat if k.startswith("layers##")]
+        got = flat[lkeys[0]]
+        np.testing.assert_array_equal(got, np.asarray(masters[0], np.float32))
+        # masters differ from the bf16-rounded params (non-vacuous)
+        p = np.asarray(ex._block_host_leaves(0)[0], np.float32)
+        assert np.abs(np.asarray(masters[0][:1], np.float32) - p[:1]).max() > 0
+
     def test_stream_stats_and_overlap_report(self):
         """VERDICT r4 #5 instrumentation: every step records streamed bytes
         + achieved bandwidth, and overlap_report produces the fetch/compute/
