@@ -1277,11 +1277,21 @@ class TrainEngine:
             from ..monitor.monitor import MonitorMaster
 
             self._monitor = MonitorMaster(self.config.monitor)
-        self._monitor.write_events([
+        events = [
             ("Train/Samples/train_loss", loss, self.global_steps),
             ("Train/Samples/lr", self._last_lr, self.global_steps),
             ("Train/Samples/grad_norm", grad_norm, self.global_steps),
-        ])
+        ]
+        if (self._param_offload is not None
+                and self._param_offload.last_step_stats):
+            st = self._param_offload.last_step_stats
+            events += [
+                ("Train/Offload/h2d_gbps", st["achieved_h2d_gbps"],
+                 self.global_steps),
+                ("Train/Offload/total_gbps", st["achieved_total_gbps"],
+                 self.global_steps),
+            ]
+        self._monitor.write_events(events)
 
     # -- checkpoint (reference engine.py:2792 save_checkpoint) ------------
     def save_checkpoint(self, save_dir: str, tag: Optional[str] = None,
