@@ -51,9 +51,9 @@ def main() -> None:
     # 'int8'/'int4' => weight-only quantized storage (compute bf16): halves/
     # quarters the weight side of the decode roofline denominator
     dtype_name = os.environ.get("BENCH_INFER_DTYPE", "bf16")
-    if dtype_name not in ("bf16", "int8", "int4", "w8a8"):
-        raise SystemExit(f"BENCH_INFER_DTYPE must be bf16|int8|int4|w8a8, "
-                         f"got '{dtype_name}' — refusing to run a "
+    if dtype_name not in ("bf16", "int8", "int4", "w8a8", "w4a8"):
+        raise SystemExit(f"BENCH_INFER_DTYPE must be bf16|int8|int4|w8a8|"
+                         f"w4a8, got '{dtype_name}' — refusing to run a "
                          "mislabelled benchmark")
     dtype = jnp.bfloat16 if dtype_name == "bf16" else dtype_name
 

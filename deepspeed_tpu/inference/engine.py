@@ -82,6 +82,10 @@ class InferenceConfig:
             self.quantize_bits = 8
             self.quantize_activations = True
             self.dtype = jnp.bfloat16
+        elif self.dtype in ("w4a8",):
+            self.quantize_bits = 4
+            self.quantize_activations = True
+            self.dtype = jnp.bfloat16
         elif self.dtype in ("int4",):
             self.quantize_bits = 4
             self.dtype = jnp.bfloat16
@@ -96,9 +100,9 @@ class InferenceConfig:
                 "4 (nibble-packed, groupwise) are supported")
         if self.quantize_groups is not None and self.quantize_bits != 4:
             raise ValueError("quantize_groups applies to int4 only")
-        if self.quantize_activations and self.quantize_bits != 8:
-            raise ValueError("quantize_activations (W8A8) requires int8 "
-                             "weights (quantize_bits=8 / dtype='w8a8')")
+        if self.quantize_activations and self.quantize_bits not in (4, 8):
+            raise ValueError("quantize_activations (W8A8/W4A8) requires "
+                             "int8 or int4 weights (dtype='w8a8'/'w4a8')")
 
 
 def _reject_dtype(name: str):

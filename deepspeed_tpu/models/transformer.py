@@ -559,9 +559,10 @@ def _qeinsum(spec: str, x: jax.Array, w: Any, dtype: Any,
                 and _tp_world() == 1
                 and K2 % 128 == 0 and N % 128 == 0
                 and (G == 1 or gs % 128 == 0)):
-            from ..ops.quant_matmul import int4_matmul
+            from ..ops.quant_matmul import int4_a8_matmul, int4_matmul
 
-            out = int4_matmul(x.reshape(B * S, -1), q4, s, out_dtype=dtype)
+            fn = int4_a8_matmul if a8 else int4_matmul
+            out = fn(x.reshape(B * S, -1), q4, s, out_dtype=dtype)
             return out.reshape(x.shape[:-1] + (N,))
         x, q4 = lax.optimization_barrier((x, q4))
         return jnp.einsum(spec, x, unpack_int4(q4, s, dtype))

@@ -8,7 +8,9 @@ from .flash_attention import flash_attention, make_attention_impl
 from .fused_adam import fused_adam_flat, reference_adam_flat
 from .fused_lamb import fused_lamb_flat, reference_lamb_flat
 from .normalization import fused_layer_norm, reference_layer_norm
-from .quant_matmul import (int4_matmul, int8_a8_matmul, int8_matmul,
+from .quant_matmul import (int4_a8_matmul, int4_matmul,
+                           int8_a8_matmul, int8_matmul,
+                           reference_int4_a8_matmul,
                            quantize_activation_rows, quantize_int4,
                            reference_int8_a8_matmul,
                            reference_int4_matmul, reference_int8_matmul,
@@ -34,6 +36,9 @@ register_op("quantize_symmetric", quantize_symmetric,
 register_op("decode_attention", decode_attention,
             reference=reference_decode_attention,
             description="single-query KV-cache decode attention (GQA, alibi)")
+register_op("int4_a8_matmul", int4_a8_matmul,
+            reference=reference_int4_a8_matmul,
+            description="W4A8 GEMM (s8 unpack + s8xs8 MXU)")
 register_op("int8_a8_matmul", int8_a8_matmul,
             reference=reference_int8_a8_matmul,
             description="W8A8 GEMM (dynamic act quant, s8xs8 MXU)")
@@ -68,6 +73,7 @@ __all__ = [
     "quantize_symmetric", "dequantize_symmetric", "fake_quantize",
     "reference_quantize_symmetric", "int8_matmul", "reference_int8_matmul",
     "int8_a8_matmul", "reference_int8_a8_matmul", "quantize_activation_rows",
+    "int4_a8_matmul", "reference_int4_a8_matmul",
     "int4_matmul", "reference_int4_matmul", "quantize_int4", "unpack_int4",
     "diffusers_attention", "fused_group_norm",
     "reference_group_norm", "available_ops", "get_op",

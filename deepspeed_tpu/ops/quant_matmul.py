@@ -192,6 +192,101 @@ def reference_int8_a8_matmul(x, q8, scale, out_dtype=None):
             ).astype(out_dtype)
 
 
+def _kernel4_a8(xl_ref, xh_ref, sx_ref, q_ref, s_ref, o_ref, acc, *,
+                nk2: int, bk2: int, gs: int, K2: int):
+    k = pl.program_id(1)
+
+    @pl.when(k == 0)
+    def _init():
+        acc[:] = jnp.zeros_like(acc)
+
+    q = q_ref[:].astype(jnp.int32)
+    lo = (((q & 0xF) ^ 8) - 8).astype(jnp.int8)    # s8, NOT bf16: the dots
+    hi = (((q >> 4) ^ 8) - 8).astype(jnp.int8)     # ride the 8-bit MXU path
+    pl_lo = jax.lax.dot_general(xl_ref[:], lo, (((1,), (0,)), ((), ())),
+                                preferred_element_type=jnp.int32)
+    pl_hi = jax.lax.dot_general(xh_ref[:], hi, (((1,), (0,)), ((), ())),
+                                preferred_element_type=jnp.int32)
+    g_lo = jax.lax.div(k * bk2, gs)
+    g_hi = jax.lax.div(K2 + k * bk2, gs)
+    s_lo = s_ref[pl.ds(g_lo, 1), :].astype(jnp.float32)
+    s_hi = s_ref[pl.ds(g_hi, 1), :].astype(jnp.float32)
+    acc[:] += pl_lo.astype(jnp.float32) * s_lo \
+        + pl_hi.astype(jnp.float32) * s_hi
+
+    @pl.when(k == nk2 - 1)
+    def _finalize():
+        o_ref[:] = (acc[:] * sx_ref[:].astype(jnp.float32)
+                    ).astype(o_ref.dtype)
+
+
+def int4_a8_matmul(x: jax.Array, q4: jax.Array, scale: jax.Array,
+                   out_dtype=None, interpret: bool = False) -> jax.Array:
+    """W4A8: activation rows quantize to s8 on the fly; packed int4 weight
+    tiles unpack to s8 IN VMEM (no bf16 convert) and both nibble planes
+    ride the MXU's s8xs8 path. Removes the int4 body's convert ops —
+    docs/quant_decode_analysis.md quantifies the remaining unpack cost."""
+    M, K = x.shape
+    K2, N = q4.shape
+    if K != 2 * K2:
+        raise ValueError(f"x K={K} vs packed K/2={K2}")
+    G = scale.shape[0]
+    gs = K // G
+    out_dtype = out_dtype or x.dtype
+    xq, sx = quantize_activation_rows(x)
+    mpad = (-M) % 8
+    if mpad:
+        xq = jnp.pad(xq, ((0, mpad), (0, 0)))
+        sx = jnp.pad(sx, ((0, mpad), (0, 0)))
+    Mp = xq.shape[0]
+    if K2 % 128 or N % 128:
+        raise ValueError(f"int4_a8_matmul needs K/2,N % 128 == 0, "
+                         f"got {K2}x{N}")
+    bk2 = _tile(K2, BK)
+    if G > 1:
+        bk2 = min(bk2, _tile(gs, BK))
+    bn = _tile(N, BN)
+    nk2 = K2 // bk2
+    out = pl.pallas_call(
+        functools.partial(_kernel4_a8, nk2=nk2, bk2=bk2, gs=gs, K2=K2),
+        grid=(N // bn, nk2),
+        in_specs=[
+            pl.BlockSpec((Mp, bk2), lambda n, k: (0, k)),
+            pl.BlockSpec((Mp, bk2), lambda n, k: (0, k + nk2)),
+            pl.BlockSpec((Mp, 1), lambda n, k: (0, 0)),
+            pl.BlockSpec((bk2, bn), lambda n, k: (k, n)),
+            pl.BlockSpec((G, bn), lambda n, k: (0, n)),
+        ],
+        out_specs=pl.BlockSpec((Mp, bn), lambda n, k: (0, n)),
+        out_shape=jax.ShapeDtypeStruct((Mp, N), out_dtype),
+        scratch_shapes=[pltpu.VMEM((Mp, bn), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=interpret,
+    )(xq, xq, sx, q4, scale)
+    return out[:M]
+
+
+def reference_int4_a8_matmul(x, q4, scale, out_dtype=None):
+    """Oracle: explicit activation quantization + integer matmul over the
+    unpacked int4 values (scales applied per group)."""
+    out_dtype = out_dtype or x.dtype
+    xq, sx = quantize_activation_rows(x)
+    q = q4.astype(jnp.int32)
+    lo = ((q & 0xF) ^ 8) - 8
+    hi = ((q >> 4) ^ 8) - 8
+    w = jnp.concatenate([lo, hi], axis=-2)                 # (K, N) int
+    K, N = w.shape
+    G = scale.shape[0]
+    # per-group integer partial products, scaled per (group, channel)
+    accs = jnp.einsum(
+        "mgk,gkn->mgn",
+        xq.astype(jnp.float32).reshape(xq.shape[0], G, K // G),
+        w.astype(jnp.float32).reshape(G, K // G, N))
+    out = (accs * scale.astype(jnp.float32)[None]).sum(axis=1)
+    return (out * sx).astype(out_dtype)
+
+
 # ---------------------------------------------------------------------------
 # int4: nibble-packed weights + per-group scales
 # ---------------------------------------------------------------------------

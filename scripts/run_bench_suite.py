@@ -29,6 +29,8 @@ SUITE = [
     # VPU-convert-bound; this removes the convert entirely)
     ("bench_infer_w8a8", ["python", "bench_infer.py"],
      {"BENCH_INFER_DTYPE": "w8a8"}),
+    ("bench_infer_w4a8", ["python", "bench_infer.py"],
+     {"BENCH_INFER_DTYPE": "w4a8"}),
     # MoE expert-parallel inference (VERDICT r4 #2) + BLOOM-7B kernel-
     # injected inference as tracked config #5 names it (VERDICT r4 #6)
     ("bench_infer_moe8e", ["python", "bench_infer.py"],

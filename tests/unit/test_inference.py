@@ -735,8 +735,10 @@ class TestW8A8:
         cfg = InferenceConfig(dtype="w8a8")
         assert cfg.quantize_bits == 8 and cfg.quantize_activations
         assert cfg.dtype == jnp.bfloat16
-        with pytest.raises(ValueError, match="W8A8"):
-            InferenceConfig(dtype="int4", quantize_activations=True)
+        cfg4 = InferenceConfig(dtype="w4a8")
+        assert cfg4.quantize_bits == 4 and cfg4.quantize_activations
+        with pytest.raises(ValueError, match="W8A8/W4A8"):
+            InferenceConfig(dtype="bf16", quantize_activations=True)
 
     @pytest.mark.slow
     def test_generate_engine_path(self):
