@@ -180,21 +180,32 @@ class InferenceEngine:
                     f"expert_parallel={ep} must divide "
                     f"moe_num_experts={cfg.moe_num_experts}")
         if config.quantize_activations:
-            # W8A8 engages through the decode-kernel gate; a config where
-            # the gate can never pass must not silently publish weight-only
-            # numbers under the w8a8 label
+            # W8A8/W4A8 engage through the decode-kernel gate; a config
+            # where the gate can never pass must not silently publish
+            # weight-only numbers under the a8 label
+            mode = "w8a8" if config.quantize_bits == 8 else "w4a8"
+            wo = "int8" if config.quantize_bits == 8 else "int4"
             if tp > 1:
                 raise NotImplementedError(
-                    "quantize_activations (W8A8) + tensor_parallel > 1 is "
-                    "not supported — the s8xs8 decode kernel is single-"
-                    "device (weight-only int8 supports TP)")
+                    f"quantize_activations ({mode.upper()}) + "
+                    "tensor_parallel > 1 is not supported — the s8xs8 "
+                    f"decode kernel is single-device (weight-only {wo} "
+                    "supports TP)")
+            # int8 sites need K,N % 128; int4 packs K/2, so contraction
+            # dims must be % 256 (every site's K is one of these dims)
+            align = 128 if config.quantize_bits == 8 else 256
             dims = (cfg.hidden_size, cfg.num_heads * cfg.head_dim,
                     cfg.ffn_hidden_size)
-            if any(d % 128 for d in dims):
+            bad = any(d % align for d in dims)
+            if (config.quantize_bits == 4 and config.quantize_groups
+                    and config.quantize_groups % 128):
+                bad = True
+            if bad:
                 logger.warning(
-                    f"w8a8: model dims {dims} are not all multiples of 128 "
-                    "— the s8xs8 kernel gate will not engage and decode "
-                    "serves the weight-only int8 path")
+                    f"{mode}: model dims {dims} (alignment {align}"
+                    f"{', groups ' + str(config.quantize_groups) if config.quantize_groups else ''}"
+                    ") do not satisfy the s8xs8 kernel gate — decode "
+                    f"serves the weight-only {wo} path")
             cfg.a8_decode = True
 
         # TP sharding plan (no fsdp axis — reference inference shards
