@@ -75,6 +75,16 @@ def main() -> int:
     ok &= check("int4_matmul (grouped)", int4_matmul(x, q4, s4),
                 reference_int4_matmul(x, q4, s4, out_dtype=jnp.float32),
                 atol=0.5)
+    from deepspeed_tpu.ops import (int4_a8_matmul, int8_a8_matmul,
+                                   reference_int4_a8_matmul,
+                                   reference_int8_a8_matmul)
+
+    ok &= check("int8_a8_matmul (W8A8)", int8_a8_matmul(x, q8, s8),
+                reference_int8_a8_matmul(x, q8, s8, out_dtype=jnp.float32),
+                atol=0.5)
+    ok &= check("int4_a8_matmul (W4A8 grouped)", int4_a8_matmul(x, q4, s4),
+                reference_int4_a8_matmul(x, q4, s4, out_dtype=jnp.float32),
+                atol=0.5)
 
     # block-sparse attention incl. the empty-row guard
     from deepspeed_tpu.ops.block_sparse_attention import (
