@@ -384,3 +384,38 @@ def sparse_self_attention(q: jax.Array, k: jax.Array, v: jax.Array,
             q[:, :, h:h + 1], k[:, :, h:h + 1], v[:, :, h:h + 1],
             mask[:, h], causal=False))
     return jnp.concatenate(outs, axis=2)
+
+
+def make_sparse_attention_impl(config: SparsityConfig,
+                               use_kernel: Optional[bool] = None,
+                               interpret: bool = False):
+    """``attention_impl`` factory — the module-swap analog of the
+    reference's ``SparseAttentionUtils.replace_model_self_attention``
+    (sparse_attention_utils.py): pass the result as
+    ``TransformerConfig.attention_impl`` (or ``create_model(...,
+    attention_impl=...)``) and every layer's attention runs through
+    :func:`sparse_self_attention` with this sparsity config.
+
+    Training/encoding only (the reference's scope too): the decode path
+    requires cache kwargs this impl deliberately does not accept, so
+    generation falls back loudly rather than silently densifying."""
+    uni = getattr(config, "attention", "bidirectional") == "unidirectional"
+
+    def impl(q, k, v, mask=None, causal=True, **kw):
+        if kw:
+            raise NotImplementedError(
+                f"sparse attention impl got unsupported kwargs "
+                f"{sorted(kw)} — sliding windows/ALiBi/decode caches "
+                "don't compose with block-sparse layouts")
+        if bool(causal) != uni:
+            raise ValueError(
+                f"model causality (causal={causal}) does not match the "
+                f"sparsity config's attention="
+                f"'{getattr(config, 'attention', 'bidirectional')}' — "
+                "pick a unidirectional config for causal models")
+        return sparse_self_attention(q, k, v, config,
+                                     key_padding_mask=mask,
+                                     use_kernel=use_kernel,
+                                     interpret=interpret)
+
+    return impl
