@@ -17,6 +17,14 @@ from .quant_matmul import (int4_a8_matmul, int4_matmul,
                            unpack_int4)
 from .quantization import (dequantize_symmetric, fake_quantize,
                            quantize_symmetric, reference_quantize_symmetric)
+from .sparse_attention import (BigBirdSparsityConfig,  # noqa: F401
+                               BSLongformerSparsityConfig,
+                               DenseSparsityConfig, FixedSparsityConfig,
+                               LocalSlidingWindowSparsityConfig,
+                               LocalSparsityConfig, SparsityConfig,
+                               VariableSparsityConfig,
+                               make_sparse_attention_impl,
+                               sparse_self_attention)
 from .spatial import (diffusers_attention, fused_group_norm,
                       reference_group_norm)
 from .registry import available_ops, get_op, is_compatible, op_report, register_op
@@ -75,6 +83,11 @@ __all__ = [
     "int8_a8_matmul", "reference_int8_a8_matmul", "quantize_activation_rows",
     "int4_a8_matmul", "reference_int4_a8_matmul",
     "int4_matmul", "reference_int4_matmul", "quantize_int4", "unpack_int4",
+    "SparsityConfig", "DenseSparsityConfig", "FixedSparsityConfig",
+    "VariableSparsityConfig", "BigBirdSparsityConfig",
+    "BSLongformerSparsityConfig", "LocalSlidingWindowSparsityConfig",
+    "LocalSparsityConfig", "sparse_self_attention",
+    "make_sparse_attention_impl",
     "diffusers_attention", "fused_group_norm",
     "reference_group_norm", "available_ops", "get_op",
     "is_compatible", "op_report", "register_op",
