@@ -16,7 +16,27 @@ version = __version__
 from . import comm  # noqa: F401
 from .config import Config, ConfigError, load_config  # noqa: F401
 from .parallel import topology  # noqa: F401
+from .parallel import zero  # noqa: F401  (reference: from .runtime import zero)
 from .utils.logging import log_dist, logger  # noqa: F401
+
+
+def init_distributed(dist_backend: str = "xla", **kwargs):
+    """Analog of ``deepspeed.init_distributed`` (reference comm bootstrap,
+    deepspeed/__init__.py:129 path): env rendezvous →
+    ``jax.distributed.initialize``. Idempotent."""
+    from .comm.comm import init_distributed as _init
+
+    return _init(dist_backend=dist_backend, **kwargs)
+
+
+def default_inference_config():
+    """Analog of ``deepspeed.default_inference_config`` (reference
+    deepspeed/__init__.py:253): the default InferenceConfig as a dict."""
+    import dataclasses
+
+    from .inference.engine import InferenceConfig
+
+    return dataclasses.asdict(InferenceConfig())
 
 
 def initialize(args=None, model=None, optimizer=None, model_parameters=None,
