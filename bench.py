@@ -93,14 +93,17 @@ def _run_child(timeout_s: float):
                             text=True, env=env, start_new_session=True)
     try:
         out, err = proc.communicate(timeout=timeout_s)
+        sys.stderr.write(err or "")   # forward child diagnostics
         return proc.returncode, out, err
     except subprocess.TimeoutExpired:
         try:
             os.killpg(proc.pid, signal.SIGKILL)
         except ProcessLookupError:
             pass
-        proc.wait()
-        return None, "", ""
+        # collect whatever the child managed to write before the kill —
+        # it shows WHERE it hung (backend init vs mid-bench)
+        out, err = proc.communicate()
+        return None, out or "", err or ""
 
 
 def _run_watchdogged() -> None:
@@ -124,8 +127,10 @@ def _run_watchdogged() -> None:
                      else max(remaining(), 60))
         rc, out, errtxt = _run_child(timeout_s)
         if rc is None:
+            tail = (errtxt or "").strip().splitlines()[-3:]
             _skip(f"bench run exceeded {timeout_s:.0f}s watchdog "
-                  "(tunnel hang suspected)")
+                  f"(tunnel hang suspected); child stderr tail: "
+                  f"{' | '.join(tail) if tail else '<empty>'}")
         if rc == 0:
             sys.stdout.write(out)
             return

@@ -191,21 +191,29 @@ class InferenceEngine:
                     "tensor_parallel > 1 is not supported — the s8xs8 "
                     f"decode kernel is single-device (weight-only {wo} "
                     "supports TP)")
-            # int8 sites need K,N % 128; int4 packs K/2, so contraction
-            # dims must be % 256 (every site's K is one of these dims)
-            align = 128 if config.quantize_bits == 8 else 256
-            dims = (cfg.hidden_size, cfg.num_heads * cfg.head_dim,
-                    cfg.ffn_hidden_size)
-            bad = any(d % align for d in dims)
+            # per-site gate preview: int8 sites need K,N % 128; int4 packs
+            # K/2, so its CONTRACTION dim must be % 256 (output dims stay
+            # % 128)
+            k_align = 128 if config.quantize_bits == 8 else 256
+            H = cfg.hidden_size
+            ND, F, V = (cfg.num_heads * cfg.head_dim, cfg.ffn_hidden_size,
+                        cfg.vocab_size)
+            sites = {"attn qkv": (H, ND), "attn out": (ND, H),
+                     "mlp in": (H, F), "mlp out": (F, H)}
+            if not cfg.tie_embeddings:
+                sites["lm_head"] = (H, V)
+            bad_sites = [name for name, (kd, nd) in sites.items()
+                         if kd % k_align or nd % 128]
             if (config.quantize_bits == 4 and config.quantize_groups
                     and config.quantize_groups % 128):
-                bad = True
-            if bad:
+                bad_sites = list(sites)
+            if bad_sites:
                 logger.warning(
-                    f"{mode}: model dims {dims} (alignment {align}"
+                    f"{mode}: the s8xs8 kernel gate will not engage for "
+                    f"site(s) {bad_sites} (K-alignment {k_align}, "
+                    f"N-alignment 128"
                     f"{', groups ' + str(config.quantize_groups) if config.quantize_groups else ''}"
-                    ") do not satisfy the s8xs8 kernel gate — decode "
-                    f"serves the weight-only {wo} path")
+                    f") — those sites serve the weight-only {wo} path")
             cfg.a8_decode = True
 
         # TP sharding plan (no fsdp axis — reference inference shards
@@ -231,7 +239,11 @@ class InferenceEngine:
                     # OOMs at 13B on a 16GB chip
                     from ..models.transformer import quantize_model_weights
 
-                    q_sh = (self._quantized_shardings() if tp > 1 else None)
+                    # shardings matter for BOTH tp>1 (sliced dense sites)
+                    # and ep>1 (expert banks over the 'expert' axis) —
+                    # gating on tp alone silently replicated MoE experts
+                    q_sh = (self._quantized_shardings()
+                            if tp > 1 or ep > 1 else None)
                     params = jax.jit(lambda key: quantize_model_weights(
                         cast_floating(model.init(key), config.dtype),
                         bits=config.quantize_bits,
@@ -251,13 +263,14 @@ class InferenceEngine:
             from ..models.transformer import quantize_model_weights
 
             params = cast_floating(params, config.dtype)
-            q_sh = self._quantized_shardings() if tp > 1 else None
+            q_sh = (self._quantized_shardings()
+                    if tp > 1 or ep > 1 else None)
             params = quantize_model_weights(params,
                                             bits=config.quantize_bits,
                                             donate=True,
                                             group_size=config.quantize_groups,
                                             shardings=q_sh)
-            if tp > 1:
+            if q_sh is not None:
                 # quantized leaves already landed sharded; this put only
                 # moves the remaining dense leaves (and no-ops the rest)
                 params = jax.tree.map(

@@ -52,7 +52,10 @@ def run_on_all_hosts(command: List[str], hostfile: Optional[str] = None,
         assert proc.stdout is not None
         for line in proc.stdout:
             print(f"{host}: {line.rstrip()}", flush=True)
-        worst = max(worst, proc.wait())
+        rc = proc.wait()
+        if rc < 0:
+            rc = 128 - rc        # died by signal: shell convention 128+N
+        worst = max(worst, rc)
     return worst
 
 

@@ -463,6 +463,13 @@ def consolidate_checkpoint(load_dir: str, out_path: str,
             src = master_of[full_key]
             used_master += 1
         src_info = arrays[src]
+        if src_info["shape"] != arrays[full_key]["shape"]:
+            # loud failure beats silently attaching a master to the wrong
+            # param (the layer_master pairing is positional)
+            raise ValueError(
+                f"consolidate: master '{src}' shape {src_info['shape']} != "
+                f"param '{full_key}' shape {arrays[full_key]['shape']} — "
+                "master/param pairing is inconsistent in this checkpoint")
         flat[pkey] = _assemble_slice(
             arrays_dir, src_info,
             [[0, d] for d in src_info["shape"]], np.float32)

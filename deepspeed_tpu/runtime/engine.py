@@ -1258,6 +1258,12 @@ class TrainEngine:
         cfg = self.model.config
         if cfg is None:
             raise ValueError("flops profile needs a transformer Model")
+        if self._param_offload is not None:
+            raise NotImplementedError(
+                "print_model_profile materialises the full dense model on "
+                "device — a param-offload engine exists because that does "
+                "NOT fit; use engine._param_offload.overlap_report() and "
+                "get_flops_profile() (analytic) instead")
         get_model_profile(
             self.model,
             batch_size or self.train_micro_batch_size_per_gpu(),

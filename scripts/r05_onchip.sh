@@ -14,9 +14,12 @@ cd "$(dirname "$0")/.."
 TAG=${1:-r05}
 run() {
   name=$1
-  if [ -f "bench_results/$TAG/$name.json" ] \
-     && ! grep -q '"skipped"\|"returncode": 1\|timeout' \
-        "bench_results/$TAG/$name.json"; then
+  f="bench_results/$TAG/$name.json"
+  # complete = a parsed result landed and it isn't a backend-outage skip;
+  # structured-OOM records (rc=1 by design) COUNT as complete, while
+  # segfaults/timeouts (no "result") re-run
+  if [ -f "$f" ] && grep -q '"result"' "$f" \
+     && ! grep -q '"skipped": true' "$f"; then
     echo "[keep] $name"
     return
   fi

@@ -702,6 +702,23 @@ class TestMoEInference:
         with pytest.raises(ValueError, match="must divide"):
             init_inference("moe-tiny", expert_parallel=3)
 
+    def test_ep2_int8_expert_banks_sharded(self, devices8):
+        """Quantized MoE load must keep the expert banks SHARDED over the
+        'expert' axis (regression: tp==1 gating replicated them, losing
+        exactly the EP memory scaling)."""
+        from deepspeed_tpu.parallel import mesh as mesh_mod
+
+        mesh_mod.reset_mesh()
+        e = init_inference("moe-tiny", dtype="int8", max_out_tokens=128,
+                           expert_parallel=2, moe_drop_tokens=False)
+        w_up = e.params["layers"]["mlp"]["w_up"]
+        assert "expert" in getattr(w_up.sharding, "spec", ())
+        # really partitioned: each device holds half the experts
+        shard_elems = w_up.addressable_shards[0].data.size
+        assert shard_elems == w_up.size // 2
+        out = e.generate(np.arange(8)[None] % 250, max_new_tokens=3)
+        assert np.asarray(out).shape == (1, 3)
+
     @pytest.mark.slow
     def test_moe_composes_with_int8_weights(self):
         """MoE + weight-only int8: dense projections quantize, expert banks
