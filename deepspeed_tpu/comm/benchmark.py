@@ -22,6 +22,7 @@ import time
 from typing import Any, Dict, List, Optional
 
 import jax
+from ..utils.compat import shard_map
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
@@ -84,7 +85,7 @@ def _build(op: str, axis: str, mesh, elems: int, dtype):
         in_spec, out_spec = P(axis), P(axis)  # exchange along dim 0
         global_shape = (n * elems,)
     x = jnp.zeros(global_shape, dtype) + 1
-    prog = jax.jit(jax.shard_map(fn, mesh=mesh, in_specs=in_spec,
+    prog = jax.jit(shard_map(fn, mesh=mesh, in_specs=in_spec,
                                  out_specs=out_spec, check_vma=False))
     return prog, x
 
@@ -129,8 +130,8 @@ def run_comm_benchmark(ops: Optional[List[str]] = None, axis: str = "data",
                                           msg_bytes, lat, n)
             rec = {"op": op, "axis": axis, "world": n,
                    "msg_bytes": msg_bytes, "latency_ms": round(lat * 1e3, 4),
-                   "algbw_gbps": round(algbw, 3),
-                   "busbw_gbps": round(busbw, 3)}
+                   "algbw_gbps": round(algbw, 6),
+                   "busbw_gbps": round(busbw, 6)}
             results.append(rec)
             if not quiet:
                 print(f"{op:<16}{msg_bytes:>12}B  {rec['latency_ms']:>10.3f} ms"

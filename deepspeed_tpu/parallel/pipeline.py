@@ -28,6 +28,7 @@ from functools import partial
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
+from ..utils.compat import shard_map
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
@@ -297,7 +298,7 @@ def pipelined_loss_fn(cfg, num_stages: int):
         layer_specs = jax.tree.map(lambda _: P(PIPE_AXIS), layers_in)
         embed_specs = jax.tree.map(lambda _: P(), embed_tree)
         batch_specs = jax.tree.map(lambda _: P(), batch)
-        fn = jax.shard_map(
+        fn = shard_map(
             body, mesh=mesh,
             in_specs=(layer_specs, embed_specs, batch_specs),
             out_specs=P(),
@@ -455,7 +456,7 @@ def pipelined_grad_fn(cfg, num_stages: int):
         layer_specs = jax.tree.map(lambda _: P(PIPE_AXIS), layers_in)
         embed_specs = jax.tree.map(lambda _: P(), embed_tree)
         batch_specs = jax.tree.map(lambda _: P(), batch)
-        fn = jax.shard_map(
+        fn = shard_map(
             body, mesh=mesh,
             in_specs=(layer_specs, embed_specs, batch_specs, P()),
             out_specs=(layer_specs, embed_specs, P()),

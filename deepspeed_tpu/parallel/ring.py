@@ -30,6 +30,7 @@ from __future__ import annotations
 from typing import Optional
 
 import jax
+from ..utils.compat import shard_map
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
@@ -135,7 +136,7 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     # partial-manual: only the 'seq' axis is named; batch keeps whatever
     # (expert, data) sharding the surrounding jit gives it automatically
     spec = P(None, SEQ_AXIS, None, None)
-    fn = jax.shard_map(body, mesh=mesh,
+    fn = shard_map(body, mesh=mesh,
                        in_specs=(spec, spec, spec),
                        out_specs=spec,
                        check_vma=False,

@@ -27,6 +27,7 @@ import time
 from typing import Any, Callable, Dict, Iterable, Optional, Tuple
 
 import jax
+from ..utils.compat import shard_map
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
@@ -688,7 +689,7 @@ class TrainEngine:
             scale = scaler_state.scale if fp16 else jnp.float32(1.0)
             batch_specs = jax.tree.map(
                 lambda x: P(None, mesh_mod.DATA_AXIS), batch)
-            body = jax.shard_map(
+            body = shard_map(
                 data_body, mesh=mesh,
                 in_specs=(P(), batch_specs, P(), P(mesh_mod.DATA_AXIS, None),
                           P(mesh_mod.DATA_AXIS), P()),

@@ -1,0 +1,46 @@
+"""Version-compat shims over the moving parts of the JAX API.
+
+The codebase targets the modern spelling (``jax.shard_map`` with
+``check_vma``/``axis_names``); older releases (< 0.5) only ship
+``jax.experimental.shard_map.shard_map`` with ``check_rep``/``auto``.
+Route every call site through here so the tree runs on both.
+"""
+
+import inspect
+from typing import Optional, Set
+
+import jax
+
+if hasattr(jax, "shard_map"):
+    _shard_map = jax.shard_map
+else:
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+# The namespace move (experimental -> jax.shard_map) and the kwarg renames
+# (check_rep->check_vma, auto->axis_names) landed in different releases, so
+# probe the signature rather than the attribute's location.
+_NEW_KWARGS = "check_vma" in inspect.signature(_shard_map).parameters
+
+
+def shard_map(f, *, mesh, in_specs, out_specs,
+              check_vma: Optional[bool] = None,
+              axis_names: Optional[Set[str]] = None):
+    """``jax.shard_map`` across JAX versions.
+
+    ``axis_names`` is the modern partial-manual spelling (the set of mesh
+    axes the body sees as manual); the legacy API takes the complement as
+    ``auto``. ``check_vma`` maps to legacy ``check_rep``.
+    """
+    kwargs = {}
+    if _NEW_KWARGS:
+        if check_vma is not None:
+            kwargs["check_vma"] = check_vma
+        if axis_names is not None:
+            kwargs["axis_names"] = set(axis_names)
+    else:
+        if check_vma is not None:
+            kwargs["check_rep"] = check_vma
+        if axis_names is not None:
+            kwargs["auto"] = frozenset(mesh.axis_names) - frozenset(axis_names)
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, **kwargs)

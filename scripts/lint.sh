@@ -1,0 +1,12 @@
+#!/usr/bin/env bash
+# Repo lint gate: tpulint over the source tree with the committed baseline.
+# Exits non-zero on any NEW finding (existing debt lives in the baseline).
+# Usage: scripts/lint.sh [extra tpulint args...]
+set -euo pipefail
+
+cd "$(dirname "$0")/.."
+
+python -m tools.tpulint \
+    deepspeed_tpu/ tools/ scripts/ tests/ \
+    bench.py bench_infer.py bench_moe.py bench_rlhf.py bench_zero.py \
+    --baseline .tpulint-baseline.json "$@"

@@ -6,7 +6,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 from jax.sharding import PartitionSpec as P
-from jax import shard_map
+from deepspeed_tpu.utils.compat import shard_map
 
 from deepspeed_tpu import comm
 from deepspeed_tpu.config.config import ParallelConfig
@@ -153,7 +153,7 @@ def test_comm_benchmark_sweep(devices8):
         assert r["world"] == 8
         assert r["latency_ms"] > 0 and r["busbw_gbps"] > 0
     # all_reduce busbw factor (n-1)/n vs its algbw (values are rounded to
-    # 3 decimals in the record, so compare loosely on the largest message)
+    # 6 decimals in the record, so compare loosely on the largest message)
     ar = [r for r in results if r["op"] == "all_reduce"][-1]
     assert abs(ar["busbw_gbps"] / ar["algbw_gbps"] - 7 / 8) < 0.1
 

@@ -4,6 +4,7 @@ compressed-stage convergence) plus primitive-level checks of the
 error-feedback collective."""
 
 import jax
+from deepspeed_tpu.utils.compat import shard_map
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -32,7 +33,7 @@ class TestCompressedAllreduce:
             out, w2, s2 = compressed_allreduce_flat(v[0], w[0], s, "data")
             return out[None], w2[None], s2
 
-        fn = jax.shard_map(body, mesh=mesh,
+        fn = shard_map(body, mesh=mesh,
                            in_specs=(P("data"), P("data"), P("data")),
                            out_specs=(P("data", None), P("data"), P("data")),
                            check_vma=False)

@@ -1,0 +1,11 @@
+"""tpulint — JAX/TPU static analysis for the deepspeed_tpu tree.
+
+Six rules catch the failure modes that are silent on TPU: host syncs inside
+jit, trace-time side effects, missing buffer donation, undeclared mesh axes,
+deprecated JAX APIs, and PRNG key reuse. See docs/tpulint.md.
+"""
+
+from .core import RULES, Finding, analyze_paths, analyze_source
+from . import rules as _rules  # noqa: F401  (imports populate the registry)
+
+__all__ = ["RULES", "Finding", "analyze_paths", "analyze_source"]

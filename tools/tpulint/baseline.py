@@ -1,0 +1,61 @@
+"""Baseline handling — committed debt doesn't block CI, new findings do.
+
+The baseline stores per-(path, rule) finding COUNTS rather than line numbers,
+so unrelated edits that shift lines don't invalidate it, while any net-new
+violation in a file (count exceeds the recorded budget) fails the gate.
+Fixing findings only ever lowers counts, which passes; regenerate with
+``--write-baseline`` to ratchet the budget down.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+from typing import Dict, List, Sequence
+
+from .core import Finding
+
+BASELINE_VERSION = 1
+
+
+def counts_of(findings: Sequence[Finding]) -> Dict[str, int]:
+    counts: Dict[str, int] = collections.Counter()
+    for f in findings:
+        counts[f.key] += 1
+    return dict(sorted(counts.items()))
+
+
+def load(path: str) -> Dict[str, int]:
+    with open(path, "r", encoding="utf-8") as fh:
+        data = json.load(fh)
+    if data.get("version") != BASELINE_VERSION:
+        raise ValueError(f"unsupported baseline version in {path}: "
+                         f"{data.get('version')!r}")
+    return {str(k): int(v) for k, v in data.get("counts", {}).items()}
+
+
+def write(path: str, findings: Sequence[Finding]) -> None:
+    payload = {
+        "version": BASELINE_VERSION,
+        "tool": "tpulint",
+        "counts": counts_of(findings),
+    }
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(payload, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+
+
+def new_findings(findings: Sequence[Finding],
+                 baseline: Dict[str, int]) -> List[Finding]:
+    """Findings over budget. Within one (path, rule) bucket the LAST findings
+    in line order are reported as new — a stable, if arbitrary, choice."""
+    by_key: Dict[str, List[Finding]] = collections.defaultdict(list)
+    for f in findings:
+        by_key[f.key].append(f)
+    out: List[Finding] = []
+    for key, group in by_key.items():
+        budget = baseline.get(key, 0)
+        if len(group) > budget:
+            out.extend(group[budget:])
+    out.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return out

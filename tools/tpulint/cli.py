@@ -1,0 +1,114 @@
+"""tpulint CLI.
+
+Usage::
+
+    python -m tools.tpulint deepspeed_tpu/ --baseline .tpulint-baseline.json
+    python -m tools.tpulint path/to/file.py --format json
+    python -m tools.tpulint deepspeed_tpu/ --baseline b.json --write-baseline
+
+Exit status: 0 clean (or all findings baselined), 1 new findings,
+2 usage/internal error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+from . import baseline as baseline_mod
+from .core import RULES, Finding, analyze_paths
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="tpulint",
+        description="JAX/TPU static analysis: jit purity, host syncs, "
+                    "donation, mesh-axis and PRNG hygiene.")
+    parser.add_argument("paths", nargs="*", default=["deepspeed_tpu"],
+                        help="files or directories to analyze "
+                             "(default: deepspeed_tpu)")
+    parser.add_argument("--format", choices=("text", "json"), default="text")
+    parser.add_argument("--baseline", metavar="FILE", default=None,
+                        help="JSON baseline of accepted findings; only "
+                             "findings over the baselined counts fail")
+    parser.add_argument("--write-baseline", action="store_true",
+                        help="write the current findings to --baseline and "
+                             "exit 0")
+    parser.add_argument("--select", metavar="RULES", default=None,
+                        help="comma-separated rule names to run "
+                             "(default: all)")
+    parser.add_argument("--root", metavar="DIR", default=None,
+                        help="directory finding paths are made relative to "
+                             "(default: cwd)")
+    parser.add_argument("--list-rules", action="store_true",
+                        help="print the rule registry and exit")
+    return parser
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+
+    if args.list_rules:
+        for rule in RULES:
+            print(f"{rule.name}: {rule.description}")
+        return 0
+
+    select = None
+    if args.select:
+        select = {r.strip() for r in args.select.split(",") if r.strip()}
+        known = {r.name for r in RULES}
+        unknown = select - known
+        if unknown:
+            print(f"tpulint: unknown rule(s): {', '.join(sorted(unknown))}",
+                  file=sys.stderr)
+            return 2
+
+    missing = [p for p in args.paths if not os.path.exists(p)]
+    if missing:
+        print(f"tpulint: no such path: {', '.join(missing)}", file=sys.stderr)
+        return 2
+
+    findings = analyze_paths(args.paths, root=args.root, select=select)
+
+    if args.write_baseline:
+        if not args.baseline:
+            print("tpulint: --write-baseline requires --baseline FILE",
+                  file=sys.stderr)
+            return 2
+        baseline_mod.write(args.baseline, findings)
+        print(f"tpulint: wrote {len(findings)} finding(s) to {args.baseline}")
+        return 0
+
+    gating: List[Finding] = findings
+    if args.baseline and not os.path.exists(args.baseline):
+        print(f"tpulint: warning: baseline {args.baseline} not found; "
+              "gating on ALL findings", file=sys.stderr)
+    if args.baseline and os.path.exists(args.baseline):
+        try:
+            known_counts = baseline_mod.load(args.baseline)
+        except (ValueError, json.JSONDecodeError) as e:
+            print(f"tpulint: bad baseline {args.baseline}: {e}",
+                  file=sys.stderr)
+            return 2
+        gating = baseline_mod.new_findings(findings, known_counts)
+
+    if args.format == "json":
+        print(json.dumps({
+            "findings": [f.to_json() for f in gating],
+            "total_findings": len(findings),
+            "new_findings": len(gating),
+        }, indent=2))
+    else:
+        for f in gating:
+            print(f.render())
+        suffix = " (after baseline)" if args.baseline else ""
+        print(f"tpulint: {len(gating)} new finding(s){suffix}, "
+              f"{len(findings)} total")
+    return 1 if gating else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

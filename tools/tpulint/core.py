@@ -1,0 +1,252 @@
+"""tpulint core — module model, suppression parsing, rule registry, driver.
+
+The analyzer is stdlib-only (``ast`` + ``tokenize``-free line scanning): it
+must run in CI containers that have nothing but the training deps installed.
+
+Terminology used by rules:
+
+* *dotted name* — the canonical dotted path of an expression after expanding
+  import aliases, e.g. with ``import numpy as np``, ``np.asarray`` resolves
+  to ``numpy.asarray``; with ``from jax import random as jr``, ``jr.split``
+  resolves to ``jax.random.split``.
+* *jit-reachable* — a function either jit-bound directly (decorator or
+  ``jax.jit(fn)``-style wrapping) or called (by simple name, same module)
+  from a jit-reachable function. See ``jitgraph.py``.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Set
+
+__all__ = [
+    "Finding", "ModuleInfo", "Rule", "RULES", "register",
+    "analyze_source", "analyze_paths", "iter_python_files", "own_nodes",
+]
+
+_SUPPRESS_RE = re.compile(
+    r"#.*?tpulint:\s*disable="
+    r"([A-Za-z0-9_\-]+(?:\s*,\s*[A-Za-z0-9_\-]+)*)")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One diagnostic. ``key`` (path::rule) is the baseline bucket."""
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+
+    @property
+    def key(self) -> str:
+        return f"{self.path}::{self.rule}"
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule}: {self.message}"
+
+    def to_json(self) -> Dict[str, object]:
+        return dataclasses.asdict(self)
+
+
+class ModuleInfo:
+    """Parsed module plus the cross-cutting lookups every rule needs."""
+
+    def __init__(self, path: str, source: str):
+        self.path = path
+        self.source = source
+        self.tree = ast.parse(source, filename=path)
+        self.lines = source.splitlines()
+        self.aliases = self._collect_aliases(self.tree)
+        self.suppressions = self._collect_suppressions(self.lines)
+        # parent links let rules climb from any node to its enclosing scope
+        self.parents: Dict[ast.AST, ast.AST] = {}
+        for node in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(node):
+                self.parents[child] = node
+
+    @staticmethod
+    def _collect_aliases(tree: ast.Module) -> Dict[str, str]:
+        aliases: Dict[str, str] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    aliases[a.asname or a.name.split(".")[0]] = (
+                        a.name if a.asname else a.name.split(".")[0])
+            elif isinstance(node, ast.ImportFrom) and node.module and node.level == 0:
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+        return aliases
+
+    @staticmethod
+    def _collect_suppressions(lines: Sequence[str]) -> Dict[int, Set[str]]:
+        """Map 1-based line -> suppressed rule names.
+
+        ``# tpulint: disable=rule-a,rule-b`` suppresses its own line; a
+        comment-only line also suppresses the next line (for statements too
+        long to carry a trailing comment).
+        """
+        out: Dict[int, Set[str]] = {}
+        for i, text in enumerate(lines, start=1):
+            m = _SUPPRESS_RE.search(text)
+            if not m:
+                continue
+            rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+            out.setdefault(i, set()).update(rules)
+            if text.lstrip().startswith("#"):
+                out.setdefault(i + 1, set()).update(rules)
+        return out
+
+    def dotted(self, node: ast.AST) -> Optional[str]:
+        """Canonical dotted path of a Name/Attribute chain, alias-expanded."""
+        parts: List[str] = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if not isinstance(node, ast.Name):
+            return None
+        parts.append(self.aliases.get(node.id, node.id))
+        return ".".join(reversed(parts))
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        rules = self.suppressions.get(line, ())
+        return rule in rules or "all" in rules
+
+
+class Rule:
+    """Base class; subclasses set ``name``/``description`` and implement
+    ``check(module, jit, context) -> Iterator[Finding]``."""
+
+    name: str = ""
+    description: str = ""
+
+    def check(self, module: ModuleInfo, jit, context: "RunContext") -> Iterator[Finding]:
+        raise NotImplementedError
+
+
+RULES: List[Rule] = []
+
+
+def register(cls):
+    RULES.append(cls())
+    return cls
+
+
+class RunContext:
+    """State shared across all modules of one run (e.g. declared mesh axes)."""
+
+    def __init__(self):
+        self.declared_axes: Set[str] = set()
+
+
+# canonical axis-declaration modules, seeded into every run (relative to
+# --root) so linting a subtree still knows the full mesh vocabulary
+AXIS_SOURCE_FILES = (
+    "deepspeed_tpu/parallel/mesh.py",
+    "deepspeed_tpu/parallel/topology.py",
+)
+
+
+def own_nodes(fn: ast.AST) -> Iterator[ast.AST]:
+    """Walk a function body without descending into nested function/lambda
+    bodies (those are separate scopes, analyzed on their own when reachable).
+    The nested def node itself IS yielded (decorators/defaults belong here).
+    """
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def iter_python_files(paths: Iterable[str]) -> Iterator[str]:
+    for p in paths:
+        if os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(d for d in dirs
+                                 if d not in {"__pycache__", ".git", ".venv", "node_modules"})
+                for f in sorted(files):
+                    if f.endswith(".py"):
+                        yield os.path.join(root, f)
+        elif p.endswith(".py"):
+            yield p
+
+
+def analyze_source(source: str, path: str = "<string>",
+                   context: Optional[RunContext] = None,
+                   select: Optional[Set[str]] = None) -> List[Finding]:
+    """Analyze one module's source. Standalone entry point used by tests;
+    declared-axes come only from this module unless a context is passed."""
+    from .jitgraph import JitGraph
+    from .rules import collect_declared_axes
+
+    context = context or RunContext()
+    try:
+        module = ModuleInfo(path, source)
+    except SyntaxError as e:
+        return [Finding("syntax-error", path, e.lineno or 0, e.offset or 0,
+                        f"could not parse: {e.msg}")]
+    context.declared_axes |= collect_declared_axes(module)
+    jit = JitGraph(module)
+    findings: List[Finding] = []
+    for rule in RULES:
+        if select and rule.name not in select:
+            continue
+        for f in rule.check(module, jit, context):
+            if not module.suppressed(f.rule, f.line):
+                findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+def analyze_paths(paths: Sequence[str], root: Optional[str] = None,
+                  select: Optional[Set[str]] = None) -> List[Finding]:
+    """Two-pass run over files/directories: first collect mesh-axis
+    declarations everywhere, then apply the rules. ``root`` makes finding
+    paths relative (stable baseline keys)."""
+    from .jitgraph import JitGraph
+    from .rules import collect_declared_axes
+
+    root = root or os.getcwd()
+    context = RunContext()
+    for rel in AXIS_SOURCE_FILES:
+        src = os.path.join(root, rel)
+        if os.path.exists(src):
+            try:
+                with open(src, "r", encoding="utf-8") as fh:
+                    context.declared_axes |= collect_declared_axes(
+                        ModuleInfo(rel, fh.read()))
+            except (SyntaxError, OSError):
+                pass
+    modules: List[ModuleInfo] = []
+    findings: List[Finding] = []
+    for fpath in iter_python_files(paths):
+        rel = os.path.relpath(fpath, root).replace(os.sep, "/")
+        try:
+            with open(fpath, "r", encoding="utf-8") as fh:
+                source = fh.read()
+            modules.append(ModuleInfo(rel, source))
+        except (SyntaxError, UnicodeDecodeError, OSError) as e:
+            msg = getattr(e, "msg", None) or str(e)
+            findings.append(Finding("syntax-error", rel, getattr(e, "lineno", 0) or 0,
+                                    getattr(e, "offset", 0) or 0,
+                                    f"could not parse: {msg}"))
+    for module in modules:
+        context.declared_axes |= collect_declared_axes(module)
+    for module in modules:
+        jit = JitGraph(module)
+        for rule in RULES:
+            if select and rule.name not in select:
+                continue
+            for f in rule.check(module, jit, context):
+                if not module.suppressed(f.rule, f.line):
+                    findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings

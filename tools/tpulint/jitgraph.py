@@ -1,0 +1,181 @@
+"""Jit-reachability analysis for one module.
+
+Roots are functions bound to a jit transform either way this codebase spells
+it:
+
+* decorator form — ``@jax.jit``, ``@jit``, ``@partial(jax.jit, ...)``;
+* call-wrapping form — ``jax.jit(step)``, ``jax.jit(lambda ...: ...)``,
+  ``jax.jit(jax.value_and_grad(micro))`` (the dominant idiom here: see
+  ``runtime/engine.py`` / ``runtime/param_offload.py``).
+
+Reachability then closes over same-module calls by simple name and over
+nested defs of reachable functions (a nested def inside a jitted function is
+traced when called — treat it as inside the trace). This is deliberately a
+per-module, name-based approximation: cheap, no imports executed, and wrong
+only in the conservative direction rules care about (a helper only ever
+called outside jit but *named* like one called inside may be over-flagged —
+that is what inline suppressions are for).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from .core import ModuleInfo, own_nodes
+
+# transforms whose operand is (eventually) jit-compiled when the outer call
+# is a jit binding: jax.jit(jax.value_and_grad(f)) makes f a root
+_WRAPPER_ATTRS = {
+    "grad", "value_and_grad", "vmap", "pmap", "remat", "checkpoint",
+    "custom_vjp", "custom_jvp",
+}
+_JIT_DOTTED = {
+    "jax.jit", "jit", "jax.pjit", "pjit",
+    "jax.experimental.pjit.pjit",
+}
+# structured-control/SPMD combinators whose function-valued arguments are
+# traced (device-side) wherever the combinator itself runs. Deliberately NOT
+# including io_callback / pure_callback / debug.callback — those arguments
+# run on HOST, where syncs and side effects are the whole point.
+_COMBINATOR_ATTRS = {
+    "scan", "cond", "while_loop", "switch", "fori_loop", "associative_scan",
+    "map", "shard_map", "checkpoint", "remat", "custom_vjp", "custom_jvp",
+    "defvjp", "defjvp", "vmap", "grad", "value_and_grad",
+}
+
+FunctionNode = ast.AST  # FunctionDef | AsyncFunctionDef | Lambda
+
+
+class JitGraph:
+    def __init__(self, module: ModuleInfo):
+        self.module = module
+        self.defs_by_name: Dict[str, List[ast.AST]] = {}
+        self.all_defs: List[ast.AST] = []
+        for node in ast.walk(module.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.defs_by_name.setdefault(node.name, []).append(node)
+                self.all_defs.append(node)
+            elif isinstance(node, ast.Lambda):
+                self.all_defs.append(node)
+        self.jit_bindings: List[ast.AST] = []   # Call/decorator nodes binding jit
+        self.roots: Set[ast.AST] = set()
+        self._find_roots()
+        self.reachable: Set[ast.AST] = self._close_over_calls(self.roots)
+
+    # -- root discovery ----------------------------------------------------
+    def _is_jit_expr(self, node: ast.AST) -> bool:
+        """True for an expression that IS a jit transform (bare or partial)."""
+        dotted = self.module.dotted(node)
+        if dotted in _JIT_DOTTED:
+            return True
+        if isinstance(node, ast.Call):
+            fd = self.module.dotted(node.func)
+            if fd in _JIT_DOTTED:
+                return True
+            if fd in {"functools.partial", "partial"} and node.args and \
+                    self.module.dotted(node.args[0]) in _JIT_DOTTED:
+                return True
+        return False
+
+    def _mark_operand(self, arg: ast.AST) -> None:
+        """Mark the function(s) an expression evaluates to as jit roots."""
+        if isinstance(arg, ast.Lambda):
+            self.roots.add(arg)
+        elif isinstance(arg, ast.Name):
+            for d in self.defs_by_name.get(arg.id, ()):
+                self.roots.add(d)
+        elif isinstance(arg, ast.Call):
+            fd = self.module.dotted(arg.func) or ""
+            if fd.rpartition(".")[2] in _WRAPPER_ATTRS and arg.args:
+                self._mark_operand(arg.args[0])
+            else:
+                # factory idiom: jax.jit(make_step()) — mark functions the
+                # factory returns (Return of a local def's name)
+                for d in self.defs_by_name.get(fd, ()):
+                    local = {n.name: n for n in ast.walk(d)
+                             if isinstance(n, (ast.FunctionDef,
+                                               ast.AsyncFunctionDef))}
+                    for n in ast.walk(d):
+                        if isinstance(n, ast.Return) and \
+                                isinstance(n.value, ast.Name) and \
+                                n.value.id in local:
+                            self.roots.add(local[n.value.id])
+
+    def _find_roots(self) -> None:
+        for node in ast.walk(self.module.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    if self._is_jit_expr(dec):
+                        self.roots.add(node)
+                        self.jit_bindings.append(dec)
+            if isinstance(node, ast.Call):
+                dotted = self.module.dotted(node.func)
+                if dotted in _JIT_DOTTED:
+                    self.jit_bindings.append(node)
+                    if node.args:
+                        self._mark_operand(node.args[0])
+                elif (dotted or "").rpartition(".")[2] == "shard_map" \
+                        and node.args:
+                    # shard_map bodies are SPMD-traced (and jitted in every
+                    # call site this tree has) — treat as roots
+                    self._mark_operand(node.args[0])
+
+    # -- closure -----------------------------------------------------------
+    def _close_over_calls(self, roots: Set[ast.AST]) -> Set[ast.AST]:
+        reachable = set(roots)
+        work = list(roots)
+        while work:
+            fn = work.pop()
+            for node in own_nodes(fn):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                     ast.Lambda)) and node not in reachable:
+                    # nested def inside a traced function: part of the trace
+                    reachable.add(node)
+                    work.append(node)
+                elif isinstance(node, ast.Call):
+                    if isinstance(node.func, ast.Name):
+                        for d in self.defs_by_name.get(node.func.id, ()):
+                            if d not in reachable:
+                                reachable.add(d)
+                                work.append(d)
+                    leaf = (self.module.dotted(node.func) or "") \
+                        .rpartition(".")[2]
+                    if leaf in _COMBINATOR_ATTRS:
+                        # function-valued args to combinators are traced too
+                        for arg in node.args:
+                            if isinstance(arg, ast.Name):
+                                for d in self.defs_by_name.get(arg.id, ()):
+                                    if d not in reachable:
+                                        reachable.add(d)
+                                        work.append(d)
+        return reachable
+
+    # -- queries used by rules --------------------------------------------
+    def enclosing_function(self, node: ast.AST) -> Optional[ast.AST]:
+        cur = self.module.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                return cur
+            cur = self.module.parents.get(cur)
+        return None
+
+    def binding_donates(self, binding: ast.AST) -> bool:
+        """Does a jit binding (Call or decorator expr) pass donate_*?"""
+        if isinstance(binding, ast.Call):
+            for kw in binding.keywords:
+                if kw.arg and kw.arg.startswith("donate"):
+                    return True
+        return False
+
+    def binding_target(self, binding: ast.AST) -> Optional[ast.AST]:
+        """The function def a jit *call* binding wraps, when resolvable."""
+        if not (isinstance(binding, ast.Call) and binding.args):
+            return None
+        arg = binding.args[0]
+        if isinstance(arg, ast.Lambda):
+            return arg
+        if isinstance(arg, ast.Name):
+            defs = self.defs_by_name.get(arg.id, ())
+            return defs[-1] if defs else None
+        return None
