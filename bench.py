@@ -199,6 +199,14 @@ def main() -> None:
         "optimizer": {"type": "adamw", "params": {"lr": 1e-4, "weight_decay": 0.01}},
         "bf16": {"enabled": True},
         "zero_optimization": {"stage": 0},
+        # per-phase breakdown next to the end-to-end number: spans + comm
+        # census + compile/memory telemetry land in a metrics JSONL so the
+        # perf trajectory carries more than one scalar (BENCH_OBS=0 opts out)
+        "observability": {
+            "enabled": os.environ.get("BENCH_OBS", "1") == "1",
+            "output_dir": os.environ.get("BENCH_OBS_DIR",
+                                         "bench_results/obs_train"),
+        },
     }
     engine, *_ = deepspeed_tpu.initialize(model=model, config=cfg)
 
@@ -225,6 +233,18 @@ def main() -> None:
     # training flops/token: 6*N for matmul params + attention 12*L*H*S per token
     flops_per_token = 6 * n_params + 12 * cfg_m.num_layers * cfg_m.hidden_size * seq
     mfu = tokens_per_sec * flops_per_token / peak_flops_per_chip()
+
+    from deepspeed_tpu.observability import get_session
+
+    obs = get_session()
+    if obs.enabled:
+        obs.registry.gauge("bench/tokens_per_sec").set(tokens_per_sec)
+        obs.registry.gauge("bench/mfu").set(mfu)
+        obs.dump_metrics(path=os.environ.get("BENCH_METRICS_JSONL",
+                                             "BENCH_metrics_train.jsonl"),
+                         metric=METRIC, steps=steps, batch=batch, seq=seq)
+        obs.export_chrome_trace()
+        obs.close(export=False)   # already exported to the bench paths
 
     print(json.dumps({
         "metric": METRIC,

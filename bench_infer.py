@@ -44,6 +44,18 @@ def hbm_bandwidth() -> float:
 def main() -> None:
     from deepspeed_tpu.inference import init_inference
 
+    # TTFT / decode spans and kv-cache metrics land in a metrics JSONL next
+    # to the BENCH record so the trajectory keeps per-phase breakdowns
+    # (BENCH_OBS=0 opts out)
+    if os.environ.get("BENCH_OBS", "1") == "1":
+        from deepspeed_tpu.config.config import ObservabilityConfig
+        from deepspeed_tpu.observability import configure_observability
+
+        configure_observability(ObservabilityConfig(
+            enabled=True,
+            output_dir=os.environ.get("BENCH_OBS_DIR",
+                                      "bench_results/obs_infer")))
+
     model_name = os.environ.get("BENCH_INFER_MODEL", "llama-7b")
     prompt_len = int(os.environ.get("BENCH_INFER_PROMPT", 512))
     n_new = int(os.environ.get("BENCH_INFER_NEW", 64))
@@ -100,6 +112,18 @@ def main() -> None:
                 * jnp.dtype(jnp.bfloat16).itemsize)
     roofline_tps = hbm_bandwidth() / (param_bytes + kv_bytes)
     frac = decode_tps / roofline_tps
+
+    from deepspeed_tpu.observability import get_session
+
+    obs = get_session()
+    if obs.enabled:
+        obs.registry.gauge("bench/p50_ttft_ms").set(p50_ttft * 1e3)
+        obs.registry.gauge("bench/decode_tokens_per_sec").set(decode_tps)
+        obs.dump_metrics(path=os.environ.get("BENCH_METRICS_JSONL",
+                                             "BENCH_metrics_infer.jsonl"),
+                         metric=f"{model_name}_{dtype_name}_p50_ttft_ms")
+        obs.export_chrome_trace()
+        obs.close(export=False)   # already exported to the bench paths
 
     print(json.dumps({
         "metric": f"{model_name}_{dtype_name}_p50_ttft_ms",

@@ -143,21 +143,55 @@ def _tensor_bytes(t: Any) -> int:
 
 
 def timed_op(fn: Callable) -> Callable:
-    """Comms-logger seam. Collectives only execute for real inside a traced
-    (shard_map/jit) program, where per-op host timing is meaningless — so under
-    tracing we record a *census* event (op + message bytes, once per compile)
-    and leave latency to the jax profiler. Eager calls are identity fallbacks
-    and are never recorded."""
+    """Comms-logger + metrics seam. Collectives only execute for real inside a
+    traced (shard_map/jit) program, where per-op host timing is meaningless —
+    so under tracing we record a *census* event (op + message bytes, once per
+    compile) into both the ``CommsLogger`` and the observability metrics
+    registry, and leave latency to the jax profiler. Eager calls are identity
+    fallbacks and are never recorded."""
 
     @functools.wraps(fn)
     def wrapper(*args, **kwargs):
-        clog = get_comms_logger()
-        if clog is not None and clog.enabled and _in_trace(args):
-            clog.append_traced(fn.__name__, kwargs.get("log_name", fn.__name__),
-                               _tensor_bytes(args[0]) if args else 0)
+        if _in_trace(args):
+            record_name = kwargs.get("log_name", fn.__name__)
+            nbytes = _tensor_bytes(args[0]) if args else 0
+            clog = get_comms_logger()
+            if clog is not None and clog.enabled:
+                clog.append_traced(fn.__name__, record_name, nbytes)
+            _record_comm_metrics(fn.__name__, record_name, nbytes)
         return fn(*args, **kwargs)
 
     return wrapper
+
+
+def _record_comm_metrics(op: str, record_name: str, nbytes: int,
+                         latency_s: Optional[float] = None) -> None:
+    """Publish one collective occurrence into the observability registry
+    (no-op unless an observability session is enabled). The two sources have
+    incomparable units, so they keep separate series: traced census entries
+    (once per compiled program) land in ``comm/ops``/``comm/bytes``;
+    host-timed entries (``CommsLogger.append`` sites — once per actual call)
+    land in ``comm/host_ops``/``comm/host_bytes`` plus a latency histogram."""
+    from ..observability import get_session
+
+    obs = get_session()
+    if not obs.enabled:
+        return
+    reg = obs.registry
+    if latency_s is None:
+        reg.counter("comm/ops", help="collective occurrences (census: once "
+                    "per compiled program)").inc(op=op)
+        reg.counter("comm/bytes", help="collective message bytes (census: "
+                    "once per compiled program)").inc(max(nbytes, 0), op=op)
+    else:
+        reg.counter("comm/host_ops",
+                    help="host-timed collective calls").inc(op=op)
+        reg.counter("comm/host_bytes",
+                    help="host-timed collective bytes").inc(
+                        max(nbytes, 0), op=op)
+        reg.histogram("comm/latency_ms",
+                      help="host-timed collective latency").observe(
+                          latency_s * 1e3, op=record_name)
 
 
 def _in_trace(args: Sequence[Any]) -> bool:

@@ -60,9 +60,14 @@ class CommsLogger:
 
     def append(self, raw_name: str, record_name: str, latency_s: float, msg_size: int) -> None:
         """Record a host-timed op (explicit instrumentation, e.g. engine-level
-        checkpoint transfers)."""
+        checkpoint transfers). Mirrored into the observability registry
+        (op/bytes counters + latency histogram) when a session is enabled."""
         if not self.prof_all and record_name not in self.prof_ops:
             return
+        from .comm import _record_comm_metrics
+
+        _record_comm_metrics(raw_name, record_name, msg_size,
+                             latency_s=latency_s)
         size, algbw, busbw = calc_bw_log(raw_name, msg_size, latency_s, self.world_size)
         self.comms_dict[record_name][size].append(latency_s * 1000.0)
         if self.verbose:

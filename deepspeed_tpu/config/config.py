@@ -264,6 +264,34 @@ class MonitorConfig(ConfigModel):
 
 
 @dataclass
+class ObservabilityConfig(ConfigModel):
+    """Gate for ``deepspeed_tpu.observability`` — span tracer, metrics
+    registry file output, recompile watchdog, memory gauges. Off by default:
+    a disabled session records nothing and writes no files (tier-1 cost is
+    zero); the monitor writers still work independently of this switch."""
+
+    enabled: bool = False
+    output_dir: str = ""               # "" => ./dstpu_obs
+    trace_file: str = "trace.jsonl"            # append-only span records
+    chrome_trace_file: str = "trace_chrome.json"  # chrome://tracing export
+    metrics_file: str = "metrics.jsonl"        # registry snapshot dump
+    all_ranks: bool = False            # False => rank-0 only (reference norm)
+    max_spans: int = 100_000           # in-memory span cap (JSONL unaffected)
+    recompile_watchdog: bool = True    # jax.monitoring compile listeners
+    steady_state_step: int = 10        # recompiles past this step warn
+    memory_poll_steps: int = 10        # device-memory gauge cadence
+    profile_dir: str = "/tmp/dstpu_trace"  # engine.start_profile() trace dir
+
+    def validate(self) -> None:
+        if self.max_spans < 1:
+            raise ConfigError("observability.max_spans must be >= 1")
+        if self.memory_poll_steps < 1:
+            raise ConfigError("observability.memory_poll_steps must be >= 1")
+        if self.steady_state_step < 0:
+            raise ConfigError("observability.steady_state_step must be >= 0")
+
+
+@dataclass
 class ElasticityConfig(ConfigModel):
     """Reference: elasticity/config.py — pure batch/world-size math."""
 
@@ -411,6 +439,8 @@ class Config(ConfigModel):
     comms_logger: CommsLoggerConfig = field(default_factory=CommsLoggerConfig)
     flops_profiler: FlopsProfilerConfig = field(default_factory=FlopsProfilerConfig)
     monitor: MonitorConfig = field(default_factory=MonitorConfig)
+    observability: ObservabilityConfig = field(
+        default_factory=ObservabilityConfig)
     elasticity: ElasticityConfig = field(default_factory=ElasticityConfig)
     curriculum_learning: CurriculumConfig = field(default_factory=CurriculumConfig)
     progressive_layer_drop: ProgressiveLayerDropConfig = field(

@@ -20,7 +20,6 @@ greedy/temperature/top-k sampling, early-EOS masking.
 from __future__ import annotations
 
 import dataclasses
-import time
 from functools import partial
 from typing import Any, Dict, Optional, Tuple
 
@@ -31,6 +30,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models.core import Model, cast_floating, resolve_param_specs
 from ..models.presets import create_model
+from ..observability import get_session
 from ..parallel import mesh as mesh_mod
 from ..utils.logging import log_dist, logger
 from . import kv_cache
@@ -293,6 +293,7 @@ class InferenceEngine:
         # allocation is wasted HBM traffic at serving cadence
         self._arena: Dict[int, Any] = {}
         self._fwd = None
+        self._generate_calls = 0   # observability step counter (watchdog)
         n = sum(int(p.size) for p in jax.tree.leaves(self.params))
         log_dist(f"inference engine ready: {n / 1e6:.1f}M params, tp={tp}, "
                  f"ep={ep}, "
@@ -465,29 +466,60 @@ class InferenceEngine:
                 # stale keys stay masked by `valid` and are overwritten as
                 # prefill/decode proceed
                 cache = {**cache, "index": jnp.zeros_like(cache["index"])}
-            t0 = time.perf_counter()
-            logits, cache = self._prefill_cache[key_p](
-                self.params, ids_pad, valid, cache)
-            # rewind the write cursor from the padded to the true prompt
-            # length: decoded tokens must take positions S, S+1, ... — the
-            # junk keys prefill wrote in the padding slots stay masked and
-            # get overwritten as decoding proceeds
-            cache = {**cache, "index": jnp.full_like(cache["index"], S)}
-            lengths = mask.sum(-1)
-            last = jnp.take_along_axis(
-                logits, (lengths - 1)[:, None, None], axis=1)[:, 0]
-            rng, r_first = jax.random.split(jax.random.PRNGKey(seed))
-            first = _sample(last, r_first, temperature, top_k, top_p)
-            first = jax.block_until_ready(first)
-            ttft = time.perf_counter() - t0
+            # TTFT through the span tracer: the span brackets prefill +
+            # first-token sampling, and the explicit block_until_ready is
+            # the async-dispatch fence that makes the wall-clock real (the
+            # tpulint wallclock-timing-without-sync contract). A disabled
+            # tracer still measures, so return_ttft works without telemetry.
+            obs = get_session()
+            prefill_span = obs.span("inference/prefill", sync=False,
+                                    batch=B, prompt_tokens=int(S))
+            with prefill_span:
+                logits, cache = self._prefill_cache[key_p](
+                    self.params, ids_pad, valid, cache)
+                # rewind the write cursor from the padded to the true prompt
+                # length: decoded tokens must take positions S, S+1, ... — the
+                # junk keys prefill wrote in the padding slots stay masked and
+                # get overwritten as decoding proceeds
+                cache = {**cache, "index": jnp.full_like(cache["index"], S)}
+                lengths = mask.sum(-1)
+                last = jnp.take_along_axis(
+                    logits, (lengths - 1)[:, None, None], axis=1)[:, 0]
+                rng, r_first = jax.random.split(jax.random.PRNGKey(seed))
+                first = _sample(last, r_first, temperature, top_k, top_p)
+                first = jax.block_until_ready(first)
+            ttft = prefill_span.duration_s
             if n_rest == 0:
                 out = first[:, None]
             else:
-                rest, cache = self._decode_cache[key_d](
-                    self.params, cache, valid, first, lengths,
-                    jnp.float32(S), rng)
-                out = jnp.concatenate([first[:, None], rest], axis=1)
+                decode_span = obs.span("inference/decode",
+                                       sync=True, batch=B,
+                                       new_tokens=int(n_rest))
+                with decode_span:
+                    rest, cache = self._decode_cache[key_d](
+                        self.params, cache, valid, first, lengths,
+                        jnp.float32(S), rng)
+                    out = jnp.concatenate([first[:, None], rest], axis=1)
+                # publish only when the span actually synced (a disabled or
+                # rank-gated tracer hands back a non-syncing span): an
+                # unfenced duration times the enqueue, not the decode
+                if decode_span.sync and decode_span.duration_s > 0:
+                    obs.registry.gauge(
+                        "inference/decode_tokens_per_sec").set(
+                            B * n_rest / decode_span.duration_s)
             self._arena[B] = cache
+            if obs.enabled:
+                obs.registry.histogram(
+                    "inference/ttft_ms",
+                    help="prefill + first token wall ms").observe(
+                        ttft * 1e3, batch=B)
+                obs.registry.gauge(
+                    "inference/kv_cache_occupancy",
+                    help="fraction of the KV arena holding live tokens"
+                ).set((S + max_new_tokens) / T_max, batch=B)
+                obs.note_step(self._generate_calls)
+                obs.maybe_record_memory(self._generate_calls)
+                self._generate_calls += 1
         return (out, ttft) if return_ttft else out
 
 

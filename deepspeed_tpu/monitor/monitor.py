@@ -1,12 +1,25 @@
 """Metrics monitoring — analog of ``deepspeed/monitor/`` (``MonitorMaster``
 monitor.py:29 fanning (name, value, step) events out to TensorBoard / WandB /
-CSV writers, rank-0 gated)."""
+CSV writers, rank-0 gated).
+
+These writers are **exporters of the observability metrics registry**
+(``deepspeed_tpu.observability.metrics.MetricsRegistry``), not an independent
+event path: the engine publishes loss/lr/grad-norm/throughput into the
+registry and hands ``registry.publish(step)``'s scalarized snapshot to its
+own ``MonitorMaster`` through the ``write_events`` contract below (the
+registry is a process singleton, so the engine deliberately does NOT attach
+its monitor as a registry-global exporter — that would cross-feed every
+engine's metrics into every other engine's monitors). ``write_events`` stays
+public, but nothing in the engine calls it with a hand-built event list
+anymore; ``registry.attach_exporter(master)`` remains available for user
+code that wants unscoped fan-out.
+"""
 
 from __future__ import annotations
 
 import csv
 import os
-from typing import List, Optional, Tuple
+from typing import Dict, List, Optional, TextIO, Tuple
 
 import jax
 
@@ -25,30 +38,55 @@ class BaseWriter:
     def flush(self) -> None:
         pass
 
+    def close(self) -> None:
+        pass
+
 
 class CSVMonitor(BaseWriter):
-    """Reference monitor/csv_monitor.py: one csv file per metric name."""
+    """Reference monitor/csv_monitor.py: one csv file per metric name.
+
+    File handles are opened once per metric, kept in ``self._files``, and
+    line-buffered — ``flush()``/``close()`` complete the lifecycle so short
+    runs cannot lose tail rows to an unflushed buffer (and steady-state
+    writes skip the per-event open/close syscall churn)."""
 
     def __init__(self, config) -> None:
         self.enabled = config.enabled and jax.process_index() == 0
         self.output_path = config.output_path or "./csv_monitor"
         self.job_name = config.job_name
-        self._files = {}
+        self._files: Dict[str, TextIO] = {}
         if self.enabled:
             os.makedirs(os.path.join(self.output_path, self.job_name), exist_ok=True)
+
+    def _handle(self, name: str) -> TextIO:
+        fh = self._files.get(name)
+        if fh is None or fh.closed:
+            fname = os.path.join(self.output_path, self.job_name,
+                                 name.replace("/", "_") + ".csv")
+            new = not os.path.exists(fname)
+            fh = open(fname, "a", newline="", buffering=1)
+            if new:
+                csv.writer(fh).writerow(["step", name])
+            self._files[name] = fh
+        return fh
 
     def write_events(self, events: List[Event]) -> None:
         if not self.enabled:
             return
         for name, value, step in events:
-            fname = os.path.join(self.output_path, self.job_name,
-                                 name.replace("/", "_") + ".csv")
-            new = not os.path.exists(fname)
-            with open(fname, "a", newline="") as fh:
-                w = csv.writer(fh)
-                if new:
-                    w.writerow(["step", name])
-                w.writerow([step, value])
+            csv.writer(self._handle(name)).writerow([step, value])
+
+    def flush(self) -> None:
+        for fh in self._files.values():
+            if not fh.closed:
+                fh.flush()
+
+    def close(self) -> None:
+        for fh in self._files.values():
+            if not fh.closed:
+                fh.close()
+        self._files.clear()
+        self.enabled = False   # terminal, like the TB/WandB writers
 
 
 class TensorBoardMonitor(BaseWriter):
@@ -75,6 +113,11 @@ class TensorBoardMonitor(BaseWriter):
         if self.enabled:
             self.summary_writer.flush()
 
+    def close(self) -> None:
+        if self.enabled:
+            self.summary_writer.close()
+            self.enabled = False
+
 
 class WandbMonitor(BaseWriter):
     def __init__(self, config) -> None:
@@ -96,9 +139,16 @@ class WandbMonitor(BaseWriter):
         for name, value, step in events:
             self._wandb.log({name: value}, step=step)
 
+    def close(self) -> None:
+        if self.enabled:
+            self._wandb.finish()
+            self.enabled = False
+
 
 class MonitorMaster(BaseWriter):
-    """Fan-out to all enabled writers (reference monitor/monitor.py:29)."""
+    """Fan-out to all enabled writers (reference monitor/monitor.py:29).
+    Attach to a ``MetricsRegistry`` via ``registry.attach_exporter(master)``
+    to receive its ``publish(step)`` snapshots."""
 
     def __init__(self, config: Optional[MonitorConfig] = None):
         config = config or MonitorConfig()
@@ -112,7 +162,12 @@ class MonitorMaster(BaseWriter):
     def write_events(self, events: List[Event]) -> None:
         for w in self.writers:
             w.write_events(events)
+        self.flush()
 
     def flush(self) -> None:
         for w in self.writers:
             w.flush()
+
+    def close(self) -> None:
+        for w in self.writers:
+            w.close()

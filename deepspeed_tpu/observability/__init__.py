@@ -1,0 +1,199 @@
+"""``deepspeed_tpu.observability`` — the one substrate the whole stack
+publishes telemetry into.
+
+The reference DeepSpeed ships telemetry as disconnected islands
+(``utils/timer.py``, ``monitor/``, ``utils/comms_logging.py``, the flops
+profiler); this package unifies them behind two process-local primitives plus
+two TPU-specific watchers:
+
+* :mod:`.spans`   — hierarchical wall-clock span tracer (context manager /
+  decorator, rank-0 aware, sync-honest), exporting Chrome trace-event JSON
+  and append-only JSONL;
+* :mod:`.metrics` — ``MetricsRegistry`` of labeled counters / gauges /
+  histograms; the ``monitor/`` CSV/TB/WandB writers are *exporters* of this
+  registry, not a parallel event path;
+* :mod:`.recompile` — XLA recompilation watchdog on ``jax.monitoring``
+  listeners: compile counts + seconds attributed to the active span, warning
+  when a steady-state step recompiles;
+* :mod:`.memory`  — device HBM gauges via ``device.memory_stats()`` (no-op
+  guarded on stat-less backends) + host RSS.
+
+Everything is **off by default** (``ObservabilityConfig.enabled``); a
+disabled session records nothing and writes no files, so tier-1 cost is zero.
+``python -m deepspeed_tpu.observability report <jsonl...>`` summarizes runs.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+from .memory import record_memory
+from .metrics import Counter, Gauge, Histogram, MetricsRegistry, get_registry
+from .recompile import RecompileWatchdog, get_watchdog
+from .recompile import install as install_watchdog
+from .recompile import uninstall as uninstall_watchdog
+from .spans import Span, SpanTracer, noop_tracer
+
+__all__ = [
+    "Observability", "configure_observability", "get_session", "reset_session",
+    "SpanTracer", "Span", "noop_tracer",
+    "MetricsRegistry", "Counter", "Gauge", "Histogram", "get_registry",
+    "RecompileWatchdog", "install_watchdog", "uninstall_watchdog",
+    "get_watchdog", "record_memory",
+]
+
+
+class Observability:
+    """One configured observability session: tracer + registry + watchdog +
+    output paths. The engine owns one; the *current* session (module global)
+    is what free-function call sites (``comm``, inference) publish through."""
+
+    def __init__(self, config: Optional[Any] = None,
+                 process_index: Optional[int] = None):
+        if config is None:
+            from ..config.config import ObservabilityConfig
+
+            config = ObservabilityConfig()
+        self.config = config
+        self.enabled = bool(config.enabled)
+        self.output_dir = (config.output_dir or "./dstpu_obs") \
+            if self.enabled else ""
+        self.registry = get_registry()
+        jsonl = (os.path.join(self.output_dir, config.trace_file)
+                 if self.enabled else None)
+        self.tracer = SpanTracer(enabled=self.enabled, jsonl_path=jsonl,
+                                 all_ranks=config.all_ranks,
+                                 max_spans=config.max_spans,
+                                 process_index=process_index)
+        self.watchdog: Optional[RecompileWatchdog] = None
+        if self.enabled and config.recompile_watchdog:
+            self.watchdog = install_watchdog(
+                registry=self.registry, tracer=self.tracer,
+                steady_state_step=config.steady_state_step)
+        self._mem_has_device_stats = None
+        self._closed = False
+        if self.enabled:
+            # nothing in the engine API marks "the run is over", so the final
+            # metrics/chrome exports ride process exit; close() is idempotent,
+            # so sessions torn down earlier (tests, bench) no-op here
+            import atexit
+
+            atexit.register(self.close)
+
+    # -- thin delegates (the API integration sites use) -------------------
+    def span(self, name: str, category: str = "span", sync: bool = False,
+             **attrs: Any) -> Span:
+        return self.tracer.span(name, category=category, sync=sync, **attrs)
+
+    def note_step(self, global_step: int) -> None:
+        if self.watchdog is not None:
+            self.watchdog.note_step(global_step)
+
+    def maybe_record_memory(self, step: int) -> None:
+        """Poll memory gauges at ``memory_poll_steps`` cadence; the first
+        reported step always polls, so short (smoke) runs still carry memory
+        telemetry."""
+        if not self.enabled:
+            return
+        every = max(int(self.config.memory_poll_steps), 1)
+        if self._mem_has_device_stats is None or step % every == 0:
+            self._mem_has_device_stats = record_memory(self.registry)
+
+    # -- output -----------------------------------------------------------
+    def metrics_path(self) -> Optional[str]:
+        if not self.enabled:
+            return None
+        return os.path.join(self.output_dir, self.config.metrics_file)
+
+    def chrome_trace_path(self) -> Optional[str]:
+        if not self.enabled:
+            return None
+        return os.path.join(self.output_dir, self.config.chrome_trace_file)
+
+    def dump_metrics(self, path: Optional[str] = None, **extra: Any) -> Optional[str]:
+        """Write the registry snapshot (+ recompile report) as JSONL. Honors
+        the same rank gate as the tracer (``all_ranks=False`` => rank 0
+        only), so N processes sharing an output dir don't interleave appends
+        into one file."""
+        path = path or self.metrics_path()
+        if path is None or not self.tracer.enabled:
+            return None
+        if self.watchdog is not None:
+            extra.setdefault("recompile_report", self.watchdog.report())
+        return self.registry.dump_jsonl(path, extra=extra or None)
+
+    def export_chrome_trace(self, path: Optional[str] = None) -> Optional[str]:
+        path = path or self.chrome_trace_path()
+        if path is None or not self.tracer.enabled:
+            return None
+        return self.tracer.export_chrome_trace(path)
+
+    def flush(self) -> None:
+        self.tracer.flush()
+
+    def close(self, export: bool = True) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        if self.enabled and export:
+            try:
+                self.dump_metrics()
+                self.export_chrome_trace()
+            except Exception:  # telemetry must never take the job down
+                from ..utils.logging import logger
+
+                logger.warning("observability export failed on close",
+                               exc_info=True)
+        self.tracer.close()
+        if self.watchdog is not None and get_watchdog() is self.watchdog:
+            uninstall_watchdog()
+
+
+_SESSION: Optional[Observability] = None
+_DISABLED: Optional[Observability] = None
+
+
+def _disabled_session() -> Observability:
+    global _DISABLED
+    if _DISABLED is None:
+        _DISABLED = Observability(config=None, process_index=0)
+    return _DISABLED
+
+
+def configure_observability(config: Optional[Any] = None,
+                            process_index: Optional[int] = None,
+                            make_current: bool = True) -> Observability:
+    """Build a session from an ``ObservabilityConfig``. An enabled session
+    becomes the *current* one (what ``get_session()`` returns — the hook the
+    comm layer and inference engine publish through); a disabled config
+    returns the shared no-op session and leaves any current session alone,
+    so constructing a telemetry-free engine never tears down a live trace."""
+    global _SESSION
+    if config is None or not getattr(config, "enabled", False):
+        return _disabled_session()
+    session = Observability(config, process_index=process_index)
+    if make_current:
+        if _SESSION is not None and _SESSION is not session:
+            # close (without exporting) the session being replaced: left
+            # open, its LIFO atexit hook would run LAST and overwrite the
+            # live run's exports with stale data, and its JSONL handle
+            # would leak until exit
+            _SESSION.close(export=False)
+        _SESSION = session
+    return session
+
+
+def get_session() -> Observability:
+    """The current session; a shared disabled one when nothing is configured
+    (callers never need a None check — test ``.enabled``)."""
+    return _SESSION if _SESSION is not None else _disabled_session()
+
+
+def reset_session(close: bool = True) -> None:
+    """Tear down the current session (tests / end of run)."""
+    global _SESSION
+    if _SESSION is not None and close:
+        _SESSION.close(export=False)
+    _SESSION = None
+    uninstall_watchdog()

@@ -1,0 +1,19 @@
+"""CLI entry: ``python -m deepspeed_tpu.observability report <files...>``."""
+
+import sys
+
+from .report import main
+
+if __name__ == "__main__":
+    args = sys.argv[1:]
+    if args and args[0] == "report":
+        args = args[1:]
+        if not args:
+            print("usage: python -m deepspeed_tpu.observability report "
+                  "<trace.jsonl|metrics.jsonl> [...]", file=sys.stderr)
+            sys.exit(2)
+    elif args and not args[0].startswith("-"):
+        print(f"unknown subcommand '{args[0]}' (only 'report')",
+              file=sys.stderr)
+        sys.exit(2)
+    sys.exit(main(args))

@@ -1,0 +1,171 @@
+"""Recompilation watchdog — observes the cost nothing else in the tree sees.
+
+On TPU the two silent budget-eaters are XLA recompilation and HBM pressure;
+this module covers the first. It hooks ``jax.monitoring``'s process-wide
+event/duration listeners (the channel jit itself reports through — no
+monkey-patching) and
+
+* counts backend compiles and attributes their seconds to the innermost open
+  span at the moment the compile happens (compiles run synchronously on the
+  calling thread, so the span stack *is* the attribution);
+* counts compilation-cache interactions (``tasks_using_cache`` /
+  ``cache_hits``-family events);
+* publishes everything into the metrics registry: counter ``xla/compiles``,
+  histogram ``xla/compile_seconds`` (labeled ``where=<span name>``), counter
+  ``xla/cache_events``;
+* **warns when a steady-state step recompiles**: after the engine reports
+  ``note_step(n)`` with ``n >= steady_state_step``, a REPEAT compile at an
+  already-seen site is a likely shape/weak-type leak re-specializing the hot
+  step — exactly the bug class that silently converts a 4ms step into a 40s
+  one. (A site's first compile stays silent — a first ``eval_batch`` or a
+  freshly built inference engine past the threshold is not a regression.)
+
+``jax.monitoring`` in the pinned jax has no targeted unregister (only a global
+``clear_event_listeners``), so the listeners are installed once per process
+and consult a module-level active watchdog; ``uninstall()`` just clears that
+pointer — cheap, and safe for tests.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional
+
+from ..utils.logging import logger
+
+_COMPILE_DURATION_EVENTS = (
+    "/jax/core/compile/backend_compile_duration",
+)
+_TRACE_DURATION_EVENTS = (
+    "/jax/core/compile/jaxpr_trace_duration",
+)
+_CACHE_EVENT_PREFIX = "/jax/compilation_cache/"
+
+
+class RecompileWatchdog:
+    """Counts jit cache misses / compile seconds and flags steady-state
+    recompiles. One instance is active per process (see ``install``)."""
+
+    def __init__(self, registry=None, tracer=None, steady_state_step: int = 10):
+        from .metrics import get_registry
+        from .spans import noop_tracer
+
+        self.registry = registry if registry is not None else get_registry()
+        self.tracer = tracer if tracer is not None else noop_tracer()
+        self.steady_state_step = steady_state_step
+        self._lock = threading.Lock()
+        self._steady = False
+        self._last_step = -1
+        self.compile_count = 0
+        self.compile_seconds = 0.0
+        self.steady_state_compiles = 0
+        self.per_site: Dict[str, Dict[str, float]] = {}
+
+    # -- engine hook ------------------------------------------------------
+    def note_step(self, global_step: int) -> None:
+        """The training/inference loop reports step boundaries; once past
+        ``steady_state_step`` distinct steps, further compiles warn."""
+        with self._lock:
+            self._last_step = global_step
+            if global_step >= self.steady_state_step:
+                self._steady = True
+
+    # -- jax.monitoring callbacks ----------------------------------------
+    def on_duration(self, name: str, secs: float, **kw: Any) -> None:
+        if name in _TRACE_DURATION_EVENTS:
+            self.registry.histogram(
+                "xla/trace_seconds",
+                help="jaxpr trace time per jit specialization").observe(secs)
+            return
+        if name not in _COMPILE_DURATION_EVENTS:
+            return
+        where = self.tracer.current_name() or "<untraced>"
+        with self._lock:
+            self.compile_count += 1
+            self.compile_seconds += secs
+            site = self.per_site.setdefault(where, {"count": 0, "seconds": 0.0})
+            site["count"] += 1
+            site["seconds"] += secs
+            # a site's FIRST compile past the threshold is a legitimately new
+            # function (first eval_batch, a fresh inference engine...); only a
+            # REPEAT compile at the same site is a hot path re-specializing
+            steady = self._steady and site["count"] > 1
+            step = self._last_step
+            if steady:
+                self.steady_state_compiles += 1
+        self.registry.counter(
+            "xla/compiles", help="XLA backend compiles").inc(where=where)
+        self.registry.histogram(
+            "xla/compile_seconds",
+            help="XLA backend compile wall seconds").observe(secs, where=where)
+        if steady:
+            self.registry.counter(
+                "xla/steady_state_recompiles",
+                help="compiles after the steady-state step threshold").inc(
+                    where=where)
+            logger.warning(
+                f"steady-state recompilation at step {step}: {secs:.2f}s "
+                f"compiling under span '{where}' — a shape, dtype or static-"
+                "arg change is re-specializing a hot function "
+                f"(threshold steady_state_step={self.steady_state_step})")
+
+    def on_event(self, name: str, **kw: Any) -> None:
+        if name.startswith(_CACHE_EVENT_PREFIX):
+            self.registry.counter(
+                "xla/cache_events",
+                help="persistent-compilation-cache interactions").inc(
+                    event=name[len(_CACHE_EVENT_PREFIX):])
+
+    # -- reporting --------------------------------------------------------
+    def report(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "compiles": self.compile_count,
+                "compile_seconds": round(self.compile_seconds, 4),
+                "steady_state_recompiles": self.steady_state_compiles,
+                "per_site": {k: dict(v) for k, v in self.per_site.items()},
+            }
+
+
+_LISTENERS_REGISTERED = False
+_ACTIVE: Optional[RecompileWatchdog] = None
+
+
+def _dispatch_duration(name: str, secs: float, **kw: Any) -> None:
+    wd = _ACTIVE
+    if wd is not None:
+        wd.on_duration(name, secs, **kw)
+
+
+def _dispatch_event(name: str, **kw: Any) -> None:
+    wd = _ACTIVE
+    if wd is not None:
+        wd.on_event(name, **kw)
+
+
+def install(registry=None, tracer=None,
+            steady_state_step: int = 10) -> RecompileWatchdog:
+    """Activate a watchdog (replacing any previous one). The process-wide
+    ``jax.monitoring`` listeners are registered exactly once and dispatch to
+    whichever watchdog is active — so repeated engine constructions (tests!)
+    never stack listeners."""
+    global _LISTENERS_REGISTERED, _ACTIVE
+    wd = RecompileWatchdog(registry=registry, tracer=tracer,
+                           steady_state_step=steady_state_step)
+    if not _LISTENERS_REGISTERED:
+        from jax import monitoring
+
+        monitoring.register_event_duration_secs_listener(_dispatch_duration)
+        monitoring.register_event_listener(_dispatch_event)
+        _LISTENERS_REGISTERED = True
+    _ACTIVE = wd
+    return wd
+
+
+def uninstall() -> None:
+    global _ACTIVE
+    _ACTIVE = None
+
+
+def get_watchdog() -> Optional[RecompileWatchdog]:
+    return _ACTIVE

@@ -1,0 +1,150 @@
+"""``python -m deepspeed_tpu.observability report <file.jsonl> [...]``
+
+Summarizes the JSONL the tracer and registry write: per-span aggregates
+(count / total / mean / max wall ms, tree-indented by median depth), metric
+tables (counters, gauges, histogram stats) and the recompile section. Accepts
+any mix of trace and metrics files — records are discriminated by ``type``.
+Stdlib only, so it runs anywhere the files land (including CI containers with
+no jax installed).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from collections import defaultdict
+from typing import Any, Dict, Iterable, List, Tuple
+
+
+def load_records(paths: Iterable[str]) -> List[Dict[str, Any]]:
+    records: List[Dict[str, Any]] = []
+    for path in paths:
+        with open(path) as fh:
+            for i, line in enumerate(fh, start=1):
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    records.append(json.loads(line))
+                except json.JSONDecodeError:
+                    print(f"warning: {path}:{i}: unparseable line skipped",
+                          file=sys.stderr)
+    return records
+
+
+def _fmt_table(headers: List[str], rows: List[List[str]]) -> str:
+    widths = [max(len(h), *(len(r[i]) for r in rows)) if rows else len(h)
+              for i, h in enumerate(headers)]
+    fmt = "  ".join(f"{{:<{w}}}" for w in widths)
+    lines = [fmt.format(*headers), fmt.format(*("-" * w for w in widths))]
+    lines += [fmt.format(*r) for r in rows]
+    return "\n".join(lines)
+
+
+def summarize_spans(records: List[Dict[str, Any]]) -> str:
+    spans = [r for r in records if r.get("type") == "span"]
+    if not spans:
+        return ""
+    agg: Dict[str, Dict[str, float]] = defaultdict(
+        lambda: {"count": 0, "total_us": 0.0, "max_us": 0.0, "depth": 0})
+    order: List[str] = []
+    for s in spans:
+        name = s.get("name", "?")
+        if name not in agg:
+            order.append(name)
+        a = agg[name]
+        a["count"] += 1
+        a["total_us"] += s.get("dur_us", 0.0)
+        a["max_us"] = max(a["max_us"], s.get("dur_us", 0.0))
+        a["depth"] = max(a["depth"], s.get("depth", 0))
+    rows = []
+    for name in sorted(order, key=lambda n: -agg[n]["total_us"]):
+        a = agg[name]
+        rows.append([
+            "  " * int(a["depth"]) + name,
+            str(int(a["count"])),
+            f"{a['total_us'] / 1e3:.2f}",
+            f"{a['total_us'] / 1e3 / max(a['count'], 1):.2f}",
+            f"{a['max_us'] / 1e3:.2f}",
+        ])
+    return ("== spans ==\n"
+            + _fmt_table(["span", "count", "total_ms", "mean_ms", "max_ms"],
+                         rows))
+
+
+def _label_str(labels: Dict[str, Any]) -> str:
+    return ",".join(f"{k}={v}" for k, v in sorted(labels.items())) or "-"
+
+
+def summarize_metrics(records: List[Dict[str, Any]]) -> str:
+    out: List[str] = []
+    counters = [r for r in records if r.get("type") == "counter"]
+    gauges = [r for r in records if r.get("type") == "gauge"]
+    hists = [r for r in records if r.get("type") == "histogram"]
+    if counters:
+        # later records supersede earlier ones (counters are cumulative)
+        latest: Dict[Tuple[str, str], float] = {}
+        for r in counters:
+            latest[(r["name"], _label_str(r.get("labels", {})))] = r["value"]
+        rows = [[n, l, f"{v:.0f}" if float(v).is_integer() else f"{v:.3f}"]
+                for (n, l), v in sorted(latest.items())]
+        out.append("== counters ==\n"
+                   + _fmt_table(["counter", "labels", "value"], rows))
+    if gauges:
+        latest = {}
+        for r in gauges:
+            latest[(r["name"], _label_str(r.get("labels", {})))] = r["value"]
+        rows = [[n, l, f"{v:.6g}"] for (n, l), v in sorted(latest.items())]
+        out.append("== gauges ==\n"
+                   + _fmt_table(["gauge", "labels", "value"], rows))
+    if hists:
+        latest_h: Dict[Tuple[str, str], Dict[str, Any]] = {}
+        for r in hists:
+            latest_h[(r["name"], _label_str(r.get("labels", {})))] = r
+        rows = [[n, l, str(int(r.get("count", 0))), f"{r.get('mean', 0):.6g}",
+                 f"{r.get('min', 0):.6g}", f"{r.get('max', 0):.6g}"]
+                for (n, l), r in sorted(latest_h.items())]
+        out.append("== histograms ==\n"
+                   + _fmt_table(["histogram", "labels", "count", "mean",
+                                 "min", "max"], rows))
+    return "\n\n".join(out)
+
+
+def summarize_recompiles(records: List[Dict[str, Any]]) -> str:
+    compiles = [r for r in records
+                if r.get("type") == "counter" and r.get("name") == "xla/compiles"]
+    if not compiles:
+        return ""
+    latest: Dict[str, float] = {}
+    for r in compiles:
+        latest[r.get("labels", {}).get("where", "?")] = r["value"]
+    steady = [r for r in records
+              if r.get("type") == "counter"
+              and r.get("name") == "xla/steady_state_recompiles"]
+    total = sum(latest.values())
+    lines = [f"== recompiles ==  total={total:.0f}"]
+    for where, n in sorted(latest.items(), key=lambda kv: -kv[1]):
+        lines.append(f"  {where}: {n:.0f}")
+    if steady:
+        lines.append("  !! steady-state recompiles detected — a hot step is "
+                     "re-specializing (see xla/steady_state_recompiles)")
+    return "\n".join(lines)
+
+
+def report(paths: List[str]) -> str:
+    records = load_records(paths)
+    sections = [s for s in (summarize_spans(records),
+                            summarize_metrics(records),
+                            summarize_recompiles(records)) if s]
+    if not sections:
+        return "no span or metric records found"
+    return "\n\n".join(sections)
+
+
+def main(argv: List[str]) -> int:
+    if not argv or argv[0] in ("-h", "--help"):
+        print("usage: python -m deepspeed_tpu.observability report "
+              "<trace.jsonl|metrics.jsonl> [...]")
+        return 0 if argv else 2
+    print(report(argv))
+    return 0

@@ -1,0 +1,261 @@
+"""Hierarchical span tracer — the wall-clock half of the observability layer.
+
+The reference DeepSpeed times things with ad-hoc ``SynchronizedWallClockTimer``
+instances and NVTX ranges; here one process-local tracer owns every timed
+region. A *span* is a named wall-clock interval with attributes; spans nest
+(context manager / decorator / explicit begin-end for non-lexical regions like
+``start_profile``..``stop_profile``) and the tracer records the completed tree.
+
+Two export formats, both loadable without this package:
+
+* **Chrome trace-event JSON** (``export_chrome_trace``) — complete ``"ph": "X"``
+  events; open in ``chrome://tracing`` / Perfetto.
+* **Append-only JSONL** (``jsonl_path``) — one record per closed span, written
+  as it closes, so a killed run keeps its tail. The ``report`` CLI
+  (``python -m deepspeed_tpu.observability report``) summarizes it.
+
+TPU honesty rule: a jitted call returns before the device finishes (async
+dispatch), so a naive wall-clock around it times the *enqueue*, not the work.
+Spans therefore carry ``sync=``: a syncing span drains the dispatch queue at
+entry and exit (the ``cudaEventSynchronize`` analog), making its duration a
+true device-inclusive measurement. Non-syncing spans are free and honest about
+what they are — their records carry ``"synced": false``.
+
+Rank-awareness: by default only process 0 records (the reference's rank-0
+logging convention); ``all_ranks=True`` records everywhere, with the process
+index in every record's ``pid``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from ..utils.logging import logger
+
+
+def _drain_dispatch_queue() -> None:
+    """Block until previously dispatched device work completes. Enqueues a
+    trivial computation and drains it — XLA executes per-device programs in
+    dispatch order, so this returns only after everything before it."""
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        (jnp.zeros(()) + 0).block_until_ready()
+    except Exception:
+        pass
+
+
+class Span:
+    """One open (then closed) timed region. Returned by ``SpanTracer.span``;
+    ``duration_s`` is valid after the context exits (or after ``end()``)."""
+
+    __slots__ = ("name", "category", "attrs", "sync", "depth", "parent_name",
+                 "start_ns", "end_ns", "_tracer")
+
+    def __init__(self, name: str, category: str, sync: bool, attrs: Dict[str, Any],
+                 tracer: Optional["SpanTracer"]):
+        self.name = name
+        self.category = category
+        self.attrs = attrs
+        self.sync = sync
+        self.depth = 0
+        self.parent_name: Optional[str] = None
+        self.start_ns = 0
+        self.end_ns = 0
+        self._tracer = tracer
+
+    @property
+    def duration_s(self) -> float:
+        return (self.end_ns - self.start_ns) / 1e9
+
+    def annotate(self, **attrs: Any) -> "Span":
+        self.attrs.update(attrs)
+        return self
+
+    # -- lifecycle --------------------------------------------------------
+    def begin(self) -> "Span":
+        if self.sync:
+            _drain_dispatch_queue()
+        t = self._tracer
+        if t is not None:
+            stack = t._stack()
+            self.depth = len(stack)
+            self.parent_name = stack[-1].name if stack else None
+            stack.append(self)
+        self.start_ns = time.perf_counter_ns()
+        return self
+
+    def end(self) -> "Span":
+        if self.sync:
+            _drain_dispatch_queue()
+        self.end_ns = time.perf_counter_ns()
+        t = self._tracer
+        if t is not None:
+            stack = t._stack()
+            # pop through any unclosed children (non-lexical misuse) so the
+            # stack cannot leak depth
+            while stack and stack[-1] is not self:
+                stack.pop()
+            if stack:
+                stack.pop()
+            t._record(self)
+        return self
+
+    def __enter__(self) -> "Span":
+        return self.begin()
+
+    def __exit__(self, *exc) -> None:
+        self.end()
+
+    def to_record(self) -> Dict[str, Any]:
+        rec: Dict[str, Any] = {
+            "type": "span",
+            "name": self.name,
+            "cat": self.category,
+            "ts_us": self.start_ns / 1e3,
+            "dur_us": (self.end_ns - self.start_ns) / 1e3,
+            "depth": self.depth,
+            "synced": self.sync,
+        }
+        if self.parent_name:
+            rec["parent"] = self.parent_name
+        if self.attrs:
+            rec["attrs"] = self.attrs
+        return rec
+
+
+class SpanTracer:
+    """Process-local span recorder. Thread-safe: each thread has its own open-
+    span stack; the closed-span list and the JSONL handle are lock-guarded."""
+
+    def __init__(self, enabled: bool = True, jsonl_path: Optional[str] = None,
+                 all_ranks: bool = False, max_spans: int = 100_000,
+                 process_index: Optional[int] = None):
+        if process_index is None:
+            try:
+                import jax
+
+                process_index = jax.process_index()
+            except Exception:
+                process_index = 0
+        self.process_index = process_index
+        self.enabled = enabled and (all_ranks or process_index == 0)
+        self.jsonl_path = jsonl_path if self.enabled else None
+        self.max_spans = max_spans
+        self.dropped = 0
+        self._spans: List[Dict[str, Any]] = []
+        self._lock = threading.Lock()
+        self._local = threading.local()
+        self._fh = None
+        if self.jsonl_path:
+            os.makedirs(os.path.dirname(os.path.abspath(self.jsonl_path)),
+                        exist_ok=True)
+            self._fh = open(self.jsonl_path, "a", buffering=1)
+
+    # -- internals --------------------------------------------------------
+    def _stack(self) -> List[Span]:
+        stack = getattr(self._local, "stack", None)
+        if stack is None:
+            stack = self._local.stack = []
+        return stack
+
+    def _record(self, span: Span) -> None:
+        rec = span.to_record()
+        rec["pid"] = self.process_index
+        rec["tid"] = threading.get_ident() & 0xFFFF
+        with self._lock:
+            if len(self._spans) < self.max_spans:
+                self._spans.append(rec)
+            else:
+                self.dropped += 1
+            if self._fh is not None:
+                self._fh.write(json.dumps(rec) + "\n")
+
+    # -- public API -------------------------------------------------------
+    def span(self, name: str, category: str = "span", sync: bool = False,
+             **attrs: Any) -> Span:
+        """Open a span as a context manager (``with tracer.span("fwd"): ...``)
+        or drive it manually via ``begin()``/``end()``. A disabled tracer
+        still returns a measuring span (``duration_s`` works — callers that
+        derive metrics from the span, e.g. TTFT, stay correct) but records
+        nothing and never syncs."""
+        if not self.enabled:
+            return Span(name, category, sync=False, attrs=attrs, tracer=None)
+        return Span(name, category, sync=sync, attrs=attrs, tracer=self)
+
+    def trace(self, name: Optional[str] = None, category: str = "span",
+              sync: bool = False):
+        """Decorator form: ``@tracer.trace("checkpoint/save")``."""
+
+        def deco(fn):
+            import functools
+
+            label = name or fn.__qualname__
+
+            @functools.wraps(fn)
+            def wrapper(*args, **kwargs):
+                with self.span(label, category=category, sync=sync):
+                    return fn(*args, **kwargs)
+
+            return wrapper
+
+        return deco
+
+    def current_name(self) -> Optional[str]:
+        """Name of the innermost open span on this thread (recompile watchdog
+        attribution hook)."""
+        stack = getattr(self._local, "stack", None)
+        return stack[-1].name if stack else None
+
+    def snapshot(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._spans)
+
+    def export_chrome_trace(self, path: str) -> str:
+        """Write the recorded spans as a Chrome trace-event JSON file."""
+        with self._lock:
+            events = [{
+                "name": rec["name"],
+                "cat": rec.get("cat", "span"),
+                "ph": "X",
+                "ts": rec["ts_us"],
+                "dur": rec["dur_us"],
+                "pid": rec.get("pid", 0),
+                "tid": rec.get("tid", 0),
+                "args": {**rec.get("attrs", {}), "synced": rec.get("synced")},
+            } for rec in self._spans]
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        with open(path, "w") as fh:
+            json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, fh)
+        if self.dropped:
+            logger.warning(f"span tracer dropped {self.dropped} spans past "
+                           f"max_spans={self.max_spans}")
+        return path
+
+    def flush(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                self._fh.flush()
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
+
+
+_NOOP_TRACER: Optional[SpanTracer] = None
+
+
+def noop_tracer() -> SpanTracer:
+    """Shared disabled tracer — what ``get_tracer()`` hands out before any
+    session is configured, so call sites never need a None check."""
+    global _NOOP_TRACER
+    if _NOOP_TRACER is None:
+        _NOOP_TRACER = SpanTracer(enabled=False, process_index=0)
+    return _NOOP_TRACER

@@ -46,6 +46,34 @@ PIPE_STAGE = "pipe_stage"   # logical axis for the stacked stage dim
 # ---------------------------------------------------------------------------
 
 
+def _record_schedule_census(schedule: str, num_stages: int, batch) -> None:
+    """Publish the pipeline schedule's shape into the observability registry.
+
+    Runs in the HOST wrapper around the shard_map body — i.e. at jit trace
+    time, once per compiled program (a census, like the comms logger's traced
+    events), never per step. The bubble fraction is the canonical
+    (P-1)/(M+P-1) pipeline idle share — the number every PP perf PR is trying
+    to push down."""
+    from ..observability import get_session
+
+    obs = get_session()
+    if not obs.enabled:
+        return
+    import numpy as _np
+
+    M = int(_np.shape(jax.tree.leaves(batch)[0])[0])
+    reg = obs.registry
+    reg.counter("pipeline/traces",
+                help="pipeline program specializations").inc(
+                    schedule=schedule)
+    reg.gauge("pipeline/stages").set(num_stages, schedule=schedule)
+    reg.gauge("pipeline/microbatches").set(M, schedule=schedule)
+    reg.gauge("pipeline/bubble_fraction",
+              help="(P-1)/(M+P-1) schedule idle share").set(
+                  (num_stages - 1) / max(M + num_stages - 1, 1),
+                  schedule=schedule)
+
+
 def partition_uniform(num_items: int, num_parts: int) -> List[int]:
     """Boundaries of a uniform split (reference runtime/utils.py:541); the
     remainder is distributed one-per-stage from the front."""
@@ -283,6 +311,7 @@ def pipelined_loss_fn(cfg, num_stages: int):
 
     def loss_fn(params, batch):
         mesh = get_mesh()
+        _record_schedule_census("fill_drain", num_stages, batch)
         layers_in = params["layers"]
         embed_tree = {k: v for k, v in params.items() if k != "layers"}
         if _needs_fp32_body():
@@ -451,6 +480,7 @@ def pipelined_grad_fn(cfg, num_stages: int):
 
     def grad_fn(params, batch, scale=jnp.float32(1.0)):
         mesh = get_mesh()
+        _record_schedule_census("1f1b", num_stages, batch)
         layers_in = params["layers"]
         embed_tree = {k: v for k, v in params.items() if k != "layers"}
         layer_specs = jax.tree.map(lambda _: P(PIPE_AXIS), layers_in)
@@ -505,10 +535,13 @@ def pipelinize_model(model: Model, num_stages: int) -> Model:
         axes["lm_head"] = ("embed", None)
 
     from ..models.transformer import eval_config
+    from ..observability import get_session
 
-    loss_fn = pipelined_loss_fn(cfg, num_stages)
-    eval_loss_fn = pipelined_loss_fn(eval_config(cfg), num_stages)
-    grad_fn = pipelined_grad_fn(cfg, num_stages)
+    with get_session().span("pipeline/build", stages=num_stages,
+                            layers=cfg.num_layers):
+        loss_fn = pipelined_loss_fn(cfg, num_stages)
+        eval_loss_fn = pipelined_loss_fn(eval_config(cfg), num_stages)
+        grad_fn = pipelined_grad_fn(cfg, num_stages)
 
     def apply(params, batch, **kw):
         # unpipelined eval path: merge stages back and run the plain forward

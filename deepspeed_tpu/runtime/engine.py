@@ -60,6 +60,12 @@ class TrainEngine:
 
             enable_compile_cache(config.compile_cache.dir,
                                  config.compile_cache.min_compile_time_secs)
+        # observability session first: model transforms (pipelinize), mesh
+        # build and step compiles below all publish through it; the disabled
+        # default is a shared no-op so tier-1 cost is zero
+        from ..observability import configure_observability
+
+        self._obs = configure_observability(config.observability)
         opt_name = config.optimizer.type.lower()
         self._onebit = opt_name in ("onebitadam", "onebitlamb", "zerooneadam")
         if self._onebit:
@@ -506,6 +512,8 @@ class TrainEngine:
         self._eval_step = None
         self._last_lr = float(self.config.optimizer.params.get("lr", 0.0))
         self._monitor = None
+        self._profiling = False
+        self._profile_span = None
 
         n = (self._n_params if self.params is None
              else param_count(self.params))
@@ -954,8 +962,52 @@ class TrainEngine:
         breakdown = self.wall_clock_breakdown()
         if breakdown:
             self.timers(TRAIN_BATCH_TIMER).start(synchronize=True)
-        with mesh_mod.ambient(self.mesh):
-            batch = self._globalize_batch(batch, leading_gas=True)
+        obs = self._obs
+        if obs.enabled:
+            # batch bytes about to cross host->device (metadata read only)
+            obs.registry.counter(
+                "comm/host_to_device/bytes",
+                help="training batch bytes transferred to device").inc(
+                    sum(int(getattr(x, "nbytes", 0))
+                        for x in jax.tree.leaves(batch)))
+        _batch_span = obs.span("train_batch", step=self.global_steps)
+        _batch_span.begin()
+        try:
+            with mesh_mod.ambient(self.mesh):
+                with obs.span("train_batch/h2d"):
+                    batch = self._globalize_batch(batch, leading_gas=True)
+                loss, stats = self._dispatch_train_step(batch)
+        finally:
+            _batch_span.end()
+        self.global_steps += 1
+        self.micro_steps += gas
+        self._skipped_accum = (stats.skipped.astype(jnp.int32)
+                               if self._skipped_accum is None
+                               else self._skipped_accum + stats.skipped)
+        if obs.enabled:
+            obs.note_step(self.global_steps)
+            obs.maybe_record_memory(self.global_steps)
+        if breakdown:
+            self.timers(TRAIN_BATCH_TIMER).stop(synchronize=True)
+            self.timers.log([TRAIN_BATCH_TIMER])
+        if self.lr_scheduler is not None and hasattr(self.lr_scheduler, "step"):
+            self.lr_scheduler.step()
+        if self.global_steps % self.steps_per_print() == 0:
+            self._sync_step_stats(stats)
+            log_dist(f"step={self.global_steps} loss={float(loss):.4f} "
+                     f"lr={self._last_lr:.3e} grad_norm={float(stats.grad_norm):.3f} "
+                     f"skipped={self.skipped_steps} "
+                     f"throughput={self.tput_timer.avg_samples_per_sec():.1f} samples/s")
+            self._publish_metrics(float(loss), float(stats.grad_norm))
+        self._steps_since_sync += 1
+        self._tput_window_start = self._tput_window_start or time.time()
+        return loss
+
+    def _dispatch_train_step(self, batch: Any):
+        """Route one globalized batch through whichever step executor this
+        engine built (offload / NVMe / 1-bit / plain jit) — the body
+        ``train_batch`` wraps in its span. Returns (loss, StepStats)."""
+        with self._obs.span("train_batch/dispatch"):
             if self._param_offload is not None:
                 # host-driven segmented step: params stream through HBM per
                 # layer block (runtime/param_offload.py)
@@ -995,26 +1047,7 @@ class TrainEngine:
                 (self.params, self.opt_state, self.scaler_state, loss,
                  stats) = self._compiled_step(self.params, self.opt_state,
                                               self.scaler_state, batch)
-        self.global_steps += 1
-        self.micro_steps += gas
-        self._skipped_accum = (stats.skipped.astype(jnp.int32)
-                               if self._skipped_accum is None
-                               else self._skipped_accum + stats.skipped)
-        if breakdown:
-            self.timers(TRAIN_BATCH_TIMER).stop(synchronize=True)
-            self.timers.log([TRAIN_BATCH_TIMER])
-        if self.lr_scheduler is not None and hasattr(self.lr_scheduler, "step"):
-            self.lr_scheduler.step()
-        if self.global_steps % self.steps_per_print() == 0:
-            self._sync_step_stats(stats)
-            log_dist(f"step={self.global_steps} loss={float(loss):.4f} "
-                     f"lr={self._last_lr:.3e} grad_norm={float(stats.grad_norm):.3f} "
-                     f"skipped={self.skipped_steps} "
-                     f"throughput={self.tput_timer.avg_samples_per_sec():.1f} samples/s")
-            self._write_monitor(float(loss), float(stats.grad_norm))
-        self._steps_since_sync += 1
-        self._tput_window_start = self._tput_window_start or time.time()
-        return loss
+        return loss, stats
 
     def _compression_wrap(self, fn):
         """Wrap a loss fn with the ACTIVE compression transform (QAT
@@ -1142,8 +1175,9 @@ class TrainEngine:
         self._pending_batch = self._globalize_batch(batch, leading_gas=False)
         scale = self.scaler_state.scale if self.fp16_enabled() else jnp.float32(1.0)
         with mesh_mod.ambient(self.mesh):
-            (scaled_loss, loss), grads = self._compiled_micro(
-                self.params, self._pending_batch, scale)
+            with self._obs.span("fwd", step=self.global_steps):
+                (scaled_loss, loss), grads = self._compiled_micro(
+                    self.params, self._pending_batch, scale)
         self._pending_grads = grads
         self._pending_loss = loss
         return loss
@@ -1152,13 +1186,14 @@ class TrainEngine:
         """Accumulate the grads computed in forward (reference engine.backward)."""
         if getattr(self, "_pending_grads", None) is None:
             raise RuntimeError("backward() called before forward()")
-        if self._staged_grads is None:
-            self._staged_grads = jax.tree.map(lambda g: g.astype(jnp.float32),
-                                              self._pending_grads)
-        else:
-            self._staged_grads = jax.tree.map(
-                lambda a, g: a + g.astype(jnp.float32),
-                self._staged_grads, self._pending_grads)
+        with self._obs.span("bwd", step=self.global_steps):
+            if self._staged_grads is None:
+                self._staged_grads = jax.tree.map(
+                    lambda g: g.astype(jnp.float32), self._pending_grads)
+            else:
+                self._staged_grads = jax.tree.map(
+                    lambda a, g: a + g.astype(jnp.float32),
+                    self._staged_grads, self._pending_grads)
         self._pending_grads = None
         self._staged_count += 1
         self.micro_steps += 1
@@ -1171,21 +1206,25 @@ class TrainEngine:
         if not self.is_gradient_accumulation_boundary():
             return
         grads = self._staged_grads
-        if self.fp16_enabled():
-            inv = 1.0 / self.scaler_state.scale
-            grads = jax.tree.map(lambda g: g * inv, grads)
-            overflow = has_overflow(grads)
-        else:
-            overflow = jnp.asarray(False)
-        with mesh_mod.ambient(self.mesh):
-            self.params, self.opt_state, stats = self.optimizer.apply(
-                self.params, grads, self.opt_state, skip_update=overflow)
+        with self._obs.span("step", step=self.global_steps):
+            if self.fp16_enabled():
+                inv = 1.0 / self.scaler_state.scale
+                grads = jax.tree.map(lambda g: g * inv, grads)
+                overflow = has_overflow(grads)
+            else:
+                overflow = jnp.asarray(False)
+            with mesh_mod.ambient(self.mesh):
+                self.params, self.opt_state, stats = self.optimizer.apply(
+                    self.params, grads, self.opt_state, skip_update=overflow)
         self.scaler_state = self.loss_scaler.update(self.scaler_state, overflow)
         if bool(stats.skipped):
             self._skipped_steps += 1
         self._staged_grads = None
         self._staged_count = 0
         self.global_steps += 1
+        if self._obs.enabled:
+            self._obs.note_step(self.global_steps)
+            self._obs.maybe_record_memory(self.global_steps)
         self._last_lr = float(stats.lr)
         if self.lr_scheduler is not None and hasattr(self.lr_scheduler, "step"):
             self.lr_scheduler.step()
@@ -1232,7 +1271,8 @@ class TrainEngine:
                 else:
                     self._eval_step = jax.jit(self._compression_wrap(loss_fn))
         with mesh_mod.ambient(self.mesh):
-            return self._eval_step(self.params, batch)
+            with self._obs.span("eval", step=self.global_steps):
+                return self._eval_step(self.params, batch)
 
     # -- profiling (reference flops_profiler engine hooks + NVTX ranges) --
     def get_flops_profile(self):
@@ -1271,34 +1311,65 @@ class TrainEngine:
             seq_len or min(cfg.max_seq_len, 512),
             print_profile=True, measured=True, output_file=output_file)
 
-    def start_profile(self, log_dir: str = "/tmp/dstpu_trace") -> None:
-        """jax profiler trace (the nsys/NVTX analog; view in XProf)."""
+    def start_profile(self, log_dir: Optional[str] = None) -> None:
+        """jax profiler trace (the nsys/NVTX analog; view in XProf).
+
+        Double-start guarded (``jax.profiler.start_trace`` would raise an
+        opaque backend error mid-run otherwise); the trace dir defaults to
+        ``ObservabilityConfig.profile_dir``; the profiled region is recorded
+        as a span so the trace window shows up in the observability export."""
+        if self._profiling:
+            raise RuntimeError(
+                "start_profile() called while a profiler trace is already "
+                "active — call stop_profile() first")
+        log_dir = log_dir or self.config.observability.profile_dir
         jax.profiler.start_trace(log_dir)
+        self._profiling = True
+        self._profile_span = self._obs.span(
+            "profile", category="profiler", dir=log_dir).begin()
 
     def stop_profile(self) -> None:
+        if not self._profiling:
+            logger.warning("stop_profile() called with no active profiler "
+                           "trace — ignoring")
+            return
         jax.profiler.stop_trace()
+        self._profiling = False
+        if self._profile_span is not None:
+            self._profile_span.end()
+            self._profile_span = None
 
     # -- monitor ----------------------------------------------------------
-    def _write_monitor(self, loss: float, grad_norm: float) -> None:
+    def _publish_metrics(self, loss: float, grad_norm: float) -> None:
+        """Publish step stats through the observability metrics registry and
+        hand the scalarized snapshot to THIS engine's monitor writers
+        (CSV/TB/WandB) — the registry is the single event source, and the
+        monitor stays engine-scoped (it is deliberately not attached as a
+        global-registry exporter: the registry is a process singleton, so a
+        global attachment would keep feeding every engine's metrics into
+        every other engine's monitors for the life of the process)."""
+        reg = self._obs.registry
+        names = ["Train/Samples/train_loss", "Train/Samples/lr",
+                 "Train/Samples/grad_norm", "Train/Samples/throughput"]
         if self._monitor is None:
             from ..monitor.monitor import MonitorMaster
 
             self._monitor = MonitorMaster(self.config.monitor)
-        events = [
-            ("Train/Samples/train_loss", loss, self.global_steps),
-            ("Train/Samples/lr", self._last_lr, self.global_steps),
-            ("Train/Samples/grad_norm", grad_norm, self.global_steps),
-        ]
+        reg.gauge("Train/Samples/train_loss").set(loss)
+        reg.gauge("Train/Samples/lr").set(self._last_lr)
+        reg.gauge("Train/Samples/grad_norm").set(grad_norm)
+        reg.gauge("Train/Samples/throughput").set(
+            self.tput_timer.avg_samples_per_sec())
         if (self._param_offload is not None
                 and self._param_offload.last_step_stats):
             st = self._param_offload.last_step_stats
-            events += [
-                ("Train/Offload/h2d_gbps", st["achieved_h2d_gbps"],
-                 self.global_steps),
-                ("Train/Offload/total_gbps", st["achieved_total_gbps"],
-                 self.global_steps),
-            ]
-        self._monitor.write_events(events)
+            reg.gauge("Train/Offload/h2d_gbps").set(st["achieved_h2d_gbps"])
+            reg.gauge("Train/Offload/total_gbps").set(
+                st["achieved_total_gbps"])
+            names += ["Train/Offload/h2d_gbps", "Train/Offload/total_gbps"]
+        events = reg.publish(self.global_steps, names=names)
+        if self._monitor.enabled:
+            self._monitor.write_events(events)
 
     # -- checkpoint (reference engine.py:2792 save_checkpoint) ------------
     def save_checkpoint(self, save_dir: str, tag: Optional[str] = None,
@@ -1342,11 +1413,12 @@ class TrainEngine:
                 opt_state = jax.tree.map(copy_np, opt_state)
                 if extra_writes:
                     extra_writes = [(f, np.array(d)) for f, d in extra_writes]
-        path = _save(save_dir, tag, params=params, opt_state=opt_state,
-                     client_state=client_state, save_latest=save_latest,
-                     tag_validation=self.config.checkpoint.tag_validation,
-                     async_save=async_save, extra_arrays=extra_arrays,
-                     extra_writes=extra_writes)
+        with self._obs.span("checkpoint/save", tag=tag, sync=True):
+            path = _save(save_dir, tag, params=params, opt_state=opt_state,
+                         client_state=client_state, save_latest=save_latest,
+                         tag_validation=self.config.checkpoint.tag_validation,
+                         async_save=async_save, extra_arrays=extra_arrays,
+                         extra_writes=extra_writes)
         if self._nvme_swapper is not None:
             # the swap files ARE the optimizer state — snapshot them into the
             # checkpoint (reference use_node_local_storage semantics); one
@@ -1415,10 +1487,11 @@ class TrainEngine:
                              and self._nvme_swapper is None)
         opt_shardings = self._opt_state_shardings() if load_resident_opt else None
         with mesh_mod.ambient(self.mesh):
-            result = _load(load_dir, tag,
-                           params_template=(self.params, self.param_shardings),
-                           opt_template=((self.opt_state, opt_shardings)
-                                         if load_resident_opt else None))
+            with self._obs.span("checkpoint/load", sync=True):
+                result = _load(load_dir, tag,
+                               params_template=(self.params, self.param_shardings),
+                               opt_template=((self.opt_state, opt_shardings)
+                                             if load_resident_opt else None))
         if result is None:
             return None, {}
         params, opt_state, client_state = result

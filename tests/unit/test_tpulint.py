@@ -246,6 +246,99 @@ class TestKeyReuse:
         assert rules_of(src) == []
 
 
+class TestWallclockTimingWithoutSync:
+    RULE = "wallclock-timing-without-sync"
+
+    def test_positive_unfenced_delta(self):
+        src = (
+            "import time\n"
+            "import jax\n"
+            "def bench(step, batch):\n"
+            "    t0 = time.perf_counter()\n"
+            "    for _ in range(10):\n"
+            "        loss = step(batch)\n"
+            "    dt = time.perf_counter() - t0\n"
+            "    return dt\n")
+        assert self.RULE in rules_of(src)
+
+    def test_positive_delta_nested_in_append(self):
+        src = (
+            "import time\n"
+            "import jax\n"
+            "def bench(step, batch, out):\n"
+            "    t0 = time.perf_counter()\n"
+            "    step(batch)\n"
+            "    out.append(time.perf_counter() - t0)\n")
+        assert self.RULE in rules_of(src)
+
+    def test_positive_work_after_last_fence(self):
+        # one early fence does not bless work dispatched after it
+        src = (
+            "import time\n"
+            "import jax\n"
+            "def bench(step1, step2, batch):\n"
+            "    t0 = time.perf_counter()\n"
+            "    a = step1(batch)\n"
+            "    jax.block_until_ready(a)\n"
+            "    b = step2(batch)\n"
+            "    return time.perf_counter() - t0\n")
+        assert self.RULE in rules_of(src)
+
+    def test_negative_block_until_ready_fence(self):
+        src = (
+            "import time\n"
+            "import jax\n"
+            "def bench(step, batch):\n"
+            "    t0 = time.perf_counter()\n"
+            "    loss = step(batch)\n"
+            "    jax.block_until_ready(loss)\n"
+            "    return time.perf_counter() - t0\n")
+        assert self.RULE not in rules_of(src)
+
+    def test_negative_float_materialisation_fence(self):
+        src = (
+            "import time\n"
+            "import jax\n"
+            "def bench(step, batch):\n"
+            "    t0 = time.perf_counter()\n"
+            "    loss = step(batch)\n"
+            "    float(loss)\n"
+            "    return time.perf_counter() - t0\n")
+        assert self.RULE not in rules_of(src)
+
+    def test_negative_local_helper_that_fences(self):
+        src = (
+            "import time\n"
+            "import jax\n"
+            "def bench(step, batch):\n"
+            "    def run():\n"
+            "        jax.block_until_ready(step(batch))\n"
+            "    run()\n"
+            "    t0 = time.perf_counter()\n"
+            "    run()\n"
+            "    return time.perf_counter() - t0\n")
+        assert self.RULE not in rules_of(src)
+
+    def test_negative_module_without_jax(self):
+        src = (
+            "import time\n"
+            "def bench(parse, data):\n"
+            "    t0 = time.perf_counter()\n"
+            "    out = parse(data)\n"
+            "    return time.perf_counter() - t0\n")
+        assert self.RULE not in rules_of(src)
+
+    def test_negative_no_calls_between(self):
+        src = (
+            "import time\n"
+            "import jax\n"
+            "def f():\n"
+            "    t0 = time.perf_counter()\n"
+            "    x = 1 + 2\n"
+            "    return time.perf_counter() - t0\n")
+        assert self.RULE not in rules_of(src)
+
+
 # ---------------------------------------------------------------------------
 # suppressions + baseline
 
@@ -333,13 +426,14 @@ class TestCli:
     def test_select_unknown_rule_errors(self, capsys):
         assert tpulint_main(["--select", "not-a-rule"]) == 2
 
-    def test_list_rules_names_all_six(self, capsys):
+    def test_list_rules_names_all_seven(self, capsys):
         assert tpulint_main(["--list-rules"]) == 0
         out = capsys.readouterr().out
         for name in ("host-sync-in-jit", "impure-jit", "missing-donation",
-                     "unknown-mesh-axis", "deprecated-jax-api", "key-reuse"):
+                     "unknown-mesh-axis", "deprecated-jax-api", "key-reuse",
+                     "wallclock-timing-without-sync"):
             assert name in out
-        assert len(RULES) >= 6
+        assert len(RULES) >= 7
 
 
 # ---------------------------------------------------------------------------

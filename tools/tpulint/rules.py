@@ -334,7 +334,153 @@ class DeprecatedJaxApi(Rule):
 
 
 # ---------------------------------------------------------------------------
-# 6. key-reuse
+# 6. wallclock-timing-without-sync
+
+
+@register
+class WallclockTimingWithoutSync(Rule):
+    name = "wallclock-timing-without-sync"
+    description = ("time.time()/time.perf_counter() delta measured around "
+                   "dispatched work with no blocking fence between — async "
+                   "dispatch means the delta times the enqueue, not the work")
+
+    _CLOCKS = {"time.time", "time.perf_counter", "time.monotonic"}
+    _SYNC_ATTRS = {"block_until_ready", "item", "tolist"}
+    _SYNC_DOTTED = {"jax.block_until_ready", "jax.device_get",
+                    "jax.effects_barrier", "numpy.asarray", "numpy.array"}
+    _SYNC_BUILTINS = {"float", "int", "bool"}
+    # calls that cannot enqueue device work — ignored when deciding whether
+    # the timed interval contains anything worth fencing
+    _BENIGN_DOTTED_PREFIXES = (
+        "time.", "os.", "sys.", "json.", "math.", "logging.", "collections.",
+        "itertools.", "functools.", "re.", "subprocess.", "argparse.",
+    )
+    _BENIGN_NAMES = {
+        "print", "len", "range", "sorted", "min", "max", "sum", "abs",
+        "round", "str", "repr", "open", "isinstance", "getattr", "hasattr",
+        "setattr", "enumerate", "zip", "list", "dict", "set", "tuple",
+        "next", "iter", "log_dist", "super", "type", "id", "format", "vars",
+    }
+    _BENIGN_ATTRS = {
+        "append", "extend", "add", "update", "join", "format", "split",
+        "strip", "items", "keys", "values", "get", "pop", "setdefault",
+        "write", "flush", "read", "close", "info", "debug", "warning",
+        "error", "exception", "mean", "startswith", "endswith", "copy",
+        # AOT lowering/compilation runs synchronously on the host — timing
+        # it needs no device fence
+        "lower", "compile",
+        # mesh context-manager factory (parallel/mesh.py ambient idiom)
+        # dispatches nothing
+        "ambient",
+    }
+
+    def _is_clock_call(self, module: ModuleInfo, node: ast.AST) -> bool:
+        return (isinstance(node, ast.Call)
+                and module.dotted(node.func) in self._CLOCKS)
+
+    def _classify(self, module: ModuleInfo, call: ast.Call,
+                  syncing_defs: Set[str]) -> str:
+        """'sync' | 'benign' | 'work' for one call in the timed interval."""
+        func = call.func
+        if isinstance(func, ast.Name) and func.id in syncing_defs:
+            # locally-defined helper whose body fences — calling it syncs
+            return "sync"
+        if isinstance(func, ast.Attribute):
+            if func.attr in self._SYNC_ATTRS:
+                return "sync"
+            if func.attr in self._BENIGN_ATTRS:
+                return "benign"
+        dotted = module.dotted(func)
+        if dotted in self._SYNC_DOTTED:
+            return "sync"
+        if dotted in self._CLOCKS:
+            return "benign"
+        if isinstance(func, ast.Name):
+            if (func.id in self._SYNC_BUILTINS and len(call.args) == 1
+                    and not isinstance(call.args[0], ast.Constant)):
+                return "sync"          # float(loss) materialises the array
+            if func.id in self._BENIGN_NAMES:
+                return "benign"
+        if dotted and (dotted in self._BENIGN_NAMES
+                       or dotted.startswith(self._BENIGN_DOTTED_PREFIXES)):
+            return "benign"
+        return "work"
+
+    def _syncing_defs(self, module: ModuleInfo, scope: ast.AST) -> Set[str]:
+        """Names of functions defined in this scope whose own body contains a
+        blocking fence — calling them from a timed interval counts as sync."""
+        out: Set[str] = set()
+        for node in own_nodes(scope):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            for inner in own_nodes(node):
+                if not isinstance(inner, ast.Call):
+                    continue
+                func = inner.func
+                if ((isinstance(func, ast.Attribute)
+                     and func.attr in self._SYNC_ATTRS)
+                        or module.dotted(func) in self._SYNC_DOTTED):
+                    out.add(node.name)
+                    break
+        return out
+
+    def _scan_scope(self, module: ModuleInfo, scope: ast.AST) -> Iterator[Finding]:
+        nodes = list(own_nodes(scope))
+        # clock-start assignments: name -> sorted start linenos
+        starts: dict = {}
+        for node in nodes:
+            if (isinstance(node, ast.Assign)
+                    and self._is_clock_call(module, node.value)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)):
+                starts.setdefault(node.targets[0].id, []).append(node.lineno)
+        if not starts:
+            return
+        syncing_defs = self._syncing_defs(module, scope)
+        calls = [n for n in nodes if isinstance(n, ast.Call)]
+        for node in nodes:
+            # delta = clock() - t0   (possibly nested, e.g. xs.append(...))
+            if not (isinstance(node, ast.BinOp) and isinstance(node.op, ast.Sub)
+                    and self._is_clock_call(module, node.left)
+                    and isinstance(node.right, ast.Name)
+                    and node.right.id in starts):
+                continue
+            begin = max((ln for ln in starts[node.right.id]
+                         if ln < node.lineno), default=None)
+            if begin is None:
+                continue
+            between = [c for c in calls if begin < c.lineno <= node.lineno
+                       and c is not node.left]
+            kinds = [(self._classify(module, c, syncing_defs), c.lineno)
+                     for c in between]
+            work_lines = [ln for k, ln in kinds if k == "work"]
+            sync_lines = [ln for k, ln in kinds if k == "sync"]
+            # work dispatched AFTER the last fence is still unfenced at the
+            # closing clock read — one early fence does not bless the rest
+            if work_lines and (not sync_lines
+                               or max(work_lines) > max(sync_lines)):
+                yield _finding(
+                    self, module, node,
+                    f"wall-clock delta over '{node.right.id}' spans "
+                    "dispatched calls with no fence (block_until_ready / "
+                    "device_get / float()) before reading the clock — "
+                    "under async dispatch this times the enqueue only")
+
+    def check(self, module: ModuleInfo, jit: JitGraph,
+              context: RunContext) -> Iterator[Finding]:
+        # a module that never imports jax cannot dispatch async device work
+        if not any(v == "jax" or v.startswith("jax.")
+                   for v in module.aliases.values()):
+            return
+        scopes = [module.tree] + [f for f in jit.all_defs
+                                  if isinstance(f, (ast.FunctionDef,
+                                                    ast.AsyncFunctionDef))]
+        for scope in scopes:
+            yield from self._scan_scope(module, scope)
+
+
+# ---------------------------------------------------------------------------
+# 7. key-reuse
 
 
 @register
