@@ -336,6 +336,135 @@ class InferenceEngine:
 
         return walk(q_shapes, self.param_shardings)
 
+    # -- tpuaudit registration (tools/tpuaudit) ------------------------------
+    def _audit_expected_collectives(self) -> frozenset:
+        """Collectives the serving programs are allowed to contain: TP
+        activation reductions/gathers, MoE dispatch all-to-alls. A
+        single-device engine declares none — any collective in its program
+        is a sharding bug."""
+        exp: set = set()
+        if int(self.mesh.shape[mesh_mod.MODEL_AXIS]) > 1:
+            exp |= {"all-reduce", "all-gather"}
+        if int(self.mesh.shape.get(mesh_mod.EXPERT_AXIS, 1)) > 1:
+            exp |= {"all-to-all", "all-reduce", "all-gather"}
+        return frozenset(exp)
+
+    def register_audit_entries(self, batch_size: int = 1,
+                               prompt_len: int = 64,
+                               max_new_tokens: int = 8,
+                               temperature: float = 0.0, top_k: int = 0,
+                               top_p: float = 1.0,
+                               eos_token_id: Optional[int] = None) -> list:
+        """Register the prefill and decode programs with the tpuaudit
+        auditor (``python -m tools.tpuaudit``) WITHOUT generating: the
+        programs are built (jit-wrapped, untraced) and handed over with
+        abstract arguments mirroring a ``generate`` call of this shape."""
+        try:
+            from tools.tpuaudit import registry as _audit  # noqa: F401 — probe
+        except ImportError:
+            return []
+        names = []
+        B, S_pad = batch_size, _bucket(prompt_len)
+        key_p = (B, S_pad)
+        if key_p not in self._prefill_cache:
+            self._prefill_cache[key_p] = self._prefill_fn(S_pad)
+        names.append(self._register_prefill_audit(B, S_pad))
+        n_rest = max_new_tokens - 1
+        if n_rest > 0:
+            key_d = (B, n_rest, float(temperature), int(top_k), float(top_p),
+                     eos_token_id, False)
+            if key_d not in self._decode_cache:
+                self._decode_cache[key_d] = self._decode_fn(
+                    n_rest, temperature, top_k, top_p, eos_token_id)
+            names.append(self._register_decode_audit(key_d))
+        return [n for n in names if n]
+
+    def _cache_sds(self, B: int):
+        return jax.eval_shape(lambda: kv_cache.init_cache(
+            self.model.config, B, self.config.max_out_tokens,
+            self.config.dtype))
+
+    def _params_sds(self):
+        return jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                           sharding=x.sharding), self.params)
+
+    def _register_prefill_audit(self, B: int, S_pad: int) -> Optional[str]:
+        try:
+            from tools.tpuaudit.registry import (StaleEntryError,
+                                                 register_entry_point)
+        except ImportError:
+            return None
+        try:
+            import weakref
+
+            wself = weakref.ref(self)
+
+            def build():
+                # everything abstract is synthesized HERE, at audit time —
+                # registration itself (which rides every first-shape
+                # generate call) stays a dict insert, and only a weakref to
+                # the engine is captured so a replaced engine's params/arena
+                # are never pinned by the registry
+                eng = wself()
+                if eng is None:
+                    raise StaleEntryError("inference/prefill: engine gone")
+                T = eng.config.max_out_tokens
+                args = (eng._params_sds(),
+                        jax.ShapeDtypeStruct((B, S_pad), jnp.int32),
+                        jax.ShapeDtypeStruct((B, T), jnp.int32),
+                        eng._cache_sds(B))
+                return eng._prefill_cache[(B, S_pad)], args, {}
+
+            register_entry_point(
+                "inference/prefill", build=build, donate_argnums=(3,),
+                expected_collectives=self._audit_expected_collectives(),
+                mesh=self.mesh,
+                tags={"engine": "InferenceEngine", "batch": B,
+                      "prompt_bucket": S_pad})
+            return "inference/prefill"
+        except Exception:   # registration must never take serving down
+            logger.warning("tpuaudit prefill registration failed",
+                           exc_info=True)
+            return None
+
+    def _register_decode_audit(self, key_d: Tuple) -> Optional[str]:
+        try:
+            from tools.tpuaudit.registry import (StaleEntryError,
+                                                 register_entry_point)
+        except ImportError:
+            return None
+        try:
+            import weakref
+
+            B, n_rest = key_d[0], key_d[1]
+            wself = weakref.ref(self)
+
+            def build():
+                eng = wself()
+                if eng is None:
+                    raise StaleEntryError("inference/decode: engine gone")
+                args = (eng._params_sds(), eng._cache_sds(B),
+                        jax.ShapeDtypeStruct((B, eng.config.max_out_tokens),
+                                             jnp.int32),
+                        jax.ShapeDtypeStruct((B,), jnp.int32),
+                        jax.ShapeDtypeStruct((B,), jnp.int32),
+                        jax.ShapeDtypeStruct((), jnp.float32),
+                        jax.ShapeDtypeStruct((2,), jnp.uint32))
+                return eng._decode_cache[key_d], args, {}
+
+            register_entry_point(
+                "inference/decode", build=build, donate_argnums=(1,),
+                expected_collectives=self._audit_expected_collectives(),
+                mesh=self.mesh,
+                tags={"engine": "InferenceEngine", "batch": B,
+                      "new_tokens": n_rest})
+            return "inference/decode"
+        except Exception:
+            logger.warning("tpuaudit decode registration failed",
+                           exc_info=True)
+            return None
+
     # -- plain forward (reference InferenceEngine.forward / module call) -----
     def forward(self, input_ids, attention_mask=None):
         """Full-sequence logits, no cache."""
@@ -443,6 +572,7 @@ class InferenceEngine:
         key_p = (B, S_pad)
         if key_p not in self._prefill_cache:
             self._prefill_cache[key_p] = self._prefill_fn(S_pad)
+            self._register_prefill_audit(B, S_pad)
         n_rest = max_new_tokens - 1
         ragged = attention_mask is not None and bool(
             np.any(np.asarray(mask).sum(-1) != S))
@@ -452,6 +582,7 @@ class InferenceEngine:
             self._decode_cache[key_d] = self._decode_fn(
                 n_rest, temperature, top_k, top_p, eos_token_id,
                 ragged=ragged)
+            self._register_decode_audit(key_d)
 
         with mesh_mod.ambient(self.mesh):
             cache = self._arena.pop(B, None)

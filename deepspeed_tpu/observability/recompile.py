@@ -107,7 +107,10 @@ class RecompileWatchdog:
                 f"steady-state recompilation at step {step}: {secs:.2f}s "
                 f"compiling under span '{where}' — a shape, dtype or static-"
                 "arg change is re-specializing a hot function "
-                f"(threshold steady_state_step={self.steady_state_step})")
+                f"(threshold steady_state_step={self.steady_state_step}). "
+                "The usual culprit is python-scalar/dtype instability at a "
+                "jit boundary: `python -m tools.tpuaudit` (weak-type-capture "
+                "check) finds those statically — see docs/tpuaudit.md")
 
     def on_event(self, name: str, **kw: Any) -> None:
         if name.startswith(_CACHE_EVENT_PREFIX):

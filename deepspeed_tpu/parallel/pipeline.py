@@ -61,6 +61,8 @@ def _record_schedule_census(schedule: str, num_stages: int, batch) -> None:
         return
     import numpy as _np
 
+    # static shape metadata, concrete at trace time (never a device sync)
+    # tpulint: disable=host-sync-in-jit
     M = int(_np.shape(jax.tree.leaves(batch)[0])[0])
     reg = obs.registry
     reg.counter("pipeline/traces",
@@ -501,6 +503,48 @@ def pipelined_grad_fn(cfg, num_stages: int):
     return grad_fn
 
 
+def _register_audit_entry_points(cfg, num_stages: int, init, loss_fn,
+                                 grad_fn) -> None:
+    """Register the stage programs with tpuaudit (tools/tpuaudit). The build
+    thunks synthesize abstract params/batch at AUDIT time (nothing traces at
+    registration), and the mesh resolves lazily to the ambient one — the
+    engine that pipelinized this model installs its mesh before any audit
+    can run. The declared collectives are the pipeline's contract: the
+    stage-to-stage ppermute ring and the tied-grad/loss psums, plus the
+    all-gathers GSPMD issues for the automatic (data/model) axes — an
+    all-to-all here would mean the partitioner is rerouting activations."""
+    try:
+        from tools.tpuaudit.registry import register_entry_point
+    except ImportError:     # deployed without the tools/ tree
+        return
+
+    expected = frozenset({"collective-permute", "all-reduce", "all-gather"})
+
+    def abstract_args(wrap_scale: bool):
+        params = jax.eval_shape(init, jax.random.PRNGKey(0))
+        S = int(min(cfg.max_seq_len, 32))
+        batch = {"input_ids": jax.ShapeDtypeStruct((num_stages, 1, S),
+                                                   jnp.int32)}
+        if wrap_scale:
+            fn = jax.jit(lambda p, b: grad_fn(p, b, jnp.float32(1.0)))
+        else:
+            fn = jax.jit(loss_fn)
+        return fn, (params, batch), {}
+
+    register_entry_point(
+        "pipeline/loss_fn", build=lambda: abstract_args(False),
+        expected_collectives=expected, mesh=get_mesh, compile=False,
+        tags={"stages": num_stages, "schedule": "fill_drain"})
+    register_entry_point(
+        "pipeline/grad_fn", build=lambda: abstract_args(True),
+        expected_collectives=expected, mesh=get_mesh, compile=False,
+        # the grads alias the params by construction; donation is owned by
+        # the ENGINE-level train step this fn is embedded in, so a
+        # standalone jit of it legitimately donates nothing
+        suppress=frozenset({"missed-donation"}),
+        tags={"stages": num_stages, "schedule": "1f1b"})
+
+
 def pipelinize_model(model: Model, num_stages: int) -> Model:
     """Transform a (transformer) Model into its pipelined variant:
     layers reshaped (L, ...) → (P, Lp, ...) with the stage dim sharded over
@@ -542,6 +586,7 @@ def pipelinize_model(model: Model, num_stages: int) -> Model:
         loss_fn = pipelined_loss_fn(cfg, num_stages)
         eval_loss_fn = pipelined_loss_fn(eval_config(cfg), num_stages)
         grad_fn = pipelined_grad_fn(cfg, num_stages)
+    _register_audit_entry_points(cfg, num_stages, init, loss_fn, grad_fn)
 
     def apply(params, batch, **kw):
         # unpipelined eval path: merge stages back and run the plain forward

@@ -954,6 +954,7 @@ class TrainEngine:
                 self._build_nvme_grads_step() if self._nvme_swapper is not None
                 else self._build_onebit_train_step() if self._onebit
                 else self._build_train_step())
+            self._register_step_audit(batch)
 
         # Steady-state path is SYNC-FREE: no host<->device scalar fetches per
         # step (each one drains the TPU queue — ruinous over remote tunnels).
@@ -1239,6 +1240,15 @@ class TrainEngine:
             # the pipelined loss_fn needs an (M, mb, ...) stack; for a plain
             # eval microbatch wrap it as a single-microbatch stack
             batch = jax.tree.map(lambda x: x[None], batch)
+        built = self._eval_step is None
+        self._ensure_eval_step()
+        if built:
+            self._register_eval_audit(batch)
+        with mesh_mod.ambient(self.mesh):
+            with self._obs.span("eval", step=self.global_steps):
+                return self._eval_step(self.params, batch)
+
+    def _ensure_eval_step(self) -> None:
         if self._eval_step is None:
             # eval_loss_fn derives an eval-mode config (regularisers off) at
             # trace time — no shared-config mutation, and the jitted step is
@@ -1270,9 +1280,175 @@ class TrainEngine:
                     self._eval_step = jax.jit(self._compression_wrap(eval_fn))
                 else:
                     self._eval_step = jax.jit(self._compression_wrap(loss_fn))
-        with mesh_mod.ambient(self.mesh):
-            with self._obs.span("eval", step=self.global_steps):
-                return self._eval_step(self.params, batch)
+
+    # -- tpuaudit registration (tools/tpuaudit) ---------------------------
+    def register_audit_entries(self, micro_batch: Any,
+                               prefix: str = "train") -> list:
+        """Register this engine's jitted programs with the tpuaudit
+        program auditor (``python -m tools.tpuaudit``), without running a
+        step: ``micro_batch`` is ONE example microbatch (host arrays are
+        fine — only shapes/dtypes reach the auditor). Returns the
+        registered entry names; a deployment without the ``tools/`` tree
+        (or a param-offload engine, whose step is a host-driven loop, not
+        one program) registers nothing."""
+        if self._param_offload is not None:
+            return []
+        try:
+            from tools.tpuaudit import registry as _audit  # noqa: F401 — probe
+        except ImportError:
+            return []
+        gas = self.gradient_accumulation_steps()
+        stacked = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(
+                (gas,) + tuple(np.shape(x)),
+                getattr(x, "dtype", None) or np.asarray(x).dtype),
+            micro_batch)
+        names = []
+        if self._compiled_step is None:
+            self._compiled_step = (
+                self._build_nvme_grads_step() if self._nvme_swapper is not None
+                else self._build_onebit_train_step() if self._onebit
+                else self._build_train_step())
+        names.append(self._register_step_audit(stacked, prefix=prefix))
+        micro_sds = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(
+                tuple(np.shape(x)),
+                getattr(x, "dtype", None) or np.asarray(x).dtype),
+            micro_batch)
+        if self.model.pipelined:
+            micro_sds = jax.tree.map(
+                lambda x: jax.ShapeDtypeStruct((1,) + x.shape, x.dtype),
+                micro_sds)
+        self._ensure_eval_step()
+        names.append(self._register_eval_audit(micro_sds, prefix=prefix))
+        return [n for n in names if n]
+
+    def _expected_collectives(self, train: bool) -> frozenset:
+        """The collective kinds this engine's programs are ALLOWED to
+        contain, derived from the parallel/ZeRO config — tpuaudit flags
+        anything beyond this set as an undeclared GSPMD reshard. On a
+        single-device mesh the set is empty: any collective is a bug."""
+        par = self.config.parallel
+        z = self.config.zero_stage
+        exp: set = set()
+        if self.mesh.size > 1:
+            exp.add("all-reduce")          # grad/loss averaging over 'data'
+        if train and z >= 1:
+            exp.add("all-gather")          # sharded master -> full params
+        if train and z >= 2:
+            exp |= {"reduce-scatter", "all-to-all"}   # grad sharding
+        if z >= 3:
+            exp.add("all-gather")          # fwd param gathers (eval too)
+        if par.tensor_parallel_size > 1:
+            exp |= {"all-gather", "all-to-all"}       # activation reshards
+        if par.sequence_parallel_size > 1:
+            exp |= {"all-gather", "all-to-all", "collective-permute"}
+        if par.pipeline_parallel_size > 1:
+            exp |= {"collective-permute", "all-gather"}
+        if par.expert_parallel_size > 1:
+            # the expert dispatch is an (E, C, H) all-to-all by intent, but
+            # on small meshes GSPMD lowers it (and the batch<->expert-bank
+            # reshards) to collective-permute pairs — the auditor caught the
+            # permutes as undeclared on the moe-tiny ep=2 engine
+            exp |= {"all-to-all", "all-gather", "collective-permute"}
+        if self._onebit and train:
+            # compressed allreduce (comm/compressed.py): chunk exchange is an
+            # explicit all_to_all, scale/result distribution an all_gather —
+            # the auditor flagged both as undeclared on the 1-bit engine
+            exp |= {"all-to-all", "all-gather"}
+        return frozenset(exp)
+
+    def _register_step_audit(self, stacked_batch: Any,
+                             prefix: str = "train") -> Optional[str]:
+        """Register the compiled train step (whatever variant this engine
+        built) under ``<prefix>/step``. Called from train_batch right after
+        the step specializes, so re-specializations (compression boundaries,
+        random-LTD) re-register the CURRENT program."""
+        try:
+            from tools.tpuaudit.registry import (StaleEntryError,
+                                                 abstract_tree,
+                                                 abstract_with_shardings,
+                                                 register_entry_point)
+        except ImportError:
+            return None
+        try:
+            import weakref
+
+            # args are ShapeDtypeStruct trees (shapes only, no buffers);
+            # the step executable itself is looked up through a weakref at
+            # audit time so the registry never pins a replaced engine
+            batch_sds = abstract_with_shardings(
+                stacked_batch, self._batch_sharding(stacked_batch,
+                                                    leading_gas=True))
+            params_sds = abstract_tree(self.params)
+            suppress = set()
+            if self._nvme_swapper is not None:
+                # params update host-side in the swapper; the device program
+                # intentionally returns grads without donating params
+                args = (params_sds, batch_sds)
+                donate: Tuple[int, ...] = ()
+                suppress.add("missed-donation")
+            elif self._onebit:
+                args = (params_sds, abstract_tree(self.opt_state),
+                        abstract_tree(self.scaler_state),
+                        abstract_tree(self._comp_state), batch_sds)
+                donate = (0, 1, 3)
+            else:
+                args = (params_sds, abstract_tree(self.opt_state),
+                        abstract_tree(self.scaler_state), batch_sds)
+                donate = (0, 1)
+            name = f"{prefix}/step"
+            wself = weakref.ref(self)
+
+            def build():
+                eng = wself()
+                if eng is None or eng._compiled_step is None:
+                    raise StaleEntryError(f"{name}: engine was torn down")
+                return eng._compiled_step, args, {}
+
+            register_entry_point(
+                name, build=build,
+                donate_argnums=donate,
+                expected_collectives=self._expected_collectives(train=True),
+                suppress=frozenset(suppress), mesh=self.mesh,
+                compile=not self.model.pipelined,  # 1F1B compiles are heavy
+                tags={"engine": "TrainEngine",
+                      "zero_stage": self.config.zero_stage})
+            return name
+        except Exception:  # registration must never take training down
+            logger.warning("tpuaudit step registration failed", exc_info=True)
+            return None
+
+    def _register_eval_audit(self, batch: Any,
+                             prefix: str = "train") -> Optional[str]:
+        try:
+            from tools.tpuaudit.registry import (StaleEntryError,
+                                                 abstract_tree,
+                                                 register_entry_point)
+        except ImportError:
+            return None
+        try:
+            import weakref
+
+            name = f"{prefix}/eval"
+            args = (abstract_tree(self.params), abstract_tree(batch))
+            wself = weakref.ref(self)
+
+            def build():
+                eng = wself()
+                if eng is None or eng._eval_step is None:
+                    raise StaleEntryError(f"{name}: engine was torn down")
+                return eng._eval_step, args, {}
+
+            register_entry_point(
+                name, build=build, donate_argnums=(),
+                expected_collectives=self._expected_collectives(train=False),
+                mesh=self.mesh, compile=not self.model.pipelined,
+                tags={"engine": "TrainEngine"})
+            return name
+        except Exception:
+            logger.warning("tpuaudit eval registration failed", exc_info=True)
+            return None
 
     # -- profiling (reference flops_profiler engine hooks + NVTX ranges) --
     def get_flops_profile(self):

@@ -1,0 +1,13 @@
+#!/usr/bin/env bash
+# Repo program-audit gate: tpuaudit over the selftest engines (train with
+# ZeRO-3 on the virtual mesh, pipeline-parallel train, inference) with the
+# committed baseline. Exits non-zero on any NEW finding or stale baseline
+# entry. Usage: scripts/audit.sh [extra tpuaudit args...]
+set -euo pipefail
+
+cd "$(dirname "$0")/.."
+
+JAX_PLATFORMS=cpu python -m tools.tpuaudit \
+    --config tools/tpuaudit/selftest_config.json \
+    --baseline .tpuaudit-baseline.json \
+    --devices 8 "$@"

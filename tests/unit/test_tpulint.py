@@ -407,6 +407,73 @@ class TestBaseline:
         data = json.loads(bl.read_text())
         assert data["counts"] == {"bad.py::host-sync-in-jit": 1}
 
+    def test_stale_baseline_entry_fails_the_gate(self, tmp_path, capsys):
+        """Fixing a finding without regenerating the baseline leaves a stale
+        budget that would silently re-admit regressions — the gate errors."""
+        bad = tmp_path / "bad.py"
+        bad.write_text("import jax\n@jax.jit\ndef f(x):\n    return x.item()\n")
+        bl = tmp_path / "bl.json"
+        assert tpulint_main([str(bad), "--root", str(tmp_path),
+                             "--baseline", str(bl), "--write-baseline"]) == 0
+        bad.write_text("import jax\n@jax.jit\ndef f(x):\n    return x\n")
+        capsys.readouterr()
+        assert tpulint_main([str(bad), "--root", str(tmp_path),
+                             "--baseline", str(bl)]) == 1
+        assert "stale baseline entry" in capsys.readouterr().out
+
+    def test_prune_baseline_drops_stale_keys(self, tmp_path, capsys):
+        bad = tmp_path / "bad.py"
+        bad.write_text("import jax\n@jax.jit\ndef f(x):\n    return x.item()\n")
+        bl = tmp_path / "bl.json"
+        assert tpulint_main([str(bad), "--root", str(tmp_path),
+                             "--baseline", str(bl), "--write-baseline"]) == 0
+        bad.write_text("import jax\n@jax.jit\ndef f(x):\n    return x\n")
+        assert tpulint_main([str(bad), "--root", str(tmp_path),
+                             "--baseline", str(bl), "--prune-baseline"]) == 0
+        assert json.loads(bl.read_text())["counts"] == {}
+        assert tpulint_main([str(bad), "--root", str(tmp_path),
+                             "--baseline", str(bl)]) == 0
+
+    def test_deleted_file_under_analyzed_dir_is_stale(self, tmp_path, capsys):
+        """Deleting a file is the most common source of baseline rot — its
+        keys are in scope when the run covers the enclosing directory."""
+        bad = tmp_path / "pkg" / "bad.py"
+        bad.parent.mkdir()
+        bad.write_text("import jax\n@jax.jit\ndef f(x):\n    return x.item()\n")
+        bl = tmp_path / "bl.json"
+        assert tpulint_main([str(tmp_path / "pkg"), "--root", str(tmp_path),
+                             "--baseline", str(bl), "--write-baseline"]) == 0
+        bad.unlink()
+        capsys.readouterr()
+        assert tpulint_main([str(tmp_path / "pkg"), "--root", str(tmp_path),
+                             "--baseline", str(bl)]) == 1
+        assert "stale baseline entry" in capsys.readouterr().out
+        assert tpulint_main([str(tmp_path / "pkg"), "--root", str(tmp_path),
+                             "--baseline", str(bl), "--prune-baseline"]) == 0
+        assert json.loads(bl.read_text())["counts"] == {}
+
+    def test_prune_missing_baseline_is_an_error(self, tmp_path, capsys):
+        a = tmp_path / "a.py"
+        a.write_text("x = 1\n")
+        assert tpulint_main([str(a), "--root", str(tmp_path),
+                             "--baseline", str(tmp_path / "nope.json"),
+                             "--prune-baseline"]) == 2
+
+    def test_partial_run_does_not_condemn_out_of_scope_keys(self, tmp_path,
+                                                            capsys):
+        """Linting one file with a baseline that also budgets another file
+        must not flag the other file's keys as stale."""
+        a = tmp_path / "a.py"
+        b = tmp_path / "b.py"
+        src = "import jax\n@jax.jit\ndef f(x):\n    return x.item()\n"
+        a.write_text(src)
+        b.write_text(src)
+        bl = tmp_path / "bl.json"
+        assert tpulint_main([str(a), str(b), "--root", str(tmp_path),
+                             "--baseline", str(bl), "--write-baseline"]) == 0
+        assert tpulint_main([str(a), "--root", str(tmp_path),
+                             "--baseline", str(bl)]) == 0
+
 
 # ---------------------------------------------------------------------------
 # CLI surface

@@ -1,0 +1,22 @@
+"""tpuaudit — trace-time program auditor for the jitted entry points.
+
+Where tpulint reads SOURCE (AST), tpuaudit reads the PROGRAM: it traces
+registered jitted callables abstractly (``jax.jit(...).trace`` on
+``ShapeDtypeStruct`` trees — CPU-safe, no device execution), lowers them to
+StableHLO, and optionally compiles them (still host-only) to see what GSPMD
+actually inserted. The failure modes it covers structurally cannot appear in
+an AST: resharding collectives, missed/dead buffer donation, host callbacks
+that survived into the program, weak-type scalar capture, and multi-MiB
+constants baked into the jaxpr.
+"""
+
+from .core import Finding, Program, audit_entry, run_audit
+from .checks import CHECKS
+from .registry import (EntryPoint, abstract_tree, clear_registry,
+                       get_entry_points, register_entry_point)
+
+__all__ = [
+    "Finding", "Program", "audit_entry", "run_audit", "CHECKS",
+    "EntryPoint", "abstract_tree", "clear_registry", "get_entry_points",
+    "register_entry_point",
+]

@@ -1,0 +1,16 @@
+"""tpuaudit baseline — identical semantics to tpulint's (count budgets per
+``entry::check`` key, stale-key erroring, pruning); the implementation is
+shared from ``tools.tpulint.baseline`` so the two gates can never drift."""
+
+from __future__ import annotations
+
+from typing import Dict, Sequence
+
+from ..tpulint.baseline import (BASELINE_VERSION, counts_of,  # noqa: F401
+                                gate_and_report, load, new_findings, pruned,
+                                stale_keys, write_counts)
+from ..tpulint import baseline as _shared
+
+
+def write(path: str, findings: Sequence) -> None:
+    _shared.write(path, findings, tool="tpuaudit")

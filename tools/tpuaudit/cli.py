@@ -1,0 +1,167 @@
+"""tpuaudit CLI — mirrors tpulint's gate semantics at the program level.
+
+Usage::
+
+    python -m tools.tpuaudit --config tools/tpuaudit/selftest_config.json \
+        --baseline .tpuaudit-baseline.json
+    python -m tools.tpuaudit --config audit.json --format json
+    python -m tools.tpuaudit --config audit.json --baseline b.json --write-baseline
+
+Exit status: 0 clean (or all findings baselined), 1 new findings or stale
+baseline entries, 2 usage/internal error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+from . import baseline as baseline_mod
+from .core import run_audit
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="tpuaudit",
+        description="JAX/TPU program-level audit: traces registered entry "
+                    "points (jaxpr + StableHLO, no device execution) and "
+                    "checks collectives, donation, callbacks, weak types "
+                    "and baked constants.")
+    parser.add_argument("--config", metavar="FILE", default=None,
+                        help="JSON harness config; builds the train/pipeline/"
+                             "inference engines so they register their entry "
+                             "points (see tools/tpuaudit/harness.py)")
+    parser.add_argument("--entries", metavar="NAMES", default=None,
+                        help="comma-separated entry-point names to audit "
+                             "(default: every registered entry)")
+    parser.add_argument("--select", metavar="CHECKS", default=None,
+                        help="comma-separated check names to run "
+                             "(default: all)")
+    parser.add_argument("--format", choices=("text", "json"), default="text")
+    parser.add_argument("--baseline", metavar="FILE", default=None,
+                        help="JSON baseline of accepted findings; only "
+                             "findings over the baselined counts fail, and "
+                             "stale baseline entries error")
+    parser.add_argument("--write-baseline", action="store_true",
+                        help="write the current findings to --baseline and "
+                             "exit 0")
+    parser.add_argument("--prune-baseline", action="store_true",
+                        help="drop stale baseline entries and ratchet "
+                             "budgets down to current counts, then exit 0")
+    parser.add_argument("--min-donation-bytes", type=int, default=None,
+                        help="missed-donation reporting threshold (default "
+                             "1MiB)")
+    parser.add_argument("--max-const-bytes", type=int, default=None,
+                        help="baked-constant reporting threshold (default "
+                             "1MiB)")
+    parser.add_argument("--no-compile", action="store_true",
+                        help="skip host-side XLA compilation (faster, but "
+                             "GSPMD-inserted collectives become invisible)")
+    parser.add_argument("--devices", type=int, default=None,
+                        help="virtual CPU device count (sets XLA_FLAGS; "
+                             "must run before jax is imported)")
+    parser.add_argument("--list-checks", action="store_true",
+                        help="print the check registry and exit")
+    parser.add_argument("--list-entries", action="store_true",
+                        help="print the registered entry points and exit")
+    return parser
+
+
+def _setup_platform(devices: Optional[int]) -> None:
+    """Force the CPU backend (the audit is host-only by design) before jax
+    initializes; a no-op when jax is already imported (in-process callers)."""
+    if "jax" in sys.modules:
+        return
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if devices and devices > 1:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                f"{flags} --xla_force_host_platform_device_count={devices}")
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+
+    from .checks import CHECKS
+
+    if args.list_checks:
+        for check in CHECKS:
+            print(f"{check.name}: {check.description}")
+        return 0
+
+    select = None
+    if args.select:
+        select = {c.strip() for c in args.select.split(",") if c.strip()}
+        known = {c.name for c in CHECKS} | {"trace-error"}
+        unknown = select - known
+        if unknown:
+            print(f"tpuaudit: unknown check(s): {', '.join(sorted(unknown))}",
+                  file=sys.stderr)
+            return 2
+
+    _setup_platform(args.devices)
+
+    from .registry import get_entry_points
+
+    if args.config:
+        from . import harness
+
+        try:
+            harness.build_from_config(harness.load_config(args.config))
+        except (OSError, json.JSONDecodeError, ValueError, KeyError) as e:
+            print(f"tpuaudit: bad --config {args.config}: {e}",
+                  file=sys.stderr)
+            return 2
+
+    try:
+        names = ([n.strip() for n in args.entries.split(",") if n.strip()]
+                 if args.entries else None)
+        entries = get_entry_points(names)
+    except KeyError as e:
+        print(f"tpuaudit: {e.args[0]}", file=sys.stderr)
+        return 2
+
+    if args.list_entries:
+        for ep in entries:
+            exp = (sorted(ep.expected_collectives)
+                   if ep.expected_collectives is not None else "unchecked")
+            print(f"{ep.name}: expected_collectives={exp} "
+                  f"donate={ep.donate_argnums} suppress={sorted(ep.suppress)}")
+        return 0
+    if not entries:
+        print("tpuaudit: no entry points registered (pass --config, or "
+              "construct the engines in-process first)", file=sys.stderr)
+        return 2
+
+    options = {}
+    if args.min_donation_bytes is not None:
+        options["min_donation_bytes"] = args.min_donation_bytes
+    if args.max_const_bytes is not None:
+        options["max_const_bytes"] = args.max_const_bytes
+    if args.no_compile:
+        options["compile"] = False
+
+    findings = run_audit(entries, select=select, options=options)
+
+    # Scope for stale-key detection: with no --entries filter, the whole
+    # registry was audited — a baselined entry that is no longer registered
+    # at all IS the rot this gate exists to catch, so every key is in scope.
+    # An explicit --entries subset only judges those names.
+    def in_scope(key: str) -> bool:
+        entry, _, check = key.rpartition("::")
+        if select is not None and check not in select:
+            return False
+        return names is None or entry in names
+
+    return baseline_mod.gate_and_report(
+        findings, tool="tpuaudit", fmt=args.format,
+        baseline_path=args.baseline, write_baseline=args.write_baseline,
+        prune_baseline=args.prune_baseline, in_scope=in_scope)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

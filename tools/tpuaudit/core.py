@@ -1,0 +1,247 @@
+"""tpuaudit core — the Program model and the audit driver.
+
+For every registered entry point the driver
+
+1. evaluates the ``build`` thunk → ``(fn, args, kwargs)``;
+2. ``jax.jit(fn).trace(*args)`` — abstract trace, no device math;
+3. ``traced.lower()`` → StableHLO text (explicit collectives from shard_map
+   bodies, donation/donor arg attributes);
+4. optionally ``lowered.compile()`` — still host-only — because GSPMD inserts
+   resharding collectives during PARTITIONING: the lowered module only carries
+   sharding annotations, the compiled module carries the all-gathers you will
+   actually pay for;
+5. hands the resulting ``Program`` to every check (``checks.py``).
+
+Findings mirror tpulint's shape (``key`` = ``entry::check`` is the baseline
+bucket) so the two analyzers share baseline/CLI semantics.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import re
+from collections import Counter
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from .registry import COLLECTIVE_KINDS, EntryPoint
+
+__all__ = ["Finding", "Program", "audit_entry", "run_audit",
+            "collect_collectives"]
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One diagnostic. ``key`` (entry::check) is the baseline bucket."""
+    check: str
+    entry: str
+    message: str
+
+    @property
+    def key(self) -> str:
+        return f"{self.entry}::{self.check}"
+
+    def render(self) -> str:
+        return f"{self.entry}: {self.check}: {self.message}"
+
+    def to_json(self) -> Dict[str, object]:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class Program:
+    """Everything a check may inspect about one traced entry point."""
+
+    entry: EntryPoint
+    closed_jaxpr: Any                  # jax ClosedJaxpr
+    in_avals: List[Any]                # flat input avals (trace order)
+    out_avals: List[Any]               # flat output avals
+    in_labels: List[str]               # "arg0['w']"-style path per input leaf
+    arg_of_input: List[int]            # top-level argnum per input leaf (-1 unknown)
+    donated: List[bool]                # per input leaf
+    stablehlo: str
+    compiled_hlo: Optional[str]
+
+    def iter_eqns(self):
+        """All equations, descending into sub-jaxprs (scan/cond/pjit/...)."""
+        seen: Set[int] = set()
+
+        def walk(jaxpr):
+            if id(jaxpr) in seen:
+                return
+            seen.add(id(jaxpr))
+            for eqn in jaxpr.eqns:
+                yield eqn
+                for sub in _subjaxprs(eqn):
+                    yield from walk(sub)
+
+        yield from walk(self.closed_jaxpr.jaxpr)
+
+
+def _subjaxprs(eqn) -> Iterable[Any]:
+    for v in eqn.params.values():
+        for cand in (v if isinstance(v, (list, tuple)) else (v,)):
+            jaxpr = getattr(cand, "jaxpr", None)
+            if jaxpr is not None and hasattr(jaxpr, "eqns"):
+                yield jaxpr
+            elif hasattr(cand, "eqns"):
+                yield cand
+
+
+# -- collective census -------------------------------------------------------
+
+# StableHLO spells kinds with underscores (`stablehlo.all_gather`); the
+# post-optimization HLO uses dashes and may split ops into -start/-done pairs.
+_STABLEHLO_RE = re.compile(
+    r'stablehlo\.(all_gather|all_reduce|reduce_scatter|all_to_all|'
+    r'collective_permute|collective_broadcast)\b')
+_HLO_RE = re.compile(
+    r'\b(all-gather|all-reduce|reduce-scatter|all-to-all|collective-permute|'
+    r'collective-broadcast)(?:-start)?\(')
+
+
+def collect_collectives(stablehlo: Optional[str],
+                        compiled_hlo: Optional[str]) -> Dict[str, int]:
+    """Collective kinds present in the program, canonical dashed names →
+    occurrence count. The two texts are complementary: shard_map bodies put
+    explicit collectives in the StableHLO; GSPMD resharding only shows up
+    post-compile. An explicit collective appears in BOTH texts, so the
+    per-kind count is the max over sources, not the sum."""
+    lowered: Counter = Counter()
+    compiled: Counter = Counter()
+    if stablehlo:
+        for m in _STABLEHLO_RE.finditer(stablehlo):
+            lowered[m.group(1).replace("_", "-")] += 1
+    if compiled_hlo:
+        for m in _HLO_RE.finditer(compiled_hlo):
+            compiled[m.group(1)] += 1
+    counts = {k: max(lowered.get(k, 0), compiled.get(k, 0))
+              for k in set(lowered) | set(compiled)}
+    assert set(counts) <= set(COLLECTIVE_KINDS)
+    return counts
+
+
+# -- program construction ----------------------------------------------------
+
+
+def _flat_labels(args: tuple, kwargs: dict) -> Tuple[List[str], List[int]]:
+    """Flat leaf labels + owning top-level argnum, matching jit's flatten
+    order ((args, kwargs) as one tree)."""
+    import jax
+
+    labels: List[str] = []
+    argnums: List[int] = []
+    for i, a in enumerate(args):
+        for path, _ in jax.tree_util.tree_leaves_with_path(a):
+            labels.append(f"arg{i}{jax.tree_util.keystr(path)}")
+            argnums.append(i)
+    for k in sorted(kwargs):
+        for path, _ in jax.tree_util.tree_leaves_with_path(kwargs[k]):
+            labels.append(f"{k}{jax.tree_util.keystr(path)}")
+            argnums.append(-1)
+    return labels, argnums
+
+
+def build_program(ep: EntryPoint, do_compile: Optional[bool] = None) -> Program:
+    """Trace + lower (+ compile) one entry point. Raises on trace failure —
+    ``audit_entry`` turns that into a ``trace-error`` finding."""
+    import jax
+
+    fn, args, kwargs = ep.build()
+    if not hasattr(fn, "trace"):      # plain python callable
+        fn = jax.jit(fn, donate_argnums=ep.donate_argnums)
+
+    # ep.mesh is either a Mesh, None, or a zero-arg resolver (registration
+    # sites that only know the mesh lazily); note jax.sharding.Mesh itself
+    # is callable (a ContextDecorator), so type-check before resolving
+    mesh = ep.mesh
+    if mesh is not None and not isinstance(mesh, jax.sharding.Mesh) \
+            and callable(mesh):
+        mesh = mesh()
+    ctx = contextlib.nullcontext()
+    if mesh is not None:
+        from deepspeed_tpu.parallel import mesh as mesh_mod
+
+        ctx = mesh_mod.ambient(mesh)
+    with ctx:
+        traced = fn.trace(*args, **kwargs)
+        lowered = traced.lower()
+        stablehlo = lowered.as_text()
+        compiled_hlo = None
+        if do_compile if do_compile is not None else ep.compile:
+            compiled_hlo = lowered.compile().as_text()
+
+    closed = traced.jaxpr
+    labels, argnums = _flat_labels(args, kwargs)
+    in_avals = list(closed.in_avals)
+    if len(labels) != len(in_avals):
+        # structure mismatch (e.g. a fn with captured tracers) — keep going
+        # with positional labels; donation mapping is disabled
+        labels = [f"in{i}" for i in range(len(in_avals))]
+        argnums = [-1] * len(in_avals)
+    donate = set(ep.donate_argnums)
+    donated = [a in donate for a in argnums]
+    return Program(entry=ep, closed_jaxpr=closed, in_avals=in_avals,
+                   out_avals=list(closed.out_avals), in_labels=labels,
+                   arg_of_input=argnums, donated=donated,
+                   stablehlo=stablehlo, compiled_hlo=compiled_hlo)
+
+
+# -- driver ------------------------------------------------------------------
+
+
+def audit_entry(ep: EntryPoint, select: Optional[Set[str]] = None,
+                options: Optional[Dict[str, Any]] = None) -> List[Finding]:
+    from .checks import CHECKS
+
+    from .registry import StaleEntryError
+
+    try:
+        program = build_program(
+            ep, do_compile=None if options is None
+            else options.get("compile"))
+    except StaleEntryError:
+        return []   # the owning engine is gone; nothing to audit
+    except Exception as e:                        # noqa: BLE001 — any trace
+        # failure is itself a reportable (and baselinable) audit outcome
+        msg = f"{type(e).__name__}: {e}"
+        return [Finding("trace-error", ep.name,
+                        f"could not trace/lower entry point: {msg[:500]}")]
+    findings: List[Finding] = []
+    for check in CHECKS:
+        if select is not None and check.name not in select:
+            continue
+        if check.name in ep.suppress:
+            continue
+        findings.extend(check.run(program, options or {}))
+    return findings
+
+
+def run_audit(entries: Sequence[EntryPoint],
+              select: Optional[Set[str]] = None,
+              options: Optional[Dict[str, Any]] = None,
+              publish_metrics: bool = True) -> List[Finding]:
+    """Audit entry points and (by default) publish per-(entry, check) finding
+    counters into the observability MetricsRegistry, so a run that also dumps
+    metrics JSONL shows audit regressions in ``observability report``."""
+    findings: List[Finding] = []
+    for ep in entries:
+        findings.extend(audit_entry(ep, select=select, options=options))
+    findings.sort(key=lambda f: (f.entry, f.check, f.message))
+    if publish_metrics:
+        _publish(entries, findings)
+    return findings
+
+
+def _publish(entries: Sequence[EntryPoint], findings: Sequence[Finding]) -> None:
+    try:
+        from deepspeed_tpu.observability import get_registry
+    except ImportError:
+        return
+    reg = get_registry()
+    reg.counter("tpuaudit/entries_audited",
+                help="entry points traced by tpuaudit").inc(len(entries))
+    counter = reg.counter("tpuaudit/findings",
+                          help="tpuaudit findings per entry point and check")
+    for f in findings:
+        counter.inc(entry=f.entry, check=f.check)

@@ -1,0 +1,128 @@
+"""Config-driven audit harness — builds engines so they self-register.
+
+``python -m tools.tpuaudit --config audit.json`` drives this module: each
+section constructs the corresponding engine (train / pipeline-parallel train /
+inference) on the CPU mesh and calls its ``register_audit_entries`` hook; the
+CLI then audits whatever landed in the registry. Engine construction
+materialises (small) params — that is init, not step execution; the audited
+programs themselves are traced abstractly.
+
+Config shape (all sections optional)::
+
+    {
+      "train":    {"model": {"type": "simple", "hidden_dim": 10},
+                   "config": {<deepspeed_tpu config dict>},
+                   "batch": {"x": [[2, 10], "float32"],
+                             "y": [[2, 1],  "float32"]}},
+      "pipeline": {"model": {"type": "preset", "name": "tiny", "dtype": "float32"},
+                   "config": {"parallel": {"pipeline_parallel_size": 2}, ...},
+                   "seq_len": 16},
+      "inference": {"model": {"type": "preset", "name": "tiny"},
+                    "batch_size": 1, "prompt_len": 64, "max_new_tokens": 8}
+    }
+
+``batch`` entries are ``name: [shape, dtype]`` pairs describing ONE microbatch
+(the gas dim is added by the engine hook). Transformer models may omit
+``batch``: token batches are synthesized from the model config.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List
+
+
+def _np_dtype(name: str):
+    import numpy as np
+
+    return np.dtype(name)
+
+
+def _build_model(spec: Dict[str, Any]):
+    kind = spec.get("type", "preset")
+    if kind == "simple":
+        from deepspeed_tpu.models import simple_model
+
+        kw = {k: v for k, v in spec.items() if k != "type"}
+        return simple_model(**kw)
+    if kind == "preset":
+        from deepspeed_tpu.models import create_model
+        import jax.numpy as jnp
+
+        kw = {k: v for k, v in spec.items() if k not in ("type", "name")}
+        if isinstance(kw.get("dtype"), str):
+            kw["dtype"] = jnp.dtype(kw["dtype"]).type
+        return create_model(spec["name"], **kw)
+    raise ValueError(f"unknown model type '{kind}' (simple | preset)")
+
+
+def _micro_batch(section: Dict[str, Any], model, micro_size: int):
+    """One microbatch of host zeros matching the declared (or synthesized)
+    shapes — only shapes/dtypes reach the auditor."""
+    import numpy as np
+
+    spec = section.get("batch")
+    if spec is not None:
+        return {k: np.zeros(tuple(shape), _np_dtype(dtype))
+                for k, (shape, dtype) in spec.items()}
+    cfg = model.config
+    if cfg is None:
+        raise ValueError(
+            "non-transformer models need an explicit 'batch' spec "
+            "({name: [shape, dtype]}) in the audit config section")
+    seq = int(section.get("seq_len", min(cfg.max_seq_len, 32)))
+    return {"input_ids": np.zeros((micro_size, seq), np.int32)}
+
+
+def run_section_train(section: Dict[str, Any],
+                      prefix: str = "train") -> List[str]:
+    import deepspeed_tpu
+
+    model = _build_model(section.get("model", {"type": "simple"}))
+    cfg = dict(section.get("config") or {})
+    cfg.setdefault("train_micro_batch_size_per_gpu", 2)
+    cfg.setdefault("optimizer", {"type": "adamw", "params": {"lr": 1e-3}})
+    cfg.setdefault("steps_per_print", 10 ** 9)
+    engine, *_ = deepspeed_tpu.initialize(model=model, config=cfg)
+    gb = engine.train_batch_size() // engine.gradient_accumulation_steps()
+    micro = _micro_batch(section, model, gb)
+    return engine.register_audit_entries(micro, prefix=prefix)
+
+
+def run_section_inference(section: Dict[str, Any]) -> List[str]:
+    from deepspeed_tpu.inference import init_inference
+
+    spec = dict(section["model"])
+    if spec.get("type", "preset") != "preset":
+        raise ValueError("inference audit section needs a preset model "
+                         "(the KV arena is sized from its config)")
+    # init_inference derives dtype and max_seq_len itself
+    overrides = {k: v for k, v in spec.items()
+                 if k not in ("type", "name", "dtype", "max_seq_len")}
+    kw = {k: section[k] for k in ("tensor_parallel", "expert_parallel",
+                                  "dtype", "max_out_tokens")
+          if k in section}
+    # pass the preset NAME: init_inference builds the model with the
+    # engine's compute dtype, keeping params/cache/program dtypes coherent
+    engine = init_inference(model=spec["name"], **kw, **overrides)
+    return engine.register_audit_entries(
+        batch_size=int(section.get("batch_size", 1)),
+        prompt_len=int(section.get("prompt_len", 64)),
+        max_new_tokens=int(section.get("max_new_tokens", 8)))
+
+
+def build_from_config(config: Dict[str, Any]) -> List[str]:
+    """Build every engine the config names; returns the registered entry
+    names (the registry keeps the entries for the CLI to audit)."""
+    registered: List[str] = []
+    for key in ("train", "pipeline"):     # pipeline IS a train engine with pp>1
+        if key in config:
+            registered += run_section_train(config[key], prefix=key)
+    if "inference" in config:
+        registered += run_section_inference(config["inference"])
+    return registered
+
+
+def load_config(path: str) -> Dict[str, Any]:
+    with open(path, "r", encoding="utf-8") as fh:
+        return json.load(fh)

@@ -1,0 +1,133 @@
+"""Entry-point registry — what the auditor audits.
+
+Each engine layer registers its jitted callables here (guarded imports, so a
+``deepspeed_tpu`` deployed without the ``tools/`` tree keeps working) together
+with the *declared* contract the checks verify the program against:
+
+* ``expected_collectives`` — the collective kinds this program is ALLOWED to
+  contain. Anything else in the lowered/compiled program is a GSPMD-inserted
+  reshard the author didn't plan for (the unexpected-collective check).
+* ``donate_argnums`` — what the jit call actually donated; the donation checks
+  compare it against what COULD alias.
+* ``suppress`` — check names this entry opts out of, with the reason kept at
+  the registration site (the program-level analog of tpulint's inline
+  ``# tpulint: disable=...``).
+
+Registration is cheap (a dataclass in a dict; jax is only imported when a
+``ShapeDtypeStruct`` tree is built) and idempotent by name — engines re-register
+when they re-specialize a step, and the newest program wins.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, FrozenSet, List, Optional, Tuple
+
+# canonical (dashed) collective kind names; both the StableHLO op spelling
+# (underscores) and the post-optimization HLO spelling (dashes) normalize here
+COLLECTIVE_KINDS = (
+    "all-gather", "all-reduce", "reduce-scatter", "all-to-all",
+    "collective-permute", "collective-broadcast",
+)
+
+
+@dataclasses.dataclass
+class EntryPoint:
+    """One auditable program: a builder returning ``(fn, args, kwargs)`` where
+    ``fn`` is jit-wrapped (or plain — the auditor wraps it) and ``args`` are
+    abstract (``ShapeDtypeStruct`` trees) or concrete arrays (only their
+    shape/dtype/sharding is used; nothing executes)."""
+
+    name: str
+    build: Callable[[], Tuple[Callable, tuple, dict]]
+    expected_collectives: Optional[FrozenSet[str]] = frozenset()
+    donate_argnums: Tuple[int, ...] = ()
+    suppress: FrozenSet[str] = frozenset()
+    mesh: Any = None          # activated (ambient) around trace/lower/compile
+    compile: bool = True      # also compile (host-only) to see GSPMD's output
+    tags: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    def __post_init__(self):
+        if self.expected_collectives is not None:
+            unknown = set(self.expected_collectives) - set(COLLECTIVE_KINDS)
+            if unknown:
+                raise ValueError(
+                    f"entry '{self.name}': unknown collective kind(s) "
+                    f"{sorted(unknown)} (valid: {list(COLLECTIVE_KINDS)})")
+            self.expected_collectives = frozenset(self.expected_collectives)
+        self.suppress = frozenset(self.suppress)
+        self.donate_argnums = tuple(self.donate_argnums)
+
+
+class StaleEntryError(RuntimeError):
+    """Raised by a ``build`` thunk whose owning engine has been garbage
+    collected. Registration sites hold only a weakref to their engine (the
+    registry must never pin params/executables of a replaced engine in a
+    long-lived process); the auditor silently skips stale entries."""
+
+
+_ENTRIES: Dict[str, EntryPoint] = {}
+
+
+def register_entry_point(name: str,
+                         build: Optional[Callable] = None,
+                         fn: Optional[Callable] = None,
+                         args: Optional[tuple] = None,
+                         kwargs: Optional[dict] = None,
+                         **opts: Any) -> EntryPoint:
+    """Register (or replace) an entry point. Pass either a ``build`` thunk —
+    evaluated lazily at audit time, so registration never traces — or a
+    ready ``fn`` + ``args`` pair."""
+    if build is None:
+        if fn is None or args is None:
+            raise ValueError("register_entry_point needs build= or fn=+args=")
+        frozen_fn, frozen_args, frozen_kwargs = fn, tuple(args), dict(kwargs or {})
+        build = lambda: (frozen_fn, frozen_args, frozen_kwargs)
+    ep = EntryPoint(name=name, build=build, **opts)
+    _ENTRIES[name] = ep
+    return ep
+
+
+def get_entry_points(names: Optional[List[str]] = None) -> List[EntryPoint]:
+    if names is None:
+        return list(_ENTRIES.values())
+    missing = [n for n in names if n not in _ENTRIES]
+    if missing:
+        raise KeyError(f"unregistered entry point(s): {', '.join(missing)}")
+    return [_ENTRIES[n] for n in names]
+
+
+def clear_registry() -> None:
+    _ENTRIES.clear()
+
+
+def abstract_tree(tree: Any) -> Any:
+    """Concrete (or mixed) pytree → ``ShapeDtypeStruct`` tree, preserving
+    shardings where leaves carry them. The registration-site helper: engines
+    hand the auditor shapes, never live buffers."""
+    import jax
+
+    def one(x):
+        if isinstance(x, jax.ShapeDtypeStruct):
+            return x
+        if isinstance(x, (bool, int, float, complex)):
+            return x  # keep python scalars AS scalars — weak types must trace
+        sharding = getattr(x, "sharding", None)
+        if not isinstance(sharding, jax.sharding.NamedSharding):
+            # single-device/committed shardings of stray host scalars would
+            # conflict with the mesh-placed arguments at trace time; only
+            # mesh shardings carry audit-relevant information
+            sharding = None
+        return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=sharding)
+
+    return jax.tree.map(one, tree)
+
+
+def abstract_with_shardings(tree: Any, shardings: Any) -> Any:
+    """Host-array pytree + matching sharding tree → ``ShapeDtypeStruct``
+    tree (engines compute batch shardings separately from the batch data)."""
+    import jax
+
+    return jax.tree.map(
+        lambda x, s: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=s),
+        tree, shardings)

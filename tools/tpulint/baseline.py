@@ -3,22 +3,26 @@
 The baseline stores per-(path, rule) finding COUNTS rather than line numbers,
 so unrelated edits that shift lines don't invalidate it, while any net-new
 violation in a file (count exceeds the recorded budget) fails the gate.
-Fixing findings only ever lowers counts, which passes; regenerate with
-``--write-baseline`` to ratchet the budget down.
+Fixing findings only ever lowers counts, which passes — but the stale budget
+then lingers, silently re-admitting regressions up to the old count. The gate
+therefore ERRORS on stale keys (a baselined bucket that no longer produces
+any finding); ``--prune-baseline`` drops stale keys and ratchets surviving
+budgets down to the current counts.
+
+tpuaudit shares these semantics (its keys are ``entry::check`` instead of
+``path::rule``) via the ``tool=`` parameter.
 """
 
 from __future__ import annotations
 
 import collections
 import json
-from typing import Dict, List, Sequence
-
-from .core import Finding
+from typing import Callable, Dict, List, Optional, Sequence
 
 BASELINE_VERSION = 1
 
 
-def counts_of(findings: Sequence[Finding]) -> Dict[str, int]:
+def counts_of(findings: Sequence) -> Dict[str, int]:
     counts: Dict[str, int] = collections.Counter()
     for f in findings:
         counts[f.key] += 1
@@ -34,10 +38,10 @@ def load(path: str) -> Dict[str, int]:
     return {str(k): int(v) for k, v in data.get("counts", {}).items()}
 
 
-def write(path: str, findings: Sequence[Finding]) -> None:
+def write(path: str, findings: Sequence, tool: str = "tpulint") -> None:
     payload = {
         "version": BASELINE_VERSION,
-        "tool": "tpulint",
+        "tool": tool,
         "counts": counts_of(findings),
     }
     with open(path, "w", encoding="utf-8") as fh:
@@ -45,17 +49,126 @@ def write(path: str, findings: Sequence[Finding]) -> None:
         fh.write("\n")
 
 
-def new_findings(findings: Sequence[Finding],
-                 baseline: Dict[str, int]) -> List[Finding]:
-    """Findings over budget. Within one (path, rule) bucket the LAST findings
-    in line order are reported as new — a stable, if arbitrary, choice."""
-    by_key: Dict[str, List[Finding]] = collections.defaultdict(list)
+def stale_keys(findings: Sequence, baseline: Dict[str, int],
+               in_scope: Optional[Callable[[str], bool]] = None) -> List[str]:
+    """Baseline keys with a positive budget but ZERO current findings — rot
+    that would silently re-admit regressions. ``in_scope`` limits the check
+    to keys this run could have produced (a partial run — subset of paths or
+    ``--select``ed rules — must not condemn keys it never looked at)."""
+    current = counts_of(findings)
+    return sorted(k for k, budget in baseline.items()
+                  if budget > 0 and current.get(k, 0) == 0
+                  and (in_scope is None or in_scope(k)))
+
+
+def pruned(findings: Sequence, baseline: Dict[str, int],
+           in_scope: Optional[Callable[[str], bool]] = None) -> Dict[str, int]:
+    """Baseline with stale keys dropped and surviving budgets clamped down to
+    the current counts. Out-of-scope keys pass through untouched."""
+    current = counts_of(findings)
+    out: Dict[str, int] = {}
+    for k, budget in baseline.items():
+        if in_scope is not None and not in_scope(k):
+            out[k] = budget
+            continue
+        n = current.get(k, 0)
+        if n > 0:
+            out[k] = min(budget, n)
+    return dict(sorted(out.items()))
+
+
+def write_counts(path: str, counts: Dict[str, int], tool: str = "tpulint") -> None:
+    """Write an already-computed counts dict (the prune path)."""
+    payload = {"version": BASELINE_VERSION, "tool": tool,
+               "counts": dict(sorted(counts.items()))}
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(payload, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+
+
+def gate_and_report(findings: Sequence, *, tool: str, fmt: str,
+                    baseline_path: Optional[str], write_baseline: bool,
+                    prune_baseline: bool,
+                    in_scope: Optional[Callable[[str], bool]] = None) -> int:
+    """The shared CLI gate driver — baseline write/prune dispatch, over-budget
+    diffing, stale-key detection, text/JSON rendering and the exit code. Both
+    analyzers route their CLI tail through here so the gate semantics
+    (including every stale/prune edge case) cannot drift between them.
+
+    Findings only need ``key``/``render()``/``to_json()``. Exit status: 0
+    clean (or fully baselined), 1 new findings or stale keys, 2 usage error.
+    """
+    import os
+    import sys
+
+    if (write_baseline or prune_baseline) and not baseline_path:
+        print(f"{tool}: --write-baseline/--prune-baseline require "
+              "--baseline FILE", file=sys.stderr)
+        return 2
+
+    if write_baseline:
+        write(baseline_path, findings, tool=tool)
+        print(f"{tool}: wrote {len(findings)} finding(s) to {baseline_path}")
+        return 0
+
+    gating: List = list(findings)
+    stale: List[str] = []
+    if baseline_path and not os.path.exists(baseline_path):
+        if prune_baseline:
+            print(f"{tool}: cannot prune: baseline {baseline_path} not found",
+                  file=sys.stderr)
+            return 2
+        print(f"{tool}: warning: baseline {baseline_path} not found; "
+              "gating on ALL findings", file=sys.stderr)
+    if baseline_path and os.path.exists(baseline_path):
+        try:
+            known_counts = load(baseline_path)
+        except (ValueError, json.JSONDecodeError) as e:
+            print(f"{tool}: bad baseline {baseline_path}: {e}",
+                  file=sys.stderr)
+            return 2
+        if prune_baseline:
+            out = pruned(findings, known_counts, in_scope=in_scope)
+            write_counts(baseline_path, out, tool=tool)
+            print(f"{tool}: pruned baseline {baseline_path}: "
+                  f"{len(known_counts)} -> {len(out)} entries")
+            return 0
+        gating = new_findings(findings, known_counts)
+        stale = stale_keys(findings, known_counts, in_scope=in_scope)
+
+    if fmt == "json":
+        print(json.dumps({
+            "findings": [f.to_json() for f in gating],
+            "stale_baseline_keys": stale,
+            "total_findings": len(findings),
+            "new_findings": len(gating),
+        }, indent=2))
+    else:
+        for f in gating:
+            print(f.render())
+        for key in stale:
+            print(f"stale baseline entry: {key} no longer produces findings "
+                  f"— run --prune-baseline")
+        suffix = " (after baseline)" if baseline_path else ""
+        print(f"{tool}: {len(gating)} new finding(s){suffix}, "
+              f"{len(stale)} stale baseline key(s), {len(findings)} total")
+    return 1 if (gating or stale) else 0
+
+
+def new_findings(findings: Sequence,
+                 baseline: Dict[str, int]) -> List:
+    """Findings over budget. Within one bucket the LAST findings in input
+    order are reported as new — a stable, if arbitrary, choice. Works for
+    both tpulint Findings (path::rule keys) and tpuaudit Findings
+    (entry::check keys)."""
+    by_key: Dict[str, List] = collections.defaultdict(list)
     for f in findings:
         by_key[f.key].append(f)
-    out: List[Finding] = []
+    out: List = []
     for key, group in by_key.items():
         budget = baseline.get(key, 0)
         if len(group) > budget:
             out.extend(group[budget:])
-    out.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    out.sort(key=lambda f: (f.key, getattr(f, "line", 0),
+                            getattr(f, "col", 0)))
     return out

@@ -13,13 +13,12 @@ Exit status: 0 clean (or all findings baselined), 1 new findings,
 from __future__ import annotations
 
 import argparse
-import json
 import os
 import sys
 from typing import List, Optional
 
 from . import baseline as baseline_mod
-from .core import RULES, Finding, analyze_paths
+from .core import RULES, analyze_paths, iter_python_files
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -37,6 +36,10 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--write-baseline", action="store_true",
                         help="write the current findings to --baseline and "
                              "exit 0")
+    parser.add_argument("--prune-baseline", action="store_true",
+                        help="drop stale baseline entries (keys that no "
+                             "longer produce findings) and ratchet budgets "
+                             "down to current counts, then exit 0")
     parser.add_argument("--select", metavar="RULES", default=None,
                         help="comma-separated rule names to run "
                              "(default: all)")
@@ -73,41 +76,31 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     findings = analyze_paths(args.paths, root=args.root, select=select)
 
-    if args.write_baseline:
-        if not args.baseline:
-            print("tpulint: --write-baseline requires --baseline FILE",
-                  file=sys.stderr)
-            return 2
-        baseline_mod.write(args.baseline, findings)
-        print(f"tpulint: wrote {len(findings)} finding(s) to {args.baseline}")
-        return 0
+    # Stale detection must only judge keys THIS run could have produced: a
+    # partial run (subset of paths, --select) says nothing about the rest.
+    # A key whose file sits UNDER an analyzed directory counts even when the
+    # file no longer exists — a deleted file is the most common source of
+    # baseline rot, and its budget must not linger.
+    root = args.root or os.getcwd()
+    analyzed = {os.path.relpath(p, root).replace(os.sep, "/")
+                for p in iter_python_files(args.paths)}
+    dir_prefixes: List[str] = []
+    for p in args.paths:
+        if os.path.isdir(p):
+            rel = os.path.relpath(p, root).replace(os.sep, "/")
+            dir_prefixes.append("" if rel == "." else rel.rstrip("/") + "/")
 
-    gating: List[Finding] = findings
-    if args.baseline and not os.path.exists(args.baseline):
-        print(f"tpulint: warning: baseline {args.baseline} not found; "
-              "gating on ALL findings", file=sys.stderr)
-    if args.baseline and os.path.exists(args.baseline):
-        try:
-            known_counts = baseline_mod.load(args.baseline)
-        except (ValueError, json.JSONDecodeError) as e:
-            print(f"tpulint: bad baseline {args.baseline}: {e}",
-                  file=sys.stderr)
-            return 2
-        gating = baseline_mod.new_findings(findings, known_counts)
+    def in_scope(key: str) -> bool:
+        path, _, rule = key.rpartition("::")
+        if select is not None and rule not in select:
+            return False
+        return path in analyzed or any(path.startswith(pref)
+                                       for pref in dir_prefixes)
 
-    if args.format == "json":
-        print(json.dumps({
-            "findings": [f.to_json() for f in gating],
-            "total_findings": len(findings),
-            "new_findings": len(gating),
-        }, indent=2))
-    else:
-        for f in gating:
-            print(f.render())
-        suffix = " (after baseline)" if args.baseline else ""
-        print(f"tpulint: {len(gating)} new finding(s){suffix}, "
-              f"{len(findings)} total")
-    return 1 if gating else 0
+    return baseline_mod.gate_and_report(
+        findings, tool="tpulint", fmt=args.format,
+        baseline_path=args.baseline, write_baseline=args.write_baseline,
+        prune_baseline=args.prune_baseline, in_scope=in_scope)
 
 
 if __name__ == "__main__":
