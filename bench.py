@@ -14,156 +14,37 @@ unavailable (round 4's official record was a bare ``UNAVAILABLE``
 traceback). The parent runs the measurement in a watchdogged child
 immediately (no extra backend init when the tunnel is healthy); only when
 the child fails with a backend-down signature does it fall back to a
-bounded probe/retry ladder (~7.5 min worst case) and one re-run. If the
-backend never comes up — or the child hangs past the watchdog — it prints
-a parseable skip record
+bounded probe/retry ladder and one re-run (``bench_common.py``). If the
+backend never comes up — or the child hangs past the watchdog (SIGUSR1
+flight-record dump, then SIGKILL) — it prints a parseable skip record
     {"metric": ..., "value": null, "unit": ..., "vs_baseline": null,
-     "skipped": true, "reason": ...}
-and exits 0 so the round still has a structured result. Genuine bench
+     "skipped": true, "failure_kind": "hang|backend-init|crash",
+     "reason": ...}
+and exits 0 so the round still has a structured result; a hang's reason
+carries the crash-bundle path and the stalled span name. Genuine bench
 bugs (non-backend failures) still exit non-zero with the child's stderr.
 """
 
 import json
 import os
-import subprocess
 import sys
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
+from bench_common import run_watchdogged  # noqa: E402
+
 METRIC = "gpt2_125m_bf16_train_tokens_per_sec_per_chip"
 UNIT = "tokens/s"
 
-# Substrings marking "the backend/tunnel is down", as opposed to a bug in
-# the bench itself. Matched against child stderr.
-_BACKEND_DOWN_MARKERS = (
-    "UNAVAILABLE",
-    "Unable to initialize backend",
-    "TPU backend setup",
-    "DEADLINE_EXCEEDED",
-    "connection dropped",
-    "Socket closed",
-    "failed to connect",
-)
-
-
-def _skip(reason: str) -> None:
-    print(json.dumps({
-        "metric": METRIC, "value": None, "unit": UNIT,
-        "vs_baseline": None, "skipped": True, "reason": reason[-500:],
-    }))
-    sys.exit(0)
-
-
-def _probe_backend(attempts: int = 5, probe_timeout: int = 75) -> str | None:
-    """Try to bring up the jax backend in a throwaway subprocess.
-
-    Returns None on success, else the last failure reason. Backend init on
-    the tunnel can HANG as well as raise, so every attempt gets its own
-    process + timeout. Worst case ~7.5 min: 5 x 75 s timeouts plus
-    8+16+24+32 s of backoff sleeps.
-    """
-    last = "unknown"
-    for i in range(attempts):
-        try:
-            r = subprocess.run(
-                [sys.executable, "-c",
-                 "import jax; jax.devices(); print(jax.default_backend())"],
-                timeout=probe_timeout, capture_output=True, text=True,
-                cwd=os.path.dirname(os.path.abspath(__file__)))
-            if r.returncode == 0:
-                return None
-            last = (r.stderr or r.stdout or "probe failed").strip()[-500:]
-        except subprocess.TimeoutExpired:
-            last = f"backend-init probe timed out after {probe_timeout}s"
-        if i < attempts - 1:
-            time.sleep(8 * (i + 1))
-    return last
-
-
-def _run_child(timeout_s: float):
-    """Run the BENCH_CHILD measurement in its own process GROUP so a
-    watchdog kill cannot orphan a hung grandchild holding the TPU.
-    Returns (returncode|None, stdout, stderr); None = timed out+killed."""
-    import signal
-
-    env = dict(os.environ, BENCH_CHILD="1")
-    proc = subprocess.Popen([sys.executable, os.path.abspath(__file__)],
-                            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
-                            text=True, env=env, start_new_session=True)
-    try:
-        out, err = proc.communicate(timeout=timeout_s)
-        sys.stderr.write(err or "")   # forward child diagnostics
-        return proc.returncode, out, err
-    except subprocess.TimeoutExpired:
-        try:
-            os.killpg(proc.pid, signal.SIGKILL)
-        except ProcessLookupError:
-            pass
-        # collect whatever the child managed to write before the kill —
-        # it shows WHERE it hung (backend init vs mid-bench)
-        out, err = proc.communicate()
-        return None, out or "", err or ""
-
-
-def _run_watchdogged() -> None:
-    """Parent mode: run the measurement child immediately; probe/retry only
-    after a backend-down failure (a healthy tunnel pays zero extra init).
-
-    The WHOLE parent is bounded by BENCH_TOTAL_BUDGET (default 1500 s) so
-    the structured skip record always lands before any outer runner's
-    timeout — run_bench_suite.py gives each entry 30 min."""
-    start = time.monotonic()
-    budget = float(os.environ.get("BENCH_TOTAL_BUDGET", 1500))
-
-    def remaining() -> float:
-        return budget - (time.monotonic() - start)
-
-    first_timeout = float(os.environ.get("BENCH_WATCHDOG_TIMEOUT",
-                                         budget * 0.6))
-    err = ""
-    for attempt in range(2):  # one mid-run tunnel drop gets one retry
-        timeout_s = (min(first_timeout, remaining()) if attempt == 0
-                     else max(remaining(), 60))
-        rc, out, errtxt = _run_child(timeout_s)
-        if rc is None:
-            tail = (errtxt or "").strip().splitlines()[-3:]
-            _skip(f"bench run exceeded {timeout_s:.0f}s watchdog "
-                  f"(tunnel hang suspected); child stderr tail: "
-                  f"{' | '.join(tail) if tail else '<empty>'}")
-        if rc == 0:
-            sys.stdout.write(out)
-            return
-        err = (errtxt or "")[-2000:]
-        if not any(m in err for m in _BACKEND_DOWN_MARKERS):
-            sys.stderr.write(errtxt or "")
-            sys.exit(rc)  # real bug: surface it
-        if attempt == 0:
-            # probe ladder capped at 3 attempts (~4.3 min worst case) to
-            # stay inside the budget
-            down = _probe_backend(attempts=3)
-            if down is not None:
-                _skip(f"TPU backend unavailable after bounded retries: {down}")
-            if remaining() < 120:
-                _skip("TPU backend recovered but the run budget is spent; "
-                      f"first failure: {err[-300:]}")
-    _skip(f"TPU backend dropped twice despite a healthy probe: {err[-400:]}")
-
 
 def peak_flops_per_chip() -> float:
-    """bf16 peak for the attached chip generation."""
+    """bf16 peak for the attached chip generation (the cost model's table)."""
     import jax
-    kind = jax.devices()[0].device_kind.lower()
-    table = {
-        "v5 lite": 197e12, "v5litepod": 197e12, "v5e": 197e12,
-        "v5p": 459e12, "v5": 459e12,
-        "v4": 275e12,
-        "v6 lite": 918e12, "v6e": 918e12,
-    }
-    for key, val in table.items():
-        if key in kind:
-            return val
-    return 197e12
+
+    from deepspeed_tpu.autotuning.cost_model import peak_flops_for
+
+    return peak_flops_for(jax.devices()[0].device_kind)
 
 
 def main() -> None:
@@ -258,4 +139,8 @@ if __name__ == "__main__":
     if os.environ.get("BENCH_CHILD") == "1":
         main()
     else:
-        _run_watchdogged()
+        run_watchdogged(
+            METRIC, UNIT, os.path.abspath(__file__),
+            crash_dir=os.path.join(
+                os.environ.get("BENCH_OBS_DIR", "bench_results/obs_train"),
+                "crash"))

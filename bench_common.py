@@ -1,0 +1,218 @@
+"""Shared bench-harness guard — watchdogged child, evidence-first kills,
+structured skip records.
+
+Both bench entrypoints (`bench.py` train, `bench_infer.py` TTFT/decode) run
+their measurement in a watchdogged child process so a tunnel hang cannot eat
+the round. The round-5 record showed what a bare SIGKILL costs: a skip
+annotated only "tunnel hang suspected", with zero evidence. This guard kills
+in two phases instead:
+
+1. **SIGUSR1** to the child's process group and a short grace wait
+   (``BENCH_SIGUSR1_GRACE``, default 20 s): the child's observability
+   session installs a SIGUSR1 handler that dumps its flight record — ring
+   of recent spans/metrics/compiles, per-thread Python stacks, open-span
+   stack, device memory (`deepspeed_tpu/observability/flightrecorder.py`);
+2. **SIGKILL** only after the grace window.
+
+The skip record then carries the crash-bundle path and the stalled span name
+in ``reason``, plus a structured ``failure_kind`` field:
+
+* ``"hang"``         — the watchdog expired (child killed);
+* ``"backend-init"`` — the TPU backend never came up / budget spent waiting;
+* ``"crash"``        — the backend dropped mid-run twice despite healthy
+  probes.
+
+Parent-side code deliberately imports neither jax nor deepspeed_tpu (backend
+init over the tunnel is exactly what hangs), so the bundle lookup re-reads
+MANIFEST.json with stdlib json.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import Dict, Optional, Tuple
+
+# Substrings marking "the backend/tunnel is down", as opposed to a bug in
+# the bench itself. Matched against child stderr.
+BACKEND_DOWN_MARKERS = (
+    "UNAVAILABLE",
+    "Unable to initialize backend",
+    "TPU backend setup",
+    "DEADLINE_EXCEEDED",
+    "connection dropped",
+    "Socket closed",
+    "failed to connect",
+)
+
+
+def skip(metric: str, unit: str, reason: str, failure_kind: str) -> None:
+    """Print the structured skip record and exit 0 (the driver still gets a
+    parseable result). ``failure_kind``: hang | backend-init | crash."""
+    print(json.dumps({
+        "metric": metric, "value": None, "unit": unit,
+        "vs_baseline": None, "skipped": True,
+        "failure_kind": failure_kind, "reason": reason[-700:],
+    }))
+    sys.exit(0)
+
+
+def probe_backend(attempts: int = 5, probe_timeout: int = 75,
+                  cwd: Optional[str] = None) -> Optional[str]:
+    """Try to bring up the jax backend in a throwaway subprocess.
+
+    Returns None on success, else the last failure reason. Backend init on
+    the tunnel can HANG as well as raise, so every attempt gets its own
+    process + timeout.
+    """
+    last = "unknown"
+    for i in range(attempts):
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax; jax.devices(); print(jax.default_backend())"],
+                timeout=probe_timeout, capture_output=True, text=True,
+                cwd=cwd)
+            if r.returncode == 0:
+                return None
+            last = (r.stderr or r.stdout or "probe failed").strip()[-500:]
+        except subprocess.TimeoutExpired:
+            last = f"backend-init probe timed out after {probe_timeout}s"
+        if i < attempts - 1:
+            time.sleep(8 * (i + 1))
+    return last
+
+
+def crash_bundle_info(crash_dir: Optional[str],
+                      newer_than: Optional[float] = None
+                      ) -> Optional[Dict[str, str]]:
+    """Newest flight-record bundle under ``crash_dir`` → its path and the
+    stalled span from MANIFEST.json (stdlib-only duplicate of
+    ``flightrecorder.find_latest_bundle`` so the parent stays jax-free).
+    ``newer_than`` (wall seconds) rejects bundles left over from a previous
+    round — a child that wedged inside native code dumps nothing, and
+    attributing an old bundle to THIS hang would be fabricated evidence."""
+    if not crash_dir:
+        return None
+    try:
+        bundles = [os.path.join(crash_dir, d) for d in os.listdir(crash_dir)
+                   if os.path.isfile(os.path.join(crash_dir, d,
+                                                  "MANIFEST.json"))]
+        if newer_than is not None:
+            bundles = [b for b in bundles
+                       if os.path.getmtime(b) >= newer_than]
+        if not bundles:
+            return None
+        bundle = max(bundles, key=os.path.getmtime)
+        with open(os.path.join(bundle, "MANIFEST.json")) as fh:
+            manifest = json.load(fh)
+        return {"bundle": bundle,
+                "stalled_span": manifest.get("stalled_span") or "<none open>"}
+    except OSError:
+        return None
+
+
+def _signal_group(pid: int, sig: int) -> None:
+    try:
+        os.killpg(pid, sig)
+    except (ProcessLookupError, PermissionError):
+        pass
+
+
+def run_child(script: str, timeout_s: float,
+              grace_s: float) -> Tuple[Optional[int], str, str, bool]:
+    """Run ``script`` with BENCH_CHILD=1 in its own process GROUP so a
+    watchdog kill cannot orphan a hung grandchild holding the TPU.
+
+    Returns (returncode, stdout, stderr, hung). On watchdog expiry the child
+    gets SIGUSR1 (flight-record dump) + ``grace_s`` to write it, then
+    SIGKILL; ``hung`` is True for that whole path even if the child died of
+    the SIGUSR1 itself (no handler ≈ no observability session — still a
+    hang, just an evidence-free one)."""
+    env = dict(os.environ, BENCH_CHILD="1")
+    proc = subprocess.Popen([sys.executable, script],
+                            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                            text=True, env=env, start_new_session=True)
+    try:
+        out, err = proc.communicate(timeout=timeout_s)
+        sys.stderr.write(err or "")   # forward child diagnostics
+        return proc.returncode, out, err, False
+    except subprocess.TimeoutExpired:
+        _signal_group(proc.pid, signal.SIGUSR1)
+        try:
+            out, err = proc.communicate(timeout=grace_s)
+        except subprocess.TimeoutExpired:
+            _signal_group(proc.pid, signal.SIGKILL)
+            # collect whatever the child managed to write before the kill —
+            # it shows WHERE it hung (backend init vs mid-bench)
+            out, err = proc.communicate()
+        return None, out or "", err or "", True
+
+
+def run_watchdogged(metric: str, unit: str, script: str,
+                    crash_dir: Optional[str] = None) -> None:
+    """Parent mode: run the measurement child immediately; probe/retry only
+    after a backend-down failure (a healthy tunnel pays zero extra init).
+
+    The WHOLE parent is bounded by BENCH_TOTAL_BUDGET (default 1500 s) so
+    the structured skip record always lands before any outer runner's
+    timeout — run_bench_suite.py gives each entry 30 min."""
+    start = time.monotonic()
+    start_wall = time.time()   # bundle mtimes are wall-clock
+    budget = float(os.environ.get("BENCH_TOTAL_BUDGET", 1500))
+    grace = float(os.environ.get("BENCH_SIGUSR1_GRACE", 20))
+
+    def remaining() -> float:
+        return budget - (time.monotonic() - start)
+
+    first_timeout = float(os.environ.get("BENCH_WATCHDOG_TIMEOUT",
+                                         budget * 0.6))
+    err = ""
+    for attempt in range(2):  # one mid-run tunnel drop gets one retry
+        timeout_s = (min(first_timeout, remaining()) if attempt == 0
+                     else max(remaining(), 60))
+        rc, out, errtxt, hung = run_child(script, timeout_s, grace)
+        if hung:
+            tail = (errtxt or "").strip().splitlines()[-3:]
+            reason = (f"bench run exceeded {timeout_s:.0f}s watchdog; "
+                      f"child stderr tail: "
+                      f"{' | '.join(tail) if tail else '<empty>'}")
+            info = crash_bundle_info(crash_dir, newer_than=start_wall)
+            if info:
+                reason += (f"; flight record: {info['bundle']} "
+                           f"(stalled span: {info['stalled_span']})")
+            else:
+                reason += "; no flight record found (BENCH_OBS=0, or the " \
+                          "child hung before its observability session)"
+            skip(metric, unit, reason, "hang")
+        if rc == 0:
+            sys.stdout.write(out)
+            return
+        err = (errtxt or "")[-2000:]
+        if not any(m in err for m in BACKEND_DOWN_MARKERS):
+            # real bug: surface it — INCLUDING the child's stdout, which may
+            # hold a structured partial record (bench_infer's OOM JSON with
+            # its single_chip_caveat prints before the re-raise)
+            sys.stdout.write(out or "")
+            sys.stderr.write(errtxt or "")
+            sys.exit(rc)
+        if attempt == 0:
+            # probe ladder capped at 3 attempts (~4.3 min worst case) to
+            # stay inside the budget
+            down = probe_backend(attempts=3,
+                                 cwd=os.path.dirname(os.path.abspath(script)))
+            if down is not None:
+                skip(metric, unit,
+                     f"TPU backend unavailable after bounded retries: {down}",
+                     "backend-init")
+            if remaining() < 120:
+                skip(metric, unit,
+                     "TPU backend recovered but the run budget is spent; "
+                     f"first failure: {err[-300:]}", "backend-init")
+    skip(metric, unit,
+         f"TPU backend dropped twice despite a healthy probe: {err[-400:]}",
+         "crash")

@@ -14,6 +14,12 @@ is saturated (the analog of the reference's kernel-injected decode claim).
 Model: largest preset that fits the attached chip (env BENCH_INFER_MODEL to
 override; weights are random — zero-egress environment — which does not
 change the memory-bound timing).
+
+Like bench.py, the measurement runs in a watchdogged child
+(``bench_common.py``): a hang gets SIGUSR1 (flight-record dump) then
+SIGKILL, and the skip record carries ``failure_kind`` + the bundle path.
+The parent imports neither jax nor deepspeed_tpu — backend init over the
+tunnel is exactly what hangs.
 """
 
 import json
@@ -23,9 +29,7 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-import jax
-import jax.numpy as jnp
-import numpy as np
+from bench_common import run_watchdogged  # noqa: E402
 
 HBM_BW = {  # bytes/s
     "v5 lite": 819e9, "v5e": 819e9, "v5litepod": 819e9,
@@ -34,6 +38,8 @@ HBM_BW = {  # bytes/s
 
 
 def hbm_bandwidth() -> float:
+    import jax
+
     kind = jax.devices()[0].device_kind.lower()
     for key, val in HBM_BW.items():
         if key in kind:
@@ -42,6 +48,10 @@ def hbm_bandwidth() -> float:
 
 
 def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
     from deepspeed_tpu.inference import init_inference
 
     # TTFT / decode spans and kv-cache metrics land in a metrics JSONL next
@@ -136,4 +146,13 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    main()
+    if os.environ.get("BENCH_CHILD") == "1":
+        main()
+    else:
+        model = os.environ.get("BENCH_INFER_MODEL", "llama-7b")
+        dtype = os.environ.get("BENCH_INFER_DTYPE", "bf16")
+        run_watchdogged(
+            f"{model}_{dtype}_p50_ttft_ms", "ms", os.path.abspath(__file__),
+            crash_dir=os.path.join(
+                os.environ.get("BENCH_OBS_DIR", "bench_results/obs_infer"),
+                "crash"))

@@ -25,6 +25,9 @@ HBM_BW = {  # bytes/s (bench_infer.py table)
 PEAK_FLOPS = {
     "v5 lite": 197e12, "v5e": 197e12, "v5litepod": 197e12,
     "v5p": 459e12, "v4": 275e12, "v6e": 918e12, "v6 lite": 918e12,
+    # bare "v5" LAST: substring fallback for device_kinds with no e/p
+    # suffix — must lose to every more specific v5* key above
+    "v5": 459e12,
 }
 # Single scalar per-link ICI bandwidth class estimate (v5e 1D ring class).
 # SCOPE (VERDICT r3 weak #6): this is a RANKING term for single-host grids
@@ -43,6 +46,14 @@ def _platform(kind: Optional[str], table: Dict[str, float],
             if key in kind.lower():
                 return val
     return default
+
+
+def peak_flops_for(device_kind: Optional[str]) -> float:
+    """bf16 peak FLOP/s for a ``device.device_kind`` string (v5e-class
+    default for unknown kinds — CPU smoke runs get a real-chip denominator
+    so MFU numbers stay comparable, just tiny). The shared lookup behind
+    bench.py's MFU math and the observability goodput/mfu gauge."""
+    return _platform(device_kind, PEAK_FLOPS, 197e12)
 
 
 @dataclasses.dataclass

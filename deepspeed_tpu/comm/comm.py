@@ -177,6 +177,9 @@ def _record_comm_metrics(op: str, record_name: str, nbytes: int,
     obs = get_session()
     if not obs.enabled:
         return
+    # collective census doubles as a liveness signal for the hang watchdog
+    # (a retrace mid-run proves the host is still driving the device)
+    obs.heartbeat(f"comm/{op}")
     reg = obs.registry
     if latency_s is None:
         reg.counter("comm/ops", help="collective occurrences (census: once "

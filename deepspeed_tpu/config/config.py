@@ -281,6 +281,26 @@ class ObservabilityConfig(ConfigModel):
     steady_state_step: int = 10        # recompiles past this step warn
     memory_poll_steps: int = 10        # device-memory gauge cadence
     profile_dir: str = "/tmp/dstpu_trace"  # engine.start_profile() trace dir
+    # flight recorder: bounded ring of recent events + crash-bundle dump
+    # (observability/flightrecorder.py); active whenever the session is
+    # enabled — recording is a deque append, dump only on crash/signal/hang
+    flight_recorder: bool = True
+    flight_ring_size: int = 4096       # events kept in the ring
+    flight_dump_dir: str = ""          # "" => <output_dir>/crash
+    flight_sigusr1: bool = True        # SIGUSR1 => dump (main thread only)
+    # hang watchdog thread (observability/hangdetect.py): opt-in — it spawns
+    # a thread and can abort the process, so an enabled session does not get
+    # one implicitly
+    hang_watchdog: bool = False
+    hang_timeout_factor: float = 8.0   # deadline = max(k*median step, floor)
+    hang_timeout_floor_s: float = 120.0
+    hang_poll_interval_s: float = 5.0  # watchdog thread check cadence
+    hang_abort: bool = False           # fire => os._exit(hang_exit_code)
+    hang_exit_code: int = 113          # distinct from python/jax exit codes
+    # goodput accounting (observability/goodput.py): step-time buckets +
+    # goodput_fraction / mfu / tokens_per_sec gauges; span-derived, so the
+    # per-step cost is a few dict updates
+    goodput: bool = True
 
     def validate(self) -> None:
         if self.max_spans < 1:
@@ -289,6 +309,16 @@ class ObservabilityConfig(ConfigModel):
             raise ConfigError("observability.memory_poll_steps must be >= 1")
         if self.steady_state_step < 0:
             raise ConfigError("observability.steady_state_step must be >= 0")
+        if self.flight_ring_size < 1:
+            raise ConfigError("observability.flight_ring_size must be >= 1")
+        if self.hang_timeout_factor <= 0:
+            raise ConfigError("observability.hang_timeout_factor must be > 0")
+        if self.hang_timeout_floor_s <= 0:
+            raise ConfigError("observability.hang_timeout_floor_s must be > 0")
+        if self.hang_poll_interval_s <= 0:
+            raise ConfigError("observability.hang_poll_interval_s must be > 0")
+        if not 1 <= self.hang_exit_code <= 255:
+            raise ConfigError("observability.hang_exit_code must be in 1..255")
 
 
 @dataclass

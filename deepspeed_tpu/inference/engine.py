@@ -543,6 +543,25 @@ class InferenceEngine:
                  temperature: float = 0.0, top_k: int = 0, top_p: float = 1.0,
                  eos_token_id: Optional[int] = None, seed: int = 0,
                  return_ttft: bool = False):
+        """Unhandled-exception guard around :meth:`_generate`: dump the
+        flight record (ring + stacks + open spans) before the exception
+        unwinds — a no-op without an enabled recorder. See ``_generate``
+        for the generation semantics."""
+        try:
+            return self._generate(
+                input_ids, attention_mask=attention_mask,
+                max_new_tokens=max_new_tokens, temperature=temperature,
+                top_k=top_k, top_p=top_p, eos_token_id=eos_token_id,
+                seed=seed, return_ttft=return_ttft)
+        except Exception as e:
+            get_session().crash_dump("generate-exception", exc=e,
+                                     call=self._generate_calls)
+            raise
+
+    def _generate(self, input_ids, attention_mask=None, max_new_tokens: int = 32,
+                  temperature: float = 0.0, top_k: int = 0, top_p: float = 1.0,
+                  eos_token_id: Optional[int] = None, seed: int = 0,
+                  return_ttft: bool = False):
         """Prompt ids (B, S) → generated ids (B, max_new_tokens).
 
         Ragged prompts: pass ``attention_mask`` (B, S); prompts are treated

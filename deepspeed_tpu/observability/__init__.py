@@ -16,7 +16,17 @@ two TPU-specific watchers:
   listeners: compile counts + seconds attributed to the active span, warning
   when a steady-state step recompiles;
 * :mod:`.memory`  — device HBM gauges via ``device.memory_stats()`` (no-op
-  guarded on stat-less backends) + host RSS.
+  guarded on stat-less backends) + host RSS;
+* :mod:`.flightrecorder` — always-cheap bounded ring of recent events with a
+  crash-bundle ``dump()`` (ring + per-thread stacks + open spans + device
+  memory + tpuaudit fingerprints) on unhandled exception, SIGUSR1, or
+  hang-watchdog fire;
+* :mod:`.hangdetect` — heartbeat watchdog: span boundaries heartbeat, and a
+  silent run past ``max(k × median step, floor)`` dumps a flight record
+  naming the stalled span (optionally aborting with a distinct exit code);
+* :mod:`.goodput` — wall-time buckets (compute/recompile/checkpoint/
+  input-wait/stall) + ``goodput_fraction`` / ``mfu`` / ``tokens_per_sec``
+  gauges.
 
 Everything is **off by default** (``ObservabilityConfig.enabled``); a
 disabled session records nothing and writes no files, so tier-1 cost is zero.
@@ -28,6 +38,11 @@ from __future__ import annotations
 import os
 from typing import Any, Optional
 
+from .flightrecorder import (FlightRecorder, find_latest_bundle,
+                             install_sigusr1, uninstall_sigusr1)
+from .goodput import GoodputAccountant
+from .goodput import STEP_SPANS as _STEP_SPANS
+from .hangdetect import HangWatchdog
 from .memory import record_memory
 from .metrics import Counter, Gauge, Histogram, MetricsRegistry, get_registry
 from .recompile import RecompileWatchdog, get_watchdog
@@ -41,6 +56,8 @@ __all__ = [
     "MetricsRegistry", "Counter", "Gauge", "Histogram", "get_registry",
     "RecompileWatchdog", "install_watchdog", "uninstall_watchdog",
     "get_watchdog", "record_memory",
+    "FlightRecorder", "find_latest_bundle", "install_sigusr1",
+    "uninstall_sigusr1", "HangWatchdog", "GoodputAccountant",
 ]
 
 
@@ -71,6 +88,35 @@ class Observability:
             self.watchdog = install_watchdog(
                 registry=self.registry, tracer=self.tracer,
                 steady_state_step=config.steady_state_step)
+        # flight recorder / hang watchdog / goodput accountant ride the span
+        # stream through ONE dispatcher on the tracer — a disabled session
+        # (or all three gates off) leaves tracer.on_event None, so the
+        # default path costs a single attribute check per span boundary
+        self.recorder: Optional[FlightRecorder] = None
+        self.hang: Optional[HangWatchdog] = None
+        self.goodput: Optional[GoodputAccountant] = None
+        if self.enabled and config.flight_recorder:
+            self.recorder = FlightRecorder(
+                capacity=config.flight_ring_size,
+                dump_dir=(config.flight_dump_dir
+                          or os.path.join(self.output_dir, "crash")))
+            self.recorder.attach_logging()
+        if self.enabled and config.hang_watchdog:
+            self.hang = HangWatchdog(
+                recorder=self.recorder, registry=self.registry,
+                timeout_factor=config.hang_timeout_factor,
+                timeout_floor_s=config.hang_timeout_floor_s,
+                poll_interval_s=config.hang_poll_interval_s,
+                abort=config.hang_abort, exit_code=config.hang_exit_code,
+                on_fire=self._on_hang_fire)
+            self.hang.start()
+        if self.enabled and config.goodput:
+            self.goodput = GoodputAccountant(self.registry)
+        if self.recorder is not None or self.hang is not None \
+                or self.goodput is not None:
+            self.tracer.on_event = self._span_event
+        if self.watchdog is not None:
+            self.watchdog.on_compile = self._on_compile
         self._mem_has_device_stats = None
         self._closed = False
         if self.enabled:
@@ -81,14 +127,80 @@ class Observability:
 
             atexit.register(self.close)
 
+    def _activate_process_hooks(self) -> None:
+        """Grab the PROCESS-global channels — the singleton registry's
+        publish hook and the SIGUSR1 recorder pointer. Only the CURRENT
+        session may own these: a side session built with
+        ``make_current=False`` must not steal the live session's crash
+        evidence, so this runs from ``configure_observability``, not from
+        construction."""
+        if self.recorder is not None:
+            self.registry.on_publish = self._on_publish
+            if self.config.flight_sigusr1:
+                install_sigusr1(self.recorder)
+
+    # -- event dispatch (span stream -> recorder/hang/goodput) ------------
+    def _span_event(self, phase: str, span: Span) -> None:
+        if self.recorder is not None:
+            self.recorder.record_span(phase, span)
+        if self.hang is not None:
+            self.hang.heartbeat(span.name)
+        if self.goodput is not None or self.hang is not None:
+            if phase == "end":
+                dur = span.duration_s
+                t = span.end_ns / 1e9
+                if self.hang is not None and span.name in _STEP_SPANS:
+                    self.hang.note_step_time(dur)
+            else:
+                dur = 0.0
+                t = span.start_ns / 1e9
+            if self.goodput is not None:
+                self.goodput.on_span(phase, span.name, t, dur_s=dur)
+
+    def _on_publish(self, step: int, events) -> None:
+        if self.recorder is not None:
+            self.recorder.record("metric_publish", step=step,
+                                 events=len(events))
+
+    def _on_compile(self, secs: float, where: str, steady: bool) -> None:
+        if self.recorder is not None:
+            self.recorder.record("compile", seconds=round(secs, 4),
+                                 where=where, steady=steady)
+        if self.goodput is not None:
+            self.goodput.on_compile(secs, where=where)
+
+    def _on_hang_fire(self, stalled_span: str, waited: float,
+                      deadline: float, bundle: str) -> None:
+        if self.goodput is not None:
+            self.goodput.on_stall(waited, where=stalled_span)
+            self.goodput.publish()
+
     # -- thin delegates (the API integration sites use) -------------------
     def span(self, name: str, category: str = "span", sync: bool = False,
              **attrs: Any) -> Span:
         return self.tracer.span(name, category=category, sync=sync, **attrs)
 
+    def heartbeat(self, name: str) -> None:
+        """Non-span liveness signal (comm census, pipeline census) for the
+        hang watchdog."""
+        if self.hang is not None:
+            self.hang.heartbeat(name)
+
+    def crash_dump(self, reason: str, exc: Optional[BaseException] = None,
+                   **extra: Any) -> Optional[str]:
+        """Dump a flight-record bundle; never raises, returns the bundle dir
+        (None when no recorder is active). The engines call this from their
+        unhandled-exception paths."""
+        if self.recorder is None:
+            return None
+        return self.recorder.dump(reason=reason, exc=exc,
+                                  extra=extra or None) or None
+
     def note_step(self, global_step: int) -> None:
         if self.watchdog is not None:
             self.watchdog.note_step(global_step)
+        if self.goodput is not None:
+            self.goodput.publish()
 
     def maybe_record_memory(self, step: int) -> None:
         """Poll memory gauges at ``memory_poll_steps`` cadence; the first
@@ -136,8 +248,13 @@ class Observability:
         if self._closed:
             return
         self._closed = True
+        if self.hang is not None:
+            self.hang.disarm()
+            self.hang.stop()
         if self.enabled and export:
             try:
+                if self.goodput is not None:
+                    self.goodput.publish()   # final bucket snapshot
                 self.dump_metrics()
                 self.export_chrome_trace()
             except Exception:  # telemetry must never take the job down
@@ -145,7 +262,19 @@ class Observability:
 
                 logger.warning("observability export failed on close",
                                exc_info=True)
+        self.tracer.on_event = None
         self.tracer.close()
+        if self.recorder is not None:
+            self.recorder.detach_logging()
+            # the registry is a process singleton: only clear the publish
+            # hook if it is still OURS — a replacement session installed its
+            # own before closing us (configure_observability ordering)
+            if self.registry.on_publish == self._on_publish:
+                self.registry.on_publish = None
+            from .flightrecorder import _ACTIVE_RECORDER
+
+            if _ACTIVE_RECORDER is self.recorder:
+                uninstall_sigusr1()
         if self.watchdog is not None and get_watchdog() is self.watchdog:
             uninstall_watchdog()
 
@@ -180,6 +309,7 @@ def configure_observability(config: Optional[Any] = None,
             # live run's exports with stale data, and its JSONL handle
             # would leak until exit
             _SESSION.close(export=False)
+        session._activate_process_hooks()
         _SESSION = session
     return session
 

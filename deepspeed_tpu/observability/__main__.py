@@ -1,16 +1,16 @@
-"""CLI entry: ``python -m deepspeed_tpu.observability report <files...>``."""
+"""CLI entry: ``python -m deepspeed_tpu.observability report <files...>``
+or ``... report --crash-dump <bundle-dir...>``."""
 
 import sys
 
-from .report import main
+from .report import USAGE, main
 
 if __name__ == "__main__":
     args = sys.argv[1:]
     if args and args[0] == "report":
         args = args[1:]
         if not args:
-            print("usage: python -m deepspeed_tpu.observability report "
-                  "<trace.jsonl|metrics.jsonl> [...]", file=sys.stderr)
+            print(USAGE, file=sys.stderr)
             sys.exit(2)
     elif args and not args[0].startswith("-"):
         print(f"unknown subcommand '{args[0]}' (only 'report')",

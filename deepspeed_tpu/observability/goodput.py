@@ -1,0 +1,237 @@
+"""Goodput / MFU accounting — where the wall-clock seconds actually went.
+
+PaLM-style goodput accounting answers the question a tokens/sec scalar
+cannot: *of the wall time this run burned, how much was the model actually
+training?* This module splits wall time into buckets from the span stream
+plus the recompile watchdog and hang watchdog:
+
+* ``compute``    — device-work spans (``train_batch/dispatch``, staged
+  ``fwd``/``bwd``/``step``, ``eval``, inference prefill/decode), minus any
+  compile seconds that ran inside them;
+* ``recompile``  — XLA compile seconds (from the recompile watchdog) plus
+  pipeline program builds — the silent budget-eater recompile storms;
+* ``checkpoint`` — ``checkpoint/*`` spans;
+* ``input_wait`` — host-to-device batch transfer (``train_batch/h2d``) plus
+  the gaps *between* step spans (the data loader / host preprocessing time);
+* ``stall``      — seconds attributed by the hang watchdog when it fires;
+* ``other``      — the remainder (engine python, logging, unattributed).
+
+Derived gauges, published through the MetricsRegistry at step cadence:
+
+* ``goodput/goodput_fraction`` = compute / wall;
+* ``goodput/mfu``             = flops_per_step × steps / (wall × peak) with
+  peak from ``autotuning/cost_model.PEAK_FLOPS`` for the attached chip and
+  flops from the engine's flops profile (XLA/analytic — see
+  ``TrainEngine._wire_goodput``);
+* ``goodput/tokens_per_sec``  and per-bucket ``goodput/seconds``.
+
+Everything is span-derived: the accountant never reads a clock around
+dispatched work (wall time comes from the span records' own monotonic
+timestamps), so there is nothing here for the ``wallclock-timing-without-
+sync`` lint rule to flag, and the per-event cost is a few float adds.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, Optional
+
+BUCKETS = ("compute", "recompile", "checkpoint", "input_wait", "stall",
+           "other")
+
+# span name -> bucket classification (step spans are the cadence markers and
+# are NOT buckets themselves: their children + gaps are)
+STEP_SPANS = frozenset({"train_batch"})
+COMPUTE_SPANS = frozenset({"train_batch/dispatch", "fwd", "bwd", "step",
+                           "eval", "inference/prefill", "inference/decode"})
+INPUT_SPANS = frozenset({"train_batch/h2d"})
+CHECKPOINT_PREFIX = "checkpoint/"
+BUILD_SPANS = frozenset({"pipeline/build"})   # program construction: badput,
+#   recompile-shaped (it exists to make a new executable)
+
+
+class GoodputAccountant:
+    """Step-time bucket accumulator + derived-gauge publisher. One per
+    enabled observability session (``ObservabilityConfig.goodput``)."""
+
+    def __init__(self, registry: Optional[Any] = None,
+                 clock: Callable[[], float] = time.perf_counter):
+        if registry is None:
+            from .metrics import get_registry
+
+            registry = get_registry()
+        self.registry = registry
+        # same basis as the span records' perf_counter_ns timestamps, so
+        # compile events (which carry no span timestamp) extend the same
+        # wall-clock window; injectable for deterministic tests
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._buckets: Dict[str, float] = {b: 0.0 for b in BUCKETS
+                                           if b != "other"}
+        self._t0: Optional[float] = None
+        self._last_t: float = 0.0
+        self._last_step_end: Optional[float] = None
+        # badput seconds (compile, stall) that occurred INSIDE a compute
+        # span: deducted from that span's eventual duration so the same
+        # wall seconds are not also counted as compute
+        self._compute_unattributed = 0.0
+        # seconds already bucketed while OUTSIDE a step span (eval,
+        # checkpoint, between-step compiles): deducted from the next
+        # inter-step gap so they are not double-counted as input_wait
+        self._in_step = False
+        self._gap_attributed = 0.0
+        self.steps = 0
+        # workload shape (set once by the engine; None => mfu/tokens gauges
+        # are skipped, buckets still publish)
+        self.tokens_per_step: Optional[float] = None
+        self.flops_per_step: Optional[float] = None
+        self.peak_flops: Optional[float] = None
+        self.flops_source = "unset"
+
+    # -- workload ---------------------------------------------------------
+    def set_workload(self, tokens_per_step: Optional[float] = None,
+                     flops_per_step: Optional[float] = None,
+                     peak_flops: Optional[float] = None,
+                     source: str = "analytic") -> None:
+        """``tokens_per_step``: global batch tokens; ``flops_per_step``:
+        fwd+bwd FLOPs *per chip* per step; ``peak_flops``: the chip's peak
+        (``cost_model.peak_flops_for``). Idempotent; the engine calls it at
+        construction."""
+        with self._lock:
+            if tokens_per_step is not None:
+                self.tokens_per_step = float(tokens_per_step)
+            if flops_per_step is not None:
+                self.flops_per_step = float(flops_per_step)
+            if peak_flops is not None:
+                self.peak_flops = float(peak_flops)
+            self.flops_source = source
+
+    # -- event feed (wired by the Observability session) ------------------
+    def on_span(self, phase: str, name: str, t: float,
+                dur_s: float = 0.0) -> None:
+        """One span boundary. ``t`` is the span's own monotonic timestamp
+        (seconds); ``dur_s`` is set on ``phase == "end"``."""
+        with self._lock:
+            if self._t0 is None:
+                self._t0 = t - (dur_s if phase == "end" else 0.0)
+            self._last_t = max(self._last_t, t)
+            if phase == "begin":
+                if name in STEP_SPANS:
+                    if self._last_step_end is not None:
+                        # only the UNATTRIBUTED part of the gap is input
+                        # wait — eval/checkpoint/compile seconds inside it
+                        # already landed in their own buckets
+                        gap = (t - self._last_step_end
+                               - self._gap_attributed)
+                        if gap > 0:
+                            self._buckets["input_wait"] += gap
+                    self._gap_attributed = 0.0
+                    self._in_step = True
+                return
+            # phase == "end"
+            if name in STEP_SPANS:
+                self.steps += 1
+                self._last_step_end = t
+                self._in_step = False
+                return
+            if name in COMPUTE_SPANS:
+                take = min(dur_s, self._compute_unattributed)
+                self._compute_unattributed -= take
+                dur_s = max(dur_s - take, 0.0)
+                self._buckets["compute"] += dur_s
+            elif name in INPUT_SPANS:
+                self._buckets["input_wait"] += dur_s
+            elif name.startswith(CHECKPOINT_PREFIX):
+                self._buckets["checkpoint"] += dur_s
+            elif name in BUILD_SPANS:
+                self._buckets["recompile"] += dur_s
+            else:
+                return
+            if not self._in_step:
+                self._gap_attributed += dur_s
+
+    def on_compile(self, secs: float, where: Optional[str] = None) -> None:
+        """Compile seconds from the recompile watchdog. ``where`` is the
+        span open when the compile ran: when that is a compute span, the
+        seconds are also remembered as 'unattributed' so the enclosing
+        span's duration is not double-counted as compute. Compiles outside
+        any step (engine build, warmup) extend the accounted wall window —
+        init compile time IS badput in a goodput report."""
+        now = self._clock()
+        with self._lock:
+            self._buckets["recompile"] += secs
+            if where in COMPUTE_SPANS:
+                self._compute_unattributed += secs
+            if not self._in_step:
+                # a between-step compile (eval build, warmup) must not be
+                # re-counted as input_wait by the next gap computation
+                self._gap_attributed += secs
+            if self._t0 is None:
+                self._t0 = now - secs   # the compile started ~secs earlier
+            self._last_t = max(self._last_t, now)
+
+    def on_stall(self, secs: float, where: Optional[str] = None) -> None:
+        """Stall seconds attributed by the hang watchdog on fire. ``where``
+        is the stalled span: when that is a compute span and the run later
+        RESUMES, the blocked span's eventual duration must not re-count the
+        silence as compute (same dedup as compile seconds); a stall between
+        steps must not re-count as the next inter-step input_wait gap. The
+        silent period also extends the accounted wall window — no span event
+        did."""
+        now = self._clock()
+        with self._lock:
+            self._buckets["stall"] += secs
+            if where in COMPUTE_SPANS:
+                self._compute_unattributed += secs
+            elif not self._in_step:
+                self._gap_attributed += secs
+            if self._t0 is None:
+                self._t0 = now - secs
+            self._last_t = max(self._last_t, now)
+
+    # -- derived ----------------------------------------------------------
+    def totals(self) -> Dict[str, Any]:
+        with self._lock:
+            buckets = dict(self._buckets)
+            t0, last = self._t0, self._last_t
+            steps = self.steps
+        wall = max((last - t0) if t0 is not None else 0.0, 0.0)
+        known = sum(buckets.values())
+        buckets["other"] = max(wall - known, 0.0)
+        out: Dict[str, Any] = {"wall_s": wall, "steps": steps,
+                               "buckets": buckets}
+        out["goodput_fraction"] = (buckets["compute"] / wall) if wall > 0 \
+            else 0.0
+        if self.flops_per_step and self.peak_flops and wall > 0:
+            out["mfu"] = self.flops_per_step * steps / (wall
+                                                        * self.peak_flops)
+        if self.tokens_per_step and wall > 0:
+            out["tokens_per_sec"] = self.tokens_per_step * steps / wall
+        return out
+
+    def publish(self) -> Dict[str, Any]:
+        """Set the derived gauges (a handful of dict writes — safe at step
+        cadence; exporter fan-out stays on the engine's steps_per_print
+        schedule)."""
+        tot = self.totals()
+        reg = self.registry
+        g = reg.gauge("goodput/seconds",
+                      help="wall seconds by goodput bucket")
+        for bucket, secs in tot["buckets"].items():
+            g.set(secs, bucket=bucket)
+        reg.gauge("goodput/wall_seconds",
+                  help="total accounted wall seconds").set(tot["wall_s"])
+        reg.gauge("goodput/steps", help="completed steps").set(tot["steps"])
+        reg.gauge("goodput/goodput_fraction",
+                  help="compute seconds / wall seconds").set(
+                      tot["goodput_fraction"])
+        if "mfu" in tot:
+            reg.gauge("goodput/mfu",
+                      help="achieved / peak FLOPs "
+                      f"(flops source: {self.flops_source})").set(tot["mfu"])
+        if "tokens_per_sec" in tot:
+            reg.gauge("goodput/tokens_per_sec",
+                      help="global batch tokens per wall second").set(
+                          tot["tokens_per_sec"])
+        return tot

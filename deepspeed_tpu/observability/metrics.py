@@ -164,6 +164,9 @@ class MetricsRegistry:
         self._metrics: Dict[str, _Metric] = {}
         self._exporters: List[Any] = []
         self._lock = threading.Lock()
+        # optional (step, events) callback — the flight recorder notes each
+        # publish in its ring; None (default) costs one attribute check
+        self.on_publish: Optional[Any] = None
 
     def _get(self, name: str, cls, help: str) -> _Metric:
         with self._lock:
@@ -215,6 +218,8 @@ class MetricsRegistry:
             exporters = list(self._exporters)
         for ex in exporters:
             ex.write_events(events)
+        if self.on_publish is not None:
+            self.on_publish(step, events)
         return events
 
     def snapshot(self) -> List[Dict[str, Any]]:

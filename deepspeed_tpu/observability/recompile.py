@@ -60,6 +60,10 @@ class RecompileWatchdog:
         self.compile_seconds = 0.0
         self.steady_state_compiles = 0
         self.per_site: Dict[str, Dict[str, float]] = {}
+        # optional (secs, where, steady) sink — the observability session
+        # feeds compile seconds into the goodput badput buckets and the
+        # flight-recorder ring through this; None costs one attribute check
+        self.on_compile: Optional[Any] = None
 
     # -- engine hook ------------------------------------------------------
     def note_step(self, global_step: int) -> None:
@@ -98,10 +102,20 @@ class RecompileWatchdog:
         self.registry.histogram(
             "xla/compile_seconds",
             help="XLA backend compile wall seconds").observe(secs, where=where)
+        if self.on_compile is not None:
+            self.on_compile(secs, where, steady)
         if steady:
             self.registry.counter(
                 "xla/steady_state_recompiles",
                 help="compiles after the steady-state step threshold").inc(
+                    where=where)
+            # goodput-facing alias: the badput report groups recompile
+            # counters under the recompile/ namespace (report CLI + dashboards
+            # key on it), while xla/steady_state_recompiles keeps the
+            # PR-2-era series name for existing consumers
+            self.registry.counter(
+                "recompile/steady_state",
+                help="steady-state recompiles (goodput badput source)").inc(
                     where=where)
             logger.warning(
                 f"steady-state recompilation at step {step}: {secs:.2f}s "

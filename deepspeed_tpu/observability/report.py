@@ -1,11 +1,20 @@
 """``python -m deepspeed_tpu.observability report <file.jsonl> [...]``
+and ``... report --crash-dump <bundle-dir> [...]``.
 
 Summarizes the JSONL the tracer and registry write: per-span aggregates
 (count / total / mean / max wall ms, tree-indented by median depth), metric
-tables (counters, gauges, histogram stats) and the recompile section. Accepts
-any mix of trace and metrics files — records are discriminated by ``type``.
-Stdlib only, so it runs anywhere the files land (including CI containers with
-no jax installed).
+tables (counters, gauges, histogram stats), the goodput buckets and the
+recompile section. Accepts any mix of trace and metrics files — records are
+discriminated by ``type``.
+
+``--crash-dump`` summarizes a flight-recorder bundle instead (the directory
+``flightrecorder.FlightRecorder.dump`` writes): the reason, the stalled
+span, per-thread open-span stacks, the last steps and tail events from the
+ring, and a per-thread stack digest — the one-screen version of what the
+run was doing when it died.
+
+Stdlib only, so it runs anywhere the files land (including CI containers
+with no jax installed).
 """
 
 from __future__ import annotations
@@ -131,20 +140,158 @@ def summarize_recompiles(records: List[Dict[str, Any]]) -> str:
     return "\n".join(lines)
 
 
+def summarize_goodput(records: List[Dict[str, Any]]) -> str:
+    gauges = [r for r in records if r.get("type") == "gauge"
+              and str(r.get("name", "")).startswith("goodput/")]
+    if not gauges:
+        return ""
+    latest: Dict[Tuple[str, str], float] = {}
+    for r in gauges:
+        latest[(r["name"], _label_str(r.get("labels", {})))] = r["value"]
+    wall = latest.get(("goodput/wall_seconds", "-"), 0.0)
+    lines = ["== goodput =="]
+    buckets = {lbl.split("=", 1)[1]: v
+               for (name, lbl), v in latest.items()
+               if name == "goodput/seconds" and lbl.startswith("bucket=")}
+    for bucket, secs in sorted(buckets.items(), key=lambda kv: -kv[1]):
+        share = secs / wall if wall > 0 else 0.0
+        lines.append(f"  {bucket:<12}{secs:>10.3f}s  {share:>6.1%}")
+    for name in ("goodput/goodput_fraction", "goodput/mfu",
+                 "goodput/tokens_per_sec", "goodput/steps"):
+        v = latest.get((name, "-"))
+        if v is not None:
+            lines.append(f"  {name.split('/', 1)[1]} = {v:.6g}")
+    return "\n".join(lines)
+
+
 def report(paths: List[str]) -> str:
     records = load_records(paths)
     sections = [s for s in (summarize_spans(records),
                             summarize_metrics(records),
+                            summarize_goodput(records),
                             summarize_recompiles(records)) if s]
     if not sections:
         return "no span or metric records found"
     return "\n\n".join(sections)
 
 
+# ---------------------------------------------------------------------------
+# crash-dump bundles (flightrecorder.FlightRecorder.dump output)
+
+
+def _stack_digest(stacks_text: str, frames_per_thread: int = 3) -> List[str]:
+    """Innermost frames per thread from stacks.txt — the 'where was every
+    thread' one-liner view. Parses the traceback-formatted section."""
+    out: List[str] = []
+    thread = None
+    frames: List[str] = []
+
+    def flush():
+        if thread is not None:
+            out.append(thread)
+            out.extend(f"  {f}" for f in frames[-frames_per_thread:])
+
+    for line in stacks_text.splitlines():
+        if line.startswith("=== faulthandler ==="):
+            break
+        if line.startswith("--- thread "):
+            flush()
+            thread = line.strip("- ").strip()
+            frames = []
+        elif line.lstrip().startswith("File \"") and thread is not None:
+            frames.append(line.strip())
+    flush()
+    return out
+
+
+def load_crash_dump(bundle_dir: str) -> Dict[str, Any]:
+    """Parse a bundle directory into {manifest, events, stacks_text}.
+    Raises ``FileNotFoundError`` for a directory without a MANIFEST."""
+    import os
+
+    manifest_path = os.path.join(bundle_dir, "MANIFEST.json")
+    with open(manifest_path) as fh:
+        manifest = json.load(fh)
+    events: List[Dict[str, Any]] = []
+    events_path = os.path.join(bundle_dir, "events.jsonl")
+    if os.path.exists(events_path):
+        events = load_records([events_path])
+    stacks_text = ""
+    stacks_path = os.path.join(bundle_dir, "stacks.txt")
+    if os.path.exists(stacks_path):
+        with open(stacks_path) as fh:
+            stacks_text = fh.read()
+    return {"manifest": manifest, "events": events,
+            "stacks_text": stacks_text}
+
+
+def crash_report(bundle_dir: str, last_steps: int = 5,
+                 tail_events: int = 15) -> str:
+    bundle = load_crash_dump(bundle_dir)
+    man = bundle["manifest"]
+    events = bundle["events"]
+    lines = [f"== crash bundle ==  {bundle_dir}",
+             f"  reason: {man.get('reason', '?')}"]
+    stalled = man.get("stalled_span")
+    lines.append(f"  stalled span: {stalled if stalled else '<none open>'}")
+    extra = man.get("extra") or {}
+    if "waited_s" in extra:
+        lines.append(f"  silent for {extra['waited_s']:.1f}s "
+                     f"(deadline {extra.get('deadline_s', 0):.1f}s)")
+    exc = man.get("exception")
+    if exc:
+        lines.append(f"  exception: {exc.get('type')}: "
+                     f"{str(exc.get('message', ''))[:200]}")
+    for tid, stack in (man.get("open_spans") or {}).items():
+        lines.append(f"  open spans [thread {tid}]: {' > '.join(stack)}")
+    env = man.get("environment") or {}
+    if env.get("devices"):
+        lines.append(f"  devices: {', '.join(env['devices'][:4])}"
+                     + (" ..." if len(env["devices"]) > 4 else ""))
+    entries = man.get("audit_entries") or []
+    if entries:
+        lines.append("  registered programs: "
+                     + ", ".join(e["name"] for e in entries))
+
+    steps = [e for e in events
+             if e.get("kind") == "span_end" and e.get("name") == "train_batch"]
+    if steps:
+        lines.append(f"\n== last steps ==  ({len(steps)} in ring)")
+        for ev in steps[-last_steps:]:
+            lines.append(f"  t={ev.get('t', 0):.3f}  "
+                         f"train_batch dur={ev.get('dur_s', 0):.4f}s")
+    if events:
+        lines.append(f"\n== event tail ==  ({len(events)} in ring)")
+        for ev in events[-tail_events:]:
+            desc = " ".join(f"{k}={v}" for k, v in ev.items()
+                            if k not in ("seq", "t", "kind"))
+            lines.append(f"  #{ev.get('seq', '?')} {ev.get('kind', '?')}"
+                         + (f"  {desc}" if desc else ""))
+    digest = _stack_digest(bundle["stacks_text"])
+    if digest:
+        lines.append("\n== stack digest ==")
+        lines.extend("  " + d for d in digest)
+    return "\n".join(lines)
+
+
+USAGE = ("usage: python -m deepspeed_tpu.observability report "
+         "<trace.jsonl|metrics.jsonl> [...] | report --crash-dump <dir> [...]")
+
+
 def main(argv: List[str]) -> int:
     if not argv or argv[0] in ("-h", "--help"):
-        print("usage: python -m deepspeed_tpu.observability report "
-              "<trace.jsonl|metrics.jsonl> [...]")
+        print(USAGE)
         return 0 if argv else 2
+    if argv[0] == "--crash-dump":
+        dirs = argv[1:]
+        if not dirs:
+            print(USAGE, file=sys.stderr)
+            return 2
+        try:
+            print("\n\n".join(crash_report(d) for d in dirs))
+        except FileNotFoundError as e:
+            print(f"error: not a crash bundle: {e}", file=sys.stderr)
+            return 1
+        return 0
     print(report(argv))
     return 0

@@ -88,6 +88,8 @@ class Span:
             self.parent_name = stack[-1].name if stack else None
             stack.append(self)
         self.start_ns = time.perf_counter_ns()
+        if t is not None and t.on_event is not None:
+            t.on_event("begin", self)
         return self
 
     def end(self) -> "Span":
@@ -104,6 +106,8 @@ class Span:
             if stack:
                 stack.pop()
             t._record(self)
+            if t.on_event is not None:
+                t.on_event("end", self)
         return self
 
     def __enter__(self) -> "Span":
@@ -148,6 +152,11 @@ class SpanTracer:
         self.jsonl_path = jsonl_path if self.enabled else None
         self.max_spans = max_spans
         self.dropped = 0
+        # optional ("begin"|"end", span) callback — the observability session
+        # wires the flight recorder / hang watchdog / goodput accountant
+        # through this single hook; None (the default) costs one attribute
+        # check per span boundary
+        self.on_event: Optional[Any] = None
         self._spans: List[Dict[str, Any]] = []
         self._lock = threading.Lock()
         self._local = threading.local()

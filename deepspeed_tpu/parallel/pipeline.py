@@ -59,6 +59,8 @@ def _record_schedule_census(schedule: str, num_stages: int, batch) -> None:
     obs = get_session()
     if not obs.enabled:
         return
+    # trace-time census is also a liveness heartbeat for the hang watchdog
+    obs.heartbeat("pipeline/census")
     import numpy as _np
 
     # static shape metadata, concrete at trace time (never a device sync)

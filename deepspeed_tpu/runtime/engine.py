@@ -515,6 +515,9 @@ class TrainEngine:
         self._profiling = False
         self._profile_span = None
 
+        if self._obs.goodput is not None:
+            self._wire_goodput()
+
         n = (self._n_params if self.params is None
              else param_count(self.params))
         log_dist(f"engine ready: {n / 1e6:.1f}M params, zero_stage={self.config.zero_stage}, "
@@ -978,6 +981,13 @@ class TrainEngine:
                 with obs.span("train_batch/h2d"):
                     batch = self._globalize_batch(batch, leading_gas=True)
                 loss, stats = self._dispatch_train_step(batch)
+        except Exception as e:
+            # black-box dump before the exception unwinds: the ring, the
+            # open-span stack and the per-thread stacks at THIS moment are
+            # what a post-mortem needs (no-op without a flight recorder)
+            obs.crash_dump("train_batch-exception", exc=e,
+                           step=self.global_steps)
+            raise
         finally:
             _batch_span.end()
         self.global_steps += 1
@@ -1515,6 +1525,45 @@ class TrainEngine:
             self._profile_span.end()
             self._profile_span = None
 
+    # -- goodput ----------------------------------------------------------
+    def _wire_goodput(self) -> None:
+        """Hand the goodput accountant the workload shape: global tokens per
+        step, fwd+bwd FLOPs per chip per step (what the ``goodput/mfu``
+        gauge divides by peak), and the attached chip's peak from the
+        autotuning cost model. Pure host arithmetic — never a device sync."""
+        from ..autotuning.cost_model import peak_flops_for
+
+        try:
+            kind = jax.devices()[0].device_kind
+        except Exception:
+            kind = None
+        gas = self.gradient_accumulation_steps()
+        micro = self.train_micro_batch_size_per_gpu()
+        cfg = self.model.config
+        try:
+            if cfg is not None:
+                from ..profiling import transformer_breakdown
+
+                seq = int(getattr(cfg, "max_seq_len", 1024))
+                prof = transformer_breakdown(cfg, micro, seq)
+                # fwd+bwd ~ 3x fwd flops (the flops profiler's 1:2 rule)
+                flops_per_step = 3.0 * prof.total_flops * gas
+                tokens_per_step = float(self.train_batch_size()) * seq
+                source = "flops-profiler"
+            else:
+                n = (self._n_params if self.params is None
+                     else param_count(self.params))
+                # config-less model: 6N training flops per sample-as-token
+                flops_per_step = 6.0 * float(n) * micro * gas
+                tokens_per_step = float(self.train_batch_size())
+                source = "param-count"
+            self._obs.goodput.set_workload(
+                tokens_per_step=tokens_per_step,
+                flops_per_step=flops_per_step,
+                peak_flops=peak_flops_for(kind), source=source)
+        except Exception:  # telemetry must never take the engine down
+            logger.warning("goodput workload wiring failed", exc_info=True)
+
     # -- monitor ----------------------------------------------------------
     def _publish_metrics(self, loss: float, grad_norm: float) -> None:
         """Publish step stats through the observability metrics registry and
@@ -1543,6 +1592,12 @@ class TrainEngine:
             reg.gauge("Train/Offload/total_gbps").set(
                 st["achieved_total_gbps"])
             names += ["Train/Offload/h2d_gbps", "Train/Offload/total_gbps"]
+        if self._obs.goodput is not None:
+            # gauges are refreshed every step by note_step; the monitor
+            # writers see them at the same steps_per_print cadence as loss
+            names += ["goodput/goodput_fraction", "goodput/mfu",
+                      "goodput/tokens_per_sec", "goodput/seconds",
+                      "goodput/wall_seconds", "goodput/steps"]
         events = reg.publish(self.global_steps, names=names)
         if self._monitor.enabled:
             self._monitor.write_events(events)
