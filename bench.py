@@ -87,6 +87,20 @@ def main() -> None:
             "enabled": os.environ.get("BENCH_OBS", "1") == "1",
             "output_dir": os.environ.get("BENCH_OBS_DIR",
                                          "bench_results/obs_train"),
+            # fleet-health smoke: per-rank step-time skew lands in the
+            # metrics JSONL, and the bench record carries it as
+            # step_time_skew (single-host: a 1-rank fleet, skew 0.0 — the
+            # wiring is what the smoke proves). Cadence defaults to
+            # warmup(2) + step count so exactly ONE gather runs, on the
+            # LAST timed step (global-step counting includes the warmup),
+            # right where the loop's own float(loss) sync lands — the
+            # tracked tokens/sec number stays comparable. The numerics
+            # sentinel is deliberately NOT enabled here: its isfinite
+            # reductions compile into the hot step.
+            "fleet_health": True,
+            "fleet_cadence_steps": int(os.environ.get(
+                "BENCH_FLEET_CADENCE",
+                2 + int(os.environ.get("BENCH_STEPS", 30)))),
         },
     }
     engine, *_ = deepspeed_tpu.initialize(model=model, config=cfg)
@@ -118,21 +132,28 @@ def main() -> None:
     from deepspeed_tpu.observability import get_session
 
     obs = get_session()
+    metrics_path = os.environ.get("BENCH_METRICS_JSONL",
+                                  "BENCH_metrics_train.jsonl")
     if obs.enabled:
         obs.registry.gauge("bench/tokens_per_sec").set(tokens_per_sec)
         obs.registry.gauge("bench/mfu").set(mfu)
-        obs.dump_metrics(path=os.environ.get("BENCH_METRICS_JSONL",
-                                             "BENCH_metrics_train.jsonl"),
+        obs.dump_metrics(path=metrics_path,
                          metric=METRIC, steps=steps, batch=batch, seq=seq)
         obs.export_chrome_trace()
         obs.close(export=False)   # already exported to the bench paths
 
-    print(json.dumps({
+    from bench_common import fleet_skew_from_metrics
+
+    record = {
         "metric": METRIC,
         "value": round(tokens_per_sec, 1),
         "unit": UNIT,
         "vs_baseline": round(mfu / 0.5, 4),
-    }))
+    }
+    skew = fleet_skew_from_metrics(metrics_path if obs.enabled else None)
+    if skew is not None:
+        record["step_time_skew"] = round(skew, 4)
+    print(json.dumps(record))
 
 
 if __name__ == "__main__":

@@ -116,6 +116,31 @@ def crash_bundle_info(crash_dir: Optional[str],
         return None
 
 
+def fleet_skew_from_metrics(path: Optional[str]) -> Optional[float]:
+    """``fleet/step_time_median_s{agg=skew}`` from a metrics JSONL dump —
+    the fleet-health smoke field the bench records carry as
+    ``step_time_skew`` ((max-median)/median across ranks; 0.0 on a one-rank
+    fleet). Stdlib-only (parent-side safe); None when the file or the gauge
+    is absent (fleet health off)."""
+    if not path or not os.path.exists(path):
+        return None
+    skew = None
+    try:
+        with open(path) as fh:
+            for line in fh:
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if (rec.get("type") == "gauge"
+                        and rec.get("name") == "fleet/step_time_median_s"
+                        and rec.get("labels", {}).get("agg") == "skew"):
+                    skew = float(rec["value"])   # latest record wins
+    except OSError:
+        return None
+    return skew
+
+
 def _signal_group(pid: int, sig: int) -> None:
     try:
         os.killpg(pid, sig)

@@ -2,15 +2,16 @@
 
 from .comm import (ReduceOp, all_gather, all_reduce, all_to_all, axis_rank,
                    axis_size, barrier, broadcast, get_rank, get_world_size,
-                   host_all_reduce_scalar, init_distributed, is_initialized,
-                   log_summary, reduce_scatter, send_next, send_prev,
-                   send_recv_permute)
+                   host_all_gather_array, host_all_reduce_scalar,
+                   init_distributed, is_initialized, log_summary,
+                   reduce_scatter, send_next, send_prev, send_recv_permute)
 from .comms_logging import CommsLogger, configure_comms_logger, get_comms_logger
 
 __all__ = [
     "ReduceOp", "all_gather", "all_reduce", "all_to_all", "axis_rank",
     "axis_size", "barrier", "broadcast", "get_rank", "get_world_size",
-    "host_all_reduce_scalar", "init_distributed", "is_initialized",
+    "host_all_gather_array", "host_all_reduce_scalar",
+    "init_distributed", "is_initialized",
     "log_summary", "reduce_scatter", "send_next", "send_prev",
     "send_recv_permute", "CommsLogger", "configure_comms_logger",
     "get_comms_logger",

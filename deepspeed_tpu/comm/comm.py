@@ -332,6 +332,26 @@ def host_all_reduce_scalar(value: float) -> float:
     return float(multihost_utils.process_allgather(jnp.asarray(value)).sum())
 
 
+def host_all_gather_array(value):
+    """Gather one host array from every process outside jit → a float32
+    numpy array with a leading ``process_count`` dim (single-process: the
+    input with a length-1 leading dim). float32 on BOTH paths: the
+    multi-process gather rides jax arrays, which silently downcast f64
+    under the default x64-disabled config — an explicit uniform dtype keeps
+    single-process tests honest about multi-host precision (callers
+    pre-scale values needing > 2^24 integer exactness). The fleet-health
+    monitor's per-rank stats gather rides this; like every host collective
+    it is a BARRIER — all processes must call it, at the same cadence."""
+    import numpy as np
+
+    arr = np.asarray(value, dtype=np.float32)
+    if jax.process_count() == 1:
+        return arr[None]
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.process_allgather(jnp.asarray(arr)))
+
+
 def log_summary() -> None:
     clog = get_comms_logger()
     if clog is not None:

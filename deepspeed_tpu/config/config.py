@@ -301,6 +301,26 @@ class ObservabilityConfig(ConfigModel):
     # goodput_fraction / mfu / tokens_per_sec gauges; span-derived, so the
     # per-step cost is a few dict updates
     goodput: bool = True
+    # fleet health (observability/fleethealth.py): cross-rank aggregation of
+    # per-rank health stats at a step cadence, straggler detection, and the
+    # replica-divergence/SDC sentinel. The cadence step pays one host sync
+    # (materialising loss/grad-norm) plus one cross-process gather; every
+    # other step costs nothing.
+    fleet_health: bool = False
+    fleet_cadence_steps: int = 10      # aggregate every N steps
+    fleet_straggler_factor: float = 2.0  # straggler: step time > k * median
+    fleet_window: int = 32             # rolling step-time window per rank
+    fleet_divergence_tolerance: float = 1e-4  # relative spread that trips
+    fleet_param_checksum: bool = False  # per-replica param checksum compare
+    # numerics sentinel (observability/numerics.py): fused isfinite +
+    # loss-spike check INSIDE the jitted train step; the flag is a device
+    # scalar threaded through the step (no extra program, no host sync) and
+    # is materialised every numerics_check_steps steps
+    numerics_sentinel: bool = False
+    numerics_action: str = "warn"      # warn | skip_step | abort
+    numerics_check_steps: int = 10     # host-side flag check cadence
+    numerics_spike_factor: float = 0.0  # loss > k * EMA trips; 0 disables
+    numerics_spike_warmup_steps: int = 20  # steps before spike check arms
 
     def validate(self) -> None:
         if self.max_spans < 1:
@@ -319,6 +339,31 @@ class ObservabilityConfig(ConfigModel):
             raise ConfigError("observability.hang_poll_interval_s must be > 0")
         if not 1 <= self.hang_exit_code <= 255:
             raise ConfigError("observability.hang_exit_code must be in 1..255")
+        if self.fleet_cadence_steps < 1:
+            raise ConfigError("observability.fleet_cadence_steps must be >= 1")
+        if self.fleet_straggler_factor <= 1.0:
+            raise ConfigError(
+                "observability.fleet_straggler_factor must be > 1 (a factor "
+                "<= 1 would flag the median rank itself)")
+        if self.fleet_window < 1:
+            raise ConfigError("observability.fleet_window must be >= 1")
+        if self.fleet_divergence_tolerance < 0:
+            raise ConfigError(
+                "observability.fleet_divergence_tolerance must be >= 0")
+        if self.numerics_action not in ("warn", "skip_step", "abort"):
+            raise ConfigError(
+                "observability.numerics_action must be warn|skip_step|abort, "
+                f"got '{self.numerics_action}'")
+        if self.numerics_check_steps < 1:
+            raise ConfigError(
+                "observability.numerics_check_steps must be >= 1")
+        if self.numerics_spike_factor < 0:
+            raise ConfigError(
+                "observability.numerics_spike_factor must be >= 0 "
+                "(0 disables the loss-spike check)")
+        if self.numerics_spike_warmup_steps < 0:
+            raise ConfigError(
+                "observability.numerics_spike_warmup_steps must be >= 0")
 
 
 @dataclass

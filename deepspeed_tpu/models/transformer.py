@@ -1199,8 +1199,17 @@ def cross_entropy_loss(logits: jax.Array, labels: jax.Array,
         valid = valid & mask.astype(bool)
     safe_labels = jnp.where(valid, labels, 0)
     lse = jax.nn.logsumexp(logits.astype(jnp.float32), axis=-1)          # (B,S)
-    picked = jnp.take_along_axis(logits, safe_labels[..., None],
-                                 axis=-1)[..., 0].astype(jnp.float32)
+    # picked logit via a one-hot masked sum, NOT take_along_axis: gathering
+    # along a vocab dim that TP shards over 'model' miscompiles in the XLA
+    # CPU SPMD partitioner (NaN in the gathered values under tp×sp meshes —
+    # the numerics-sentinel triage of the zero3×TP×SP dryrun; the
+    # de-optimized program is clean). The compare+select fuses into the
+    # reduction, and each vocab shard contributes its local partial sum —
+    # the standard TP-safe cross-entropy contraction.
+    one_hot = safe_labels[..., None] == jnp.arange(
+        logits.shape[-1], dtype=safe_labels.dtype)
+    picked = jnp.sum(jnp.where(one_hot, logits.astype(jnp.float32), 0.0),
+                     axis=-1)
     token_loss = jnp.where(valid, lse - picked, 0.0)
     return token_loss.sum() / jnp.maximum(valid.sum(), 1)
 

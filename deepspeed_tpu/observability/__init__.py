@@ -26,7 +26,16 @@ two TPU-specific watchers:
   naming the stalled span (optionally aborting with a distinct exit code);
 * :mod:`.goodput` — wall-time buckets (compute/recompile/checkpoint/
   input-wait/stall) + ``goodput_fraction`` / ``mfu`` / ``tokens_per_sec``
-  gauges.
+  gauges;
+* :mod:`.fleethealth` — cross-rank health aggregation at a step cadence
+  (fleet min/median/max/skew of step time / loss / grad norm / HBM /
+  recompiles), straggler detection (``fleet/straggler_rank``), and the
+  replica-divergence/SDC sentinel (loss/grad-norm agreement + optional
+  per-replica param checksums) dumping a bundle that names the culprit
+  rank;
+* :mod:`.numerics` — in-program numerics sentinel: a fused isfinite /
+  loss-spike flag threaded through the jitted train step (no extra host
+  sync on the happy path) with configurable ``warn | skip_step | abort``.
 
 Everything is **off by default** (``ObservabilityConfig.enabled``); a
 disabled session records nothing and writes no files, so tier-1 cost is zero.
@@ -38,6 +47,7 @@ from __future__ import annotations
 import os
 from typing import Any, Optional
 
+from .fleethealth import FleetHealthMonitor, build_replica_checksum_probe
 from .flightrecorder import (FlightRecorder, find_latest_bundle,
                              install_sigusr1, uninstall_sigusr1)
 from .goodput import GoodputAccountant
@@ -45,6 +55,7 @@ from .goodput import STEP_SPANS as _STEP_SPANS
 from .hangdetect import HangWatchdog
 from .memory import record_memory
 from .metrics import Counter, Gauge, Histogram, MetricsRegistry, get_registry
+from .numerics import NumericsSentinel, NumericsState, NumericsTrip
 from .recompile import RecompileWatchdog, get_watchdog
 from .recompile import install as install_watchdog
 from .recompile import uninstall as uninstall_watchdog
@@ -58,6 +69,8 @@ __all__ = [
     "get_watchdog", "record_memory",
     "FlightRecorder", "find_latest_bundle", "install_sigusr1",
     "uninstall_sigusr1", "HangWatchdog", "GoodputAccountant",
+    "FleetHealthMonitor", "build_replica_checksum_probe",
+    "NumericsSentinel", "NumericsState", "NumericsTrip",
 ]
 
 
@@ -112,9 +125,32 @@ class Observability:
             self.hang.start()
         if self.enabled and config.goodput:
             self.goodput = GoodputAccountant(self.registry)
+        # fleet health + numerics sentinel: off unless their gates are on;
+        # the disabled path wires nothing (no hooks, no state)
+        self.fleet: Optional[FleetHealthMonitor] = None
+        if self.enabled and getattr(config, "fleet_health", False):
+            self.fleet = FleetHealthMonitor(
+                registry=self.registry, recorder=self.recorder,
+                cadence_steps=config.fleet_cadence_steps,
+                straggler_factor=config.fleet_straggler_factor,
+                divergence_tolerance=config.fleet_divergence_tolerance,
+                window=config.fleet_window)
+            self.fleet.heartbeat = self.heartbeat
+        self.numerics: Optional[NumericsSentinel] = None
+        if self.enabled and getattr(config, "numerics_sentinel", False):
+            self.numerics = NumericsSentinel(
+                action=config.numerics_action,
+                check_steps=config.numerics_check_steps,
+                spike_factor=config.numerics_spike_factor,
+                spike_warmup=config.numerics_spike_warmup_steps,
+                registry=self.registry, recorder=self.recorder)
         if self.recorder is not None or self.hang is not None \
-                or self.goodput is not None:
+                or self.goodput is not None or self.fleet is not None:
             self.tracer.on_event = self._span_event
+        if self.hang is not None and self.fleet is not None:
+            # a hang dump taken while blocked in the fleet gather should
+            # name the rank that never arrived
+            self.hang.context_fn = self.fleet.hang_context
         if self.watchdog is not None:
             self.watchdog.on_compile = self._on_compile
         self._mem_has_device_stats = None
@@ -145,12 +181,16 @@ class Observability:
             self.recorder.record_span(phase, span)
         if self.hang is not None:
             self.hang.heartbeat(span.name)
-        if self.goodput is not None or self.hang is not None:
+        if self.goodput is not None or self.hang is not None \
+                or self.fleet is not None:
             if phase == "end":
                 dur = span.duration_s
                 t = span.end_ns / 1e9
-                if self.hang is not None and span.name in _STEP_SPANS:
-                    self.hang.note_step_time(dur)
+                if span.name in _STEP_SPANS:
+                    if self.hang is not None:
+                        self.hang.note_step_time(dur)
+                    if self.fleet is not None:
+                        self.fleet.note_step_time(dur)
             else:
                 dur = 0.0
                 t = span.start_ns / 1e9
@@ -251,6 +291,10 @@ class Observability:
         if self.hang is not None:
             self.hang.disarm()
             self.hang.stop()
+        if self.numerics is not None:
+            # final-window flush: a trip after the last cadence check must
+            # not exit silently (never raises; abort downgrades to log)
+            self.numerics.flush()
         if self.enabled and export:
             try:
                 if self.goodput is not None:

@@ -1,0 +1,394 @@
+"""Fleet health — cross-rank aggregation, straggler and divergence detection.
+
+PRs 2–4 built a deep but strictly process-local observability stack; at
+multichip scale the failures that actually burn wall-clock are *relative* —
+one slow host, one data-parallel replica silently diverging — and no
+process-local layer can name the culprit rank. This module is the missing
+cross-rank layer (MegaScale-style; the reference DeepSpeed's ``monitor/`` +
+comms logger only ever saw rank 0):
+
+* **cross-rank aggregation** — at ``fleet_cadence_steps`` cadence, each rank
+  assembles a small fixed vector of health stats (rolling-median and last
+  step wall time, loss, grad norm, HBM high-water, recompile count) and the
+  fleet gathers them over the existing :mod:`deepspeed_tpu.comm` layer
+  (:func:`~deepspeed_tpu.comm.host_all_gather_array`). Fleet
+  min/median/max/skew per stat — plus a per-rank step-time series for the
+  report CLI's fleet table — publish into the :class:`MetricsRegistry`;
+  rank 0 (whose exports are the ones written under the default
+  ``all_ranks=False``) holds the fleet view.
+* **straggler detection** — a rank whose rolling step time exceeds
+  ``fleet_straggler_factor × fleet median`` is flagged:
+  ``fleet/straggler_rank`` names it (-1 when none), ``fleet/straggler_events``
+  counts incidents, and the flight-recorder ring gets a ``straggler`` event.
+  The gather itself is a barrier, so the monitor also **heartbeats the hang
+  watchdog** around it and exposes :meth:`hang_context` — wired to
+  ``HangWatchdog.context_fn`` — so a hang dump taken while blocked in the
+  gather says "waiting on the step-N fleet gather" and names the last known
+  straggler as the prime suspect (the rank that never arrived).
+* **divergence / SDC sentinel** — data-parallel replicas must agree on
+  loss and grad norm (they are reductions of the SAME logical program); a
+  relative spread past ``fleet_divergence_tolerance`` means a diverging or
+  silently-corrupting rank. The check runs two ways: across *processes* on
+  the gathered loss/grad-norm columns, and — with
+  ``fleet_param_checksum: true`` — across *in-process replicas* via a cheap
+  per-replica parameter checksum probe (:func:`build_replica_checksum_probe`,
+  a shard_map over the 'data' axis; valid for ZeRO ≤ 2, where replica
+  copies exist). Disagreement dumps a flight-record bundle whose MANIFEST
+  names the culprit rank and step.
+
+Cost model: every non-cadence step costs one float append (the step-time
+window). The cadence step pays one host materialisation of loss/grad-norm,
+one cross-process gather of a ~6-float vector, and (checksum mode) one tiny
+jitted probe — the documented cadence-cost tradeoff. Everything is
+injectable (``gather_fn``, ``rank``, ``world``, ``clock``) so the suite
+tests multi-rank behavior single-process.
+"""
+
+from __future__ import annotations
+
+import collections
+import statistics
+import threading
+import time
+from typing import Any, Callable, Deque, Dict, List, Optional
+
+from ..utils.logging import logger
+
+# order of the per-rank health vector (gathered as one float32 row — the
+# comm gather's uniform dtype; HBM rides in MiB so a 16 PiB ceiling stays
+# integer-exact in f32)
+HEALTH_STATS = ("step_time_median_s", "step_time_last_s", "loss",
+                "grad_norm", "hbm_peak_mib", "recompiles")
+# stats whose cross-rank agreement the divergence sentinel enforces
+DIVERGENCE_STATS = ("loss", "grad_norm")
+
+
+def _default_gather(vec) -> "Any":
+    """Gather one host vector from every process → (world, len) array."""
+    from ..comm.comm import host_all_gather_array
+
+    return host_all_gather_array(vec)
+
+
+def build_replica_checksum_probe(mesh, param_specs) -> Callable:
+    """Jitted probe: params → (dp,) per-data-replica checksum vector.
+
+    Each 'data'-axis position sums ``|leaf|`` over its addressable shards
+    (in f32), psums over the non-data axes so every replica's scalar covers
+    the FULL logical tree, and the per-replica scalars concatenate into a
+    (dp,) vector. Replicated trees (ZeRO ≤ 2) must produce identical
+    entries; a mismatch is replica divergence or silent data corruption on
+    one replica's copy. ``param_specs`` must be the tree's actual partition
+    specs (the ZeRO plan's) so no resharding collective is inserted.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.mesh import DATA_AXIS
+    from ..utils.compat import shard_map
+
+    other_axes = tuple(a for a in mesh.axis_names
+                       if a != DATA_AXIS and mesh.shape[a] > 1)
+
+    def body(tree):
+        total = jnp.float32(0.0)
+        for leaf in jax.tree.leaves(tree):
+            if jnp.issubdtype(leaf.dtype, jnp.floating):
+                total = total + jnp.sum(jnp.abs(leaf.astype(jnp.float32)))
+        if other_axes:
+            total = lax.psum(total, other_axes)
+        return total[None]                       # (1,) per data position
+
+    fn = shard_map(body, mesh=mesh, in_specs=(param_specs,),
+                   out_specs=P(DATA_AXIS), check_vma=False,
+                   axis_names=set(mesh.axis_names))
+    return jax.jit(fn)
+
+
+class FleetHealthMonitor:
+    """One per enabled observability session when
+    ``ObservabilityConfig.fleet_health`` is on."""
+
+    def __init__(self, registry: Any, recorder: Optional[Any] = None,
+                 cadence_steps: int = 10, straggler_factor: float = 2.0,
+                 divergence_tolerance: float = 1e-4, window: int = 32,
+                 gather_fn: Optional[Callable] = None,
+                 rank: Optional[int] = None, world: Optional[int] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.registry = registry
+        self.recorder = recorder
+        self.cadence_steps = max(int(cadence_steps), 1)
+        self.straggler_factor = float(straggler_factor)
+        self.divergence_tolerance = float(divergence_tolerance)
+        self._clock = clock
+        if rank is None or world is None:
+            try:
+                import jax
+
+                rank = jax.process_index() if rank is None else rank
+                world = jax.process_count() if world is None else world
+            except Exception:
+                rank, world = rank or 0, world or 1
+        self.rank = int(rank)
+        self.world = int(world)
+        self.gather_fn = gather_fn or _default_gather
+        self._lock = threading.Lock()
+        self._step_times: Deque[float] = collections.deque(maxlen=window)
+        self._checksum_fn: Optional[Callable] = None
+        # hang-watchdog context: what a dump should say if we block mid-gather
+        self._in_gather = False
+        self._gather_step = -1
+        self.last_straggler_rank = -1
+        self.last_divergence: Optional[dict] = None
+        self.aggregations = 0
+        self.straggler_events = 0
+        self.divergence_events = 0
+        # bundle rate limit: a PERSISTENT divergence (the SDC case) trips
+        # every cadence step — counters/gauges update every time, but only
+        # the FIRST trip per (stat, culprit) writes a crash bundle, or a
+        # long run fills the dump dir with thousands of identical bundles
+        self._dumped_divergences: set = set()
+        # liveness hook (Observability wires the hang watchdog's heartbeat)
+        self.heartbeat: Callable[[str], None] = lambda name: None
+
+    # -- feed (must stay O(1); called at span/step cadence) ----------------
+    def note_step_time(self, secs: float) -> None:
+        if secs > 0:
+            with self._lock:
+                self._step_times.append(float(secs))
+
+    def set_checksum_fn(self, fn: Optional[Callable]) -> None:
+        """``fn()`` → per-replica checksum vector (device array ok; it is
+        materialised only at cadence)."""
+        self._checksum_fn = fn
+
+    def note_step(self, step: int, loss: Any = None,
+                  grad_norm: Any = None) -> bool:
+        """Per-step entry point. ``loss``/``grad_norm`` may be lazy device
+        scalars — they are only materialised on a cadence step. Returns True
+        when an aggregation ran."""
+        if step % self.cadence_steps != 0:
+            return False
+        try:
+            self.aggregate(step, loss=loss, grad_norm=grad_norm)
+            return True
+        except Exception:   # telemetry must never take training down
+            self._in_gather = False
+            logger.warning("fleet health aggregation failed", exc_info=True)
+            return False
+
+    # -- the cadence body --------------------------------------------------
+    def _local_vector(self, loss: Any, grad_norm: Any) -> List[float]:
+        with self._lock:
+            times = list(self._step_times)
+        med = statistics.median(times) if times else 0.0
+        last = times[-1] if times else 0.0
+        from .memory import device_memory_stats
+
+        hbm = 0
+        for stats in device_memory_stats().values():
+            hbm = max(hbm, int(stats.get("peak_bytes_in_use", 0)))
+        recompiles = sum(
+            self.registry.counter("xla/compiles").series().values())
+        to_f = lambda v: float(v) if v is not None else float("nan")
+        return [med, last, to_f(loss), to_f(grad_norm),
+                hbm / (1024.0 * 1024.0), float(recompiles)]
+
+    def aggregate(self, step: int, loss: Any = None,
+                  grad_norm: Any = None) -> Dict[str, Any]:
+        """Gather the fleet's health vectors, publish the fleet view, run
+        straggler + divergence detection. The ONE deliberate sync point."""
+        import numpy as np
+
+        vec = np.asarray(self._local_vector(loss, grad_norm), np.float64)
+        # the gather is a barrier: tell the watchdog (and any dump taken
+        # while we block here) what we are waiting on
+        self._gather_step = step
+        self._in_gather = True
+        self.heartbeat("fleet/gather")
+        try:
+            table = np.asarray(self.gather_fn(vec), np.float64)
+        finally:
+            self._in_gather = False
+        self.heartbeat("fleet/gather")
+        if table.ndim == 1:
+            table = table[None]
+        world = table.shape[0]
+        self.aggregations += 1
+
+        reg = self.registry
+        summary: Dict[str, Any] = {"step": step, "world": world}
+        for i, name in enumerate(HEALTH_STATS):
+            col = table[:, i]
+            finite = col[np.isfinite(col)]
+            if finite.size == 0:
+                continue
+            lo, med, hi = (float(finite.min()), float(np.median(finite)),
+                           float(finite.max()))
+            skew = (hi - med) / med if med > 0 else 0.0
+            g = reg.gauge(f"fleet/{name}",
+                          help=f"fleet {name}: min/median/max/skew")
+            g.set(lo, agg="min")
+            g.set(med, agg="median")
+            g.set(hi, agg="max")
+            g.set(skew, agg="skew")
+            summary[name] = {"min": lo, "median": med, "max": hi,
+                             "skew": skew}
+        # per-rank step-time series for the report CLI's fleet table
+        for r in range(world):
+            reg.gauge("fleet/rank_step_time_s",
+                      help="per-rank rolling-median step seconds").set(
+                          float(table[r, 0]), rank=r)
+        reg.gauge("fleet/world", help="ranks in the fleet view").set(world)
+
+        self._detect_straggler(step, table, summary)
+        self._detect_divergence(step, table, summary)
+        if self._checksum_fn is not None:
+            self._check_replica_checksums(step, summary)
+        return summary
+
+    # -- straggler ---------------------------------------------------------
+    def _detect_straggler(self, step: int, table, summary: Dict) -> None:
+        import numpy as np
+
+        times = table[:, 0]
+        finite = times[np.isfinite(times) & (times > 0)]
+        if finite.size < 2:
+            self.registry.gauge(
+                "fleet/straggler_rank",
+                help="slowest rank past k×median; -1 when none").set(-1)
+            return
+        med = float(np.median(finite))
+        lagging = np.where(
+            np.isfinite(times) & (times > self.straggler_factor * med))[0]
+        if lagging.size == 0:
+            self.registry.gauge("fleet/straggler_rank").set(-1)
+            return
+        culprit = int(lagging[np.argmax(times[lagging])])
+        self.last_straggler_rank = culprit
+        self.straggler_events += 1
+        self.registry.gauge(
+            "fleet/straggler_rank",
+            help="slowest rank past k×median; -1 when none").set(culprit)
+        self.registry.counter(
+            "fleet/straggler_events",
+            help="straggler detections").inc(rank=culprit)
+        summary["straggler_rank"] = culprit
+        if self.recorder is not None:
+            self.recorder.record(
+                "straggler", rank=culprit, step=step,
+                step_time_s=round(float(times[culprit]), 6),
+                fleet_median_s=round(med, 6),
+                factor=self.straggler_factor)
+        if self.rank == 0:
+            # all ranks computed the same verdict from the same table —
+            # one warning per fleet, not one per process
+            logger.warning(
+                f"FLEET: rank {culprit} is straggling — rolling step time "
+                f"{times[culprit]:.4f}s > {self.straggler_factor:g} × fleet "
+                f"median {med:.4f}s (step {step})")
+
+    # -- divergence --------------------------------------------------------
+    def _max_deviation_culprit(self, values):
+        """THE divergence criterion, single-sourced for the cross-process
+        and replica-checksum paths: relative deviation from the median past
+        ``divergence_tolerance`` → (culprit index, tripped). Requires ≥2
+        all-finite values (non-finite is the numerics sentinel's
+        jurisdiction); returns (-1, False) otherwise."""
+        import numpy as np
+
+        values = np.asarray(values)
+        if values.size < 2 or not np.all(np.isfinite(values)):
+            return -1, False
+        med = float(np.median(values))
+        dev = np.abs(values - med)
+        tol = self.divergence_tolerance * max(abs(med), 1e-12)
+        if float(dev.max()) > tol:
+            return int(np.argmax(dev)), True
+        return -1, False
+
+    def _trip_divergence(self, step: int, stat: str, values,
+                         culprit: int, summary: Dict,
+                         index_kind: str = "rank") -> None:
+        """``index_kind``: what ``culprit`` indexes — "rank" for gathered
+        cross-process stats (a process index), "replica" for the in-process
+        checksum probe (a data-axis position, NOT a process rank — on a
+        tp/sp/pipe mesh one replica spans several hosts, and mislabeling it
+        a rank would misdirect SDC triage to a healthy host)."""
+        import numpy as np
+
+        self.divergence_events += 1
+        info = {"stat": stat, f"culprit_{index_kind}": culprit, "step": step,
+                "values": [round(float(v), 8) for v in np.asarray(values)]}
+        self.last_divergence = info
+        summary.setdefault("divergence", []).append(info)
+        self.registry.counter(
+            "fleet/divergence_events",
+            help="replica divergence detections").inc(stat=stat)
+        if index_kind == "rank":
+            self.registry.gauge(
+                "fleet/diverging_rank",
+                help="last rank that disagreed with the fleet").set(culprit)
+        else:
+            self.registry.gauge(
+                "fleet/diverging_replica",
+                help="last data-axis replica whose param checksum "
+                     "disagreed").set(culprit)
+        # every rank sees the SAME gathered table, so only rank 0 dumps and
+        # logs — N identical bundles per incident would not scale
+        bundle = ""
+        if self.recorder is not None:
+            self.recorder.record("divergence", **info)
+            key = (stat, culprit)
+            if (self.rank == 0
+                    and key not in self._dumped_divergences):
+                self._dumped_divergences.add(key)
+                bundle = self.recorder.dump(reason="divergence",
+                                            extra=dict(info))
+        if self.rank == 0:
+            logger.error(
+                f"FLEET DIVERGENCE: {index_kind} {culprit} disagrees on "
+                f"{stat} at step {step} (values {info['values']}, tolerance "
+                f"{self.divergence_tolerance:g})"
+                + (f"; flight record at {bundle}" if bundle else ""))
+
+    def _detect_divergence(self, step: int, table, summary: Dict) -> None:
+        for stat in DIVERGENCE_STATS:
+            col = table[:, HEALTH_STATS.index(stat)]
+            culprit, tripped = self._max_deviation_culprit(col)
+            if tripped:
+                self._trip_divergence(step, stat, col, culprit, summary)
+
+    def _check_replica_checksums(self, step: int, summary: Dict) -> None:
+        import numpy as np
+
+        checks = np.asarray(self._checksum_fn(), np.float64).ravel()
+        for r in range(checks.size):
+            self.registry.gauge(
+                "fleet/param_checksum",
+                help="per-data-replica parameter checksum").set(
+                    float(checks[r]), replica=r)
+        culprit, tripped = self._max_deviation_culprit(checks)
+        if tripped:
+            self._trip_divergence(step, "param_checksum", checks, culprit,
+                                  summary, index_kind="replica")
+
+    # -- hang-watchdog context --------------------------------------------
+    def hang_context(self) -> Dict[str, Any]:
+        """Merged into a hang dump's MANIFEST extra. If the process is
+        blocked inside the cadence gather, the missing rank is — to the
+        best of local knowledge — the last known straggler."""
+        ctx: Dict[str, Any] = {
+            "in_fleet_gather": self._in_gather,
+            "fleet_gather_step": self._gather_step,
+            "fleet_world": self.world,
+            "last_straggler_rank": self.last_straggler_rank,
+        }
+        if self._in_gather:
+            suspect = (f"rank {self.last_straggler_rank}"
+                       if self.last_straggler_rank >= 0 else "an unknown rank")
+            ctx["note"] = (f"blocked in the step-{self._gather_step} fleet "
+                           f"gather — {suspect} never arrived")
+        return ctx

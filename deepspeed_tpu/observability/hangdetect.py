@@ -68,6 +68,10 @@ class HangWatchdog:
         self.abort = bool(abort)
         self.exit_code = int(exit_code)
         self.on_fire = on_fire
+        # optional () -> dict merged into the fire dump's extra — the fleet
+        # monitor uses it to say "blocked in the step-N gather, rank R never
+        # arrived"; None (default) costs one attribute check per fire
+        self.context_fn: Optional[Callable[[], dict]] = None
         self._abort_fn = abort_fn
         self._clock = clock
         self._lock = threading.Lock()
@@ -128,6 +132,13 @@ class HangWatchdog:
         return True
 
     def _fire(self, stalled_span: str, waited: float, deadline: float) -> None:
+        extra = {"waited_s": waited, "deadline_s": deadline}
+        if self.context_fn is not None:
+            try:
+                extra.update(self.context_fn() or {})
+            except Exception:
+                logger.warning("hang watchdog context_fn failed",
+                               exc_info=True)
         bundle = ""
         if self.recorder is not None:
             self.recorder.record("watchdog_fire", stalled_span=stalled_span,
@@ -135,8 +146,7 @@ class HangWatchdog:
                                  deadline_s=round(deadline, 3))
             bundle = self.recorder.dump(reason="hang",
                                         stalled_span=stalled_span,
-                                        extra={"waited_s": waited,
-                                               "deadline_s": deadline})
+                                        extra=extra)
         self.last_fire = {"stalled_span": stalled_span,
                           "waited_s": waited, "deadline_s": deadline,
                           "bundle": bundle}

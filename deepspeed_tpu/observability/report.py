@@ -119,6 +119,68 @@ def summarize_metrics(records: List[Dict[str, Any]]) -> str:
     return "\n\n".join(out)
 
 
+def summarize_fleet(records: List[Dict[str, Any]]) -> str:
+    """``== fleet ==`` — per-rank step-time table, skew, and the straggler/
+    divergence incident counters, from the aggregated fleet/* metrics."""
+    fleet_recs = [r for r in records
+                  if str(r.get("name", "")).startswith("fleet/")]
+    if not fleet_recs:
+        return ""
+    latest: Dict[Tuple[str, str], Dict[str, Any]] = {}
+    for r in fleet_recs:
+        latest[(r["name"], _label_str(r.get("labels", {})))] = r
+    lines = ["== fleet =="]
+    world = latest.get(("fleet/world", "-"))
+    if world:
+        lines[0] += f"  ranks={world['value']:.0f}"
+    # per-rank step-time table
+    ranks = sorted(
+        (int(r["labels"]["rank"]), r["value"])
+        for (n, _), r in latest.items() if n == "fleet/rank_step_time_s")
+    if ranks:
+        med = latest.get(("fleet/step_time_median_s", "agg=median"))
+        med_v = med["value"] if med else None
+        rows = []
+        for rank, secs in ranks:
+            rel = f"{secs / med_v:.2f}x" if med_v else "-"
+            rows.append([str(rank), f"{secs * 1e3:.2f}", rel])
+        lines.append(_fmt_table(["rank", "step_ms", "vs_median"], rows))
+    skew = latest.get(("fleet/step_time_median_s", "agg=skew"))
+    if skew:
+        lines.append(f"  step_time skew (max-median)/median = "
+                     f"{skew['value']:.3f}")
+    for name, label in (("fleet/loss", "loss"),
+                        ("fleet/grad_norm", "grad_norm")):
+        parts = []
+        for agg in ("min", "median", "max"):
+            r = latest.get((name, f"agg={agg}"))
+            if r is not None:
+                parts.append(f"{agg}={r['value']:.6g}")
+        if parts:
+            lines.append(f"  {label}: " + "  ".join(parts))
+    # incidents
+    straggler = latest.get(("fleet/straggler_rank", "-"))
+    if straggler is not None and straggler["value"] >= 0:
+        lines.append(f"  !! straggler: rank {straggler['value']:.0f}")
+    events = [(r["labels"], r["value"]) for (n, _), r in latest.items()
+              if n == "fleet/straggler_events"]
+    for labels, count in sorted(events, key=lambda kv: -kv[1]):
+        lines.append(f"  straggler incidents [rank "
+                     f"{labels.get('rank', '?')}]: {count:.0f}")
+    for name, kind in (("fleet/diverging_rank", "rank"),
+                       ("fleet/diverging_replica", "replica")):
+        diverging = latest.get((name, "-"))
+        if diverging is not None:
+            lines.append(f"  !! divergence: {kind} {diverging['value']:.0f} "
+                         "disagreed with the fleet (see crash bundles)")
+    dev_events = [(r["labels"], r["value"]) for (n, _), r in latest.items()
+                  if n == "fleet/divergence_events"]
+    for labels, count in sorted(dev_events, key=lambda kv: -kv[1]):
+        lines.append(f"  divergence incidents [{labels.get('stat', '?')}]: "
+                     f"{count:.0f}")
+    return "\n".join(lines)
+
+
 def summarize_recompiles(records: List[Dict[str, Any]]) -> str:
     compiles = [r for r in records
                 if r.get("type") == "counter" and r.get("name") == "xla/compiles"]
@@ -169,6 +231,7 @@ def report(paths: List[str]) -> str:
     sections = [s for s in (summarize_spans(records),
                             summarize_metrics(records),
                             summarize_goodput(records),
+                            summarize_fleet(records),
                             summarize_recompiles(records)) if s]
     if not sections:
         return "no span or metric records found"
@@ -238,6 +301,20 @@ def crash_report(bundle_dir: str, last_steps: int = 5,
     if "waited_s" in extra:
         lines.append(f"  silent for {extra['waited_s']:.1f}s "
                      f"(deadline {extra.get('deadline_s', 0):.1f}s)")
+    for kind in ("rank", "replica"):
+        # fleet divergence / numerics bundles name the offending process
+        # rank; the in-process checksum probe names a data-axis replica
+        if f"culprit_{kind}" in extra:
+            what = extra.get("stat") or extra.get("trip_kind") or "fault"
+            lines.append(
+                f"  culprit: {kind} {extra[f'culprit_{kind}']} ({what}"
+                + (f", step {extra['step']}" if "step" in extra else "")
+                + ")")
+    if extra.get("in_fleet_gather"):
+        note = extra.get("note") or (
+            f"blocked in the step-{extra.get('fleet_gather_step', '?')} "
+            "fleet gather")
+        lines.append(f"  fleet: {note}")
     exc = man.get("exception")
     if exc:
         lines.append(f"  exception: {exc.get('type')}: "

@@ -243,13 +243,17 @@ def pipelined_loss_fn(cfg, num_stages: int):
     (embed_helper, stage_apply, head_loss_fn, derive_labels,
      aux_coef) = _stage_helpers(cfg)
 
-    def body(layers_stacked, embed_tree, batch):
+    def body(stage_arr, layers_stacked, embed_tree, batch):
         """Runs per-pipe-group (manual over 'pipe'; data/seq/model auto).
+        stage_arr: (1,) i32 — this stage's index (an arange fed through the
+        shard_map, sharded over 'pipe'; ``lax.axis_index`` would lower to a
+        partition-id instruction the SPMD partitioner for the remaining
+        AUTO axes rejects — the test_pipeline standalone failure).
         layers_stacked leaves: (1, Lp, ...) — this stage's layers.
         embed_tree: full non-layer params (replicated over pipe).
         batch leaves: (M, mb, S)."""
-        stage_id = lax.axis_index(PIPE_AXIS)
-        P_ = lax.psum(1, PIPE_AXIS)
+        stage_id = stage_arr[0]
+        P_ = lax.psum(1, PIPE_AXIS)   # static: psum of a python int
         stage_layers = jax.tree.map(lambda x: x[0], layers_stacked)
         body_dtype = jnp.float32 if _needs_fp32_body() else cfg.dtype
         ids = batch["input_ids"]
@@ -333,11 +337,12 @@ def pipelined_loss_fn(cfg, num_stages: int):
         batch_specs = jax.tree.map(lambda _: P(), batch)
         fn = shard_map(
             body, mesh=mesh,
-            in_specs=(layer_specs, embed_specs, batch_specs),
+            in_specs=(P(PIPE_AXIS), layer_specs, embed_specs, batch_specs),
             out_specs=P(),
             check_vma=False,
             axis_names={PIPE_AXIS})
-        return fn(layers_in, embed_tree, batch)
+        return fn(jnp.arange(num_stages, dtype=jnp.int32), layers_in,
+                  embed_tree, batch)
 
     return loss_fn
 
@@ -368,9 +373,12 @@ def pipelined_grad_fn(cfg, num_stages: int):
     (embed_helper, stage_apply_helper, head_loss_helper, derive_labels,
      aux_coef) = _stage_helpers(cfg)
 
-    def body(layers_stacked, embed_tree, batch, scale):
-        s = lax.axis_index(PIPE_AXIS)
-        P_ = lax.psum(1, PIPE_AXIS)
+    def body(stage_arr, layers_stacked, embed_tree, batch, scale):
+        # stage index from a pipe-sharded arange, NOT lax.axis_index — the
+        # partition-id lowering of axis_index breaks the partitioner for the
+        # remaining auto axes (see pipelined_loss_fn.body)
+        s = stage_arr[0]
+        P_ = lax.psum(1, PIPE_AXIS)   # static: psum of a python int
         stage_layers = jax.tree.map(lambda x: x[0], layers_stacked)
         ids = batch["input_ids"]                        # (M, mb, S)
         attn_mask = batch.get("attention_mask")
@@ -492,11 +500,13 @@ def pipelined_grad_fn(cfg, num_stages: int):
         batch_specs = jax.tree.map(lambda _: P(), batch)
         fn = shard_map(
             body, mesh=mesh,
-            in_specs=(layer_specs, embed_specs, batch_specs, P()),
+            in_specs=(P(PIPE_AXIS), layer_specs, embed_specs, batch_specs,
+                      P()),
             out_specs=(layer_specs, embed_specs, P()),
             check_vma=False,
             axis_names={PIPE_AXIS})
-        g_layers, g_embed, loss = fn(layers_in, embed_tree, batch,
+        g_layers, g_embed, loss = fn(jnp.arange(num_stages, dtype=jnp.int32),
+                                     layers_in, embed_tree, batch,
                                      jnp.float32(scale))
         grads = dict(g_embed)
         grads["layers"] = g_layers

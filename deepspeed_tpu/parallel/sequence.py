@@ -45,11 +45,14 @@ def _in_manual_pipe() -> bool:
     constraints over auto axes there trip an XLA SPMD partitioner check
     (spmd_partitioner_util.cc subgroup mismatch), so constraints are skipped
     and layout is left to propagation."""
-    import jax.numpy as jnp
     from jax import lax
 
     try:
-        lax.axis_index("pipe")
+        # psum of a python int constant-folds to the axis size — unlike
+        # axis_index it emits NO op into the traced program (axis_index
+        # lowers to partition-id, which the partial-auto partitioner
+        # rejects even when the value is unused before DCE)
+        lax.psum(1, "pipe")
         return True
     except Exception:
         return False

@@ -515,8 +515,39 @@ class TrainEngine:
         self._profiling = False
         self._profile_span = None
 
+        # numerics sentinel: fused into the jitted train step the engine
+        # builds itself; the host-driven executors (param offload, NVMe
+        # swap) and the compressed-comm step run their update outside that
+        # program, so the sentinel is disabled (loudly) there
+        self._numerics = self._obs.numerics
+        self._numerics_state = None
+        if self._numerics is not None and (
+                self._param_offload is not None
+                or self._nvme_swapper is not None or self._onebit):
+            logger.warning(
+                "observability.numerics_sentinel is not supported with "
+                "offload_param / NVMe offload / 1-bit optimizers (the "
+                "update runs outside the single jitted step) — disabling")
+            self._numerics = None
+        if self._numerics is not None:
+            # session close force-checks the device flags so a trip in the
+            # final (step % check_steps) window is still reported; weakref
+            # so the sentinel never pins a replaced engine
+            import weakref
+
+            wself = weakref.ref(self)
+
+            def _flush_numerics():
+                eng = wself()
+                if eng is not None:
+                    eng.check_numerics(force=True)
+
+            self._numerics.attach_flush(_flush_numerics)
+
         if self._obs.goodput is not None:
             self._wire_goodput()
+        if self._obs.fleet is not None:
+            self._wire_fleet_health()
 
         n = (self._n_params if self.params is None
              else param_count(self.params))
@@ -783,6 +814,7 @@ class TrainEngine:
                                        skip_update=skip)
 
         pipelined = model.pipelined
+        sentinel = self._numerics
 
         # QAT straight-through: compression transform inside the
         # differentiation path; the step is rebuilt when the scheduler's
@@ -806,7 +838,7 @@ class TrainEngine:
                 loss_scaled, grads = jax.value_and_grad(pipe_loss)(params, batch)
                 return loss_scaled / scale, grads
 
-        def train_step(params, opt_state, scaler_state, batch):
+        def train_step(params, opt_state, scaler_state, num_state, batch):
             scale = scaler_state.scale if fp16 else jnp.float32(1.0)
 
             def one_micro(carry, mb):
@@ -848,17 +880,37 @@ class TrainEngine:
             # config compatibility. (Round-1 advisory: we wrongly divided by
             # predivide under prescale, changing the effective grad scale.)
 
-            new_params, new_opt_state, stats = apply_update(
-                params, grads, opt_state, overflow)
-            new_scaler = loss_scaler.update(scaler_state, overflow)
             mean_loss = jnp.mean(losses.astype(jnp.float32))
-            return new_params, new_opt_state, new_scaler, mean_loss, stats
+            skip = overflow
+            new_num_state = num_state
+            if sentinel is not None:
+                # fused in-program check on values the step already holds:
+                # loss mean + unscaled accumulated grads. No extra program,
+                # no host sync, no collective kinds beyond the step's own
+                # (the isfinite reductions partition like the loss mean).
+                # An fp16 scaler overflow suppresses the nonfinite-grads
+                # bit: periodic inf grads are the DynamicLossScaler's
+                # expected backoff signal, not a numerics fault.
+                new_num_state, tripped = sentinel.observe(
+                    num_state, mean_loss, grads,
+                    suppress_grads=overflow if fp16 else None)
+                if sentinel.skip_in_step:
+                    # action='skip_step': a poisoned update never lands —
+                    # ride the overflow-skip path on device
+                    skip = skip | tripped
+            new_params, new_opt_state, stats = apply_update(
+                params, grads, opt_state, skip)
+            new_scaler = loss_scaler.update(scaler_state, overflow)
+            return (new_params, new_opt_state, new_scaler, new_num_state,
+                    mean_loss, stats)
 
         opt_shardings = self._opt_state_shardings()
         return jax.jit(
             train_step,
-            in_shardings=(self.param_shardings, opt_shardings, None, None),
-            out_shardings=(self.param_shardings, opt_shardings, None, None, None),
+            in_shardings=(self.param_shardings, opt_shardings, None, None,
+                          None),
+            out_shardings=(self.param_shardings, opt_shardings, None, None,
+                           None, None),
             donate_argnums=(0, 1))
 
     def _build_nvme_grads_step(self) -> Callable:
@@ -998,6 +1050,15 @@ class TrainEngine:
         if obs.enabled:
             obs.note_step(self.global_steps)
             obs.maybe_record_memory(self.global_steps)
+        # cadence-gated flag materialisation (the sentinel's ONE host sync);
+        # between cadence steps this is a single modulo. Raises NumericsTrip
+        # under action='abort' — after dumping the bundle.
+        self.check_numerics()
+        if obs.fleet is not None:
+            # lazy device scalars: materialised only on a cadence step,
+            # inside the fleet gather (the documented cadence cost)
+            obs.fleet.note_step(self.global_steps, loss=loss,
+                                grad_norm=stats.grad_norm)
         if breakdown:
             self.timers(TRAIN_BATCH_TIMER).stop(synchronize=True)
             self.timers.log([TRAIN_BATCH_TIMER])
@@ -1014,11 +1075,35 @@ class TrainEngine:
         self._tput_window_start = self._tput_window_start or time.time()
         return loss
 
+    def check_numerics(self, force: bool = False) -> None:
+        """Materialise and act on the numerics sentinel's device flags —
+        at ``numerics_check_steps`` cadence (train_batch calls this every
+        step), or immediately with ``force=True`` (session close flushes
+        the final window through here)."""
+        if self._numerics is None or self._numerics_state is None:
+            return
+        try:
+            cleared = self._numerics.maybe_check(
+                self._numerics_state, self.global_steps, force=force)
+        except Exception:
+            # abort raises AFTER logging+bundling: clear the handled flags
+            # before the exception escapes, or the close-time flush (and a
+            # supervisor that catches-and-continues) re-reports the SAME
+            # trip with a duplicate bundle
+            self._numerics_state = self._numerics.cleared(
+                self._numerics_state)
+            raise
+        if cleared is not None:
+            self._numerics_state = cleared
+
     def _dispatch_train_step(self, batch: Any):
         """Route one globalized batch through whichever step executor this
         engine built (offload / NVMe / 1-bit / plain jit) — the body
         ``train_batch`` wraps in its span. Returns (loss, StepStats)."""
-        with self._obs.span("train_batch/dispatch"):
+        from ..utils.compat import pipeline_partitioner
+
+        with self._obs.span("train_batch/dispatch"), \
+                pipeline_partitioner(self.model.pipelined):
             if self._param_offload is not None:
                 # host-driven segmented step: params stream through HBM per
                 # layer block (runtime/param_offload.py)
@@ -1055,9 +1140,12 @@ class TrainEngine:
                     self.params, self.opt_state, self.scaler_state,
                     self._comp_state, batch)
             else:
-                (self.params, self.opt_state, self.scaler_state, loss,
-                 stats) = self._compiled_step(self.params, self.opt_state,
-                                              self.scaler_state, batch)
+                if self._numerics is not None and self._numerics_state is None:
+                    self._numerics_state = self._numerics.init_state()
+                (self.params, self.opt_state, self.scaler_state,
+                 self._numerics_state, loss, stats) = self._compiled_step(
+                    self.params, self.opt_state, self.scaler_state,
+                    self._numerics_state, batch)
         return loss, stats
 
     def _compression_wrap(self, fn):
@@ -1254,8 +1342,11 @@ class TrainEngine:
         self._ensure_eval_step()
         if built:
             self._register_eval_audit(batch)
+        from ..utils.compat import pipeline_partitioner
+
         with mesh_mod.ambient(self.mesh):
-            with self._obs.span("eval", step=self.global_steps):
+            with self._obs.span("eval", step=self.global_steps), \
+                    pipeline_partitioner(self.model.pipelined):
                 return self._eval_step(self.params, batch)
 
     def _ensure_eval_step(self) -> None:
@@ -1404,8 +1495,12 @@ class TrainEngine:
                         abstract_tree(self._comp_state), batch_sds)
                 donate = (0, 1, 3)
             else:
+                # the numerics-state slot exists even with the sentinel off
+                # (None = empty pytree), mirroring the step signature
+                num_sds = (abstract_tree(self._numerics.init_state())
+                           if self._numerics is not None else None)
                 args = (params_sds, abstract_tree(self.opt_state),
-                        abstract_tree(self.scaler_state), batch_sds)
+                        abstract_tree(self.scaler_state), num_sds, batch_sds)
                 donate = (0, 1)
             name = f"{prefix}/step"
             wself = weakref.ref(self)
@@ -1563,6 +1658,71 @@ class TrainEngine:
                 peak_flops=peak_flops_for(kind), source=source)
         except Exception:  # telemetry must never take the engine down
             logger.warning("goodput workload wiring failed", exc_info=True)
+
+    # -- fleet health ------------------------------------------------------
+    def _wire_fleet_health(self) -> None:
+        """Wire the optional per-replica param-checksum probe into the fleet
+        monitor. ZeRO ≤ 2 only: stage 3 shards the params over 'data', so
+        replica copies (the thing SDC corrupts divergently) don't exist."""
+        if not self.config.observability.fleet_param_checksum:
+            return
+        if self.config.zero_stage >= 3 or self.params is None:
+            logger.warning(
+                "observability.fleet_param_checksum needs replicated "
+                "parameter copies (ZeRO stage <= 2, resident params) — "
+                "disabling the checksum probe; loss/grad-norm agreement "
+                "still checks")
+            return
+        try:
+            from ..observability import build_replica_checksum_probe
+
+            probe = build_replica_checksum_probe(self.mesh,
+                                                 self.plan.param_specs)
+
+            def checksum():
+                with mesh_mod.ambient(self.mesh):
+                    return probe(self.params)
+
+            self._obs.fleet.set_checksum_fn(checksum)
+            self._register_fleet_probe_audit(probe)
+        except Exception:  # telemetry must never take the engine down
+            logger.warning("fleet checksum probe wiring failed",
+                           exc_info=True)
+
+    def _register_fleet_probe_audit(self, probe) -> None:
+        """Declare the checksum probe's program to tpuaudit: its only
+        collective is the psum over the non-data axes (none on a pure-DP
+        mesh)."""
+        try:
+            from tools.tpuaudit.registry import (StaleEntryError,
+                                                 abstract_tree,
+                                                 register_entry_point)
+        except ImportError:
+            return
+        try:
+            import weakref
+
+            wself = weakref.ref(self)
+            args = (abstract_tree(self.params),)
+
+            def build():
+                eng = wself()
+                if eng is None:
+                    raise StaleEntryError(
+                        "train/fleet_probe: engine was torn down")
+                return probe, args, {}
+
+            non_data = any(self.mesh.shape[a] > 1
+                           for a in self.mesh.axis_names
+                           if a != mesh_mod.DATA_AXIS)
+            register_entry_point(
+                "train/fleet_probe", build=build, donate_argnums=(),
+                expected_collectives=(frozenset({"all-reduce"}) if non_data
+                                      else frozenset()),
+                mesh=self.mesh, tags={"engine": "TrainEngine"})
+        except Exception:
+            logger.warning("fleet probe audit registration failed",
+                           exc_info=True)
 
     # -- monitor ----------------------------------------------------------
     def _publish_metrics(self, loss: float, grad_norm: float) -> None:

@@ -6,6 +6,7 @@ The codebase targets the modern spelling (``jax.shard_map`` with
 Route every call site through here so the tree runs on both.
 """
 
+import contextlib
 import inspect
 from typing import Optional, Set
 
@@ -44,3 +45,32 @@ def shard_map(f, *, mesh, in_specs, out_specs,
             kwargs["auto"] = frozenset(mesh.axis_names) - frozenset(axis_names)
     return _shard_map(f, mesh=mesh, in_specs=in_specs,
                       out_specs=out_specs, **kwargs)
+
+
+@contextlib.contextmanager
+def pipeline_partitioner(enable: bool = True):
+    """Compile-scope context for pipelined (partial-manual shard_map)
+    programs: the classic GSPMD partitioner hard-crashes on ``lax.scan``
+    inside a manual-subgroup region when any automatic mesh axis is >1
+    (``hlo_sharding_util.cc Check failed: sharding.IsManualSubgroup()`` on
+    jaxlib 0.4.x CPU — the pipelined step only ever ran from the persistent
+    compile cache), while the shardy partitioner compiles it correctly. The
+    engine enters this around every pipelined-program compile/dispatch;
+    ``enable=False`` (non-pipelined engines) is a no-op, and so is a jax
+    without the flag.
+    """
+    if not enable:
+        yield
+        return
+    state = None
+    try:
+        from jax._src import config as _jax_config
+
+        state = _jax_config.use_shardy_partitioner
+    except Exception:
+        state = None
+    if state is None:
+        yield
+        return
+    with state(True):
+        yield

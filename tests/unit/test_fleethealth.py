@@ -1,0 +1,569 @@
+"""Fleet health + numerics sentinel tests.
+
+Multi-rank behavior is tested single-process: the gather is injectable
+(``gather_fn``), so a fake fleet table stands in for N processes, and
+in-process data-parallel replicas over the 8 virtual CPU devices exercise
+the replica-checksum divergence path with a genuinely corrupted replica
+buffer (the SDC the sentinel exists for).
+"""
+
+import glob
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models import simple_model
+from deepspeed_tpu.observability import (FleetHealthMonitor, NumericsTrip,
+                                         get_session, reset_session)
+from deepspeed_tpu.observability.flightrecorder import FlightRecorder
+from deepspeed_tpu.observability.metrics import MetricsRegistry
+from deepspeed_tpu.observability import numerics as numerics_mod
+
+
+@pytest.fixture(autouse=True)
+def _fresh_session():
+    yield
+    reset_session()
+
+
+def _obs_cfg(tmp_path, **over):
+    cfg = {"enabled": True, "output_dir": str(tmp_path / "obs"),
+           "flight_dump_dir": str(tmp_path / "crash")}
+    cfg.update(over)
+    return cfg
+
+
+def _engine(tmp_path, obs=None, hidden=10, micro=4, zero=0):
+    model = simple_model(hidden_dim=hidden)
+    cfg = {"train_micro_batch_size_per_gpu": micro,
+           "steps_per_print": 10 ** 9,
+           "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+           "zero_optimization": {"stage": zero}}
+    if obs is not None:
+        cfg["observability"] = obs
+    engine, *_ = deepspeed_tpu.initialize(model=model, config=cfg)
+    return engine
+
+
+def _batch(engine, hidden=10, nan=False, seed=0):
+    gb = engine.train_batch_size()
+    rng = np.random.RandomState(seed)
+    x = rng.randn(1, gb, hidden).astype(np.float32)
+    y = rng.randn(1, gb, 1).astype(np.float32)
+    if nan:
+        x[0, 0, 0] = np.nan
+    return {"x": x, "y": y}
+
+
+# ---------------------------------------------------------------------------
+# numerics: device-half unit behavior
+# ---------------------------------------------------------------------------
+
+
+class TestNumericsObserve:
+    def test_clean_step_no_flags(self):
+        st = numerics_mod.init_state()
+        st, tripped = numerics_mod.observe(st, jnp.float32(1.0),
+                                           {"g": jnp.ones((3,))})
+        assert not bool(tripped)
+        assert int(st.flags) == 0 and int(st.trip_step) == -1
+        assert float(st.ema_loss) == pytest.approx(1.0)
+
+    def test_nonfinite_loss_and_grads_flagged(self):
+        st = numerics_mod.init_state()
+        st, tripped = numerics_mod.observe(
+            st, jnp.float32("nan"), {"g": jnp.array([1.0, jnp.inf])})
+        assert bool(tripped)
+        flags = int(st.flags)
+        assert flags & numerics_mod.NONFINITE_LOSS
+        assert flags & numerics_mod.NONFINITE_GRADS
+        assert int(st.trip_step) == 0
+        assert numerics_mod.describe_flags(flags) == \
+            "nonfinite-loss+nonfinite-grads"
+
+    def test_nan_does_not_poison_ema(self):
+        st = numerics_mod.init_state()
+        st, _ = numerics_mod.observe(st, jnp.float32(2.0), {})
+        st, _ = numerics_mod.observe(st, jnp.float32("nan"), {})
+        assert float(st.ema_loss) == pytest.approx(2.0)
+
+    def test_loss_spike_after_warmup(self):
+        st = numerics_mod.init_state()
+        for _ in range(3):
+            st, tripped = numerics_mod.observe(st, jnp.float32(1.0), {},
+                                               spike_factor=3.0,
+                                               spike_warmup=2)
+            assert not bool(tripped)
+        st, tripped = numerics_mod.observe(st, jnp.float32(100.0), {},
+                                           spike_factor=3.0, spike_warmup=2)
+        assert bool(tripped)
+        assert int(st.flags) & numerics_mod.LOSS_SPIKE
+
+    def test_spike_disarmed_during_warmup(self):
+        st = numerics_mod.init_state()
+        st, tripped = numerics_mod.observe(st, jnp.float32(100.0), {},
+                                           spike_factor=3.0, spike_warmup=5)
+        assert not bool(tripped)
+
+    def test_warmup_zero_first_step_not_a_spike(self):
+        # spike arming requires a SEEDED ema: with warmup=0 the first
+        # positive loss must not trip against the unseeded 0.0 reference
+        st = numerics_mod.init_state()
+        st, tripped = numerics_mod.observe(st, jnp.float32(5.0), {},
+                                           spike_factor=2.0, spike_warmup=0)
+        assert not bool(tripped)
+        st, tripped = numerics_mod.observe(st, jnp.float32(50.0), {},
+                                           spike_factor=2.0, spike_warmup=0)
+        assert bool(tripped)
+        assert int(st.flags) & numerics_mod.LOSS_SPIKE
+
+    def test_nonfinite_first_loss_does_not_seed_ema(self):
+        st = numerics_mod.init_state()
+        st, _ = numerics_mod.observe(st, jnp.float32("nan"), {})
+        assert int(st.steps) == 0           # finite-loss counter
+        st, _ = numerics_mod.observe(st, jnp.float32(3.0), {})
+        assert float(st.ema_loss) == pytest.approx(3.0)  # seeded directly
+
+    def test_fp16_overflow_suppresses_grads_bit(self):
+        # the DynamicLossScaler's periodic inf grads are its own backoff
+        # signal, not a numerics fault — the engine passes overflow as
+        # suppress_grads
+        st = numerics_mod.init_state()
+        st, tripped = numerics_mod.observe(
+            st, jnp.float32(1.0), {"g": jnp.array([1.0, jnp.inf])},
+            suppress_grads=jnp.bool_(True))
+        assert not bool(tripped) and int(st.flags) == 0
+        # a nonfinite LOSS still trips even under suppression
+        st, tripped = numerics_mod.observe(
+            st, jnp.float32("nan"), {"g": jnp.array([jnp.inf])},
+            suppress_grads=jnp.bool_(True))
+        assert bool(tripped)
+        assert int(st.flags) == numerics_mod.NONFINITE_LOSS
+
+
+# ---------------------------------------------------------------------------
+# numerics: engine integration, three actions
+# ---------------------------------------------------------------------------
+
+
+class TestNumericsEngine:
+    def test_warn_action_trips_and_dumps_bundle(self, tmp_path):
+        engine = _engine(tmp_path, _obs_cfg(
+            tmp_path, numerics_sentinel=True, numerics_action="warn",
+            numerics_check_steps=1))
+        engine.train_batch(batch=_batch(engine))
+        obs = get_session()
+        assert obs.numerics.trips == 0
+        engine.train_batch(batch=_batch(engine, nan=True))
+        assert obs.numerics.trips == 1
+        trip = obs.numerics.last_trip
+        assert "nonfinite" in trip["trip_kind"]
+        bundles = glob.glob(str(tmp_path / "crash" / "*numerics*"))
+        assert bundles, "numerics trip must dump a flight-record bundle"
+        man = json.load(open(os.path.join(bundles[0], "MANIFEST.json")))
+        assert man["reason"] == "numerics"
+        assert man["extra"]["culprit_rank"] == 0
+        assert man["extra"]["step"] == 2
+        # warn does NOT protect the params: the NaN update landed, so the
+        # next (clean-data) step is genuinely non-finite and re-trips
+        engine.train_batch(batch=_batch(engine))
+        assert obs.numerics.trips == 2
+
+    def test_skip_step_action_preserves_params(self, tmp_path):
+        engine = _engine(tmp_path, _obs_cfg(
+            tmp_path, numerics_sentinel=True, numerics_action="skip_step",
+            numerics_check_steps=1))
+        engine.train_batch(batch=_batch(engine))
+        before = jax.device_get(engine.params)
+        engine.train_batch(batch=_batch(engine, nan=True))
+        after = jax.device_get(engine.params)
+        jax.tree.map(np.testing.assert_array_equal, before, after)
+        assert get_session().numerics.trips == 1
+        # flags cleared after handling: the skipped update kept params
+        # finite, so a clean step does not re-trip — and updates params
+        engine.train_batch(batch=_batch(engine))
+        assert get_session().numerics.trips == 1
+        after2 = jax.device_get(engine.params)
+        w2 = np.asarray(after2["head"]["w"])
+        assert np.isfinite(w2).all()
+        assert not np.allclose(w2, np.asarray(after["head"]["w"]))
+
+    def test_warn_action_does_not_skip(self, tmp_path):
+        engine = _engine(tmp_path, _obs_cfg(
+            tmp_path, numerics_sentinel=True, numerics_action="warn",
+            numerics_check_steps=1))
+        engine.train_batch(batch=_batch(engine))
+        engine.train_batch(batch=_batch(engine, nan=True))
+        after = jax.device_get(engine.params)
+        assert not np.isfinite(np.asarray(after["head"]["w"])).all()
+
+    def test_abort_action_raises(self, tmp_path):
+        engine = _engine(tmp_path, _obs_cfg(
+            tmp_path, numerics_sentinel=True, numerics_action="abort",
+            numerics_check_steps=1))
+        engine.train_batch(batch=_batch(engine))
+        with pytest.raises(NumericsTrip) as exc:
+            engine.train_batch(batch=_batch(engine, nan=True))
+        assert "nonfinite" in str(exc.value)
+        assert exc.value.bundle and os.path.isdir(exc.value.bundle)
+        # the handled flags were cleared on the raise path: session close
+        # must NOT re-report the same trip with a duplicate bundle
+        obs = get_session()
+        assert int(engine._numerics_state.flags) == 0
+        trips_before = obs.numerics.trips
+        bundles_before = len(glob.glob(str(tmp_path / "crash" / "*")))
+        reset_session()
+        assert obs.numerics.trips == trips_before
+        assert len(glob.glob(str(tmp_path / "crash" / "*"))) == \
+            bundles_before
+
+    def test_happy_path_no_sync_no_extra_dispatch(self, tmp_path):
+        """The sentinel must be FUSED: one executable dispatch per step, no
+        recompile after warmup, and zero host materialisations between
+        cadence checks."""
+        engine = _engine(tmp_path, _obs_cfg(
+            tmp_path, numerics_sentinel=True, numerics_action="warn",
+            numerics_check_steps=100))
+        batch = _batch(engine)
+        # two warmup steps: the first compiles the step, the second the tiny
+        # skipped-counter accumulation op (pre-existing, sentinel-unrelated)
+        engine.train_batch(batch=batch)
+        engine.train_batch(batch=batch)
+        obs = get_session()
+        compiled = engine._compiled_step
+        calls = []
+
+        def counting_step(*args):
+            calls.append(1)
+            return compiled(*args)
+
+        engine._compiled_step = counting_step
+        compiles_before = sum(
+            obs.registry.counter("xla/compiles").series().values())
+        for _ in range(3):
+            engine.train_batch(batch=batch)
+        compiles_after = sum(
+            obs.registry.counter("xla/compiles").series().values())
+        assert len(calls) == 3          # exactly ONE dispatch per step
+        assert compiles_after == compiles_before   # no re-specialisation
+        assert obs.numerics.checks == 0  # no host sync before the cadence
+        # the pending flag stays a lazy device value on the happy path
+        assert isinstance(engine._numerics_state.flags, jax.Array)
+
+    def test_final_window_trip_flushed_on_close(self, tmp_path):
+        """A trip AFTER the last cadence check must still be reported when
+        the session closes — the silent-NaN-exit the sentinel exists for."""
+        engine = _engine(tmp_path, _obs_cfg(
+            tmp_path, numerics_sentinel=True, numerics_action="warn",
+            numerics_check_steps=100))
+        engine.train_batch(batch=_batch(engine, nan=True))
+        obs = get_session()
+        assert obs.numerics.trips == 0     # cadence (step 100) never hit
+        reset_session()                    # closes the session -> flush
+        assert obs.numerics.trips == 1
+        assert glob.glob(str(tmp_path / "crash" / "*numerics*"))
+        del engine
+
+    def test_check_runs_at_cadence(self, tmp_path):
+        engine = _engine(tmp_path, _obs_cfg(
+            tmp_path, numerics_sentinel=True, numerics_action="warn",
+            numerics_check_steps=3))
+        batch = _batch(engine)
+        for _ in range(6):
+            engine.train_batch(batch=batch)
+        assert get_session().numerics.checks == 2   # steps 3 and 6
+
+
+# ---------------------------------------------------------------------------
+# fleet: straggler + divergence on injected gathers (fake fleet)
+# ---------------------------------------------------------------------------
+
+
+def _fake_table(world=4, step_time=0.1, overrides=None):
+    from deepspeed_tpu.observability.fleethealth import HEALTH_STATS
+
+    table = np.zeros((world, len(HEALTH_STATS)))
+    table[:, 0] = step_time         # rolling median
+    table[:, 1] = step_time         # last
+    table[:, 2] = 1.5               # loss
+    table[:, 3] = 0.7               # grad_norm
+    for (stat, rank), value in (overrides or {}).items():
+        table[rank, HEALTH_STATS.index(stat)] = value
+    return table
+
+
+class TestStragglerDetection:
+    def _monitor(self, tmp_path, table, **kw):
+        reg = MetricsRegistry()
+        rec = FlightRecorder(dump_dir=str(tmp_path / "crash"))
+        mon = FleetHealthMonitor(
+            registry=reg, recorder=rec, cadence_steps=10,
+            straggler_factor=2.0, gather_fn=lambda vec: table,
+            rank=0, world=table.shape[0], **kw)
+        return mon, reg, rec
+
+    def test_fake_clock_straggler_flagged(self, tmp_path):
+        # rank 2's injected delay: 10x the fleet median step time
+        table = _fake_table(world=4, overrides={("step_time_median_s", 2): 1.0,
+                                       ("step_time_last_s", 2): 1.0})
+        mon, reg, rec = self._monitor(tmp_path, table)
+        mon.note_step_time(0.1)
+        summary = mon.aggregate(10)
+        assert summary["straggler_rank"] == 2
+        assert reg.gauge("fleet/straggler_rank").value() == 2
+        assert reg.counter("fleet/straggler_events").value(rank=2) == 1
+        kinds = [e["kind"] for e in rec.snapshot()]
+        assert "straggler" in kinds
+        assert mon.last_straggler_rank == 2
+
+    def test_no_straggler_publishes_minus_one(self, tmp_path):
+        mon, reg, _ = self._monitor(tmp_path, _fake_table(world=4))
+        mon.aggregate(10)
+        assert reg.gauge("fleet/straggler_rank").value() == -1
+        assert mon.straggler_events == 0
+
+    def test_fleet_aggregates_published(self, tmp_path):
+        table = _fake_table(world=4, overrides={("step_time_median_s", 3): 0.2})
+        mon, reg, _ = self._monitor(tmp_path, table)
+        mon.aggregate(10)
+        g = reg.gauge("fleet/step_time_median_s")
+        assert g.value(agg="min") == pytest.approx(0.1)
+        assert g.value(agg="max") == pytest.approx(0.2)
+        assert g.value(agg="skew") == pytest.approx(1.0)  # (0.2-0.1)/0.1
+        for r in range(4):
+            assert reg.gauge("fleet/rank_step_time_s").value(rank=r) \
+                is not None
+        assert reg.gauge("fleet/world").value() == 4
+
+    def test_cadence_gating(self, tmp_path):
+        mon, _, _ = self._monitor(tmp_path, _fake_table())
+        assert not mon.note_step(7)
+        assert mon.aggregations == 0
+        assert mon.note_step(20)
+        assert mon.aggregations == 1
+
+    def test_divergent_loss_dumps_bundle_naming_rank(self, tmp_path):
+        table = _fake_table(world=4, overrides={("loss", 1): 9.0})
+        mon, reg, rec = self._monitor(tmp_path, table)
+        summary = mon.aggregate(30)
+        assert summary["divergence"][0]["culprit_rank"] == 1
+        assert reg.counter("fleet/divergence_events").value(stat="loss") == 1
+        assert rec.dumps, "divergence must dump a bundle"
+        man = json.load(open(os.path.join(rec.dumps[0], "MANIFEST.json")))
+        assert man["reason"] == "divergence"
+        assert man["extra"]["culprit_rank"] == 1
+        assert man["extra"]["step"] == 30
+        assert man["extra"]["stat"] == "loss"
+
+    def test_agreeing_fleet_no_divergence(self, tmp_path):
+        mon, _, rec = self._monitor(tmp_path, _fake_table(world=4))
+        mon.aggregate(10)
+        assert mon.divergence_events == 0 and not rec.dumps
+
+    def test_nonzero_rank_counts_but_does_not_dump(self, tmp_path):
+        """Every rank sees the same gathered table; only rank 0 dumps and
+        logs — N identical bundles per incident would not scale."""
+        table = _fake_table(world=4, overrides={("loss", 1): 9.0})
+        reg = MetricsRegistry()
+        rec = FlightRecorder(dump_dir=str(tmp_path / "crash"))
+        mon = FleetHealthMonitor(registry=reg, recorder=rec,
+                                 gather_fn=lambda v: table, rank=3, world=4)
+        mon.aggregate(10)
+        assert mon.divergence_events == 1
+        assert reg.counter("fleet/divergence_events").value(stat="loss") == 1
+        assert not rec.dumps                      # rank 3 stays quiet
+        assert any(e["kind"] == "divergence" for e in rec.snapshot())
+
+    def test_persistent_divergence_dumps_one_bundle(self, tmp_path):
+        """Counters keep counting every cadence, but a persistent (same
+        stat, same culprit) divergence writes only the FIRST bundle."""
+        table = _fake_table(world=4, overrides={("loss", 1): 9.0})
+        mon, reg, rec = self._monitor(tmp_path, table)
+        mon.aggregate(10)
+        mon.aggregate(20)
+        mon.aggregate(30)
+        assert mon.divergence_events == 3
+        assert reg.counter("fleet/divergence_events").value(stat="loss") == 3
+        assert len(rec.dumps) == 1
+        # a DIFFERENT culprit still gets its own bundle
+        table2 = _fake_table(world=4, overrides={("loss", 2): 9.0})
+        mon.gather_fn = lambda vec: table2
+        mon.aggregate(40)
+        assert len(rec.dumps) == 2
+
+    def test_hang_context_names_missing_rank(self, tmp_path):
+        seen = {}
+
+        def gather(vec):
+            seen.update(mon.hang_context())
+            return _fake_table(world=2)
+
+        reg = MetricsRegistry()
+        mon = FleetHealthMonitor(registry=reg, gather_fn=gather,
+                                 rank=0, world=2)
+        mon.last_straggler_rank = 1
+        mon.aggregate(40)
+        assert seen["in_fleet_gather"] is True
+        assert seen["fleet_gather_step"] == 40
+        assert "rank 1 never arrived" in seen["note"]
+        assert mon.hang_context()["in_fleet_gather"] is False
+
+    def test_gather_failure_never_raises(self, tmp_path):
+        def broken(vec):
+            raise RuntimeError("gather transport down")
+
+        mon = FleetHealthMonitor(registry=MetricsRegistry(),
+                                 gather_fn=broken, rank=0, world=2)
+        assert mon.note_step(10) is False   # swallowed, logged
+
+
+# ---------------------------------------------------------------------------
+# fleet: real replica divergence on the CPU mesh (corrupted replica buffer)
+# ---------------------------------------------------------------------------
+
+
+class TestReplicaChecksumDivergence:
+    def test_corrupted_replica_named(self, tmp_path):
+        engine = _engine(tmp_path, _obs_cfg(
+            tmp_path, fleet_health=True, fleet_cadence_steps=2,
+            fleet_param_checksum=True), zero=0)
+        obs = get_session()
+        assert obs.fleet is not None and obs.fleet._checksum_fn is not None
+        batch = _batch(engine)
+        engine.train_batch(batch=batch)
+        engine.train_batch(batch=batch)     # cadence step: clean fleet
+        assert obs.fleet.aggregations == 1
+        assert obs.fleet.divergence_events == 0
+
+        # simulate SDC: corrupt ONE data-parallel replica's copy of a
+        # replicated param (per-device buffers of a replicated jax.Array)
+        leaf = engine.params["linear_0"]["w"]
+        culprit_dev = engine.mesh.devices[0, 0, 3, 0, 0]   # data index 3
+        shards = []
+        for shard in leaf.addressable_shards:
+            buf = np.array(shard.data)
+            if shard.device == culprit_dev:
+                buf[0, 0] += 100.0
+            shards.append(jax.device_put(buf, shard.device))
+        engine.params["linear_0"]["w"] = \
+            jax.make_array_from_single_device_arrays(
+                leaf.shape, leaf.sharding, shards)
+
+        summary = obs.fleet.aggregate(4)
+        div = summary["divergence"]
+        assert div and div[0]["stat"] == "param_checksum"
+        # a data-axis REPLICA index, deliberately not labeled a rank
+        assert div[0]["culprit_replica"] == 3
+        bundles = glob.glob(str(tmp_path / "crash" / "*divergence*"))
+        assert bundles
+        man = json.load(open(os.path.join(bundles[0], "MANIFEST.json")))
+        assert man["extra"]["culprit_replica"] == 3
+        assert man["extra"]["step"] == 4
+
+    def test_checksum_probe_refused_for_zero3(self, tmp_path):
+        engine = _engine(tmp_path, _obs_cfg(
+            tmp_path, fleet_health=True, fleet_param_checksum=True), zero=3)
+        assert get_session().fleet._checksum_fn is None
+        del engine
+
+
+# ---------------------------------------------------------------------------
+# disabled-path wiring + report CLI
+# ---------------------------------------------------------------------------
+
+
+class TestWiring:
+    def test_disabled_gates_wire_nothing(self, tmp_path):
+        engine = _engine(tmp_path, _obs_cfg(tmp_path))
+        obs = get_session()
+        assert obs.fleet is None and obs.numerics is None
+        assert engine._numerics is None and engine._numerics_state is None
+        if obs.hang is not None:
+            assert obs.hang.context_fn is None
+        # the step runs with an empty numerics slot
+        engine.train_batch(batch=_batch(engine))
+        assert engine._numerics_state is None
+
+    def test_fully_disabled_session(self, tmp_path):
+        engine = _engine(tmp_path, obs=None)
+        obs = engine._obs
+        assert not obs.enabled
+        assert obs.fleet is None and obs.numerics is None
+        engine.train_batch(batch=_batch(engine))
+
+    def test_engine_fleet_note_step_cadence(self, tmp_path):
+        engine = _engine(tmp_path, _obs_cfg(
+            tmp_path, fleet_health=True, fleet_cadence_steps=2))
+        obs = get_session()
+        batch = _batch(engine)
+        for _ in range(4):
+            engine.train_batch(batch=batch)
+        assert obs.fleet.aggregations == 2
+        # the engine's loss/grad-norm made it into the fleet table
+        assert obs.registry.gauge("fleet/loss").value(agg="median") \
+            is not None
+        assert obs.registry.gauge("fleet/grad_norm").value(agg="median") \
+            is not None
+
+
+class TestReportCLI:
+    def _dump(self, tmp_path, reg):
+        path = str(tmp_path / "metrics.jsonl")
+        reg.dump_jsonl(path)
+        return path
+
+    def test_fleet_section(self, tmp_path, capsys):
+        from deepspeed_tpu.observability.report import main
+
+        table = _fake_table(world=4, overrides={("step_time_median_s", 2): 1.0})
+        reg = MetricsRegistry()
+        mon = FleetHealthMonitor(registry=reg, gather_fn=lambda v: table,
+                                 rank=0, world=4)
+        mon.aggregate(10)
+        rc = main([self._dump(tmp_path, reg)])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "== fleet ==" in out and "ranks=4" in out
+        assert "step_time skew" in out
+        assert "!! straggler: rank 2" in out
+        assert "straggler incidents [rank 2]: 1" in out
+        # four per-rank rows
+        for r in range(4):
+            assert f"\n{r} " in out or out.count(f"{r}  ") >= 1
+
+    def test_no_fleet_records_no_section(self, tmp_path, capsys):
+        from deepspeed_tpu.observability.report import main
+
+        reg = MetricsRegistry()
+        reg.gauge("Train/Samples/train_loss").set(1.0)
+        main([self._dump(tmp_path, reg)])
+        assert "== fleet ==" not in capsys.readouterr().out
+
+    def test_crash_dump_surfaces_culprit_rank(self, tmp_path, capsys):
+        from deepspeed_tpu.observability.report import main
+
+        rec = FlightRecorder(dump_dir=str(tmp_path / "crash"))
+        bundle = rec.dump(reason="divergence",
+                          extra={"culprit_rank": 5, "step": 12,
+                                 "stat": "grad_norm"})
+        rc = main(["--crash-dump", bundle])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "culprit: rank 5 (grad_norm, step 12)" in out
+
+    def test_crash_dump_fleet_gather_note(self, tmp_path, capsys):
+        from deepspeed_tpu.observability.report import main
+
+        rec = FlightRecorder(dump_dir=str(tmp_path / "crash"))
+        bundle = rec.dump(reason="hang", extra={
+            "in_fleet_gather": True, "fleet_gather_step": 30,
+            "note": "blocked in the step-30 fleet gather — rank 2 never "
+                    "arrived"})
+        main(["--crash-dump", bundle])
+        out = capsys.readouterr().out
+        assert "rank 2 never arrived" in out
