@@ -6,6 +6,15 @@ Prints ONE JSON line:
     {"metric": ..., "value": <p50 TTFT ms>, "unit": "ms",
      "decode_tokens_per_sec": ..., "roofline_frac": ..., "vs_baseline": ...}
 
+``--serving`` (or BENCH_INFER_MODE=serving): continuous-batching load test
+instead — synthetic Poisson arrivals with mixed prompt lengths through
+``ServingEngine`` (deepspeed_tpu/serving), reporting TTFT p50/p99, time per
+output token, tokens/s and arena occupancy, with the serving/* metrics
+dumped to BENCH_metrics_serve.jsonl. Knobs (env): BENCH_SERVE_REQUESTS,
+BENCH_SERVE_RATE (req/s), BENCH_SERVE_PROMPT (max prompt len),
+BENCH_SERVE_NEW, BENCH_SERVE_ROWS, BENCH_SERVE_BLOCK, BENCH_SERVE_BLOCKS,
+BENCH_SERVE_LEN, BENCH_SERVE_CHUNK.
+
 Decode is HBM-bandwidth-bound: the roofline is
     BW / (param_bytes + live-KV bytes per token);
 ``vs_baseline`` reports achieved/roofline — 1.0 == the chip's memory system
@@ -116,10 +125,13 @@ def main() -> None:
 
     param_bytes = sum(int(p.size) * p.dtype.itemsize
                       for p in jax.tree.leaves(engine.params))
-    # live KV read per decode token (valid region ~ prompt + half the gen)
+    # live KV read per decode token (valid region ~ prompt + half the gen);
+    # sized at the ENGINE's arena dtype — the roofline denominator must not
+    # silently assume bf16 for an fp16/fp32 engine
+    from deepspeed_tpu.inference import cache_memory_bytes
+
     live = prompt_len + n_new // 2
-    kv_bytes = (2 * cfg.num_layers * live * cfg.num_kv_heads * cfg.head_dim
-                * jnp.dtype(jnp.bfloat16).itemsize)
+    kv_bytes = cache_memory_bytes(cfg, 1, live, engine.config.dtype)
     roofline_tps = hbm_bandwidth() / (param_bytes + kv_bytes)
     frac = decode_tps / roofline_tps
 
@@ -145,14 +157,135 @@ def main() -> None:
     }))
 
 
+def serving_main() -> None:
+    """Continuous-batching load test: Poisson arrivals over a synthetic
+    request trace, real-time injected between scheduler iterations."""
+    import numpy as np
+
+    model_name = os.environ.get("BENCH_INFER_MODEL", "llama-7b")
+    dtype_name = os.environ.get("BENCH_INFER_DTYPE", "bf16")
+    n_requests = int(os.environ.get("BENCH_SERVE_REQUESTS", 32))
+    rate = float(os.environ.get("BENCH_SERVE_RATE", 8.0))      # req/s
+    prompt_max = int(os.environ.get("BENCH_SERVE_PROMPT", 256))
+    n_new = int(os.environ.get("BENCH_SERVE_NEW", 32))
+    rows = int(os.environ.get("BENCH_SERVE_ROWS", 8))
+    block = int(os.environ.get("BENCH_SERVE_BLOCK", 16))
+    max_len = int(os.environ.get("BENCH_SERVE_LEN", prompt_max + n_new))
+    max_len = -(-max_len // block) * block      # whole-block budget
+    num_blocks = int(os.environ.get("BENCH_SERVE_BLOCKS",
+                                    rows * (max_len // block) * 3 // 4))
+    chunk = int(os.environ.get("BENCH_SERVE_CHUNK", max(block, 64)))
+    chunk = -(-chunk // block) * block
+
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.serving import ServingConfig, init_serving
+
+    dtype = jnp.bfloat16 if dtype_name == "bf16" else dtype_name
+    metric = f"{model_name}_{dtype_name}_serving_p50_ttft_ms"
+    try:
+        srv = init_serving(
+            model_name, dtype=dtype,
+            serving_config=ServingConfig(
+                block_size=block, num_blocks=num_blocks, max_seqs=rows,
+                max_model_len=max_len, prefill_chunk=chunk,
+                max_queue=max(2 * n_requests, 64)))
+        cfg = srv.engine.model.config
+        rng = np.random.RandomState(0)
+        # mixed lengths: uniform over [prompt_max/4, prompt_max]
+        lens = rng.randint(max(prompt_max // 4, 1), prompt_max + 1,
+                           size=n_requests)
+        prompts = [rng.randint(0, cfg.vocab_size, (int(n),)) for n in lens]
+        arrivals = np.cumsum(rng.exponential(1.0 / rate, size=n_requests))
+        # warmup: compile both serving programs off the clock, BEFORE the
+        # observability session exists — otherwise its compile-scale TTFT
+        # would land in the serving/ttft_ms histogram the report renders
+        srv.submit(prompts[0][: max(block, 8)], max_new_tokens=2).result()
+    except Exception as e:  # noqa: BLE001 — structured OOM record
+        msg = str(e)
+        if "RESOURCE_EXHAUSTED" in msg or "out of memory" in msg.lower():
+            print(json.dumps({
+                "metric": metric, "value": None, "unit": "ms",
+                "vs_baseline": None, "oom": True, "reason": msg[-300:],
+            }))
+        raise
+
+    if os.environ.get("BENCH_OBS", "1") == "1":
+        from deepspeed_tpu.config.config import ObservabilityConfig
+        from deepspeed_tpu.observability import configure_observability
+
+        configure_observability(ObservabilityConfig(
+            enabled=True,
+            output_dir=os.environ.get("BENCH_OBS_DIR",
+                                      "bench_results/obs_serve")))
+    srv.reset_latency_stats()   # tokens/s + p50/p99 describe the load only
+
+    t0 = time.perf_counter()
+    handles = []
+    i = 0
+    while i < n_requests or srv.in_flight():
+        # every srv.step() host-materializes its sampled tokens
+        # (np.asarray inside the iteration) — the clock reads below are
+        # fenced by construction, the linter just can't see through step()
+        now = time.perf_counter() - t0  # tpulint: disable=wallclock-timing-without-sync
+        while i < n_requests and arrivals[i] <= now:
+            handles.append(srv.submit(prompts[i], max_new_tokens=n_new))
+            i += 1
+        if srv.in_flight():
+            srv.step()
+        elif i < n_requests:
+            time.sleep(min(arrivals[i] - now, 0.01))
+    wall = time.perf_counter() - t0  # tpulint: disable=wallclock-timing-without-sync
+
+    from deepspeed_tpu.serving.api import _percentile as p
+
+    ttfts = sorted(h.ttft_s for h in handles)
+    tpots = sorted(h.tpot_s for h in handles if h.tpot_s is not None)
+    total_tokens = sum(len(h.tokens) for h in handles)
+
+    from deepspeed_tpu.observability import get_session
+
+    obs = get_session()
+    srv.close()   # publishes serving/ttft_p50_ms etc.
+    if obs.enabled:
+        obs.dump_metrics(path=os.environ.get("BENCH_METRICS_JSONL",
+                                             "BENCH_metrics_serve.jsonl"),
+                         metric=metric)
+        obs.export_chrome_trace()
+        obs.close(export=False)
+
+    print(json.dumps({
+        "metric": metric,
+        "value": round(p(ttfts, 0.50) * 1e3, 2),
+        "unit": "ms",
+        "p99_ttft_ms": round(p(ttfts, 0.99) * 1e3, 2),
+        "tpot_ms": round(p(tpots, 0.50) * 1e3, 3) if tpots else None,
+        "tokens_per_sec": round(total_tokens / wall, 1),
+        "requests_per_sec": round(len(handles) / wall, 2),
+        "arena_peak_blocks": srv.alloc.peak_in_use,
+        "arena_peak_occupancy": round(
+            srv.alloc.peak_in_use / srv.alloc.capacity, 4),
+        "preemptions": srv.sched.preemption_count,
+        "vs_baseline": None,
+    }))
+
+
 if __name__ == "__main__":
+    serving = ("--serving" in sys.argv[1:]
+               or os.environ.get("BENCH_INFER_MODE") == "serving")
     if os.environ.get("BENCH_CHILD") == "1":
-        main()
+        serving_main() if serving else main()
     else:
+        if serving:
+            # the watchdogged child re-runs this file argv-less; mode rides
+            # the environment
+            os.environ["BENCH_INFER_MODE"] = "serving"
         model = os.environ.get("BENCH_INFER_MODEL", "llama-7b")
         dtype = os.environ.get("BENCH_INFER_DTYPE", "bf16")
+        suffix = "serving_p50_ttft_ms" if serving else "p50_ttft_ms"
+        obs_dir = "bench_results/obs_serve" if serving \
+            else "bench_results/obs_infer"
         run_watchdogged(
-            f"{model}_{dtype}_p50_ttft_ms", "ms", os.path.abspath(__file__),
+            f"{model}_{dtype}_{suffix}", "ms", os.path.abspath(__file__),
             crash_dir=os.path.join(
-                os.environ.get("BENCH_OBS_DIR", "bench_results/obs_infer"),
-                "crash"))
+                os.environ.get("BENCH_OBS_DIR", obs_dir), "crash"))

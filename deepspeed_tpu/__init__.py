@@ -7,6 +7,7 @@ of CUDA. Public entry points mirror the reference (``deepspeed/__init__.py``):
 
   initialize()       -> (engine, optimizer, dataloader, lr_scheduler)
   init_inference()   -> InferenceEngine
+  init_serving()     -> ServingEngine (continuous batching, the MII analog)
   comm               -> named-axis collective API
 """
 
@@ -59,6 +60,16 @@ def init_inference(model=None, config=None, **kwargs):
     from .inference.engine import init_inference as _init_inference
 
     return _init_inference(model=model, config=config, **kwargs)
+
+
+def init_serving(model=None, serving_config=None, **kwargs):
+    """Continuous-batching serving front end (the MII/FastGen analog):
+    builds an inference engine and wraps it in a
+    ``serving.ServingEngine`` — paged KV arena, iteration-level scheduler,
+    streaming submit/stream API. See docs/serving.md."""
+    from .serving import init_serving as _init_serving
+
+    return _init_serving(model=model, serving_config=serving_config, **kwargs)
 
 
 def add_config_arguments(parser):

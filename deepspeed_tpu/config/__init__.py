@@ -5,7 +5,7 @@ from .config import (AIOConfig, ActivationCheckpointingConfig, BF16Config,
                      ElasticityConfig, FlopsProfilerConfig, FP16Config,
                      MonitorConfig, OffloadOptimizerConfig, OffloadParamConfig,
                      OptimizerConfig, ParallelConfig, SchedulerConfig,
-                     ZeroConfig, load_config)
+                     ServingConfig, ZeroConfig, load_config)
 
 __all__ = [
     "ConfigError", "ConfigModel", "Config", "load_config",
@@ -14,5 +14,5 @@ __all__ = [
     "ParallelConfig", "ActivationCheckpointingConfig", "CommsLoggerConfig",
     "FlopsProfilerConfig", "MonitorConfig", "ElasticityConfig",
     "CurriculumConfig", "DataEfficiencyConfig", "CompressionConfig",
-    "AIOConfig", "CheckpointConfig",
+    "AIOConfig", "CheckpointConfig", "ServingConfig",
 ]

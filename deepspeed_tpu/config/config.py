@@ -367,6 +367,78 @@ class ObservabilityConfig(ConfigModel):
 
 
 @dataclass
+class ServingConfig(ConfigModel):
+    """Continuous-batching serving layer (``deepspeed_tpu/serving``) — the
+    MII/FastGen analog: paged KV arena + iteration-level scheduler +
+    streaming front end. Every knob here is a STATIC shape parameter of the
+    two serving programs (prefill-chunk and decode), so changing one after
+    engine construction means a recompile — the jit-cache discipline the
+    whole layer is built around."""
+
+    block_size: int = 16               # KV tokens per arena block
+    num_blocks: int = 0                # allocatable blocks in the shared
+    #   pool (excluding the reserved scratch block); 0 => fully provisioned
+    #   (max_seqs × blocks-per-sequence — no sharing pressure, never
+    #   preempts). Undersize it deliberately to share HBM across requests;
+    #   the scheduler preempts by block eviction when the pool runs dry.
+    max_seqs: int = 8                  # decode batch rows (max concurrent
+    #   decoding sequences; admission is iteration-level — rows recycle)
+    max_model_len: int = 256           # per-sequence token budget
+    #   (prompt + generated); must split into whole blocks
+    prefill_chunk: int = 64            # tokens per prefill chunk — long
+    #   prompts prefill in chunks interleaved with decode steps so TTFT of
+    #   queued requests stays bounded (Sarathi/Orca-style chunked prefill);
+    #   must be a multiple of block_size so a chunk never strands a
+    #   partially-used block it can't finish
+    max_queue: int = 256               # backpressure: submit() beyond this
+    #   many in-flight (queued + running) requests raises
+    fairness: str = "fair"             # 'fair' (least-service tenant first,
+    #   EDF within a tenant) | 'fcfs' (arrival order)
+    default_max_new_tokens: int = 64
+    seed: int = 0                      # sampling stream seed
+
+    def blocks_per_seq(self) -> int:
+        return self.max_model_len // self.block_size
+
+    def pool_blocks(self) -> int:
+        """Allocatable pool size (0 => fully provisioned)."""
+        return (self.num_blocks if self.num_blocks
+                else self.max_seqs * self.blocks_per_seq())
+
+    def validate(self) -> None:
+        if self.block_size < 1:
+            raise ConfigError("serving.block_size must be >= 1")
+        if self.max_model_len < 1:
+            raise ConfigError("serving.max_model_len must be >= 1")
+        if self.max_model_len % self.block_size != 0:
+            raise ConfigError(
+                f"serving.max_model_len={self.max_model_len} must be a "
+                f"multiple of block_size={self.block_size} (whole-block "
+                "sequence budget — see inference/kv_cache.py)")
+        if self.prefill_chunk < 1:
+            raise ConfigError("serving.prefill_chunk must be >= 1")
+        if self.prefill_chunk % self.block_size != 0:
+            raise ConfigError(
+                f"serving.prefill_chunk={self.prefill_chunk} must be a "
+                f"multiple of block_size={self.block_size} — a chunk that "
+                "ends mid-block would allocate a block it cannot fill")
+        if self.max_seqs < 1:
+            raise ConfigError("serving.max_seqs must be >= 1")
+        if self.max_queue < 1:
+            raise ConfigError("serving.max_queue must be >= 1")
+        if self.num_blocks and self.num_blocks < self.blocks_per_seq():
+            raise ConfigError(
+                f"serving.num_blocks={self.num_blocks} cannot hold even one "
+                f"max-length sequence ({self.blocks_per_seq()} blocks) — "
+                "the scheduler could never make progress")
+        if self.fairness not in ("fair", "fcfs"):
+            raise ConfigError("serving.fairness must be 'fair' or 'fcfs', "
+                              f"got '{self.fairness}'")
+        if self.default_max_new_tokens < 1:
+            raise ConfigError("serving.default_max_new_tokens must be >= 1")
+
+
+@dataclass
 class ElasticityConfig(ConfigModel):
     """Reference: elasticity/config.py — pure batch/world-size math."""
 

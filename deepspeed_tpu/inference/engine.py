@@ -69,6 +69,11 @@ class InferenceConfig:
     #   pt_binding.cpp quantize_activation). dtype='w8a8' sets this.
     compile_cache: bool = True         # persistent XLA compile cache
     #   (utils/compile_cache.py); DSTPU_COMPILE_CACHE overrides dir/disables
+    prompt_bucket: int = 64            # prompt-length compile bucket: prompts
+    #   pad up to a multiple of this, bounding the number of distinct
+    #   compiled prefill programs. The serving layer pins it to its KV
+    #   block_size so a bucketed prompt never reserves arena blocks the
+    #   true prompt can't use (ServingEngine does this at construction).
 
     def __post_init__(self):
         # dtype='int8' is storage quantization, not a compute dtype — the
@@ -100,6 +105,9 @@ class InferenceConfig:
                 "4 (nibble-packed, groupwise) are supported")
         if self.quantize_groups is not None and self.quantize_bits != 4:
             raise ValueError("quantize_groups applies to int4 only")
+        if self.prompt_bucket < 1:
+            raise ValueError(f"prompt_bucket must be >= 1, got "
+                             f"{self.prompt_bucket}")
         if self.quantize_activations and self.quantize_bits not in (4, 8):
             raise ValueError("quantize_activations (W8A8/W4A8) requires "
                              "int8 or int4 weights (dtype='w8a8'/'w4a8')")
@@ -364,7 +372,7 @@ class InferenceEngine:
         except ImportError:
             return []
         names = []
-        B, S_pad = batch_size, _bucket(prompt_len)
+        B, S_pad = batch_size, _bucket(prompt_len, self.config.prompt_bucket)
         key_p = (B, S_pad)
         if key_p not in self._prefill_cache:
             self._prefill_cache[key_p] = self._prefill_fn(S_pad)
@@ -573,7 +581,7 @@ class InferenceEngine:
         cfg = self.model.config
         ids = jnp.asarray(np.asarray(input_ids), jnp.int32)
         B, S = ids.shape
-        S_pad = _bucket(S)
+        S_pad = _bucket(S, self.config.prompt_bucket)
         T_max = self.config.max_out_tokens
         if S_pad + max_new_tokens > T_max:
             raise ValueError(

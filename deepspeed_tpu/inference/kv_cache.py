@@ -16,6 +16,25 @@ the stacked layer params (models/transformer.py forward):
 Static T_max keeps every decode step the same XLA program (the reference's
 CUDA-graph discipline becomes jit-cache discipline); tokens are written with
 ``lax.dynamic_update_slice`` at the cursor.
+
+**Paged arena** (the serving layer, ``deepspeed_tpu/serving``): instead of
+one ``T_max`` row per sequence, the time axis is carved into fixed-size
+blocks shared by every in-flight request (vLLM's PagedAttention block
+tables, Kwon et al. SOSP '23):
+
+    {"k": (L, NUM_BLOCKS, BLOCK, KV_HEADS, HEAD_DIM),
+     "v": (L, NUM_BLOCKS, BLOCK, KV_HEADS, HEAD_DIM)}
+
+Block 0 is a reserved scratch block: writes of inactive decode rows and
+prompt-chunk padding land there, so the jit program needs no write-masking
+branch. A host-side free list (``serving/paged_kv.BlockAllocator``) owns
+blocks 1.. and hands each sequence a block table ``(MAX_BLOCKS,)`` of
+physical ids; attention reads gather ``k[block_table]`` — a shape-static
+lookup, so one decode program serves any occupancy.
+
+``dtype`` is mandatory throughout: a default here let call sites silently
+allocate a bf16 arena for an fp32 (or fp16) engine — the arena dtype must
+come from ``InferenceConfig.dtype``.
 """
 
 from __future__ import annotations
@@ -26,7 +45,7 @@ import jax
 import jax.numpy as jnp
 
 
-def init_cache(cfg, batch_size: int, max_seq_len: int, dtype=jnp.bfloat16
+def init_cache(cfg, batch_size: int, max_seq_len: int, dtype
                ) -> Dict[str, jax.Array]:
     """Allocate the arena for ``cfg`` (a TransformerConfig)."""
     L = cfg.num_layers
@@ -50,7 +69,7 @@ def cache_memory_bytes(cfg, batch_size: int, max_seq_len: int,
 
 
 def cache_shape_struct(cfg, batch_size: int, max_seq_len: int,
-                       dtype=jnp.bfloat16) -> Dict[str, Any]:
+                       dtype) -> Dict[str, Any]:
     """eval_shape-compatible structure (for AOT sharding planning)."""
     L, K, D = cfg.num_layers, cfg.num_kv_heads, cfg.head_dim
     shape = (L, batch_size, max_seq_len, K, D)
@@ -59,3 +78,54 @@ def cache_shape_struct(cfg, batch_size: int, max_seq_len: int,
         "v": jax.ShapeDtypeStruct(shape, dtype),
         "index": jax.ShapeDtypeStruct((cfg.num_layers,), jnp.int32),
     }
+
+
+# ---------------------------------------------------------------------------
+# paged arena (serving layer)
+# ---------------------------------------------------------------------------
+
+
+def blocks_for_tokens(n_tokens: int, block_size: int) -> int:
+    """Blocks needed to hold ``n_tokens`` KV entries."""
+    return -(-max(int(n_tokens), 0) // int(block_size))
+
+
+def assert_block_divisible(max_seq_len: int, block_size: int) -> int:
+    """``max_seq_len`` must split into whole blocks — a ragged tail block
+    would make the gathered view wider than the sequence budget and break
+    the one-program shape discipline. Returns blocks per sequence."""
+    if block_size <= 0:
+        raise ValueError(f"block_size must be positive, got {block_size}")
+    if max_seq_len % block_size != 0:
+        raise ValueError(
+            f"max_seq_len={max_seq_len} is not divisible by "
+            f"block_size={block_size} — the paged arena needs whole blocks "
+            "(pick a block size that divides the sequence budget)")
+    return max_seq_len // block_size
+
+
+def init_paged_cache(cfg, num_blocks: int, block_size: int, dtype
+                     ) -> Dict[str, jax.Array]:
+    """Allocate the paged arena: ``num_blocks`` INCLUDES the reserved
+    scratch block 0 (allocatable blocks are 1..num_blocks-1)."""
+    if num_blocks < 2:
+        raise ValueError(f"num_blocks={num_blocks}: need the scratch block "
+                         "plus at least one allocatable block")
+    L, K, D = cfg.num_layers, cfg.num_kv_heads, cfg.head_dim
+    shape = (L, num_blocks, block_size, K, D)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+def paged_cache_memory_bytes(cfg, num_blocks: int, block_size: int,
+                             dtype) -> int:
+    itemsize = jnp.dtype(dtype).itemsize
+    return (2 * cfg.num_layers * num_blocks * block_size
+            * cfg.num_kv_heads * cfg.head_dim * itemsize)
+
+
+def paged_cache_shape_struct(cfg, num_blocks: int, block_size: int,
+                             dtype) -> Dict[str, Any]:
+    L, K, D = cfg.num_layers, cfg.num_kv_heads, cfg.head_dim
+    shape = (L, num_blocks, block_size, K, D)
+    return {"k": jax.ShapeDtypeStruct(shape, dtype),
+            "v": jax.ShapeDtypeStruct(shape, dtype)}

@@ -742,14 +742,23 @@ def _layer_forward(cfg: TransformerConfig, x: jax.Array, layer: Dict[str, Any],
                    cache: Optional[Dict[str, jax.Array]] = None,
                    static_prefill: bool = False,
                    key_positions: Optional[jax.Array] = None,
-                   window: Optional[jax.Array] = None
+                   window: Optional[jax.Array] = None,
+                   block_table: Optional[jax.Array] = None,
+                   paged_write_mask: Optional[jax.Array] = None
                    ) -> Tuple[jax.Array, Optional[Dict[str, jax.Array]]]:
     """One decoder block. ``layer`` holds this layer's (unstacked) params.
     ``cache`` (decode): dict with k/v of shape (B, T_max, K, D) and scalar
     ``index`` — returns the updated cache. ``window``: this layer's
     sliding-window width (traced scalar, <=0 = global) — present only for
     attention_layers models (GPT-Neo), which take the windowed jnp
-    attention path throughout."""
+    attention path throughout.
+
+    ``block_table`` switches the cache to PAGED mode (serving layer): the
+    per-layer cache is a shared pool ``{"k","v": (NUM_BLOCKS, BLOCK, K, D)}``
+    and ``block_table`` (B, MAX_BLOCKS) maps each row's logical blocks to
+    physical ids. ``positions`` must then be the (B, S) absolute write
+    positions; ``paged_write_mask`` (B, S) routes masked-off tokens (prompt
+    chunk padding) to the scratch block 0 instead of the row's blocks."""
     B, S, H = x.shape
     N, K, D = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
 
@@ -839,7 +848,45 @@ def _layer_forward(cfg: TransformerConfig, x: jax.Array, layer: Dict[str, Any],
                             "position='alibi' models (BLOOM) — silently "
                             "dropping the alibi bias would change the model")
     new_cache = None
-    if cache is not None:
+    if cache is not None and block_table is not None:
+        # PAGED serving path (deepspeed_tpu/serving/paged_kv.py): token at
+        # absolute position p lands in physical block block_table[b, p//BS]
+        # at offset p%BS — a scatter write; attention reads the row's blocks
+        # back through the table with a shape-static gather, so ONE decode
+        # program covers any arena occupancy (the jit-cache analog of
+        # vLLM's PagedAttention block tables). The layout is left-aligned
+        # (column == true position), which makes the causal mask the only
+        # mask needed and keys' alibi column bias exact by construction.
+        BSz = cache["k"].shape[1]
+        T_view = block_table.shape[1] * BSz
+        pos = positions if positions.ndim == 2 else jnp.broadcast_to(
+            positions[None], (B, S))
+        wpos = jnp.minimum(pos, T_view - 1)   # clamp pad writes in-range
+        blk = jnp.take_along_axis(block_table, wpos // BSz, axis=1)  # (B,S)
+        off = wpos % BSz
+        if paged_write_mask is not None:
+            # chunk padding / inactive decode rows write to scratch block 0
+            blk = jnp.where(paged_write_mask, blk, 0)
+            off = jnp.where(paged_write_mask, off, 0)
+        ck = cache["k"].at[blk, off].set(k.astype(cache["k"].dtype))
+        cv = cache["v"].at[blk, off].set(v.astype(cache["v"].dtype))
+        new_cache = {"k": ck, "v": cv}
+        kk = ck[block_table].reshape(B, T_view, K, D)
+        vv = cv[block_table].reshape(B, T_view, K, D)
+        # left-aligned layout: a key's column IS its position, so causality
+        # over true positions is the whole validity story (columns past the
+        # row's length hold scratch/stale data and are strictly future)
+        col = jnp.arange(T_view, dtype=jnp.int32)
+        full = (col[None, None, :] <= pos[:, :, None]).astype(jnp.int32)
+        # jnp attention only: the Pallas flash/decode kernels have no
+        # block-table operand (a paged Pallas decode kernel is the TPU-
+        # native follow-up — ServingEngine rejects custom attention_impl)
+        if alibi is None:
+            attn = dot_product_attention(q, kk, vv, full, causal=False)
+        else:
+            attn = dot_product_attention(q, kk, vv, full, causal=False,
+                                         alibi=alibi)
+    elif cache is not None:
         idx = cache["index"]
         ck = lax.dynamic_update_slice(cache["k"], k, (0, idx, 0, 0))
         cv = lax.dynamic_update_slice(cache["v"], v, (0, idx, 0, 0))
@@ -1032,14 +1079,22 @@ def forward(params: Dict[str, Any], input_ids: jax.Array,
             pld_theta: Optional[jax.Array] = None,
             positions: Optional[jax.Array] = None,
             token_type_ids: Optional[jax.Array] = None,
-            key_positions: Optional[jax.Array] = None
+            key_positions: Optional[jax.Array] = None,
+            block_table: Optional[jax.Array] = None,
+            paged_write_mask: Optional[jax.Array] = None
             ) -> Tuple[jax.Array, Optional[Dict[str, Any]], jax.Array]:
     """Token ids (B,S) → (logits (B,S,V), new_cache, moe_aux_loss). With
     ``cache``, runs in decode mode (cache is a per-layer stacked pytree; see
     inference/kv_cache.py). ``positions``: explicit absolute positions, (S,)
     shared or (B, S) per-row — ragged batches decode with each row's TRUE
     token index (the KV arena column stays uniform; only the position
-    values differ)."""
+    values differ).
+
+    ``block_table`` (B, MAX_BLOCKS) switches the cache to the PAGED layout
+    ``{"k","v": (L, NUM_BLOCKS, BLOCK, K, D)}`` (serving layer); ``positions``
+    is then REQUIRED — per-row absolute write positions — and
+    ``paged_write_mask`` (B, S) routes padding writes to the scratch block
+    (see ``_layer_forward``)."""
     B, S = input_ids.shape
     x = params["embed"]["tokens"][input_ids].astype(cfg.dtype)
     if positions is None:
@@ -1057,7 +1112,11 @@ def forward(params: Dict[str, Any], input_ids: jax.Array,
     if cache is None:
         x = _dropout(x, cfg, salt=29)
 
-    static_prefill = (cache is not None
+    if block_table is not None and (cache is None or positions is None
+                                    or positions.ndim != 2):
+        raise ValueError("paged mode (block_table) requires cache= and "
+                         "explicit (B, S) positions")
+    static_prefill = (cache is not None and block_table is None
                       and isinstance(start_pos, int) and start_pos == 0)
 
     use_pld = (cfg.pld_enabled and cache is None and pld_theta is not None)
@@ -1138,7 +1197,8 @@ def forward(params: Dict[str, Any], input_ids: jax.Array,
             h_new, new_cache, aux = _layer_forward(
                 cfg, h, layer, attention_mask, positions, layer_cache,
                 static_prefill=static_prefill, key_positions=key_positions,
-                window=window)
+                window=window, block_table=block_table,
+                paged_write_mask=paged_write_mask)
         if use_pld:
             h_new, aux = pld_gate(cfg, h, h_new, aux, idx, pld_theta)
         return (h_new, aux_acc + aux), new_cache

@@ -181,6 +181,76 @@ def summarize_fleet(records: List[Dict[str, Any]]) -> str:
     return "\n".join(lines)
 
 
+def summarize_serving(records: List[Dict[str, Any]]) -> str:
+    """``== serving ==`` — TTFT/TPOT latency (histogram stats + host-side
+    p50/p99 gauges from ``ServingEngine.publish_latency_gauges``), load
+    (queue depth, decode-batch and arena occupancy), and the request /
+    preemption counters, from the serving/* metrics."""
+    recs = [r for r in records
+            if str(r.get("name", "")).startswith("serving/")]
+    if not recs:
+        return ""
+    latest: Dict[Tuple[str, str], Dict[str, Any]] = {}
+    for r in recs:
+        latest[(r["name"], _label_str(r.get("labels", {})))] = r
+    lines = ["== serving =="]
+
+    def gauge(name: str) -> Any:
+        r = latest.get((name, "-"))
+        return r["value"] if r is not None else None
+
+    for label, stem in (("ttft", "serving/ttft"), ("tpot", "serving/tpot")):
+        hist = [(lbl, r) for (n, lbl), r in latest.items()
+                if n == f"{stem}_ms" and r.get("type") == "histogram"]
+        parts = []
+        for lbl, r in sorted(hist):
+            tag = f"[{lbl}] " if lbl != "-" else ""
+            parts.append(f"{tag}n={int(r.get('count', 0))} "
+                         f"mean={r.get('mean', 0):.2f} "
+                         f"min={r.get('min', 0):.2f} "
+                         f"max={r.get('max', 0):.2f}")
+        p50, p99 = gauge(f"{stem}_p50_ms"), gauge(f"{stem}_p99_ms")
+        if p50 is not None:
+            parts.append(f"p50={p50:.2f} p99={p99:.2f}"
+                         if p99 is not None else f"p50={p50:.2f}")
+        if parts:
+            lines.append(f"  {label}_ms: " + "  ".join(parts))
+    tps = gauge("serving/tokens_per_sec")
+    if tps is not None:
+        lines.append(f"  tokens_per_sec = {tps:.6g}")
+    load = []
+    for name, label in (("serving/queue_depth", "queue_depth"),
+                        ("serving/decode_batch_occupancy", "decode_occ"),
+                        ("serving/arena_occupancy", "arena_occ"),
+                        ("serving/kv_blocks_in_use", "kv_blocks"),
+                        ("serving/kv_blocks_peak", "kv_blocks_peak")):
+        v = gauge(name)
+        if v is not None:
+            load.append(f"{label}={v:.6g}")
+    if load:
+        lines.append("  load: " + "  ".join(load))
+    counts = []
+    preempt = 0.0
+    for name, label in (("serving/requests_submitted", "submitted"),
+                        ("serving/requests_completed", "completed"),
+                        ("serving/requests_cancelled", "cancelled"),
+                        ("serving/preemptions", "preemptions")):
+        total = sum(r["value"] for (n, _), r in latest.items()
+                    if n == name and r.get("type") == "counter")
+        if name == "serving/preemptions":
+            preempt = total
+        if total:
+            counts.append(f"{label}={total:.0f}")
+    if counts:
+        lines.append("  requests: " + "  ".join(counts))
+    if preempt:
+        lines.append(f"  !! {preempt:.0f} preemption(s): the block pool ran "
+                     "dry under load — requests recomputed after eviction "
+                     "(grow serving.num_blocks to trade HBM for tail "
+                     "latency)")
+    return "\n".join(lines)
+
+
 def summarize_recompiles(records: List[Dict[str, Any]]) -> str:
     compiles = [r for r in records
                 if r.get("type") == "counter" and r.get("name") == "xla/compiles"]
@@ -231,6 +301,7 @@ def report(paths: List[str]) -> str:
     sections = [s for s in (summarize_spans(records),
                             summarize_metrics(records),
                             summarize_goodput(records),
+                            summarize_serving(records),
                             summarize_fleet(records),
                             summarize_recompiles(records)) if s]
     if not sections:

@@ -1,0 +1,32 @@
+"""Continuous-batching serving layer — the MII/FastGen analog.
+
+The reference DeepSpeed serves models through MII/FastGen (dynamic
+batching, blocked KV, streaming); this package is the same capability
+rebuilt on the repo's inference substrate under jit-cache discipline:
+
+  paged_kv.py    paged KV arena: host block allocator + the two
+                 shape-static serving programs (prefill-chunk, decode)
+  scheduler.py   Orca-style iteration-level scheduler: admission, chunked
+                 prefill, multi-tenant fair queueing + deadlines,
+                 preemption by block eviction (device-free, injectable
+                 clock)
+  session.py     RequestHandle: incremental token streaming, cancellation
+  api.py         ServingEngine.submit()/stream()/step()/run(), metrics
+                 into the observability registry, tpuaudit registration
+
+See docs/serving.md for the architecture and the block-table layout.
+"""
+
+from ..config.config import ServingConfig  # noqa: F401
+from .api import ServingEngine, init_serving  # noqa: F401
+from .paged_kv import BlockAllocator, BlockAllocatorError  # noqa: F401
+from .scheduler import (QueueFull, Request, SamplingParams,  # noqa: F401
+                        Scheduler)
+from .session import RequestCancelled, RequestHandle  # noqa: F401
+
+__all__ = [
+    "ServingConfig", "ServingEngine", "init_serving",
+    "BlockAllocator", "BlockAllocatorError",
+    "Scheduler", "Request", "SamplingParams", "QueueFull",
+    "RequestHandle", "RequestCancelled",
+]

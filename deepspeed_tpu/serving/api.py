@@ -1,0 +1,558 @@
+"""ServingEngine — the continuous-batching request front end.
+
+The MII/FastGen analog for this stack: wraps an ``InferenceEngine`` (which
+owns params, mesh and dtype discipline) with the paged KV arena
+(``paged_kv.py``), the iteration-level scheduler (``scheduler.py``) and a
+streaming session API (``session.py``).
+
+One *iteration* (``step()``) is: admit queued requests onto free decode
+rows → run at most one prefill chunk → run one decode step over every
+decoding row → host-materialize the sampled tokens (the iteration's one
+sync), stream them to handles, grow/free blocks. Both device programs are
+compiled exactly once per (shape) configuration: occupancy, request mix and
+sampling settings are all *data* (see ``docs/serving.md`` for the jit-cache
+discipline rationale).
+
+Telemetry flows through the PR-2 observability substrate: ``serving/*``
+metrics in the MetricsRegistry (ttft_ms, tpot_ms, queue_depth,
+kv_blocks_in_use, preemptions, ...), spans ``serving/prefill_chunk`` and
+``serving/decode`` (which also give the recompile watchdog its attribution
+site), and tpuaudit entries of the same names.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from ..config.config import ServingConfig
+from ..observability import get_session
+from ..parallel import mesh as mesh_mod
+from ..utils.logging import log_dist, logger
+from . import paged_kv
+from .scheduler import DECODE, Request, SamplingParams, Scheduler
+from .session import RequestHandle
+
+__all__ = ["ServingEngine", "init_serving"]
+
+
+def _percentile(samples: List[float], q: float) -> float:
+    xs = sorted(samples)
+    return xs[min(len(xs) - 1, int(round(q * (len(xs) - 1))))]
+
+
+class ServingEngine:
+    """Continuous-batching serving over an ``InferenceEngine``'s params."""
+
+    def __init__(self, engine, config: Optional[ServingConfig] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.engine = engine
+        self.config = config or ServingConfig()
+        self.config.validate()
+        cfg = engine.model.config
+        if cfg.attention_layers or cfg.attention_scale is not None:
+            raise NotImplementedError(
+                "serving does not support sliding-window/custom-scale "
+                "attention models (GPT-Neo family) yet — the paged read "
+                "path has no window operand")
+        if cfg.attention_impl is not None:
+            raise NotImplementedError(
+                "serving ignores custom attention_impl — the paged arena "
+                "read is a block-table gather the custom impl cannot see")
+        if cfg.position == "learned" and \
+                self.config.max_model_len > cfg.max_seq_len:
+            raise ValueError(
+                f"serving.max_model_len={self.config.max_model_len} exceeds "
+                f"the model's learned-position table ({cfg.max_seq_len})")
+        self.blocks_per_seq = paged_kv.assert_block_divisible(
+            self.config.max_model_len, self.config.block_size)
+        # bucketing unification (the _bucket satellite): align the wrapped
+        # engine's prompt buckets to the serving block size, so a prompt
+        # padded for compile-bucket reasons never implies arena blocks the
+        # true prompt cannot use
+        engine.config.prompt_bucket = self.config.block_size
+        self.clock = clock
+        self._lock = threading.RLock()
+        self.alloc = paged_kv.BlockAllocator(self.config.pool_blocks())
+        self.sched = Scheduler(self.config, allocator=self.alloc, clock=clock)
+        self._dtype = engine.config.dtype
+        with mesh_mod.ambient(engine.mesh):
+            self._arena = paged_kv.init_paged_cache(
+                cfg, self.config.pool_blocks() + 1, self.config.block_size,
+                self._dtype)
+        self._prefill = paged_kv.build_prefill_program(cfg)
+        self._decode = paged_kv.build_decode_program(cfg)
+        import jax
+
+        self._base_rng = jax.random.PRNGKey(self.config.seed)
+        self._rid = 0
+        self._iterations = 0
+        # rid -> handle for requests still in flight; pruned at finish/
+        # cancel (the client keeps its own reference) so a long-running
+        # server never accumulates per-request state
+        self._handles: Dict[int, RequestHandle] = {}
+        self._published_preemptions = 0
+        # bounded latency reservoirs: percentiles over the most recent
+        # window, constant memory at serving lifetimes
+        import collections
+
+        self._ttft_samples = collections.deque(maxlen=8192)
+        self._tpot_samples = collections.deque(maxlen=8192)
+        self._tokens_out = 0
+        self._started_s = clock()
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._closed = False
+        self._register_audit_entries()
+        log_dist(
+            f"serving engine ready: rows={self.config.max_seqs}, "
+            f"blocks={self.config.pool_blocks()}x{self.config.block_size} "
+            f"(+scratch), max_model_len={self.config.max_model_len}, "
+            f"chunk={self.config.prefill_chunk}, arena="
+            f"{paged_kv.paged_cache_memory_bytes(cfg, self.config.pool_blocks() + 1, self.config.block_size, self._dtype) / 2 ** 20:.0f}"
+            " MiB")
+
+    # -- client API --------------------------------------------------------
+    @property
+    def threaded(self) -> bool:
+        return self._thread is not None
+
+    def submit(self, prompt, max_new_tokens: Optional[int] = None,
+               temperature: float = 0.0, top_k: int = 0, top_p: float = 1.0,
+               eos_token_id: Optional[int] = None, tenant: str = "default",
+               deadline_s: Optional[float] = None,
+               seed: int = 0) -> RequestHandle:
+        """Enqueue one prompt; returns a streaming handle immediately.
+        ``deadline_s`` is relative to now (scheduler-clock seconds) and
+        drives EDF ordering within the tenant. ``seed`` selects the
+        request's sampling stream: draws depend only on (engine seed,
+        request seed, output-token index) — reproducible regardless of how
+        the scheduler batched the request, and stable across
+        preemption/recompute. Raises ``scheduler.QueueFull`` past
+        ``serving.max_queue`` in-flight requests (backpressure) and
+        ``ValueError`` for prompts that cannot fit the ``max_model_len``
+        budget."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        with self._lock:
+            req = Request(
+                rid=self._rid, prompt=prompt,
+                max_new_tokens=(max_new_tokens if max_new_tokens is not None
+                                else self.config.default_max_new_tokens),
+                sampling=SamplingParams(temperature=float(temperature),
+                                        top_k=int(top_k), top_p=float(top_p)),
+                eos_token_id=eos_token_id, tenant=tenant, seed=seed,
+                deadline_s=(self.clock() + deadline_s
+                            if deadline_s is not None else None))
+            self.sched.submit(req)   # raises before rid is consumed
+            self._rid += 1
+            handle = RequestHandle(self, req)
+            self._handles[req.rid] = handle
+            obs = get_session()
+            if obs.enabled:
+                obs.registry.counter(
+                    "serving/requests_submitted",
+                    help="requests accepted into the serving queue").inc(
+                        tenant=tenant)
+            return handle
+
+    def cancel(self, handle: RequestHandle) -> bool:
+        with self._lock:
+            ok = self.sched.cancel(handle._req)
+            self._handles.pop(handle._req.rid, None)
+        if ok:
+            obs = get_session()
+            if obs.enabled:
+                obs.registry.counter(
+                    "serving/requests_cancelled",
+                    help="requests cancelled before completion").inc()
+        handle._wake()
+        return ok
+
+    def in_flight(self) -> int:
+        with self._lock:
+            return self.sched.in_flight()
+
+    # -- the iteration -----------------------------------------------------
+    def step(self) -> bool:
+        """One continuous-batching iteration; returns True when any request
+        made progress (admission, a prefill chunk, or a decode token)."""
+        with self._lock:
+            progress = bool(self.sched.admit())
+            progress |= self._step_prefill()
+            progress |= self._step_decode()
+            self._publish_iteration()
+            self._iterations += 1
+            return progress
+
+    def _table_for(self, reqs: List[Request]) -> np.ndarray:
+        """(len(reqs), MAXB) block table; unfilled entries → scratch 0."""
+        bt = np.zeros((len(reqs), self.blocks_per_seq), np.int32)
+        for i, r in enumerate(reqs):
+            if r.blocks:
+                bt[i, :len(r.blocks)] = r.blocks
+        return bt
+
+    @staticmethod
+    def _sampling_arrays(reqs: List[Request]):
+        return (np.asarray([r.sampling.temperature for r in reqs],
+                           np.float32),
+                np.asarray([r.sampling.top_k for r in reqs], np.int32),
+                np.asarray([r.sampling.top_p for r in reqs], np.float32),
+                np.asarray([r.seed for r in reqs], np.int32))
+
+    def _step_prefill(self) -> bool:
+        req = self.sched.next_prefill()
+        if req is None:
+            return False
+        C = self.config.prefill_chunk
+        src = req.prompt
+        start = req.prefill_pos
+        n_valid = min(C, int(src.size) - start)
+        if not self.sched.ensure_blocks(req, start + n_valid):
+            return False    # pool dry, nothing evictable — wait a turn
+        chunk = np.zeros((1, C), np.int32)
+        chunk[0, :n_valid] = src[start:start + n_valid]
+        temps, topks, topps, seeds = self._sampling_arrays([req])
+        obs = get_session()
+        with mesh_mod.ambient(self.engine.mesh):
+            with obs.span("serving/prefill_chunk", batch=1,
+                          tokens=int(n_valid)):
+                tok, _last, self._arena = self._prefill(
+                    self.engine.params, self._arena,
+                    self._table_for([req]), chunk,
+                    np.asarray(start, np.int32),
+                    np.asarray(n_valid, np.int32),
+                    temps, topks, topps, seeds, self._base_rng)
+                tok = np.asarray(tok)   # the fence: chunk really ran
+        req.prefill_pos += n_valid
+        req.length = req.prefill_pos
+        self.sched.note_service(req, n_valid)
+        if req.prefill_pos == int(src.size):
+            req.state = DECODE
+            if req.resume:
+                # recompute after preemption: the stored pending token is
+                # authoritative (identical under greedy; under temperature
+                # sampling the resampled one may diverge) and was already
+                # streamed — never re-emit
+                req.resume = False
+            else:
+                self._emit(req, int(tok[0]), first=True)
+        return True
+
+    def _step_decode(self) -> bool:
+        dec = self.sched.decode_requests()
+        if not dec:
+            return False
+        for r in dec:
+            # re-check state INSIDE the loop: an earlier ensure_blocks may
+            # have evicted this very request — growing a now-QUEUED request
+            # would hand pool blocks to a non-running request (and, pool
+            # dry, let it evict an active one)
+            if r.state == DECODE:
+                self.sched.ensure_blocks(r, r.length + 1)
+        ready = [r for r in dec if r.state == DECODE
+                 and len(r.blocks) * self.config.block_size > r.length]
+        if not ready:
+            return False
+        R = self.config.max_seqs
+        bt = np.zeros((R, self.blocks_per_seq), np.int32)
+        lengths = np.zeros((R,), np.int32)
+        tokens = np.zeros((R,), np.int32)
+        temps = np.zeros((R,), np.float32)
+        topks = np.zeros((R,), np.int32)
+        topps = np.ones((R,), np.float32)
+        seeds = np.zeros((R,), np.int32)
+        steps = np.zeros((R,), np.int32)
+        for r in ready:
+            row = r.row
+            bt[row, :len(r.blocks)] = r.blocks
+            lengths[row] = r.length
+            tokens[row] = r.pending_token
+            temps[row] = r.sampling.temperature
+            topks[row] = r.sampling.top_k
+            topps[row] = r.sampling.top_p
+            seeds[row] = r.seed
+            steps[row] = len(r.generated)   # output-token index: the
+            #   sampling stream is (engine seed, request seed, index) —
+            #   schedule-independent and preemption-stable
+        obs = get_session()
+        with mesh_mod.ambient(self.engine.mesh):
+            with obs.span("serving/decode", batch=len(ready)):
+                nxt, self._arena = self._decode(
+                    self.engine.params, self._arena, bt, lengths, tokens,
+                    temps, topks, topps, seeds, steps, self._base_rng)
+                nxt = np.asarray(nxt)   # the iteration's one host sync
+        for r in ready:
+            r.length += 1
+            self.sched.note_service(r, 1)
+            self._emit(r, int(nxt[r.row]))
+        return True
+
+    def _emit(self, req: Request, token: int, first: bool = False) -> None:
+        now = self.clock()
+        obs = get_session()
+        if first:
+            req.first_token_s = now
+            if obs.enabled:
+                ttft_ms = (now - req.arrival_s) * 1e3
+                self._ttft_samples.append(ttft_ms)
+                obs.registry.histogram(
+                    "serving/ttft_ms",
+                    help="arrival → first streamed token, wall ms").observe(
+                        ttft_ms, tenant=req.tenant)
+        req.generated.append(token)
+        req.pending_token = token
+        self._tokens_out += 1
+        handle = self._handles.get(req.rid)
+        if handle is not None:
+            handle._push(token)
+        finished = (len(req.generated) >= req.max_new_tokens
+                    or (req.eos_token_id is not None
+                        and token == req.eos_token_id))
+        if finished:
+            self.sched.finish(req)
+            if obs.enabled:
+                obs.registry.counter(
+                    "serving/requests_completed",
+                    help="requests that finished generation").inc(
+                        tenant=req.tenant)
+                tpot = req.tpot_s
+                if tpot is not None:
+                    self._tpot_samples.append(tpot * 1e3)
+                    obs.registry.histogram(
+                        "serving/tpot_ms",
+                        help="mean per-token wall ms after the first "
+                             "token").observe(tpot * 1e3, tenant=req.tenant)
+            self._handles.pop(req.rid, None)   # the client holds its own
+            #   reference; keeping ours would leak one handle per request
+            #   over a server's lifetime
+            if handle is not None:
+                handle._wake()
+
+    def _publish_iteration(self) -> None:
+        obs = get_session()
+        if not obs.enabled:
+            return
+        reg = obs.registry
+        reg.gauge("serving/queue_depth",
+                  help="requests waiting for admission").set(
+                      self.sched.queue_depth())
+        reg.gauge("serving/kv_blocks_in_use",
+                  help="allocated arena blocks").set(self.alloc.blocks_in_use)
+        reg.gauge("serving/kv_blocks_peak",
+                  help="peak allocated arena blocks").set(
+                      self.alloc.peak_in_use)
+        reg.gauge("serving/arena_occupancy",
+                  help="allocated fraction of the block pool").set(
+                      self.alloc.blocks_in_use / max(self.alloc.capacity, 1))
+        reg.gauge("serving/decode_batch_occupancy",
+                  help="decoding rows / max_seqs").set(
+                      len(self.sched.decode_requests())
+                      / self.config.max_seqs)
+        new_preempt = self.sched.preemption_count \
+            - self._published_preemptions
+        if new_preempt:
+            reg.counter("serving/preemptions",
+                        help="requests evicted from the arena "
+                             "(recompute on re-admission)").inc(new_preempt)
+            self._published_preemptions = self.sched.preemption_count
+        # steady-state marker for the recompile watchdog: past warmup, a
+        # recompile under a serving span is a shape-discipline bug
+        obs.note_step(self._iterations)
+
+    # -- drivers -----------------------------------------------------------
+    def run(self, max_steps: Optional[int] = None) -> int:
+        """Step until every in-flight request is terminal (tests/benches).
+        Returns the number of iterations run."""
+        steps = 0
+        starved = 0
+        while self.in_flight():
+            progress = self.step()
+            steps += 1
+            if max_steps is not None and steps >= max_steps:
+                break
+            if progress:
+                starved = 0
+            else:
+                starved += 1
+                if starved > 2 * self.config.max_queue + 4:
+                    raise RuntimeError(
+                        "serving stalled: no request can make progress "
+                        f"({self.sched.queue_depth()} queued, "
+                        f"{self.alloc.blocks_free} free blocks) — the block "
+                        "pool or row count is too small for the workload")
+        return steps
+
+    def start(self) -> None:
+        """Background driver thread (the 'server' mode): steps while work is
+        in flight, idles cheaply otherwise."""
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._drive,
+                                        name="dstpu-serving", daemon=True)
+        self._thread.start()
+
+    def _drive(self) -> None:
+        while not self._stop.is_set():
+            try:
+                if self.in_flight():
+                    self.step()
+                else:
+                    self._stop.wait(0.002)
+            except Exception:
+                logger.exception("serving driver step failed")
+                get_session().crash_dump("serving-step-exception")
+                self._stop.wait(0.05)
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=5.0)
+            self._thread = None
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self.stop()
+        self.publish_latency_gauges()
+
+    def publish_latency_gauges(self) -> None:
+        """Host-side percentile gauges (the registry histogram keeps only
+        count/sum/min/max): serving/ttft_p50_ms, p99, tpot p50/p99, and the
+        end-to-end tokens/s — the ``report`` CLI's ``== serving ==``
+        inputs."""
+        obs = get_session()
+        if not obs.enabled:
+            return
+        reg = obs.registry
+        for name, samples in (("ttft", self._ttft_samples),
+                              ("tpot", self._tpot_samples)):
+            if samples:
+                reg.gauge(f"serving/{name}_p50_ms").set(
+                    _percentile(list(samples), 0.50))
+                reg.gauge(f"serving/{name}_p99_ms").set(
+                    _percentile(list(samples), 0.99))
+        wall = max(self.clock() - self._started_s, 1e-9)
+        reg.gauge("serving/tokens_per_sec",
+                  help="generated tokens / wall seconds").set(
+                      self._tokens_out / wall)
+
+    def reset_latency_stats(self) -> None:
+        """Drop the host-side latency reservoirs and restart the
+        tokens/s window — benches call this after their warmup request so
+        the published p50/p99/tokens_per_sec describe the measured load,
+        not program compilation."""
+        with self._lock:
+            self._ttft_samples.clear()
+            self._tpot_samples.clear()
+            self._tokens_out = 0
+            self._started_s = self.clock()
+
+    # -- tpuaudit ----------------------------------------------------------
+    def _audit_args_prefill(self):
+        import jax
+        import jax.numpy as jnp
+
+        cfg = self.engine.model.config
+        C, MAXB = self.config.prefill_chunk, self.blocks_per_seq
+        i32 = jnp.int32
+        return (self.engine._params_sds(),
+                self._arena_sds(),
+                jax.ShapeDtypeStruct((1, MAXB), i32),
+                jax.ShapeDtypeStruct((1, C), i32),
+                jax.ShapeDtypeStruct((), i32),
+                jax.ShapeDtypeStruct((), i32),
+                jax.ShapeDtypeStruct((1,), jnp.float32),
+                jax.ShapeDtypeStruct((1,), i32),
+                jax.ShapeDtypeStruct((1,), jnp.float32),
+                jax.ShapeDtypeStruct((1,), i32),
+                jax.ShapeDtypeStruct((2,), jnp.uint32))
+
+    def _arena_sds(self):
+        from ..inference.kv_cache import paged_cache_shape_struct
+
+        return paged_cache_shape_struct(
+            self.engine.model.config, self.config.pool_blocks() + 1,
+            self.config.block_size, self._dtype)
+
+    def _register_audit_entries(self) -> List[str]:
+        try:
+            from tools.tpuaudit.registry import (StaleEntryError,
+                                                 register_entry_point)
+        except ImportError:
+            return []
+        try:
+            import weakref
+
+            import jax
+            import jax.numpy as jnp
+
+            wself = weakref.ref(self)
+            expected = self.engine._audit_expected_collectives()
+            R, MAXB = self.config.max_seqs, self.blocks_per_seq
+            C = self.config.prefill_chunk
+
+            def build_prefill():
+                eng = wself()
+                if eng is None:
+                    raise StaleEntryError("serving/prefill_chunk: "
+                                          "engine gone")
+                return eng._prefill, eng._audit_args_prefill(), {}
+
+            def build_decode():
+                eng = wself()
+                if eng is None:
+                    raise StaleEntryError("serving/decode: engine gone")
+                i32 = jnp.int32
+                args = (eng.engine._params_sds(), eng._arena_sds(),
+                        jax.ShapeDtypeStruct((R, MAXB), i32),
+                        jax.ShapeDtypeStruct((R,), i32),
+                        jax.ShapeDtypeStruct((R,), i32),
+                        jax.ShapeDtypeStruct((R,), jnp.float32),
+                        jax.ShapeDtypeStruct((R,), i32),
+                        jax.ShapeDtypeStruct((R,), jnp.float32),
+                        jax.ShapeDtypeStruct((R,), i32),
+                        jax.ShapeDtypeStruct((R,), i32),
+                        jax.ShapeDtypeStruct((2,), jnp.uint32))
+                return eng._decode, args, {}
+
+            register_entry_point(
+                "serving/prefill_chunk", build=build_prefill,
+                donate_argnums=(1,), expected_collectives=expected,
+                mesh=self.engine.mesh,
+                tags={"engine": "ServingEngine", "chunk": C,
+                      "max_blocks": MAXB})
+            register_entry_point(
+                "serving/decode", build=build_decode, donate_argnums=(1,),
+                expected_collectives=expected, mesh=self.engine.mesh,
+                tags={"engine": "ServingEngine", "rows": R,
+                      "max_blocks": MAXB})
+            return ["serving/prefill_chunk", "serving/decode"]
+        except Exception:   # registration must never take serving down
+            logger.warning("tpuaudit serving registration failed",
+                           exc_info=True)
+            return []
+
+
+def init_serving(model=None, serving_config: Optional[Any] = None,
+                 clock: Callable[[], float] = time.monotonic,
+                 **init_inference_kwargs) -> ServingEngine:
+    """Build an ``InferenceEngine`` (same surface as ``init_inference``) and
+    wrap it in a ``ServingEngine``. ``serving_config``: a ``ServingConfig``
+    or plain dict."""
+    from ..inference import init_inference
+
+    if isinstance(serving_config, dict):
+        serving_config = ServingConfig.from_dict(serving_config)
+    scfg = serving_config or ServingConfig()
+    # the offline arena is unused by serving, but a shared engine may still
+    # serve generate() calls — keep its budget at least the serving budget
+    init_inference_kwargs.setdefault("max_out_tokens", scfg.max_model_len)
+    engine = init_inference(model=model, **init_inference_kwargs)
+    return ServingEngine(engine, scfg, clock=clock)

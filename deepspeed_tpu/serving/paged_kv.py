@@ -1,0 +1,215 @@
+"""Paged KV arena — the device half of the serving layer.
+
+The inference engine's arena reserves a full ``T_max`` row per sequence
+(``inference/kv_cache.py``); at serving concurrency that wastes HBM
+proportional to the spread of sequence lengths. Here the arena is a shared
+pool of fixed-size **blocks** (vLLM's PagedAttention, Kwon et al. SOSP '23):
+
+* ``BlockAllocator`` — host-side free list over the pool. Block 0 is a
+  reserved scratch block (inactive decode rows and prompt-chunk padding
+  write there); allocatable ids are 1..num_blocks.
+* ``build_prefill_program`` / ``build_decode_program`` — the two jitted
+  serving programs. Both are **shape-static**: the block table
+  ``(rows, max_blocks)`` and per-row lengths are data, not shapes, so one
+  compiled decode program serves every occupancy the scheduler produces
+  (the jit-cache analog of the reference's CUDA-graph discipline). The
+  attention read gathers ``arena[block_table]`` — an XLA gather; a Pallas
+  paged-decode kernel with per-page async DMA is the TPU-native follow-up
+  (see ``docs/serving.md``).
+* ``sample_rows`` — per-row greedy/temperature/top-k/top-p sampling with
+  *array-valued* knobs, so requests with different sampling settings share
+  one decode program. The greedy path is bit-identical to
+  ``inference/engine._sample`` at ``temperature=0``.
+
+The model-side write/read lives in ``models/transformer._layer_forward``
+(paged branch): the layout is left-aligned — token at position ``p`` sits in
+block ``table[p // BLOCK]`` offset ``p % BLOCK`` — so a key's gathered
+column IS its position and causality over true positions is the entire
+validity story.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..inference.kv_cache import (assert_block_divisible, blocks_for_tokens,
+                                  init_paged_cache, paged_cache_memory_bytes)
+
+__all__ = ["BlockAllocator", "BlockAllocatorError", "blocks_for_tokens",
+           "assert_block_divisible", "init_paged_cache",
+           "paged_cache_memory_bytes", "build_prefill_program",
+           "build_decode_program", "sample_rows"]
+
+
+class BlockAllocatorError(RuntimeError):
+    """Allocator invariant violation (double free, foreign block)."""
+
+
+class BlockAllocator:
+    """Free-list allocator over the arena's allocatable blocks (1..capacity).
+
+    Invariants (tested in tests/unit/test_serving.py):
+      * ``blocks_in_use + blocks_free == capacity`` at all times;
+      * a block is never handed out twice without an intervening free;
+      * freeing a block that is not held raises (double free / foreign id);
+      * block 0 (scratch) is never allocated.
+    """
+
+    def __init__(self, num_blocks: int):
+        if num_blocks < 1:
+            raise ValueError(f"num_blocks must be >= 1, got {num_blocks}")
+        self.capacity = int(num_blocks)
+        # LIFO free list, lowest ids first out — deterministic for tests
+        self._free: List[int] = list(range(self.capacity, 0, -1))
+        self._held: set = set()
+        self.peak_in_use = 0
+        self.total_allocs = 0
+
+    @property
+    def blocks_in_use(self) -> int:
+        return len(self._held)
+
+    @property
+    def blocks_free(self) -> int:
+        return len(self._free)
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """``n`` fresh block ids, or None when the pool can't satisfy the
+        request (caller decides whether to wait or preempt) — partial
+        allocations never happen."""
+        if n < 0:
+            raise ValueError(f"alloc({n})")
+        if n > len(self._free):
+            return None
+        ids = [self._free.pop() for _ in range(n)]
+        self._held.update(ids)
+        self.total_allocs += n
+        self.peak_in_use = max(self.peak_in_use, len(self._held))
+        return ids
+
+    def free(self, ids: List[int]) -> None:
+        for b in ids:
+            if b not in self._held:
+                raise BlockAllocatorError(
+                    f"free of block {b} which is not allocated "
+                    "(double free or foreign id)")
+            self._held.remove(b)
+            self._free.append(b)
+
+
+# ---------------------------------------------------------------------------
+# per-row sampling
+# ---------------------------------------------------------------------------
+
+
+def sample_rows(logits: jax.Array, base_key: jax.Array,
+                temperature: jax.Array, top_k: jax.Array, top_p: jax.Array,
+                seeds: jax.Array, steps: jax.Array) -> jax.Array:
+    """Per-row sampling with array-valued knobs: ``logits`` (R, V);
+    ``temperature``/``top_p`` (R,) float32; ``top_k`` (R,) int32 (0 = off).
+    Rows with ``temperature <= 0`` take the greedy branch — the same
+    fp32 argmax as ``inference/engine._sample``, so serving greedy output
+    is bit-identical to offline ``generate()``.
+
+    Each row draws from ``fold_in(fold_in(base_key, seeds[r]), steps[r])``
+    — ``seeds`` the request's sampling seed, ``steps`` its output-token
+    index — so a request's stream depends only on (engine seed, request
+    seed, token index), NOT on how the scheduler batched it: reproducible
+    across runs and bit-stable across preemption/recompute."""
+    logits = logits.astype(jnp.float32)
+    V = logits.shape[-1]
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    scaled = logits / jnp.maximum(temperature, 1e-6)[:, None]
+    # top-k: keep scores >= the k-th largest (per row, traced k)
+    desc = jnp.sort(scaled, axis=-1)[:, ::-1]
+    kth = jnp.take_along_axis(
+        desc, jnp.clip(top_k - 1, 0, V - 1)[:, None], axis=1)
+    scaled = jnp.where((top_k[:, None] > 0) & (scaled < kth),
+                       -jnp.inf, scaled)
+    # top-p over the (possibly top-k-filtered) scores; top-1 always survives
+    desc = jnp.sort(scaled, axis=-1)[:, ::-1]
+    probs = jax.nn.softmax(desc, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    keep = (cum - probs < top_p[:, None]).at[:, 0].set(True)
+    cutoff = jnp.min(jnp.where(keep, desc, jnp.inf), axis=-1, keepdims=True)
+    scaled = jnp.where(scaled >= cutoff, scaled, -jnp.inf)
+    keys = jax.vmap(
+        lambda s, t: jax.random.fold_in(jax.random.fold_in(base_key, s), t)
+    )(seeds, steps)
+    sampled = jax.vmap(jax.random.categorical)(keys, scaled).astype(jnp.int32)
+    return jnp.where(temperature <= 0.0, greedy, sampled)
+
+
+# ---------------------------------------------------------------------------
+# the two serving programs
+# ---------------------------------------------------------------------------
+
+
+def build_prefill_program(cfg):
+    """Jitted prefill-chunk program over the paged arena.
+
+    Args (all shapes static per (C, max_blocks) pair):
+      params, cache          — model params / paged arena (arena DONATED)
+      block_table (1, MAXB)  — the request's physical block ids
+      chunk (1, C) int32     — prompt tokens, zero-padded past ``n_valid``
+      start () int32         — absolute position of chunk[0]
+      n_valid () int32       — real tokens in this chunk (pad writes land in
+                               the scratch block; pad logits are never read)
+      temperature/top_k/top_p/seeds (1,) — the request's sampling knobs
+      base_key               — the engine's sampling key (constant)
+
+    Returns (token (1,), last_logits (1, V) f32, cache): ``token`` samples
+    the position-``n_valid-1`` logits at output-token index 0 — the
+    request's FIRST generated token when this was the final chunk, ignored
+    otherwise.
+    """
+    from ..models.transformer import forward as model_forward
+
+    def prefill_chunk(params, cache, block_table, chunk, start, n_valid,
+                      temperature, top_k, top_p, seeds, base_key):
+        C = chunk.shape[1]
+        pos = (start + jnp.arange(C, dtype=jnp.int32))[None]
+        write_mask = (jnp.arange(C, dtype=jnp.int32) < n_valid)[None]
+        logits, cache, _ = model_forward(params, chunk, cfg, cache=cache,
+                                         positions=pos,
+                                         block_table=block_table,
+                                         paged_write_mask=write_mask)
+        last = jnp.take_along_axis(
+            logits, jnp.maximum(n_valid - 1, 0)[None, None, None],
+            axis=1)[:, 0].astype(jnp.float32)
+        tok = sample_rows(last, base_key, temperature, top_k, top_p,
+                          seeds, jnp.zeros((1,), jnp.int32))
+        return tok, last, cache
+
+    return jax.jit(prefill_chunk, donate_argnums=(1,))
+
+
+def build_decode_program(cfg):
+    """Jitted one-token decode step over the paged arena for a fixed row
+    count R. Inactive rows carry an all-zero block table and length 0 — their
+    writes land in the scratch block and their sampled tokens are ignored by
+    the host — so occupancy changes never respecialize the program.
+
+    Args: params, cache (DONATED), block_table (R, MAXB), lengths (R,) int32
+    (tokens already in cache per row — the incoming token's position),
+    tokens (R,) int32, temperature/top_k/top_p/seeds (R,), steps (R,) int32
+    (each row's output-token index, for the schedule-independent sampling
+    stream), base_key.
+    Returns (next_token (R,), cache).
+    """
+    from ..models.transformer import forward as model_forward
+
+    def decode(params, cache, block_table, lengths, tokens,
+               temperature, top_k, top_p, seeds, steps, base_key):
+        logits, cache, _ = model_forward(params, tokens[:, None], cfg,
+                                         cache=cache,
+                                         positions=lengths[:, None],
+                                         block_table=block_table)
+        nxt = sample_rows(logits[:, -1], base_key, temperature, top_k,
+                          top_p, seeds, steps)
+        return nxt, cache
+
+    return jax.jit(decode, donate_argnums=(1,))

@@ -1,0 +1,299 @@
+"""Iteration-level continuous-batching scheduler (Orca, Yu et al. OSDI '22).
+
+Device-free by design: the scheduler manipulates ``Request`` state, rows and
+blocks; ``ServingEngine`` (api.py) executes the device programs it plans.
+That split keeps every policy decision testable with an injectable clock and
+zero sleeps (the ``hangdetect.py`` testing convention).
+
+Policies:
+
+* **Admission** — iteration-level: whenever a decode row is free and the
+  pool can hold the request's first prefill chunk, a queued request joins
+  the running batch. Under ``fairness='fair'``, the next request comes from
+  the tenant with the least accumulated service (tokens processed), and
+  within a tenant earliest-deadline-first (requests without a deadline sort
+  last, then by arrival). ``'fcfs'`` is plain arrival order.
+* **Chunked prefill** — one prompt chunk per iteration, interleaved with
+  the decode step, so a long prompt cannot freeze time-to-first-token for
+  everyone else (Sarathi-style).
+* **Preemption by block eviction** — when the pool runs dry mid-decode, the
+  most recently admitted other request is evicted: its blocks free
+  immediately, and it re-queues in *recompute* mode (its re-prefill source
+  is prompt + tokens generated so far; already-streamed tokens are never
+  re-emitted). LIFO victim choice protects the oldest requests' latency.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import time
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from .paged_kv import BlockAllocator, blocks_for_tokens
+
+__all__ = ["Request", "SamplingParams", "Scheduler", "QueueFull",
+           "QUEUED", "PREFILL", "DECODE", "FINISHED", "CANCELLED"]
+
+QUEUED = "queued"
+PREFILL = "prefill"
+DECODE = "decode"
+FINISHED = "finished"
+CANCELLED = "cancelled"
+
+
+class QueueFull(RuntimeError):
+    """Backpressure: the serving queue is at ``max_queue`` in-flight
+    requests — callers shed load or retry later."""
+
+
+@dataclasses.dataclass
+class SamplingParams:
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
+
+
+@dataclasses.dataclass
+class Request:
+    """One in-flight generation request. ``prompt`` is the CURRENT prefill
+    source — after a preemption it becomes prompt+generated-so-far
+    (recompute mode); ``n_prompt`` keeps the original prompt length for
+    TTFT/budget accounting."""
+
+    rid: int
+    prompt: np.ndarray                       # (S,) int32 prefill source
+    max_new_tokens: int
+    sampling: SamplingParams = dataclasses.field(default_factory=SamplingParams)
+    eos_token_id: Optional[int] = None
+    tenant: str = "default"
+    deadline_s: Optional[float] = None       # absolute (scheduler clock)
+    seed: int = 0
+    arrival_s: float = 0.0
+    # -- runtime state (scheduler-owned) --
+    state: str = QUEUED
+    row: Optional[int] = None                # decode-batch row while running
+    blocks: List[int] = dataclasses.field(default_factory=list)
+    prefill_pos: int = 0                     # tokens of `prompt` prefilled
+    length: int = 0                          # KV tokens written for this row
+    pending_token: Optional[int] = None      # sampled, not yet in the cache
+    generated: List[int] = dataclasses.field(default_factory=list)
+    n_prompt: int = 0                        # ORIGINAL prompt length
+    resume: bool = False                     # recompute after preemption
+    preemptions: int = 0
+    first_token_s: Optional[float] = None
+    finish_s: Optional[float] = None
+
+    def __post_init__(self):
+        self.prompt = np.asarray(self.prompt, np.int32).reshape(-1)
+        if self.n_prompt == 0:
+            self.n_prompt = int(self.prompt.size)
+
+    @property
+    def done(self) -> bool:
+        return self.state in (FINISHED, CANCELLED)
+
+    @property
+    def ttft_s(self) -> Optional[float]:
+        if self.first_token_s is None:
+            return None
+        return self.first_token_s - self.arrival_s
+
+    @property
+    def tpot_s(self) -> Optional[float]:
+        """Mean time per output token after the first."""
+        if (self.finish_s is None or self.first_token_s is None
+                or len(self.generated) < 2):
+            return None
+        return ((self.finish_s - self.first_token_s)
+                / (len(self.generated) - 1))
+
+
+class Scheduler:
+    """Owns the queue, the decode rows, and the block pool accounting."""
+
+    def __init__(self, config, allocator: Optional[BlockAllocator] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        config.validate()
+        self.config = config
+        self.alloc = allocator or BlockAllocator(config.pool_blocks())
+        self.clock = clock
+        self.queued: List[Request] = []
+        self.running: Dict[int, Request] = {}      # row -> request
+        self._free_rows: List[int] = list(range(config.max_seqs))[::-1]
+        self.service: Dict[str, float] = {}        # tenant -> tokens served
+        self._admit_seq = 0
+        # rid -> admission order, for RUNNING requests only (pruned on
+        # release so a long-lived server's memory stays bounded)
+        self._admit_index: Dict[int, int] = {}
+        import collections
+
+        # bounded trace of admission order (tests + debugging)
+        self.admitted_log = collections.deque(maxlen=4096)
+        self.preemption_count = 0
+        self.finished_count = 0
+        self.cancelled_count = 0
+
+    # -- intake ------------------------------------------------------------
+    def submit(self, req: Request) -> None:
+        if len(self.queued) + len(self.running) >= self.config.max_queue:
+            raise QueueFull(
+                f"serving queue full ({self.config.max_queue} in-flight); "
+                "shed load or raise serving.max_queue")
+        if req.max_new_tokens < 1:
+            raise ValueError(
+                f"request {req.rid}: max_new_tokens must be >= 1, got "
+                f"{req.max_new_tokens}")
+        limit = self.config.max_model_len
+        if req.n_prompt + req.max_new_tokens > limit:
+            raise ValueError(
+                f"request {req.rid}: prompt ({req.n_prompt}) + "
+                f"max_new_tokens ({req.max_new_tokens}) exceeds "
+                f"serving.max_model_len={limit}")
+        if req.n_prompt < 1:
+            raise ValueError(f"request {req.rid}: empty prompt")
+        req.arrival_s = self.clock()
+        req.state = QUEUED
+        self.queued.append(req)
+
+    def cancel(self, req: Request) -> bool:
+        if req.done:
+            return False
+        if req.state == QUEUED:
+            self.queued.remove(req)
+        # _release is a no-op for row-less requests but still frees any
+        # blocks a queued request may hold (a request evicted mid-iteration
+        # can transiently carry blocks) — skipping it here leaked them for
+        # the server's lifetime
+        self._release(req)
+        req.state = CANCELLED
+        req.finish_s = self.clock()
+        self.cancelled_count += 1
+        return True
+
+    # -- bookkeeping -------------------------------------------------------
+    def queue_depth(self) -> int:
+        return len(self.queued)
+
+    def in_flight(self) -> int:
+        return len(self.queued) + len(self.running)
+
+    def note_service(self, req: Request, tokens: int) -> None:
+        self.service[req.tenant] = self.service.get(req.tenant, 0.0) + tokens
+
+    def _release(self, req: Request) -> None:
+        """Free the request's row and blocks (state left to the caller)."""
+        if req.row is not None:
+            del self.running[req.row]
+            self._free_rows.append(req.row)
+            req.row = None
+        if req.blocks:
+            self.alloc.free(req.blocks)
+            req.blocks = []
+        self._admit_index.pop(req.rid, None)
+
+    def finish(self, req: Request) -> None:
+        self._release(req)
+        req.state = FINISHED
+        req.finish_s = self.clock()
+        self.finished_count += 1
+
+    # -- admission ---------------------------------------------------------
+    def _pick_next(self) -> Optional[Request]:
+        if not self.queued:
+            return None
+        if self.config.fairness == "fcfs":
+            return min(self.queued, key=lambda r: (r.arrival_s, r.rid))
+        # fair: least-service tenant first (stable tie-break on name), then
+        # EDF within the tenant (no deadline sorts last), then arrival
+        tenant = min({r.tenant for r in self.queued},
+                     key=lambda t: (self.service.get(t, 0.0), t))
+        cands = [r for r in self.queued if r.tenant == tenant]
+        return min(cands, key=lambda r: (
+            r.deadline_s if r.deadline_s is not None else math.inf,
+            r.arrival_s, r.rid))
+
+    def admit(self) -> List[Request]:
+        """Move queued requests onto free decode rows while their first
+        chunk's blocks fit in the FREE pool (admission never preempts — only
+        progress for already-admitted requests may evict)."""
+        admitted: List[Request] = []
+        while self._free_rows:
+            req = self._pick_next()
+            if req is None:
+                break
+            first = min(self.config.prefill_chunk, int(req.prompt.size))
+            need = blocks_for_tokens(first, self.config.block_size)
+            ids = self.alloc.alloc(need)
+            if ids is None:
+                break
+            req.blocks.extend(ids)
+            self.queued.remove(req)
+            req.row = self._free_rows.pop()
+            req.state = PREFILL
+            self.running[req.row] = req
+            self._admit_index[req.rid] = self._admit_seq
+            self._admit_seq += 1
+            self.admitted_log.append(req.rid)
+            admitted.append(req)
+        return admitted
+
+    # -- block growth + preemption ----------------------------------------
+    def ensure_blocks(self, req: Request, upto_tokens: int) -> bool:
+        """Grow ``req``'s block list to cover positions [0, upto_tokens).
+        When the pool is dry, evicts the most recently admitted OTHER
+        request and retries; returns False when nothing can be evicted
+        (the caller skips this request for the iteration)."""
+        need = blocks_for_tokens(upto_tokens, self.config.block_size) \
+            - len(req.blocks)
+        if need <= 0:
+            return True
+        while True:
+            ids = self.alloc.alloc(need)
+            if ids is not None:
+                req.blocks.extend(ids)
+                return True
+            if not self._preempt_one(exclude=req):
+                return False
+
+    def _preempt_one(self, exclude: Request) -> bool:
+        victims = [r for r in self.running.values() if r is not exclude]
+        if not victims:
+            return False
+        victim = max(victims, key=lambda r: self._admit_index[r.rid])
+        self.preempt(victim)
+        return True
+
+    def preempt(self, req: Request) -> None:
+        """Evict ``req``'s blocks and re-queue it in recompute mode: the new
+        prefill source is prompt + generated-so-far minus the pending token
+        (whose KV was never written); the stored ``pending_token`` is
+        re-used on resume so the client stream never sees a duplicate — or,
+        under temperature sampling, a diverged — token."""
+        self.preemption_count += 1
+        req.preemptions += 1
+        self._release(req)
+        if req.generated:
+            req.prompt = np.concatenate(
+                [req.prompt[:req.n_prompt],
+                 np.asarray(req.generated[:-1], np.int32)]).astype(np.int32)
+            req.pending_token = req.generated[-1]
+            req.resume = True
+        req.prefill_pos = 0
+        req.length = 0
+        req.state = QUEUED
+        self.queued.append(req)
+
+    # -- iteration planning ------------------------------------------------
+    def next_prefill(self) -> Optional[Request]:
+        """The PREFILL-state request to advance this iteration — oldest
+        admission first, so a chunked long prompt finishes in order."""
+        cands = [r for r in self.running.values() if r.state == PREFILL]
+        if not cands:
+            return None
+        return min(cands, key=lambda r: self._admit_index[r.rid])
+
+    def decode_requests(self) -> List[Request]:
+        return [r for r in self.running.values() if r.state == DECODE]

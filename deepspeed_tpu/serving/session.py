@@ -1,0 +1,141 @@
+"""Request sessions — the client half of the streaming front end.
+
+A ``RequestHandle`` is what ``ServingEngine.submit`` returns: a thread-safe
+incremental view of one request's output tokens. It works in both engine
+modes:
+
+* **step-driven** (tests, benches): iterating ``stream()`` or calling
+  ``result()`` drives ``engine.step()`` itself until tokens arrive;
+* **threaded** (``engine.start()``): a driver thread steps the engine;
+  consumers block on the handle's condition variable.
+
+Cancellation is cooperative: ``cancel()`` marks the request and the engine
+releases its row/blocks at the next iteration boundary (or immediately when
+called between steps).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Iterator, List, Optional
+
+import numpy as np
+
+from .scheduler import CANCELLED, FINISHED, Request
+
+__all__ = ["RequestHandle", "RequestCancelled"]
+
+
+class RequestCancelled(RuntimeError):
+    """Raised by ``result()`` when the request was cancelled."""
+
+
+class RequestHandle:
+    """Incremental, thread-safe view of one request's generated tokens."""
+
+    def __init__(self, engine, req: Request):
+        self._engine = engine
+        self._req = req
+        self._cond = threading.Condition()
+        self._tokens: List[int] = []
+
+    # -- engine-side (called from ServingEngine.step under its lock) -------
+    def _push(self, token: int) -> None:
+        with self._cond:
+            self._tokens.append(int(token))
+            self._cond.notify_all()
+
+    def _wake(self) -> None:
+        """Terminal-state transition: wake any blocked consumers."""
+        with self._cond:
+            self._cond.notify_all()
+
+    # -- client-side -------------------------------------------------------
+    @property
+    def request_id(self) -> int:
+        return self._req.rid
+
+    @property
+    def state(self) -> str:
+        return self._req.state
+
+    @property
+    def done(self) -> bool:
+        return self._req.done
+
+    @property
+    def tokens(self) -> List[int]:
+        with self._cond:
+            return list(self._tokens)
+
+    @property
+    def ttft_s(self) -> Optional[float]:
+        return self._req.ttft_s
+
+    @property
+    def tpot_s(self) -> Optional[float]:
+        return self._req.tpot_s
+
+    @property
+    def preemptions(self) -> int:
+        return self._req.preemptions
+
+    def cancel(self) -> bool:
+        """Cancel the request; returns False when it already finished."""
+        return self._engine.cancel(self)
+
+    def stream(self, timeout_s: Optional[float] = None) -> Iterator[int]:
+        """Yield tokens as they are generated. In step-driven mode this
+        DRIVES the engine (each starved iteration runs one engine step); in
+        threaded mode it blocks on the condition variable. Ends when the
+        request finishes or is cancelled; raises TimeoutError past
+        ``timeout_s`` without a token (engine clock in step-driven mode),
+        and RuntimeError when the engine stops making progress entirely
+        (the same starvation guard as ``ServingEngine.run``)."""
+        i = 0
+        deadline = (self._engine.clock() + timeout_s
+                    if timeout_s is not None else None)
+        starved = 0
+        while True:
+            tok = None
+            with self._cond:
+                if i < len(self._tokens):
+                    tok = self._tokens[i]
+                    i += 1
+                elif self._req.done:
+                    return
+                elif self._engine.threaded:
+                    if not self._cond.wait(timeout=timeout_s):
+                        raise TimeoutError(
+                            f"request {self._req.rid}: no token within "
+                            f"{timeout_s}s")
+                    continue
+            if tok is not None:
+                deadline = (self._engine.clock() + timeout_s
+                            if timeout_s is not None else None)
+                starved = 0
+                yield tok
+                continue
+            # step-driven: advance the engine outside our condition lock
+            if deadline is not None and self._engine.clock() > deadline:
+                raise TimeoutError(
+                    f"request {self._req.rid}: no token within {timeout_s}s")
+            if self._engine.step():
+                starved = 0
+            else:
+                starved += 1
+                if starved > 2 * self._engine.config.max_queue + 4:
+                    raise RuntimeError(
+                        f"request {self._req.rid}: serving stalled — no "
+                        "request can make progress (block pool or row "
+                        "count too small for the workload)")
+
+    def result(self, timeout_s: Optional[float] = None) -> np.ndarray:
+        """Block (or drive) until the request finishes; returns the full
+        generated token array. Raises ``RequestCancelled`` on cancellation."""
+        for _ in self.stream(timeout_s=timeout_s):
+            pass
+        if self._req.state == CANCELLED:
+            raise RequestCancelled(f"request {self._req.rid} was cancelled")
+        assert self._req.state == FINISHED
+        return np.asarray(self.tokens, np.int32)

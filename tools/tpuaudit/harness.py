@@ -18,7 +18,10 @@ Config shape (all sections optional)::
                    "config": {"parallel": {"pipeline_parallel_size": 2}, ...},
                    "seq_len": 16},
       "inference": {"model": {"type": "preset", "name": "tiny"},
-                    "batch_size": 1, "prompt_len": 64, "max_new_tokens": 8}
+                    "batch_size": 1, "prompt_len": 64, "max_new_tokens": 8},
+      "serving":   {"model": {"type": "preset", "name": "tiny"},
+                    "config": {"block_size": 16, "max_seqs": 4,
+                               "max_model_len": 64, "prefill_chunk": 16}}
     }
 
 ``batch`` entries are ``name: [shape, dtype]`` pairs describing ONE microbatch
@@ -111,6 +114,26 @@ def run_section_inference(section: Dict[str, Any]) -> List[str]:
         max_new_tokens=int(section.get("max_new_tokens", 8)))
 
 
+def run_section_serving(section: Dict[str, Any]) -> List[str]:
+    from deepspeed_tpu.config.config import ServingConfig
+    from deepspeed_tpu.serving import init_serving
+
+    spec = dict(section["model"])
+    if spec.get("type", "preset") != "preset":
+        raise ValueError("serving audit section needs a preset model "
+                         "(the paged arena is sized from its config)")
+    overrides = {k: v for k, v in spec.items()
+                 if k not in ("type", "name", "dtype", "max_seq_len")}
+    kw = {k: section[k] for k in ("tensor_parallel", "expert_parallel",
+                                  "dtype") if k in section}
+    scfg = ServingConfig.from_dict(section.get("config") or {})
+    engine = init_serving(model=spec["name"], serving_config=scfg,
+                          **kw, **overrides)
+    # construction registered the entries; the explicit call returns their
+    # names for the CLI (idempotent — latest registration wins)
+    return engine._register_audit_entries()
+
+
 def build_from_config(config: Dict[str, Any]) -> List[str]:
     """Build every engine the config names; returns the registered entry
     names (the registry keeps the entries for the CLI to audit)."""
@@ -120,6 +143,8 @@ def build_from_config(config: Dict[str, Any]) -> List[str]:
             registered += run_section_train(config[key], prefix=key)
     if "inference" in config:
         registered += run_section_inference(config["inference"])
+    if "serving" in config:
+        registered += run_section_serving(config["serving"])
     return registered
 
 
