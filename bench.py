@@ -47,6 +47,39 @@ def peak_flops_per_chip() -> float:
     return peak_flops_for(jax.devices()[0].device_kind)
 
 
+def predict_main() -> None:
+    """BENCH_PREDICT=1 child mode: the ANALYTIC predicted MFU for this
+    bench's exact config, host-side (CPU jax, no engine, no params). This is
+    what a tunnel-outage skip record carries as ``predicted_mfu`` — the
+    static half of the measured-vs-predicted pairing, computable when the
+    measured half isn't."""
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.autotuning.cost_model import (TpuCostModel,
+                                                     peak_flops_for)
+    from deepspeed_tpu.models import create_model
+    from deepspeed_tpu.profiling import transformer_breakdown
+
+    batch = int(os.environ.get("BENCH_BATCH", 32))
+    seq = int(os.environ.get("BENCH_SEQ", 1024))
+    model = create_model("gpt2-125m", dtype=jnp.bfloat16, max_seq_len=seq)
+    cfg = model.config
+    n = transformer_breakdown(cfg, batch, seq).total_params
+    flops_per_token = 6 * n + 12 * cfg.num_layers * cfg.hidden_size * seq
+    # mfu=1.0: predict the CEILING (roofline + overhead), not the 50% target
+    cm = TpuCostModel(model_info={
+        "num_params": n, "hidden_size": cfg.hidden_size,
+        "num_layers": cfg.num_layers, "seq_length": seq,
+        "vocab_size": cfg.vocab_size}, mfu=1.0)
+    tps = cm.predict_throughput({"train_micro_batch_size_per_gpu": batch})
+    print(json.dumps({
+        "predicted_mfu": round(tps * flops_per_token / peak_flops_for(None),
+                               4),
+        "predicted_tokens_per_sec": round(tps, 1),
+        "source": "analytic-roofline",
+    }))
+
+
 def main() -> None:
     import jax
     import jax.numpy as jnp
@@ -153,11 +186,28 @@ def main() -> None:
     skew = fleet_skew_from_metrics(metrics_path if obs.enabled else None)
     if skew is not None:
         record["step_time_skew"] = round(skew, 4)
+
+    # static cost vector for the step program the loop just ran (the
+    # engine registered it with the audit registry at first train_batch):
+    # the record carries measured-vs-predicted MFU side by side, so the
+    # r03-style trajectory shows how far each round sat from its own
+    # program's ceiling. BENCH_COST=0 opts out (the AOT re-extraction
+    # costs one uncached host compile).
+    if os.environ.get("BENCH_COST", "1") == "1":
+        from bench_common import cost_vector_record
+
+        cost = cost_vector_record("train/step")
+        if cost is not None:
+            record["tpucost"] = cost
+            record["measured_vs_predicted_mfu"] = [
+                round(mfu, 4), cost["predicted_mfu"]]
     print(json.dumps(record))
 
 
 if __name__ == "__main__":
-    if os.environ.get("BENCH_CHILD") == "1":
+    if os.environ.get("BENCH_PREDICT") == "1":
+        predict_main()
+    elif os.environ.get("BENCH_CHILD") == "1":
         main()
     else:
         run_watchdogged(

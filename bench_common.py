@@ -50,15 +50,83 @@ BACKEND_DOWN_MARKERS = (
 )
 
 
-def skip(metric: str, unit: str, reason: str, failure_kind: str) -> None:
+def skip(metric: str, unit: str, reason: str, failure_kind: str,
+         predicted_mfu: Optional[float] = None) -> None:
     """Print the structured skip record and exit 0 (the driver still gets a
-    parseable result). ``failure_kind``: hang | backend-init | crash."""
+    parseable result). ``failure_kind``: hang | backend-init | crash.
+    ``predicted_mfu`` carries the STATIC roofline number (computed host-side,
+    no TPU) so a tunnel-outage round still reports what the program should
+    have achieved — the measured-vs-predicted pairing just loses its
+    measured half."""
     print(json.dumps({
         "metric": metric, "value": None, "unit": unit,
         "vs_baseline": None, "skipped": True,
         "failure_kind": failure_kind, "reason": reason[-700:],
+        "predicted_mfu": predicted_mfu,
     }))
     sys.exit(0)
+
+
+def static_prediction(script: str,
+                      timeout_s: float = 180.0) -> Optional[float]:
+    """The bench's analytic predicted-MFU, computed in a throwaway CPU-only
+    subprocess (``BENCH_PREDICT=1`` child mode — the parent stays jax-free
+    by design, and forcing ``JAX_PLATFORMS=cpu`` keeps the probe off the
+    very tunnel whose outage we are annotating). None when the probe fails
+    or times out — a skip record must never block on its annotation."""
+    env = dict(os.environ, BENCH_PREDICT="1", JAX_PLATFORMS="cpu")
+    env.pop("BENCH_CHILD", None)
+    try:
+        r = subprocess.run([sys.executable, script], env=env,
+                           timeout=timeout_s, capture_output=True, text=True)
+        if r.returncode != 0:
+            return None
+        for line in reversed((r.stdout or "").strip().splitlines()):
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            v = rec.get("predicted_mfu")
+            return float(v) if v is not None else None
+    except (subprocess.TimeoutExpired, OSError, ValueError):
+        return None
+    return None
+
+
+def cost_vector_record(entry: str) -> Optional[Dict]:
+    """Static cost vector for a registered audit entry, flattened for
+    embedding in a BENCH_*.json record (child-side only — pulls in jax and
+    the tools/ tree). The next on-chip round reports measured-vs-predicted
+    MFU side by side from this. None when the tools tree is absent, the
+    entry never registered, or extraction fails — the bench number itself
+    must never depend on the annotation."""
+    try:
+        import jax
+
+        from tools.tpucost import registry_cost_vector
+
+        vec = registry_cost_vector(
+            entry, device_kind=jax.devices()[0].device_kind)
+    except Exception:                               # noqa: BLE001
+        return None
+    if vec is None:
+        return None
+    m = vec.metrics
+    rec = {
+        "entry": entry,
+        "flops": m.get("flops"),
+        "bytes_accessed": m.get("bytes_accessed"),
+        "peak_hbm_bytes": m.get("peak_hbm_bytes"),
+        "collective_bytes": m.get("collective_bytes"),
+        "predicted_step_ms": round(vec.predicted_step_s * 1e3, 4),
+        "predicted_mfu": round(vec.mfu_ceiling, 4),
+        "bound": vec.bound,
+        "program_hash": vec.program_hash[:12],
+    }
+    if vec.predicted_tokens_per_sec is not None:
+        rec["predicted_tokens_per_sec"] = round(
+            vec.predicted_tokens_per_sec, 1)
+    return rec
 
 
 def probe_backend(attempts: int = 5, probe_timeout: int = 75,
@@ -194,6 +262,15 @@ def run_watchdogged(metric: str, unit: str, script: str,
     def remaining() -> float:
         return budget - (time.monotonic() - start)
 
+    _prediction: list = []   # lazy one-shot cache: probe only when skipping
+
+    def _skip(reason: str, kind: str) -> None:
+        if not _prediction:
+            t = min(max(remaining(), 0.0), 180.0)
+            _prediction.append(static_prediction(script, t)
+                               if t >= 30 else None)
+        skip(metric, unit, reason, kind, predicted_mfu=_prediction[0])
+
     first_timeout = float(os.environ.get("BENCH_WATCHDOG_TIMEOUT",
                                          budget * 0.6))
     err = ""
@@ -213,7 +290,7 @@ def run_watchdogged(metric: str, unit: str, script: str,
             else:
                 reason += "; no flight record found (BENCH_OBS=0, or the " \
                           "child hung before its observability session)"
-            skip(metric, unit, reason, "hang")
+            _skip(reason, "hang")
         if rc == 0:
             sys.stdout.write(out)
             return
@@ -231,13 +308,10 @@ def run_watchdogged(metric: str, unit: str, script: str,
             down = probe_backend(attempts=3,
                                  cwd=os.path.dirname(os.path.abspath(script)))
             if down is not None:
-                skip(metric, unit,
-                     f"TPU backend unavailable after bounded retries: {down}",
-                     "backend-init")
+                _skip(f"TPU backend unavailable after bounded retries: "
+                      f"{down}", "backend-init")
             if remaining() < 120:
-                skip(metric, unit,
-                     "TPU backend recovered but the run budget is spent; "
-                     f"first failure: {err[-300:]}", "backend-init")
-    skip(metric, unit,
-         f"TPU backend dropped twice despite a healthy probe: {err[-400:]}",
-         "crash")
+                _skip("TPU backend recovered but the run budget is spent; "
+                      f"first failure: {err[-300:]}", "backend-init")
+    _skip(f"TPU backend dropped twice despite a healthy probe: {err[-400:]}",
+          "crash")

@@ -40,20 +40,53 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 from bench_common import run_watchdogged  # noqa: E402
 
-HBM_BW = {  # bytes/s
-    "v5 lite": 819e9, "v5e": 819e9, "v5litepod": 819e9,
-    "v5p": 2765e9, "v4": 1228e9, "v6e": 1640e9, "v6 lite": 1640e9,
-}
-
-
 def hbm_bandwidth() -> float:
+    """Attached chip's HBM bytes/s — the shared cost-model table, so the
+    measured roofline_frac and tpucost's predicted numbers can never be
+    computed against different bandwidths."""
     import jax
 
-    kind = jax.devices()[0].device_kind.lower()
-    for key, val in HBM_BW.items():
-        if key in kind:
-            return val
-    return 819e9
+    from deepspeed_tpu.autotuning.cost_model import hbm_bw_for
+
+    return hbm_bw_for(jax.devices()[0].device_kind)
+
+
+def predict_main() -> None:
+    """BENCH_PREDICT=1 child mode: the analytic decode roofline for this
+    bench's config, host-side (no engine, no params — weight bytes come
+    from the analytic param count, KV bytes from ``cache_memory_bytes``).
+    Decode MFU is tiny by nature (memory-bound); the number still pins the
+    skip record to THIS config's ceiling."""
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.autotuning.cost_model import (hbm_bw_for,
+                                                     peak_flops_for)
+    from deepspeed_tpu.inference import cache_memory_bytes
+    from deepspeed_tpu.models import create_model
+    from deepspeed_tpu.profiling import transformer_breakdown
+
+    model_name = os.environ.get("BENCH_INFER_MODEL", "llama-7b")
+    prompt_len = int(os.environ.get("BENCH_INFER_PROMPT", 512))
+    n_new = int(os.environ.get("BENCH_INFER_NEW", 64))
+    dtype_name = os.environ.get("BENCH_INFER_DTYPE", "bf16")
+    model = create_model(model_name, dtype=jnp.bfloat16)
+    cfg = model.config
+    n = transformer_breakdown(cfg, 1, 1).total_params
+    weight_bytes = {"int8": 1.0, "w8a8": 1.0,
+                    "int4": 0.5, "w4a8": 0.5}.get(dtype_name, 2.0)
+    live = prompt_len + n_new // 2
+    # KV stays bf16 for every allowed BENCH_INFER_DTYPE: the quant modes are
+    # weight-storage-only and InferenceConfig normalizes their compute/arena
+    # dtype to bf16 — matching main()'s engine.config.dtype sizing
+    kv = cache_memory_bytes(cfg, 1, live, jnp.bfloat16)
+    roofline_tps = hbm_bw_for(None) / (n * weight_bytes + kv)
+    print(json.dumps({
+        # ~2N matmul flops per decoded token against the chip's peak
+        "predicted_mfu": round(roofline_tps * 2 * n / peak_flops_for(None),
+                               6),
+        "predicted_decode_tokens_per_sec": round(roofline_tps, 1),
+        "source": "analytic-roofline",
+    }))
 
 
 def main() -> None:
@@ -147,14 +180,27 @@ def main() -> None:
         obs.export_chrome_trace()
         obs.close(export=False)   # already exported to the bench paths
 
-    print(json.dumps({
+    record = {
         "metric": f"{model_name}_{dtype_name}_p50_ttft_ms",
         "value": round(p50_ttft * 1e3, 2),
         "unit": "ms",
         "decode_tokens_per_sec": round(decode_tps, 1),
         "roofline_frac": round(frac, 4),
         "vs_baseline": round(frac, 4),
-    }))
+    }
+    # static cost vectors for the prefill/decode programs generate() just
+    # ran (registered with the audit registry at first generate); the next
+    # on-chip round reports measured-vs-predicted side by side
+    if os.environ.get("BENCH_COST", "1") == "1":
+        from bench_common import cost_vector_record
+
+        cost = cost_vector_record("inference/decode")
+        if cost is not None:
+            record["tpucost"] = cost
+            prefill = cost_vector_record("inference/prefill")
+            if prefill is not None:
+                record["tpucost_prefill"] = prefill
+    print(json.dumps(record))
 
 
 def serving_main() -> None:
@@ -254,7 +300,7 @@ def serving_main() -> None:
         obs.export_chrome_trace()
         obs.close(export=False)
 
-    print(json.dumps({
+    record = {
         "metric": metric,
         "value": round(p(ttfts, 0.50) * 1e3, 2),
         "unit": "ms",
@@ -267,13 +313,22 @@ def serving_main() -> None:
             srv.alloc.peak_in_use / srv.alloc.capacity, 4),
         "preemptions": srv.sched.preemption_count,
         "vs_baseline": None,
-    }))
+    }
+    if os.environ.get("BENCH_COST", "1") == "1":
+        from bench_common import cost_vector_record
+
+        cost = cost_vector_record("serving/decode")
+        if cost is not None:
+            record["tpucost"] = cost
+    print(json.dumps(record))
 
 
 if __name__ == "__main__":
     serving = ("--serving" in sys.argv[1:]
                or os.environ.get("BENCH_INFER_MODE") == "serving")
-    if os.environ.get("BENCH_CHILD") == "1":
+    if os.environ.get("BENCH_PREDICT") == "1":
+        predict_main()
+    elif os.environ.get("BENCH_CHILD") == "1":
         serving_main() if serving else main()
     else:
         if serving:

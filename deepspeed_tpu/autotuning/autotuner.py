@@ -117,6 +117,7 @@ class Autotuner:
         self.script_args = script_args or []
         self.runner = runner or self._subprocess_runner
         self.results: Dict[str, Optional[float]] = {}
+        self.cost_backend: Optional[str] = None   # set per tune() sweep
 
     def _subprocess_runner(self, name: str, config: Dict) -> Optional[float]:
         exp_dir = os.path.join(self.results_dir, name)
@@ -139,14 +140,36 @@ class Autotuner:
             logger.warning(f"experiment {name} failed: {exc}")
             return None
 
+    @staticmethod
+    def _discover_cost_vector(entry: str = "train/step"):
+        """tpucost vector for an in-process registered entry, or None —
+        the deprecation shim for the static cost tables: when the engine
+        being tuned has registered its step with the audit registry, the
+        cost model calibrates on XLA's own flops count instead of the
+        6N+12LHS tables, and every estimate is traceable to a program
+        hash. Degrades silently (no tools/ tree, no registry entry, trace
+        failure) — the tuner must never require tpucost."""
+        try:
+            from tools.tpucost import registry_cost_vector
+        except ImportError:
+            return None
+        try:
+            return registry_cost_vector(entry)
+        except Exception:                           # noqa: BLE001
+            return None
+
     def tune(self, space: Optional[Dict[str, Sequence[Any]]] = None,
              tuner_type: str = "gridsearch", num_trials: int = 50,
              model_info: Optional[Dict[str, Any]] = None,
              max_parallel: int = 1,
+             cost_vector: Any = None,
              **model_kwargs) -> Tuple[Optional[str], Optional[float]]:
         """Run the sweep. ``model_based``: rank the grid with the analytic
         cost model, measure only the top ``num_trials`` feasible configs
-        (reference ModelBasedTuner's surrogate-guided selection)."""
+        (reference ModelBasedTuner's surrogate-guided selection).
+        ``cost_vector``: an explicit ``tools.tpucost.CostVector`` to
+        calibrate the model on; by default one is discovered from the
+        in-process tpucost/tpuaudit registry (entry ``train/step``)."""
         if tuner_type == "model_based":
             if model_info is None:
                 model_info = (self.base_config.get("autotuning", {})
@@ -158,6 +181,19 @@ class Autotuner:
             from .cost_model import TpuCostModel
 
             model = TpuCostModel(model_info=model_info, **model_kwargs)
+            vec = cost_vector or self._discover_cost_vector()
+            if vec is not None and model.calibrate_from_vector(vec):
+                logger.info(
+                    f"autotuning(model_based): cost estimates from "
+                    f"{model.backend} (entry "
+                    f"'{getattr(vec, 'entry', '?')}', XLA-counted flops)")
+            else:
+                logger.info(
+                    "autotuning(model_based): cost estimates from "
+                    "static-tables (no tpucost vector available — register "
+                    "the engine's audit entries to calibrate on the real "
+                    "program)")
+            self.cost_backend = model.backend
             all_exps = generate_experiments(self.base_config, space,
                                             "gridsearch", num_trials)
             scored = [(model.predict_throughput(cfg), name, cfg)
@@ -175,6 +211,7 @@ class Autotuner:
             experiments = generate_experiments(self.base_config, space,
                                                tuner_type, num_trials)
             self.predictions = {}
+            self.cost_backend = None
         logger.info(f"autotuning: {len(experiments)} experiments")
         manager = ResourceManager(self.runner, max_parallel=max_parallel)
         sweep_results = manager.run(experiments)
@@ -188,7 +225,8 @@ class Autotuner:
         with open(os.path.join(self.results_dir, "summary.json"), "w") as fh:
             json.dump({"best": best_name, "metric": self.metric,
                        "results": self.results,
-                       "predictions": self.predictions}, fh, indent=1)
+                       "predictions": self.predictions,
+                       "cost_backend": self.cost_backend}, fh, indent=1)
         return best_name, best_val
 
 

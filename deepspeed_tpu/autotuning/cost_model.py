@@ -18,7 +18,7 @@ from __future__ import annotations
 import dataclasses
 from typing import Any, Dict, Optional
 
-HBM_BW = {  # bytes/s (bench_infer.py table)
+HBM_BW = {  # bytes/s (the one table: bench_infer + tpucost read hbm_bw_for)
     "v5 lite": 819e9, "v5e": 819e9, "v5litepod": 819e9,
     "v5p": 2765e9, "v4": 1228e9, "v6e": 1640e9, "v6 lite": 1640e9,
 }
@@ -52,8 +52,16 @@ def peak_flops_for(device_kind: Optional[str]) -> float:
     """bf16 peak FLOP/s for a ``device.device_kind`` string (v5e-class
     default for unknown kinds — CPU smoke runs get a real-chip denominator
     so MFU numbers stay comparable, just tiny). The shared lookup behind
-    bench.py's MFU math and the observability goodput/mfu gauge."""
+    bench.py's MFU math, the observability goodput/mfu gauge and tpucost's
+    roofline bound."""
     return _platform(device_kind, PEAK_FLOPS, 197e12)
+
+
+def hbm_bw_for(device_kind: Optional[str]) -> float:
+    """HBM bytes/s for a ``device.device_kind`` string (v5e-class default
+    for unknown kinds) — the other roofline denominator, shared by
+    bench_infer.py's decode roofline and tpucost."""
+    return _platform(device_kind, HBM_BW, 819e9)
 
 
 @dataclasses.dataclass
@@ -82,6 +90,30 @@ class TpuCostModel:
         self.layers = float(self.model_info.get("num_layers", 12))
         self.seq = float(self.model_info.get("seq_length", 1024))
         self.vocab = float(self.model_info.get("vocab_size", 50257))
+        # provenance of the flops term: the static 6N+12LHS tables by
+        # default; calibrate_from_vector switches to a tpucost-measured
+        # program ("tpucost:<hash>") so tuner recommendations are traceable
+        self.backend = "static-tables"
+        self._flops_per_token: Optional[float] = None
+
+    # -- tpucost calibration (the static-table deprecation shim) ----------
+    def calibrate_from_vector(self, vector: Any) -> bool:
+        """Replace the analytic flops estimate with a tpucost cost vector's
+        XLA-counted flops (``tools.tpucost.CostVector`` or anything with
+        ``metrics['flops']``, a ``tokens_per_step`` tag and a
+        ``program_hash``). The measured program covers fwd+bwd+update, like
+        the 6N rule it replaces. Returns False (and stays on the static
+        tables) when the vector lacks flops or a token count."""
+        try:
+            flops = float(vector.metrics["flops"])
+            tokens = float(vector.tags["tokens_per_step"])
+        except (AttributeError, KeyError, TypeError, ValueError):
+            return False
+        if flops <= 0 or tokens <= 0:
+            return False
+        self._flops_per_token = flops / tokens
+        self.backend = f"tpucost:{getattr(vector, 'program_hash', '?')[:12]}"
+        return True
 
     # -- memory ----------------------------------------------------------
     def memory_bytes(self, config: Dict[str, Any]) -> float:
@@ -126,8 +158,11 @@ class TpuCostModel:
         off_par = zo.get("offload_param", {}).get("device", "none")
         W = max(1, self.world_size)
         tokens = micro * self.seq
-        flops = tokens * (6 * self.n
-                          + 12 * self.layers * self.hidden * self.seq)
+        flops_per_token = (self._flops_per_token
+                           if self._flops_per_token is not None
+                           else 6 * self.n
+                           + 12 * self.layers * self.hidden * self.seq)
+        flops = tokens * flops_per_token
         compute_t = flops / (self.peak * self.mfu)
         # optimizer-state HBM traffic per step amortises over gas micros
         hbm_t = (16 * self.n / self.bw) / max(gas, 1)

@@ -429,7 +429,9 @@ class InferenceEngine:
                 expected_collectives=self._audit_expected_collectives(),
                 mesh=self.mesh,
                 tags={"engine": "InferenceEngine", "batch": B,
-                      "prompt_bucket": S_pad})
+                      "prompt_bucket": S_pad,
+                      # prefill ingests the whole padded prompt per run
+                      "tokens_per_step": B * S_pad})
             return "inference/prefill"
         except Exception:   # registration must never take serving down
             logger.warning("tpuaudit prefill registration failed",
@@ -466,7 +468,9 @@ class InferenceEngine:
                 expected_collectives=self._audit_expected_collectives(),
                 mesh=self.mesh,
                 tags={"engine": "InferenceEngine", "batch": B,
-                      "new_tokens": n_rest})
+                      "new_tokens": n_rest,
+                      # one decode program emits n_rest tokens per row
+                      "tokens_per_step": B * n_rest})
             return "inference/decode"
         except Exception:
             logger.warning("tpuaudit decode registration failed",

@@ -251,6 +251,61 @@ def summarize_serving(records: List[Dict[str, Any]]) -> str:
     return "\n".join(lines)
 
 
+def summarize_cost(records: List[Dict[str, Any]]) -> str:
+    """``== cost ==`` — the static cost vectors tpucost publishes as
+    ``tpucost/<entry>/<metric>`` gauges: per-entry flops / bytes / peak HBM /
+    collective payload and the analytic roofline bound (predicted step time,
+    MFU ceiling, which pipe binds). When a measured ``goodput/mfu`` gauge is
+    present in the same records, the footer puts measured MFU next to the
+    static ceiling — the measured-vs-predicted pairing the bench rounds
+    report."""
+    recs = [r for r in records if r.get("type") == "gauge"
+            and str(r.get("name", "")).startswith("tpucost/")]
+    if not recs:
+        return ""
+    entries: Dict[str, Dict[str, Dict[str, Any]]] = {}
+    for r in recs:
+        entry, _, metric = r["name"][len("tpucost/"):].rpartition("/")
+        entries.setdefault(entry, {})[metric] = r   # latest record wins
+    rows = []
+    for entry in sorted(entries):
+        m = entries[entry]
+
+        def val(name: str, scale: float = 1.0, fmt: str = ",.0f") -> str:
+            r = m.get(name)
+            return format(r["value"] * scale, fmt) if r else "-"
+
+        pred = m.get("predicted_step_ms")
+        rows.append([
+            entry,
+            val("flops"),
+            val("bytes_accessed"),
+            val("peak_hbm_bytes"),
+            val("collective_bytes"),
+            f"{pred['value']:.4f}" if pred else "-",
+            val("mfu_ceiling", fmt=".3f"),
+            (pred or {}).get("labels", {}).get("bound", "-"),
+            val("predicted_tokens_per_sec"),
+        ])
+    lines = ["== cost ==",
+             _fmt_table(["entry", "flops", "bytes", "peak_hbm", "coll_B",
+                         "pred_ms", "mfu_ceil", "bound", "pred_tok/s"],
+                        rows)]
+    mfu = next((r["value"] for r in reversed(records)
+                if r.get("type") == "gauge" and r.get("name") == "goodput/mfu"),
+               None)
+    if mfu is not None:
+        # goodput/mfu is published by the TRAIN engine — pair it with the
+        # train step's own ceiling, never some other program's
+        for entry in ("train/step", "pipeline/step"):
+            ceiling = entries.get(entry, {}).get("mfu_ceiling")
+            if ceiling is not None:
+                lines.append(f"  measured mfu = {mfu:.4f} vs static ceiling "
+                             f"{ceiling['value']:.4f} ({entry})")
+                break
+    return "\n".join(lines)
+
+
 def summarize_recompiles(records: List[Dict[str, Any]]) -> str:
     compiles = [r for r in records
                 if r.get("type") == "counter" and r.get("name") == "xla/compiles"]
@@ -301,6 +356,7 @@ def report(paths: List[str]) -> str:
     sections = [s for s in (summarize_spans(records),
                             summarize_metrics(records),
                             summarize_goodput(records),
+                            summarize_cost(records),
                             summarize_serving(records),
                             summarize_fleet(records),
                             summarize_recompiles(records)) if s]

@@ -52,15 +52,22 @@ def duration_string(sec: float, precision: int = 2) -> str:
 
 
 def compiled_cost(compiled) -> Dict[str, float]:
-    """flops / bytes from a ``jax.stages.Compiled`` (XLA cost analysis)."""
+    """flops / bytes from a ``jax.stages.Compiled`` (XLA cost analysis).
+
+    Delegates to the tpucost extraction helpers — the single implementation
+    of compiled-artifact metric parsing (``tools/tpucost/extract.py``), the
+    same one the CI cost gate reads, so the profiler and the gate can never
+    disagree on what a program costs. A deployment shipped without the
+    ``tools/`` tree degrades to {} (the same contract as a backend without
+    cost analysis)."""
     try:
-        cost = compiled.cost_analysis()
-    except Exception:
+        from tools.tpucost.extract import cost_analysis_dict
+    except ImportError:
         return {}
-    if isinstance(cost, (list, tuple)):
-        cost = cost[0] if cost else {}
-    return {"flops": float(cost.get("flops", 0.0)),
-            "bytes_accessed": float(cost.get("bytes accessed", 0.0))}
+    cost = cost_analysis_dict(compiled)
+    if not cost:
+        return {}
+    return {"flops": cost["flops"], "bytes_accessed": cost["bytes_accessed"]}
 
 
 # -- analytic transformer breakdown -----------------------------------------

@@ -48,6 +48,21 @@ from .lr_schedules import build_lr_schedule
 from .optimizer import MixedPrecisionOptimizer, OptimizerState, StepStats, build_optimizer
 
 
+def _batch_tokens(batch) -> int:
+    """Tokens consumed by ONE execution of a program fed ``batch`` (a pytree
+    of arrays or ShapeDtypeStructs): the full ``input_ids`` extent for token
+    batches — including any leading gas dim — else the example count of the
+    first leaf (feature dims dropped). Registered as the ``tokens_per_step``
+    audit tag so tpucost can turn its roofline bound into tokens/sec."""
+    if isinstance(batch, dict) and "input_ids" in batch:
+        return int(np.prod(np.shape(batch["input_ids"])))
+    leaves = jax.tree.leaves(batch)
+    if not leaves:
+        return 0
+    shape = tuple(np.shape(leaves[0]))
+    return int(np.prod(shape[:-1] if len(shape) > 1 else shape))
+
+
 class TrainEngine:
     """One engine instance per process; owns sharded state + jitted step."""
 
@@ -1518,7 +1533,11 @@ class TrainEngine:
                 suppress=frozenset(suppress), mesh=self.mesh,
                 compile=not self.model.pipelined,  # 1F1B compiles are heavy
                 tags={"engine": "TrainEngine",
-                      "zero_stage": self.config.zero_stage})
+                      "zero_stage": self.config.zero_stage,
+                      # tokens processed by ONE execution of this program
+                      # (all gas microbatches) — tpucost's roofline turns
+                      # it into a predicted tokens/sec bound
+                      "tokens_per_step": _batch_tokens(stacked_batch)})
             return name
         except Exception:  # registration must never take training down
             logger.warning("tpuaudit step registration failed", exc_info=True)
@@ -1549,7 +1568,8 @@ class TrainEngine:
                 name, build=build, donate_argnums=(),
                 expected_collectives=self._expected_collectives(train=False),
                 mesh=self.mesh, compile=not self.model.pipelined,
-                tags={"engine": "TrainEngine"})
+                tags={"engine": "TrainEngine",
+                      "tokens_per_step": _batch_tokens(batch)})
             return name
         except Exception:
             logger.warning("tpuaudit eval registration failed", exc_info=True)

@@ -527,12 +527,16 @@ class ServingEngine:
                 donate_argnums=(1,), expected_collectives=expected,
                 mesh=self.engine.mesh,
                 tags={"engine": "ServingEngine", "chunk": C,
-                      "max_blocks": MAXB})
+                      "max_blocks": MAXB,
+                      # one chunked-prefill run ingests C prompt tokens
+                      "tokens_per_step": C})
             register_entry_point(
                 "serving/decode", build=build_decode, donate_argnums=(1,),
                 expected_collectives=expected, mesh=self.engine.mesh,
                 tags={"engine": "ServingEngine", "rows": R,
-                      "max_blocks": MAXB})
+                      "max_blocks": MAXB,
+                      # one decode iteration emits one token per row
+                      "tokens_per_step": R})
             return ["serving/prefill_chunk", "serving/decode"]
         except Exception:   # registration must never take serving down
             logger.warning("tpuaudit serving registration failed",

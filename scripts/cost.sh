@@ -1,0 +1,11 @@
+#!/usr/bin/env bash
+# Repo static-cost gate: tpucost over the selftest engines against the
+# committed baseline. Exits non-zero on any over-band metric regression or
+# stale baseline entry. Usage: scripts/cost.sh [extra tpucost args...]
+set -euo pipefail
+
+cd "$(dirname "$0")/.."
+
+JAX_PLATFORMS=cpu python -m tools.tpucost \
+    --config tools/tpuaudit/selftest_config.json \
+    --baseline .tpucost-baseline.json "$@"

@@ -51,6 +51,11 @@ for a, b in zip(l_no, l_off):
     assert abs(a - b) < 5e-3, (a, b)
 
 # compiled-step memory accounting: device args must shrink by ~master+moments
+# (metrics parsed through the shared tpucost extraction helpers — the same
+# implementation the CI cost gate uses)
+from tools.tpucost.extract import memory_analysis_dict  # noqa: E402
+
+
 def arg_bytes(offload):
     mesh_mod.reset_mesh()
     model = create_model("gpt2-125m", dtype=jnp.bfloat16, remat=True,
@@ -69,9 +74,10 @@ def arg_bytes(offload):
     batch = jax.device_put({"input_ids": ids},
                            engine._batch_sharding({"input_ids": ids}, True))
     with engine.mesh:
-        ma = step.lower(engine.params, engine.opt_state, engine.scaler_state,
-                        batch).compile().memory_analysis()
-    return ma.argument_size_in_bytes
+        ma = memory_analysis_dict(
+            step.lower(engine.params, engine.opt_state, engine.scaler_state,
+                       batch).compile())
+    return ma["argument_hbm_bytes"]
 
 saved = (arg_bytes(False) - arg_bytes(True)) / 2**30
 print(f"device-resident argument bytes saved: {saved:.2f} GiB")

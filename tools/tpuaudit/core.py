@@ -27,7 +27,8 @@ from typing import Any, Dict, Iterable, List, Optional, Sequence, Set, Tuple
 from .registry import COLLECTIVE_KINDS, EntryPoint
 
 __all__ = ["Finding", "Program", "audit_entry", "run_audit",
-            "collect_collectives"]
+            "collect_collectives", "resolve_mesh", "trace_entry",
+            "iter_eqns_of"]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -64,18 +65,24 @@ class Program:
 
     def iter_eqns(self):
         """All equations, descending into sub-jaxprs (scan/cond/pjit/...)."""
-        seen: Set[int] = set()
+        yield from iter_eqns_of(self.closed_jaxpr)
 
-        def walk(jaxpr):
-            if id(jaxpr) in seen:
-                return
-            seen.add(id(jaxpr))
-            for eqn in jaxpr.eqns:
-                yield eqn
-                for sub in _subjaxprs(eqn):
-                    yield from walk(sub)
 
-        yield from walk(self.closed_jaxpr.jaxpr)
+def iter_eqns_of(closed_jaxpr) -> Iterable[Any]:
+    """All equations of a ClosedJaxpr, descending into sub-jaxprs — shared
+    by the audit checks and tpucost's jaxpr op census."""
+    seen: Set[int] = set()
+
+    def walk(jaxpr):
+        if id(jaxpr) in seen:
+            return
+        seen.add(id(jaxpr))
+        for eqn in jaxpr.eqns:
+            yield eqn
+            for sub in _subjaxprs(eqn):
+                yield from walk(sub)
+
+    yield from walk(closed_jaxpr.jaxpr)
 
 
 def _subjaxprs(eqn) -> Iterable[Any]:
@@ -142,22 +149,33 @@ def _flat_labels(args: tuple, kwargs: dict) -> Tuple[List[str], List[int]]:
     return labels, argnums
 
 
-def build_program(ep: EntryPoint, do_compile: Optional[bool] = None) -> Program:
-    """Trace + lower (+ compile) one entry point. Raises on trace failure —
-    ``audit_entry`` turns that into a ``trace-error`` finding."""
+def resolve_mesh(ep: EntryPoint):
+    """The entry's mesh: a Mesh, None, or a zero-arg resolver (registration
+    sites that only know the mesh lazily); note jax.sharding.Mesh itself is
+    callable (a ContextDecorator), so type-check before resolving."""
+    import jax
+
+    mesh = ep.mesh
+    if mesh is not None and not isinstance(mesh, jax.sharding.Mesh) \
+            and callable(mesh):
+        mesh = mesh()
+    return mesh
+
+
+def trace_entry(ep: EntryPoint, do_compile: Optional[bool] = None
+                ) -> Tuple[Any, Any, Any, tuple, dict]:
+    """Trace + lower (+ compile) one entry point under its mesh; returns
+    ``(traced, lowered, compiled-or-None, args, kwargs)``. The shared front
+    half of ``build_program``, also used by ``tools.tpucost`` — which needs
+    the live ``Lowered``/``Compiled`` stages for XLA's cost and memory
+    analysis, not just their text."""
     import jax
 
     fn, args, kwargs = ep.build()
     if not hasattr(fn, "trace"):      # plain python callable
         fn = jax.jit(fn, donate_argnums=ep.donate_argnums)
 
-    # ep.mesh is either a Mesh, None, or a zero-arg resolver (registration
-    # sites that only know the mesh lazily); note jax.sharding.Mesh itself
-    # is callable (a ContextDecorator), so type-check before resolving
-    mesh = ep.mesh
-    if mesh is not None and not isinstance(mesh, jax.sharding.Mesh) \
-            and callable(mesh):
-        mesh = mesh()
+    mesh = resolve_mesh(ep)
     ctx = contextlib.nullcontext()
     if mesh is not None:
         from deepspeed_tpu.parallel import mesh as mesh_mod
@@ -166,10 +184,18 @@ def build_program(ep: EntryPoint, do_compile: Optional[bool] = None) -> Program:
     with ctx:
         traced = fn.trace(*args, **kwargs)
         lowered = traced.lower()
-        stablehlo = lowered.as_text()
-        compiled_hlo = None
+        compiled = None
         if do_compile if do_compile is not None else ep.compile:
-            compiled_hlo = lowered.compile().as_text()
+            compiled = lowered.compile()
+    return traced, lowered, compiled, args, kwargs
+
+
+def build_program(ep: EntryPoint, do_compile: Optional[bool] = None) -> Program:
+    """Trace + lower (+ compile) one entry point. Raises on trace failure —
+    ``audit_entry`` turns that into a ``trace-error`` finding."""
+    traced, lowered, compiled, args, kwargs = trace_entry(ep, do_compile)
+    stablehlo = lowered.as_text()
+    compiled_hlo = compiled.as_text() if compiled is not None else None
 
     closed = traced.jaxpr
     labels, argnums = _flat_labels(args, kwargs)

@@ -34,6 +34,20 @@ from __future__ import annotations
 import json
 from typing import Any, Dict, List
 
+# Engines built by ``build_from_config``. Registration sites hold only a
+# WEAKREF to their engine (the registry must never pin a replaced engine in a
+# long-lived training process) — so the harness itself must keep the engines
+# it constructed alive, or every entry goes stale before the analyzers run:
+# exactly that happened between PR 3 and PR 7, where the audit gate silently
+# audited only the two pipeline closures that survive by accident of cyclic
+# references. CLI runs are short-lived, so pinning here is free; in-process
+# callers (tests) release the engines with ``clear_keepalive()``.
+_KEEPALIVE: List[Any] = []
+
+
+def clear_keepalive() -> None:
+    _KEEPALIVE.clear()
+
 
 def _np_dtype(name: str):
     import numpy as np
@@ -87,6 +101,7 @@ def run_section_train(section: Dict[str, Any],
     cfg.setdefault("optimizer", {"type": "adamw", "params": {"lr": 1e-3}})
     cfg.setdefault("steps_per_print", 10 ** 9)
     engine, *_ = deepspeed_tpu.initialize(model=model, config=cfg)
+    _KEEPALIVE.append(engine)
     gb = engine.train_batch_size() // engine.gradient_accumulation_steps()
     micro = _micro_batch(section, model, gb)
     return engine.register_audit_entries(micro, prefix=prefix)
@@ -108,6 +123,7 @@ def run_section_inference(section: Dict[str, Any]) -> List[str]:
     # pass the preset NAME: init_inference builds the model with the
     # engine's compute dtype, keeping params/cache/program dtypes coherent
     engine = init_inference(model=spec["name"], **kw, **overrides)
+    _KEEPALIVE.append(engine)
     return engine.register_audit_entries(
         batch_size=int(section.get("batch_size", 1)),
         prompt_len=int(section.get("prompt_len", 64)),
@@ -129,6 +145,7 @@ def run_section_serving(section: Dict[str, Any]) -> List[str]:
     scfg = ServingConfig.from_dict(section.get("config") or {})
     engine = init_serving(model=spec["name"], serving_config=scfg,
                           **kw, **overrides)
+    _KEEPALIVE.append(engine)
     # construction registered the entries; the explicit call returns their
     # names for the CLI (idempotent — latest registration wins)
     return engine._register_audit_entries()

@@ -1,0 +1,234 @@
+"""tpucost baseline — committed cost vectors with per-metric tolerance bands.
+
+Where tpulint/tpuaudit budget finding COUNTS, tpucost budgets metric VALUES:
+the committed ``.tpucost-baseline.json`` records each entry's cost vector,
+and the gate compares the current vector against it per metric:
+
+* **over the band** (``current > baseline * (1 + tol)``) → a regression
+  finding naming the entry, the metric, the delta — and, when the entry was
+  compiled both times, the HLO op classes whose counts grew (the "what got
+  fatter" attribution);
+* **under the band** → the same stale-rot semantics as the other two
+  analyzers: the improvement passed, but the lingering budget would silently
+  re-admit a regression up to the old value, so the gate ERRORS until
+  ``--prune-baseline`` ratchets it down;
+* **within the band** → clean.
+
+Tolerances are per metric: deterministic compiler outputs (flops, argument
+bytes, collective payload) gate exactly; layout/fusion-sensitive ones (peak
+HBM ±2%) and text-shaped ones (op counts, program size ±10%, which drift
+with unrelated source-location metadata) get bands. The report/exit tail is
+``tools.tpulint.baseline.render_report`` — shared by all three analyzers.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from ..tpulint.baseline import BASELINE_VERSION
+
+# relative tolerance per gated metric; metrics absent here ride along in the
+# vector (report/diff display) but do not gate
+TOLERANCES: Dict[str, float] = {
+    "flops": 0.0,
+    "transcendentals": 0.0,
+    "bytes_accessed": 0.02,
+    "collective_bytes": 0.0,
+    "peak_hbm_bytes": 0.02,
+    "temp_hbm_bytes": 0.02,
+    "argument_hbm_bytes": 0.0,
+    "output_hbm_bytes": 0.0,
+    "jaxpr_eqns": 0.10,
+    "hlo_op_count": 0.10,
+    "program_bytes": 0.10,
+}
+
+_EPS = 1e-9
+
+
+@dataclasses.dataclass(frozen=True)
+class CostFinding:
+    """One gate diagnostic; ``key`` (entry::metric) mirrors the other
+    analyzers' baseline buckets."""
+
+    entry: str
+    metric: str
+    message: str
+
+    @property
+    def key(self) -> str:
+        return f"{self.entry}::{self.metric}"
+
+    def render(self) -> str:
+        return f"{self.entry}: {self.metric}: {self.message}"
+
+    def to_json(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+def _fmt(v: float) -> str:
+    if v == int(v) and abs(v) < 1e15:
+        return f"{int(v):,}"
+    return f"{v:.6g}"
+
+
+def _delta_pct(base: float, cur: float) -> str:
+    if base == 0:
+        return "+inf%"
+    return f"{(cur - base) / base:+.2%}"
+
+
+def grown_op_classes(base_ops: Dict[str, int], cur_ops: Dict[str, int],
+                     top: int = 4) -> List[Tuple[str, int]]:
+    """HLO op classes whose counts grew, largest growth first — the
+    attribution attached to a regression finding."""
+    deltas = [(op, cur_ops.get(op, 0) - base_ops.get(op, 0))
+              for op in set(base_ops) | set(cur_ops)]
+    grown = [(op, d) for op, d in deltas if d > 0]
+    grown.sort(key=lambda t: (-t[1], t[0]))
+    return grown[:top]
+
+
+def entry_record(vector) -> Dict[str, Any]:
+    """What the baseline stores per entry."""
+    return {"metrics": {k: float(v) for k, v in sorted(vector.metrics.items())
+                        if k in TOLERANCES},
+            "hlo_ops": dict(vector.hlo_ops),
+            "collective_bytes_by_axis": dict(
+                vector.collectives.get("by_axis", {})),
+            "program_hash": vector.program_hash}
+
+
+def load(path: str) -> Dict[str, Dict[str, Any]]:
+    with open(path, "r", encoding="utf-8") as fh:
+        data = json.load(fh)
+    if data.get("version") != BASELINE_VERSION:
+        raise ValueError(f"unsupported baseline version in {path}: "
+                         f"{data.get('version')!r}")
+    return data.get("entries", {})
+
+
+def write(path: str, entries: Dict[str, Dict[str, Any]]) -> None:
+    payload = {"version": BASELINE_VERSION, "tool": "tpucost",
+               "entries": {k: entries[k] for k in sorted(entries)}}
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(payload, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+
+
+def records_of(vectors: Sequence) -> Dict[str, Dict[str, Any]]:
+    return {v.entry: entry_record(v) for v in vectors}
+
+
+def compare(vectors: Sequence, baseline: Dict[str, Dict[str, Any]],
+            errors: Optional[Dict[str, str]] = None,
+            in_scope=None) -> Tuple[List[CostFinding], List[str]]:
+    """Current vectors vs baseline → (regression findings, stale keys).
+    ``errors`` (entry → trace/compile failure) gate unconditionally: a
+    program that stopped building host-side is a regression, not a skip.
+    ``in_scope`` limits staleness to keys this run could have produced
+    (partial --entries runs must not condemn what they never measured)."""
+    findings: List[CostFinding] = []
+    stale: List[str] = []
+    current = {v.entry: v for v in vectors}
+
+    for name, msg in sorted((errors or {}).items()):
+        findings.append(CostFinding(name, "trace-error",
+                                    f"entry failed to trace/compile "
+                                    f"host-side: {msg}"))
+
+    for v in vectors:
+        base = baseline.get(v.entry)
+        if base is None:
+            findings.append(CostFinding(
+                v.entry, "unbaselined",
+                "entry has no committed cost vector — review the "
+                "== cost == numbers and run --write-baseline"))
+            continue
+        base_metrics = base.get("metrics", {})
+        for metric, tol in TOLERANCES.items():
+            cur = v.metrics.get(metric)
+            key = f"{v.entry}::{metric}"
+            if cur is None:
+                if metric in base_metrics and (in_scope is None
+                                               or in_scope(key)):
+                    stale.append(key)   # e.g. compiled -> uncompiled entry
+                continue
+            if metric not in base_metrics:
+                findings.append(CostFinding(
+                    v.entry, metric,
+                    f"metric is not in the baseline (current "
+                    f"{_fmt(cur)}) — run --write-baseline"))
+                continue
+            b = float(base_metrics[metric])
+            if cur > b * (1 + tol) + _EPS:
+                attribution = ""
+                grown = grown_op_classes(base.get("hlo_ops", {}), v.hlo_ops)
+                if grown and v.hlo_ops:
+                    attribution = ("; grown HLO op classes: " + ", ".join(
+                        f"{op} +{d}" for op, d in grown))
+                band = f" (band ±{tol:.0%})" if tol else ""
+                findings.append(CostFinding(
+                    v.entry, metric,
+                    f"{_fmt(b)} -> {_fmt(cur)} "
+                    f"({_delta_pct(b, cur)}){band}{attribution}"))
+            elif cur < b * (1 - tol) - _EPS and (in_scope is None
+                                                 or in_scope(key)):
+                stale.append(key)
+
+    for name, base in baseline.items():
+        if name in current or name in (errors or {}):
+            continue
+        for metric in base.get("metrics", {}):
+            key = f"{name}::{metric}"
+            if in_scope is None or in_scope(key):
+                stale.append(key)
+    return findings, sorted(stale)
+
+
+def pruned(vectors: Sequence, baseline: Dict[str, Dict[str, Any]],
+           in_scope=None) -> Dict[str, Dict[str, Any]]:
+    """Baseline with vanished entries/metrics dropped and surviving values
+    ratcheted DOWN to current (never up — a regression still fails after a
+    prune, exactly like the count-baseline semantics). Out-of-scope entries
+    pass through untouched; the CLI refuses to prune at all while entries
+    fail to build."""
+    current = {v.entry: v for v in vectors}
+    out: Dict[str, Dict[str, Any]] = {}
+    for name, base in baseline.items():
+        v = current.get(name)
+        if v is None:
+            # vanished entry: drop its in-scope metrics, keep the rest
+            kept = {m: b for m, b in base.get("metrics", {}).items()
+                    if in_scope is not None
+                    and not in_scope(f"{name}::{m}")}
+            if kept:
+                rec = dict(base)
+                rec["metrics"] = kept
+                out[name] = rec
+            continue
+        new_metrics: Dict[str, float] = {}
+        regressed = False
+        for metric, b in base.get("metrics", {}).items():
+            cur = v.metrics.get(metric)
+            key = f"{name}::{metric}"
+            if in_scope is not None and not in_scope(key):
+                new_metrics[metric] = float(b)
+                continue
+            if cur is None:
+                continue                        # metric vanished: drop
+            new_metrics[metric] = min(float(b), float(cur))
+            if float(cur) > float(b):
+                regressed = True
+        rec = entry_record(v)
+        rec["metrics"] = new_metrics
+        if regressed:
+            # the metrics kept an old (lower) budget — keep the op census
+            # they describe so regression attribution stays coherent
+            rec["hlo_ops"] = base.get("hlo_ops", rec["hlo_ops"])
+            rec["program_hash"] = base.get("program_hash",
+                                           rec["program_hash"])
+        out[name] = rec
+    return out

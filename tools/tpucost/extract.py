@@ -1,0 +1,250 @@
+"""Compiled-artifact metric extraction — the ONE place that parses XLA's
+cost/memory analysis and HLO text into plain dicts.
+
+Everything downstream of a ``jax.stages.Lowered``/``Compiled`` pair reads
+through these helpers: the tpucost analyzer itself, the flops profiler
+(``deepspeed_tpu/profiling/flops_profiler.py``), and the on-chip offload
+validator (``scripts/validate_offload_tpu.py``). XLA's dict keys ("bytes
+accessed", per-operand "bytes accessed3{}" subkeys, list-vs-dict returns
+across jax versions) and ``CompiledMemoryStats`` attribute spellings are
+quirky enough that two call sites parsing them independently WILL disagree;
+this module is the single implementation.
+
+Stdlib + re only at import time; jax objects are consumed duck-typed, so the
+module also parses HLO text handed to it directly (tests, stored programs).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import re
+from collections import Counter
+from typing import Any, Dict, Optional
+
+# -- XLA cost analysis -------------------------------------------------------
+
+
+def cost_analysis_dict(stage: Any) -> Dict[str, float]:
+    """Whole-program scalars from ``stage.cost_analysis()`` where ``stage``
+    is a ``Compiled`` (post-optimization — exact for what runs) or a
+    ``Lowered`` (pre-partitioning — the fallback for entries whose compile
+    is disabled, e.g. the 1F1B pipeline programs that crash CPU GSPMD).
+    Returns {} when the backend exposes no analysis."""
+    try:
+        cost = stage.cost_analysis()
+    except Exception:
+        return {}
+    if isinstance(cost, (list, tuple)):
+        cost = cost[0] if cost else {}
+    if not isinstance(cost, dict):
+        return {}
+    return {
+        "flops": max(float(cost.get("flops", 0.0)), 0.0),
+        "transcendentals": max(float(cost.get("transcendentals", 0.0)), 0.0),
+        # the plain key is the total; "bytes accessedN{}" operand subkeys
+        # are deliberately not summed (they double-count the total)
+        "bytes_accessed": max(float(cost.get("bytes accessed", 0.0)), 0.0),
+    }
+
+
+def memory_analysis_dict(compiled: Any) -> Dict[str, float]:
+    """``compiled.memory_analysis()`` → plain dict. ``peak_hbm_bytes`` is the
+    buffer-donation-aware device residency bound XLA budgets for:
+    arguments + outputs + temps − aliased (donated) bytes. Returns {} when
+    the stage has no memory analysis (None, or a backend without it)."""
+    try:
+        ma = compiled.memory_analysis()
+    except Exception:
+        return {}
+    if ma is None:
+        return {}
+
+    def grab(attr: str) -> float:
+        return float(getattr(ma, attr, 0) or 0)
+
+    out = {
+        "argument_hbm_bytes": grab("argument_size_in_bytes"),
+        "output_hbm_bytes": grab("output_size_in_bytes"),
+        "temp_hbm_bytes": grab("temp_size_in_bytes"),
+        "alias_hbm_bytes": grab("alias_size_in_bytes"),
+        "generated_code_bytes": grab("generated_code_size_in_bytes"),
+    }
+    out["peak_hbm_bytes"] = (out["argument_hbm_bytes"]
+                             + out["output_hbm_bytes"]
+                             + out["temp_hbm_bytes"]
+                             - out["alias_hbm_bytes"])
+    return out
+
+
+def program_hash(text: str) -> str:
+    """Stable identity of one compiled/lowered program (autotuner provenance,
+    baseline diff display)."""
+    return hashlib.sha256(text.encode("utf-8")).hexdigest()
+
+
+# -- HLO text parsing --------------------------------------------------------
+
+# post-optimization HLO op line: `  %name = f32[2,4]{1,0} opcode(...)` or
+# `  %name = (f32[...], s32[...]) opcode(...)`; the opcode is the last
+# bare token before the open paren
+_HLO_OP_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?%?[\w.\-]+\s*=\s*(\(?[^=]*?)\s([\w\-]+)\(",
+    re.MULTILINE)
+
+# an HLO shape token: dtype[dims]; dims empty for scalars
+_SHAPE_RE = re.compile(r"\b([a-z]+[0-9]+(?:[a-z][0-9a-z]*)?|pred)\[([0-9,]*)\]")
+
+_DTYPE_BYTES = {
+    "pred": 1, "s4": 1, "u4": 1, "s8": 1, "u8": 1,
+    "f8e4m3fn": 1, "f8e5m2": 1, "f8e4m3b11fnuz": 1, "f8e4m3fnuz": 1,
+    "f8e5m2fnuz": 1,
+    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4,
+    "s64": 8, "u64": 8, "f64": 8, "c64": 8,
+    "c128": 16,
+}
+
+# the canonical collective-kind names (tpuaudit's scanner owns the list)
+from ..tpuaudit.registry import COLLECTIVE_KINDS as COLLECTIVE_OPS  # noqa: E402
+
+
+def _shape_bytes(type_text: str) -> int:
+    """Total bytes of every dtype[dims] shape token in an HLO type string
+    (handles tuple types by summing the elements)."""
+    total = 0
+    for dtype, dims in _SHAPE_RE.findall(type_text):
+        itemsize = _DTYPE_BYTES.get(dtype)
+        if itemsize is None:
+            continue
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        total += n * itemsize
+    return total
+
+
+def hlo_op_census(hlo_text: str) -> Dict[str, int]:
+    """Opcode → occurrence count over a post-optimization HLO module. The
+    paired -start/-done halves of async collectives count as ONE op (the
+    -done is bookkeeping, and splitting differs across XLA versions)."""
+    census: Counter = Counter()
+    for _, opcode in _HLO_OP_RE.findall(hlo_text):
+        if opcode.endswith("-done"):
+            continue
+        census[opcode[:-6] if opcode.endswith("-start") else opcode] += 1
+    return dict(sorted(census.items()))
+
+
+_REPLICA_GROUPS_RE = re.compile(
+    r"replica_groups=(?:\{\{([0-9, ]*)\}|\[(\d+),(\d+)\]<=)")
+
+
+def _group_size(op_line: str) -> Optional[int]:
+    """Participants per replica group of one collective op line: the literal
+    format ``{{0,1},{2,3}}`` (ids in the first group) or the iota v2 format
+    ``[groups,size]<=[...]``."""
+    m = _REPLICA_GROUPS_RE.search(op_line)
+    if not m:
+        return None
+    if m.group(1) is not None:
+        return len([x for x in m.group(1).split(",") if x.strip()])
+    return int(m.group(3))
+
+
+def _axis_of_group(group_size: Optional[int],
+                   axis_sizes: Optional[Dict[str, int]]) -> str:
+    """Attribute a collective to the mesh axis whose extent matches its
+    replica-group size — exact when one non-trivial axis matches; a group
+    spanning the whole (multi-axis) mesh is "mesh"; anything else is
+    "unattributed" rather than a guess."""
+    if not axis_sizes or not group_size or group_size <= 1:
+        return "unattributed"
+    nontrivial = {a: s for a, s in axis_sizes.items() if s > 1}
+    matches = [a for a, s in nontrivial.items() if s == group_size]
+    if len(matches) == 1:
+        return matches[0]
+    total = 1
+    for s in nontrivial.values():
+        total *= s
+    if group_size == total and len(nontrivial) > 1:
+        return "mesh"
+    return "unattributed"
+
+
+def collective_census(hlo_text: str,
+                      axis_sizes: Optional[Dict[str, int]] = None
+                      ) -> Dict[str, Any]:
+    """Collective ops in a post-optimization HLO module with their output
+    bytes, attributed to mesh axes by replica-group extent. Returns::
+
+        {"total_bytes": float,
+         "by_kind": {kind: {"count": int, "bytes": float}},
+         "by_axis": {axis: float}}
+
+    Bytes are the op's OUTPUT shape bytes — the payload a step pays ICI/HBM
+    for, and the quantity that grows when GSPMD inserts a reshard. The
+    -start half of async pairs is counted, the -done skipped."""
+    by_kind: Dict[str, Dict[str, float]] = {}
+    by_axis: Dict[str, float] = {}
+    total = 0.0
+    for m in _HLO_OP_RE.finditer(hlo_text):
+        type_text, opcode = m.group(1), m.group(2)
+        if opcode.endswith("-done"):
+            continue
+        kind = opcode[:-6] if opcode.endswith("-start") else opcode
+        if kind not in COLLECTIVE_OPS:
+            continue
+        line = hlo_text[m.start():hlo_text.find("\n", m.start())]
+        nbytes = float(_shape_bytes(type_text))
+        total += nbytes
+        k = by_kind.setdefault(kind, {"count": 0, "bytes": 0.0})
+        k["count"] += 1
+        k["bytes"] += nbytes
+        axis = _axis_of_group(_group_size(line), axis_sizes)
+        by_axis[axis] = by_axis.get(axis, 0.0) + nbytes
+    return {"total_bytes": total,
+            "by_kind": dict(sorted(by_kind.items())),
+            "by_axis": dict(sorted(by_axis.items()))}
+
+
+# StableHLO spelling, for entries analyzed pre-compile (compile=False): op
+# name with underscores, result type trailing as `-> tensor<2x4xf32>` (or a
+# tuple of tensors). Byte counts here are the UNPARTITIONED global shapes —
+# comparable run-to-run, not comparable to a compiled census.
+_STABLEHLO_COLL_RE = re.compile(
+    r'stablehlo\.(all_gather|all_reduce|reduce_scatter|all_to_all|'
+    r'collective_permute|collective_broadcast)\b[^\n]*')
+_TENSOR_RE = re.compile(r"tensor<([0-9x]*)x?([a-z][0-9a-z]*)>")
+
+
+def stablehlo_collective_census(stablehlo_text: str) -> Dict[str, Any]:
+    """Best-effort collective census over StableHLO (the compile=False
+    path). Counts are exact; bytes are parsed from the op's trailing result
+    type when present on the line (0 otherwise). No axis attribution — the
+    pre-partitioning module has no replica groups to read."""
+    by_kind: Dict[str, Dict[str, float]] = {}
+    total = 0.0
+    for m in _STABLEHLO_COLL_RE.finditer(stablehlo_text):
+        kind = m.group(1).replace("_", "-")
+        line = m.group(0)
+        nbytes = 0.0
+        arrow = line.rfind("->")
+        if arrow != -1:
+            for dims, dtype in _TENSOR_RE.findall(line[arrow:]):
+                itemsize = _DTYPE_BYTES.get(
+                    {"i1": "pred"}.get(dtype, dtype.replace("i", "s", 1)
+                                       if dtype.startswith("i") else dtype))
+                if itemsize is None:
+                    continue
+                n = 1
+                for d in dims.split("x"):
+                    if d:
+                        n *= int(d)
+                nbytes += n * itemsize
+        total += nbytes
+        k = by_kind.setdefault(kind, {"count": 0, "bytes": 0.0})
+        k["count"] += 1
+        k["bytes"] += nbytes
+    return {"total_bytes": total, "by_kind": dict(sorted(by_kind.items())),
+            "by_axis": {}}

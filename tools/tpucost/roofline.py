@@ -1,0 +1,60 @@
+"""Analytic roofline bound over a static cost vector.
+
+Given what XLA says one program execution costs — flops, HBM bytes touched,
+collective payload bytes — the fastest the chip could possibly run it is the
+slowest of the three pipes, assuming perfect overlap of the other two::
+
+    t_bound = max(flops / peak_flops,  bytes / hbm_bw,  coll_bytes / ici_bw)
+
+``mfu_ceiling = t_compute / t_bound`` is then the hard upper bound on MFU for
+this program on this chip generation: a bandwidth-bound program cannot reach
+it regardless of kernel quality, so a *drop* in the ceiling is a program-
+level perf regression visible with zero TPU time. Platform constants come
+from the autotuner's cost model (``cost_model.peak_flops_for`` /
+``hbm_bw_for`` / ``ICI_BW``) so the static gate, the bench MFU math and the
+tuner all share one denominator. On CPU runs the device kind is unknown and
+the v5e-class defaults apply — deliberately: the ceiling is a property of
+the PROGRAM, reported against a real chip's pipes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class RooflineBound:
+    predicted_step_s: float      # lower bound on one program execution
+    mfu_ceiling: float           # hard MFU upper bound (0..1)
+    bound: str                   # "compute" | "hbm" | "ici" — the slow pipe
+    peak_flops: float
+    hbm_bw: float
+    ici_bw: float
+    predicted_tokens_per_sec: Optional[float] = None  # when tokens/step known
+
+
+def roofline(flops: float, bytes_accessed: float, collective_bytes: float,
+             device_kind: Optional[str] = None,
+             tokens_per_step: Optional[float] = None,
+             ici_bw: Optional[float] = None) -> RooflineBound:
+    # imported lazily: pulling in deepspeed_tpu initializes jax, and the CLI
+    # must set the virtual-device XLA flags first
+    from deepspeed_tpu.autotuning.cost_model import (ICI_BW, hbm_bw_for,
+                                                     peak_flops_for)
+
+    ici_bw = ICI_BW if ici_bw is None else ici_bw
+    peak = peak_flops_for(device_kind)
+    bw = hbm_bw_for(device_kind)
+    t_compute = flops / peak
+    t_hbm = bytes_accessed / bw
+    t_ici = collective_bytes / ici_bw
+    t_bound = max(t_compute, t_hbm, t_ici)
+    bound = ("compute" if t_bound == t_compute
+             else "hbm" if t_bound == t_hbm else "ici")
+    mfu = t_compute / t_bound if t_bound > 0 else 0.0
+    tps = (tokens_per_step / t_bound
+           if tokens_per_step and t_bound > 0 else None)
+    return RooflineBound(predicted_step_s=t_bound, mfu_ceiling=mfu,
+                         bound=bound, peak_flops=peak, hbm_bw=bw,
+                         ici_bw=ici_bw, predicted_tokens_per_sec=tps)

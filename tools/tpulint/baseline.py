@@ -136,22 +136,36 @@ def gate_and_report(findings: Sequence, *, tool: str, fmt: str,
         gating = new_findings(findings, known_counts)
         stale = stale_keys(findings, known_counts, in_scope=in_scope)
 
+    return render_report(gating, stale, tool=tool, fmt=fmt,
+                         baseline_path=baseline_path, total=len(findings))
+
+
+def render_report(gating: Sequence, stale: Sequence[str], *, tool: str,
+                  fmt: str, baseline_path: Optional[str], total: int,
+                  stale_note: str = ("no longer produces findings — run "
+                                     "--prune-baseline"),
+                  extra_json: Optional[Dict] = None) -> int:
+    """The shared report/exit tail — text/JSON rendering of over-budget
+    findings + stale keys and the exit code. All three analyzers (tpulint,
+    tpuaudit, tpucost) end here, so ``scripts/check.sh`` composes three
+    identical gate semantics into one CI exit code. ``stale_note`` lets a
+    value-gated tool (tpucost) phrase staleness in its own terms."""
     if fmt == "json":
         print(json.dumps({
             "findings": [f.to_json() for f in gating],
-            "stale_baseline_keys": stale,
-            "total_findings": len(findings),
+            "stale_baseline_keys": list(stale),
+            "total_findings": total,
             "new_findings": len(gating),
+            **(extra_json or {}),
         }, indent=2))
     else:
         for f in gating:
             print(f.render())
         for key in stale:
-            print(f"stale baseline entry: {key} no longer produces findings "
-                  f"— run --prune-baseline")
+            print(f"stale baseline entry: {key} {stale_note}")
         suffix = " (after baseline)" if baseline_path else ""
         print(f"{tool}: {len(gating)} new finding(s){suffix}, "
-              f"{len(stale)} stale baseline key(s), {len(findings)} total")
+              f"{len(stale)} stale baseline key(s), {total} total")
     return 1 if (gating or stale) else 0
 
 
