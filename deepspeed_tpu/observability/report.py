@@ -229,11 +229,23 @@ def summarize_serving(records: List[Dict[str, Any]]) -> str:
             load.append(f"{label}={v:.6g}")
     if load:
         lines.append("  load: " + "  ".join(load))
+    sharing = []
+    for name, label in (("serving/prefix_hit_rate", "prefix_hit_rate"),
+                        ("serving/prefix_cache_blocks", "prefix_blocks"),
+                        ("serving/kv_blocks_shared", "blocks_shared"),
+                        ("serving/kv_blocks_shared_peak",
+                         "blocks_shared_peak")):
+        v = gauge(name)
+        if v is not None:
+            sharing.append(f"{label}={v:.6g}")
+    if sharing:
+        lines.append("  sharing: " + "  ".join(sharing))
     counts = []
     preempt = 0.0
     for name, label in (("serving/requests_submitted", "submitted"),
                         ("serving/requests_completed", "completed"),
                         ("serving/requests_cancelled", "cancelled"),
+                        ("serving/cow_copies", "cow_copies"),
                         ("serving/preemptions", "preemptions")):
         total = sum(r["value"] for (n, _), r in latest.items()
                     if n == name and r.get("type") == "counter")

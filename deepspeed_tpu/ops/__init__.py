@@ -4,6 +4,9 @@ op_builder/, csrc/)."""
 from .block_sparse_attention import (TilePlan, block_sparse_attention,
                                      build_tile_plan)
 from .decode_attention import decode_attention, reference_decode_attention
+from .paged_decode_attention import (paged_decode_attention,
+                                     paged_prefill_attention,
+                                     reference_paged_attention)
 from .flash_attention import flash_attention, make_attention_impl
 from .fused_adam import fused_adam_flat, reference_adam_flat
 from .fused_lamb import fused_lamb_flat, reference_lamb_flat
@@ -44,6 +47,14 @@ register_op("quantize_symmetric", quantize_symmetric,
 register_op("decode_attention", decode_attention,
             reference=reference_decode_attention,
             description="single-query KV-cache decode attention (GQA, alibi)")
+register_op("paged_decode_attention", paged_decode_attention,
+            reference=reference_paged_attention,
+            description="block-table decode attention over the paged arena "
+                        "(resident pages only; GQA, alibi)")
+register_op("paged_prefill_attention", paged_prefill_attention,
+            reference=reference_paged_attention,
+            description="chunked-prefill flash attention through the "
+                        "serving block table")
 register_op("int4_a8_matmul", int4_a8_matmul,
             reference=reference_int4_a8_matmul,
             description="W4A8 GEMM (s8 unpack + s8xs8 MXU)")
@@ -75,6 +86,8 @@ def _ref_attn(q, k, v, mask=None, causal=True, **_):
 __all__ = [
     "TilePlan", "block_sparse_attention", "build_tile_plan",
     "decode_attention", "reference_decode_attention",
+    "paged_decode_attention", "paged_prefill_attention",
+    "reference_paged_attention",
     "flash_attention", "make_attention_impl", "fused_adam_flat",
     "reference_adam_flat", "fused_lamb_flat", "reference_lamb_flat",
     "fused_layer_norm", "reference_layer_norm",

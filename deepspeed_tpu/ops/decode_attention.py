@@ -33,10 +33,16 @@ from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
 LANES = 128
+# VMEM budget for double-buffered k+v blocks: at K=32,D=128 a 512-token
+# f32 block sits ~100KB over the 16MB limit (observed on v5e), so budget
+# half of VMEM. ONE constant shared with ops/paged_decode_attention.py —
+# the two kernels sizing their KV tiles against different budgets would
+# rot independently.
+VMEM_KV_BUDGET = 8 << 20
 
 
 def _kernel(q_ref, k_ref, v_ref, valid_ref, alibi_ref, kpos_ref, o_ref,
-            acc, m_scr, l_scr, *, scale: float, bt: int,
+            acc, m_scr, l_scr, *, scale: float, bt: int, t_total: int,
             n_heads: int, kv_heads: int, has_alibi: bool):
     jt = pl.program_id(1)
     njt = pl.num_programs(1)
@@ -52,6 +58,12 @@ def _kernel(q_ref, k_ref, v_ref, valid_ref, alibi_ref, kpos_ref, o_ref,
     q = q_ref[0].astype(jnp.float32) * scale          # (N, D)
     k = k_ref[0].astype(jnp.float32)                  # (bt, K, D)
     v = v_ref[0].astype(jnp.float32)                  # (bt, K, D)
+    if t_total % bt != 0:
+        # zero v's edge-padded rows: the pad is arbitrary bits (NaN under
+        # the interpreter) and 0 * NaN would poison the p @ v accumulation
+        # even though the scores there are masked to NEG_INF
+        vrow = jt * bt + jax.lax.broadcasted_iota(jnp.int32, (bt, 1, 1), 0)
+        v = jnp.where(vrow < t_total, v, 0.0)
 
     # s[n, t] per KV-head group: (G, D) @ (D, bt) — statically unrolled over
     # the (small) KV-head count
@@ -68,6 +80,12 @@ def _kernel(q_ref, k_ref, v_ref, valid_ref, alibi_ref, kpos_ref, o_ref,
         # generated keys their true positions, not arena columns)
         s = s + alibi_ref[0][:, None] * kpos_ref[0, 0][None, :]
     mask = (valid_ref[0, 0] != 0)[None, :]             # (1, bt)
+    if t_total % bt != 0:
+        # the final KV tile overruns the cache — its k/v/valid/kpos reads
+        # are edge-padded garbage, so mask by true column (the valid-mask
+        # alone can't be trusted there: the padding isn't 0-filled)
+        col = jt * bt + jax.lax.broadcasted_iota(jnp.int32, (1, bt), 1)
+        mask = mask & (col < t_total)
     s = jnp.where(mask, s, NEG_INF)
 
     m_prev = m_scr[:, :1]
@@ -98,24 +116,23 @@ def decode_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
                      interpret: bool = False) -> jax.Array:
     """q (B, N, D) — one new token; k/v_cache (B, T, K, D); valid (B, T)
     marks live cache slots (causal + padding in one mask). Returns (B, N, D).
-    T must be a multiple of 128 (the arena is sized that way).
+    Any T works: a final tile that overruns the cache is edge-padded by the
+    pipeline and masked in-kernel (bucketed non-multiple cache lengths used
+    to silently fall back to jnp attention).
     ``key_positions`` (B, T): true per-row key positions for the alibi bias
     (ragged batches — defaults to the arena column index)."""
     B, N, D = q.shape
     T, K = k_cache.shape[1], k_cache.shape[2]
-    if T % LANES != 0:
-        raise ValueError(f"cache length {T} must be a multiple of {LANES}")
-    # bt must divide T exactly (grid = T//bt) AND the double-buffered k/v
-    # blocks must fit scoped VMEM — at K=32,D=128 a 512 block sits ~100KB
-    # over the 16MB limit (observed on v5e), so budget half of VMEM
+    # the double-buffered k/v blocks must fit scoped VMEM (see
+    # VMEM_KV_BUDGET above)
     itemsize = jnp.dtype(k_cache.dtype).itemsize
     per_t = K * D * itemsize * 4            # k+v, double-buffered
-    budget = 8 << 20
+    budget = VMEM_KV_BUDGET
     # bt is a middle block dim so sub-128 values are legal (the last-two-dims
-    # tiling rule applies to (K, D), taken whole); T % 128 == 0 implies every
-    # candidate divides T
+    # tiling rule applies to (K, D), taken whole); grid = ceil(T/bt), the
+    # final partial tile is masked by true column in-kernel
     bt = next((b for b in (512, 256, 128, 64, 32)
-               if T % b == 0 and b * per_t <= budget), None)
+               if b * per_t <= budget), None)
     if bt is None:
         raise ValueError(
             f"decode_attention KV blocks do not fit VMEM: {K} kv-heads x "
@@ -139,11 +156,11 @@ def decode_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
     kpos_map = ((lambda b, t: (b, 0, t)) if per_row
                 else (lambda b, t: (0, 0, t)))
 
-    kernel = functools.partial(_kernel, scale=scale, bt=bt, n_heads=N,
-                               kv_heads=K, has_alibi=has_alibi)
+    kernel = functools.partial(_kernel, scale=scale, bt=bt, t_total=T,
+                               n_heads=N, kv_heads=K, has_alibi=has_alibi)
     out = pl.pallas_call(
         kernel,
-        grid=(B, T // bt),
+        grid=(B, pl.cdiv(T, bt)),
         in_specs=[
             pl.BlockSpec((1, N, D), lambda b, t: (b, 0, 0)),
             pl.BlockSpec((1, bt, K, D), lambda b, t: (b, t, 0, 0)),

@@ -1,0 +1,345 @@
+"""Pallas paged attention: block-table-aware decode + chunked-prefill kernels.
+
+The serving layer's arena is a shared pool of fixed-size KV blocks
+(``serving/paged_kv.py``; vLLM's PagedAttention, Kwon et al. SOSP '23). The
+jnp read path materializes a dense ``(R, MAXB*BLOCK, K, D)`` view per layer
+per step (``arena[block_table]``), so every decode token pays HBM traffic
+proportional to the *pool view*, not the tokens actually resident. These
+kernels walk each row's block table instead and DMA only **resident** pages:
+
+* ``paged_decode_attention`` — single-query decode. Grid ``(R, MAXB)``; the
+  block table and per-row lengths ride as scalar-prefetch operands, so the
+  k/v BlockSpec index maps resolve ``table[row, page]`` *before* the pipeline
+  issues the page's DMA. Non-resident trailing pages re-request the row's
+  last resident page — consecutive identical block indices make the Pallas
+  pipeline skip the copy, so a row with 3 live pages out of 64 costs 3 page
+  DMAs, not 64. GQA-native (KV heads never expanded), alibi in-kernel.
+* ``paged_prefill_attention`` — the chunked-prefill mate: C queries at
+  absolute positions ``start..start+C-1`` read prior context through the
+  same table, flash-accumulating page by page (grid ``(B, K, MAXB)``), so a
+  later chunk never materializes the gathered view either.
+
+Layout contract (shared with ``models/transformer._layer_forward``): the
+arena is LEFT-ALIGNED — the token at absolute position ``p`` sits in block
+``table[p // BLOCK]`` at offset ``p % BLOCK`` — so a key's (page, offset)
+coordinate IS its position: causality over true positions is the entire
+validity story and the alibi key bias is exact by construction.
+
+``reference_paged_attention`` is the pure-jnp oracle and CPU fallback:
+GQA-native over the gathered view (no head expansion, no (B,S,T) mask
+materialization) — also measurably leaner than the PR-6 gather +
+``dot_product_attention`` path that it replaces.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+LANES = 128
+# k + v pages, double-buffered by the pipeline — ONE budget shared with
+# the dense decode kernel's tile sizing
+from .decode_attention import VMEM_KV_BUDGET as _VMEM_PAGE_BUDGET
+
+
+def _check_page_fits(block_size: int, kv_heads: int, head_dim: int,
+                     itemsize: int) -> None:
+    per_page = block_size * kv_heads * head_dim * itemsize * 4
+    if per_page > _VMEM_PAGE_BUDGET:
+        raise ValueError(
+            f"paged attention KV pages do not fit VMEM: block_size "
+            f"{block_size} x {kv_heads} kv-heads x head_dim {head_dim} x "
+            f"{itemsize}B needs {per_page} B double-buffered — shrink "
+            "serving.block_size or shard KV heads (tensor parallelism)")
+
+
+# ---------------------------------------------------------------------------
+# decode: one query token per row
+# ---------------------------------------------------------------------------
+
+
+def _decode_kernel(bt_ref, len_ref, q_ref, k_ref, v_ref, alibi_ref, o_ref,
+                   acc, m_scr, l_scr, *, scale: float, bs: int,
+                   n_heads: int, kv_heads: int, has_alibi: bool):
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+    nj = pl.num_programs(1)
+    G = n_heads // kv_heads
+    length = len_ref[b]
+
+    @pl.when(j == 0)
+    def _init():
+        acc[:] = jnp.zeros_like(acc)
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+
+    # page j holds positions [j*bs, (j+1)*bs) — all-future pages are skipped
+    # (their DMA was already elided by the clamped index map)
+    @pl.when(j * bs < length)
+    def _step():
+        q = q_ref[0].astype(jnp.float32) * scale      # (N, D)
+        k = k_ref[0].astype(jnp.float32)              # (bs, K, D)
+        v = v_ref[0].astype(jnp.float32)              # (bs, K, D)
+        parts = []
+        for kh in range(kv_heads):
+            qg = q[kh * G:(kh + 1) * G]               # (G, D) static slice
+            parts.append(jax.lax.dot_general(
+                qg, k[:, kh, :], (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32))  # (G, bs)
+        s = jnp.concatenate(parts, axis=0)            # (N, bs)
+        col = j * bs + jax.lax.broadcasted_iota(jnp.int32, (1, bs), 1)
+        if has_alibi:
+            # left-aligned layout: the page column IS the key position
+            s = s + alibi_ref[0][:, None] * col.astype(jnp.float32)
+        s = jnp.where(col < length, s, NEG_INF)
+        m_prev = m_scr[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m_prev - m_new)
+        l_scr[:] = jnp.broadcast_to(
+            corr * l_scr[:, :1] + jnp.sum(p, axis=1, keepdims=True),
+            l_scr.shape)
+        outs = []
+        for kh in range(kv_heads):
+            pg = p[kh * G:(kh + 1) * G]
+            outs.append(jax.lax.dot_general(
+                pg, v[:, kh, :], (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32))
+        acc[:] = acc[:] * corr + jnp.concatenate(outs, axis=0)
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+
+    @pl.when(j == nj - 1)
+    def _finalize():
+        l = l_scr[:, :1]
+        safe = jnp.where(l == 0.0, 1.0, l)            # length-0 rows → 0
+        o_ref[0] = (acc[:] / safe).astype(o_ref.dtype)
+
+
+def paged_decode_attention(q: jax.Array, k_pool: jax.Array,
+                           v_pool: jax.Array, block_table: jax.Array,
+                           lengths: jax.Array,
+                           alibi: Optional[jax.Array] = None,
+                           scale: Optional[float] = None,
+                           interpret: bool = False) -> jax.Array:
+    """q (R, N, D) — one new token per row; k/v_pool (NUM_BLOCKS, BLOCK,
+    K, D) — the shared arena; block_table (R, MAXB) int32 physical page ids
+    (unfilled entries 0 = scratch); lengths (R,) int32 — valid keys per row
+    INCLUDING the just-written token (0 ⇒ inactive row, output zeros).
+    Returns (R, N, D). Reads only each row's resident pages."""
+    R, N, D = q.shape
+    BS, K = k_pool.shape[1], k_pool.shape[2]
+    MAXB = block_table.shape[1]
+    if N % K != 0:
+        raise ValueError(f"n_heads {N} not a multiple of kv_heads {K}")
+    _check_page_fits(BS, K, D, jnp.dtype(k_pool.dtype).itemsize)
+    scale = scale if scale is not None else D ** -0.5
+    has_alibi = alibi is not None
+    alibi_arr = (alibi.astype(jnp.float32).reshape(1, N) if has_alibi
+                 else jnp.zeros((1, N), jnp.float32))
+
+    def _page(b, j, bt_ref, len_ref):
+        # clamp to the row's last resident page: trailing grid steps
+        # re-request the same block index, which the pipeline recognizes
+        # and skips the DMA — only resident pages move
+        last = jnp.maximum((len_ref[b] + BS - 1) // BS - 1, 0)
+        return (bt_ref[b, jnp.minimum(j, last)], 0, 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(R, MAXB),
+        in_specs=[
+            pl.BlockSpec((1, N, D), lambda b, j, bt, ln: (b, 0, 0)),
+            pl.BlockSpec((1, BS, K, D), _page),
+            pl.BlockSpec((1, BS, K, D), _page),
+            pl.BlockSpec((1, N), lambda b, j, bt, ln: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, N, D), lambda b, j, bt, ln: (b, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((N, D), jnp.float32),
+            pltpu.VMEM((N, LANES), jnp.float32),
+            pltpu.VMEM((N, LANES), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(_decode_kernel, scale=scale, bs=BS,
+                               n_heads=N, kv_heads=K, has_alibi=has_alibi)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((R, N, D), q.dtype),
+        compiler_params=pltpu.TPUCompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=interpret,
+    )(block_table.astype(jnp.int32), lengths.astype(jnp.int32),
+      q, k_pool, v_pool, alibi_arr)
+
+
+# ---------------------------------------------------------------------------
+# chunked prefill: C queries per row at positions start..start+C-1
+# ---------------------------------------------------------------------------
+
+
+def _prefill_kernel(bt_ref, start_ref, q_ref, k_ref, v_ref, alibi_ref, o_ref,
+                    acc, m_scr, l_scr, *, scale: float, bs: int, C: int,
+                    has_alibi: bool):
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+    nj = pl.num_programs(2)
+    st = start_ref[b]
+    GC = q_ref.shape[2]
+
+    @pl.when(j == 0)
+    def _init():
+        acc[:] = jnp.zeros_like(acc)
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+
+    # a page is visible iff it holds positions <= the last query (st + C - 1)
+    @pl.when(j * bs < st + C)
+    def _step():
+        q = q_ref[0, 0].astype(jnp.float32) * scale   # (GC, D), rows (g, c)
+        k = k_ref[0, :, 0, :].astype(jnp.float32)     # (bs, D)
+        v = v_ref[0, :, 0, :].astype(jnp.float32)     # (bs, D)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        col = j * bs + jax.lax.broadcasted_iota(jnp.int32, (GC, bs), 1)
+        # query row r = (g, c): its absolute position is st + (r mod C)
+        qpos = st + jax.lax.broadcasted_iota(jnp.int32, (GC, bs), 0) % C
+        if has_alibi:
+            s = s + alibi_ref[0][:, None] * col.astype(jnp.float32)
+        s = jnp.where(col <= qpos, s, NEG_INF)
+        m_prev = m_scr[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m_prev - m_new)
+        l_scr[:] = jnp.broadcast_to(
+            corr * l_scr[:, :1] + jnp.sum(p, axis=1, keepdims=True),
+            l_scr.shape)
+        acc[:] = acc[:] * corr + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+
+    @pl.when(j == nj - 1)
+    def _finalize():
+        l = l_scr[:, :1]
+        safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc[:] / safe).astype(o_ref.dtype)
+
+
+def paged_prefill_attention(q: jax.Array, k_pool: jax.Array,
+                            v_pool: jax.Array, block_table: jax.Array,
+                            start: jax.Array,
+                            alibi: Optional[jax.Array] = None,
+                            scale: Optional[float] = None,
+                            interpret: bool = False) -> jax.Array:
+    """Chunked-prefill attention through the block table: q (B, C, N, D) —
+    C contiguous queries per row at absolute positions ``start[b] + s``
+    (the serving ``prefill_chunk`` contract; the chunk's own keys must
+    already be scatter-written into the pool). Returns (B, C, N, D).
+    Grid (B, K, MAXB): each KV head flash-accumulates its G*C query rows
+    page by page; pages past ``start + C`` never move."""
+    B, C, N, D = q.shape
+    BS, K = k_pool.shape[1], k_pool.shape[2]
+    MAXB = block_table.shape[1]
+    if N % K != 0:
+        raise ValueError(f"n_heads {N} not a multiple of kv_heads {K}")
+    G = N // K
+    GC = G * C
+    _check_page_fits(BS, 1, D, jnp.dtype(k_pool.dtype).itemsize)
+    scale = scale if scale is not None else D ** -0.5
+    has_alibi = alibi is not None
+    # (B, C, N, D) -> (B, K, G*C, D): head-major rows grouped by KV head so
+    # one grid step's queries share the page it just DMA'd
+    qk = q.reshape(B, C, K, G, D).transpose(0, 2, 3, 1, 4).reshape(
+        B, K, GC, D)
+    if has_alibi:
+        # per-row slopes, expanded host-side to match the (g, c) row order
+        # (in-kernel gather by r // C would need an unsupported dynamic
+        # index; a (K, G*C) operand is trivially small)
+        alibi_arr = jnp.broadcast_to(
+            alibi.astype(jnp.float32).reshape(K, G)[:, :, None],
+            (K, G, C)).reshape(K, GC)
+    else:
+        alibi_arr = jnp.zeros((K, GC), jnp.float32)
+
+    def _page(b, kh, j, bt_ref, start_ref):
+        npages = jnp.maximum((start_ref[b] + C + BS - 1) // BS, 1)
+        return (bt_ref[b, jnp.minimum(j, npages - 1)], 0, kh, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, K, MAXB),
+        in_specs=[
+            pl.BlockSpec((1, 1, GC, D), lambda b, kh, j, bt, st: (b, kh, 0, 0)),
+            pl.BlockSpec((1, BS, 1, D), _page),
+            pl.BlockSpec((1, BS, 1, D), _page),
+            pl.BlockSpec((1, GC), lambda b, kh, j, bt, st: (kh, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, GC, D),
+                               lambda b, kh, j, bt, st: (b, kh, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((GC, D), jnp.float32),
+            pltpu.VMEM((GC, LANES), jnp.float32),
+            pltpu.VMEM((GC, LANES), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(_prefill_kernel, scale=scale, bs=BS, C=C,
+                               has_alibi=has_alibi)
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, K, GC, D), q.dtype),
+        compiler_params=pltpu.TPUCompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(block_table.astype(jnp.int32), start.astype(jnp.int32),
+      qk, k_pool, v_pool, alibi_arr)
+    return out.reshape(B, K, G, C, D).transpose(0, 3, 1, 2, 4).reshape(
+        B, C, N, D)
+
+
+# ---------------------------------------------------------------------------
+# jnp oracle / CPU fallback
+# ---------------------------------------------------------------------------
+
+
+def reference_paged_attention(q: jax.Array, k_pool: jax.Array,
+                              v_pool: jax.Array, block_table: jax.Array,
+                              positions: jax.Array,
+                              alibi: Optional[jax.Array] = None,
+                              scale: Optional[float] = None) -> jax.Array:
+    """GQA-native jnp paged attention — parity oracle for both kernels and
+    the CPU serving fallback. q (B, S, N, D); positions (B, S) absolute
+    query positions (decode: the row's length-1; negative ⇒ row inactive,
+    output zeros); pools (NUM_BLOCKS, BLOCK, K, D); mask is causality over
+    true positions (left-aligned layout: gathered column == position)."""
+    B, S, N, D = q.shape
+    BS, K = k_pool.shape[1], k_pool.shape[2]
+    MAXB = block_table.shape[1]
+    T = MAXB * BS
+    G = N // K
+    scale = scale if scale is not None else D ** -0.5
+    kk = k_pool[block_table].reshape(B, T, K, D)
+    vv = v_pool[block_table].reshape(B, T, K, D)
+    q5 = q.reshape(B, S, K, G, D)
+    s = jnp.einsum("bskgd,btkd->bkgst", q5, kk).astype(jnp.float32) * scale
+    col = jnp.arange(T, dtype=jnp.int32)
+    if alibi is not None:
+        al = alibi.astype(jnp.float32).reshape(K, G)
+        s = s + al[None, :, :, None, None] * col.astype(jnp.float32)
+    keep = col[None, None, :] <= positions[:, :, None]          # (B, S, T)
+    s = jnp.where(keep[:, None, None, :, :], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+    o = jnp.einsum("bkgst,btkd->bskgd", p, vv)
+    # rows whose position is negative have an all-masked score row; the
+    # softmax then returns uniform weights — zero them explicitly so
+    # inactive rows are exactly 0 like the kernel
+    inactive = (positions < 0)[:, :, None, None]
+    o = jnp.where(inactive[:, :, None], 0.0, o.reshape(B, S, K, G, D))
+    return o.reshape(B, S, N, D)

@@ -19,14 +19,15 @@ See docs/serving.md for the architecture and the block-table layout.
 
 from ..config.config import ServingConfig  # noqa: F401
 from .api import ServingEngine, init_serving  # noqa: F401
-from .paged_kv import BlockAllocator, BlockAllocatorError  # noqa: F401
+from .paged_kv import (BlockAllocator, BlockAllocatorError,  # noqa: F401
+                       PrefixCache)
 from .scheduler import (QueueFull, Request, SamplingParams,  # noqa: F401
                         Scheduler)
 from .session import RequestCancelled, RequestHandle  # noqa: F401
 
 __all__ = [
     "ServingConfig", "ServingEngine", "init_serving",
-    "BlockAllocator", "BlockAllocatorError",
+    "BlockAllocator", "BlockAllocatorError", "PrefixCache",
     "Scheduler", "Request", "SamplingParams", "QueueFull",
     "RequestHandle", "RequestCancelled",
 ]

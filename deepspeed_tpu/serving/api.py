@@ -59,9 +59,11 @@ class ServingEngine:
                 "attention models (GPT-Neo family) yet — the paged read "
                 "path has no window operand")
         if cfg.attention_impl is not None:
-            raise NotImplementedError(
-                "serving ignores custom attention_impl — the paged arena "
-                "read is a block-table gather the custom impl cannot see")
+            # custom impls are served through the dense gathered-view path
+            # (the impl has no block-table operand); the Pallas paged
+            # kernels only engage for attention_impl=None
+            log_dist("serving: custom attention_impl set — the paged read "
+                     "uses the dense gather view, not the paged kernels")
         if cfg.position == "learned" and \
                 self.config.max_model_len > cfg.max_seq_len:
             raise ValueError(
@@ -77,14 +79,25 @@ class ServingEngine:
         self.clock = clock
         self._lock = threading.RLock()
         self.alloc = paged_kv.BlockAllocator(self.config.pool_blocks())
-        self.sched = Scheduler(self.config, allocator=self.alloc, clock=clock)
+        self.prefix = (paged_kv.PrefixCache(self.alloc,
+                                            self.config.block_size)
+                       if self.config.prefix_cache else None)
+        self.sched = Scheduler(self.config, allocator=self.alloc,
+                               clock=clock, prefix_cache=self.prefix)
         self._dtype = engine.config.dtype
         with mesh_mod.ambient(engine.mesh):
             self._arena = paged_kv.init_paged_cache(
                 cfg, self.config.pool_blocks() + 1, self.config.block_size,
                 self._dtype)
-        self._prefill = paged_kv.build_prefill_program(cfg)
-        self._decode = paged_kv.build_decode_program(cfg)
+        # 'off' pins the dense gather-view read (the A/B baseline);
+        # 'auto' = Pallas paged kernels on TPU, jnp paged reference on CPU
+        self._paged_impl = ("gather" if self.config.paged_kernel == "off"
+                            else "auto")
+        self._prefill = paged_kv.build_prefill_program(cfg, self._paged_impl)
+        self._decode = paged_kv.build_decode_program(cfg, self._paged_impl)
+        self._cow = paged_kv.build_cow_program()
+        self._cow_copies = 0
+        self._published_cow = 0
         import jax
 
         self._base_rng = jax.random.PRNGKey(self.config.seed)
@@ -203,6 +216,31 @@ class ServingEngine:
                 np.asarray([r.sampling.top_p for r in reqs], np.float32),
                 np.asarray([r.seed for r in reqs], np.int32))
 
+    def _make_writable(self, req: Request, start: int, end: int) -> bool:
+        """Copy-on-write: every block covering write positions
+        [start, end) must be exclusively owned before the jitted program
+        scatters into it. Shared blocks (prefix sharing, refcount > 1) are
+        duplicated on device and swapped into the request's table; the
+        sharers keep the original. Returns False when the pool can't
+        provide a private copy this iteration — the caller skips the
+        request; copies already made stay (they are real private blocks,
+        the retry skips them)."""
+        for bi in self.sched.cow_block_indices(req, start, end):
+            nid = self.sched.alloc_for_cow(req)
+            if nid is None:
+                return False
+            old = req.blocks[bi]
+            obs = get_session()
+            with mesh_mod.ambient(self.engine.mesh):
+                with obs.span("serving/cow_copy"):
+                    self._arena = self._cow(self._arena,
+                                            np.asarray(old, np.int32),
+                                            np.asarray(nid, np.int32))
+            req.blocks[bi] = nid
+            self.alloc.free([old])   # drop THIS request's shared reference
+            self._cow_copies += 1
+        return True
+
     def _step_prefill(self) -> bool:
         req = self.sched.next_prefill()
         if req is None:
@@ -213,6 +251,8 @@ class ServingEngine:
         n_valid = min(C, int(src.size) - start)
         if not self.sched.ensure_blocks(req, start + n_valid):
             return False    # pool dry, nothing evictable — wait a turn
+        if not self._make_writable(req, start, start + n_valid):
+            return False    # shared block needs a copy the pool can't give
         chunk = np.zeros((1, C), np.int32)
         chunk[0, :n_valid] = src[start:start + n_valid]
         temps, topks, topps, seeds = self._sampling_arrays([req])
@@ -229,6 +269,8 @@ class ServingEngine:
                 tok = np.asarray(tok)   # the fence: chunk really ran
         req.prefill_pos += n_valid
         req.length = req.prefill_pos
+        # newly completed full prompt blocks become shareable prefix cache
+        self.sched.note_prefill_progress(req, start, req.prefill_pos)
         self.sched.note_service(req, n_valid)
         if req.prefill_pos == int(src.size):
             req.state = DECODE
@@ -253,8 +295,19 @@ class ServingEngine:
             # dry, let it evict an active one)
             if r.state == DECODE:
                 self.sched.ensure_blocks(r, r.length + 1)
-        ready = [r for r in dec if r.state == DECODE
-                 and len(r.blocks) * self.config.block_size > r.length]
+        ready = []
+        for r in dec:
+            if r.state != DECODE:
+                continue
+            if len(r.blocks) * self.config.block_size <= r.length:
+                continue
+            # the incoming token's block must be exclusively owned —
+            # writing into a prefix-shared block would corrupt the sharers
+            if not self._make_writable(r, r.length, r.length + 1):
+                continue
+            ready.append(r)
+        # a later row's COW may have preempted an earlier accepted row
+        ready = [r for r in ready if r.state == DECODE]
         if not ready:
             return False
         R = self.config.max_seqs
@@ -352,6 +405,28 @@ class ServingEngine:
                   help="decoding rows / max_seqs").set(
                       len(self.sched.decode_requests())
                       / self.config.max_seqs)
+        reg.gauge("serving/kv_blocks_shared",
+                  help="arena blocks referenced by more than one "
+                       "holder (prefix sharing)").set(
+                      self.alloc.blocks_shared)
+        reg.gauge("serving/kv_blocks_shared_peak",
+                  help="peak concurrently-shared arena blocks").set(
+                      self.alloc.peak_shared)
+        if self.prefix is not None:
+            reg.gauge("serving/prefix_hit_rate",
+                      help="prompt tokens served from the prefix cache / "
+                           "prompt tokens of admitted requests").set(
+                          self.sched.prefix_hit_tokens
+                          / max(self.sched.prefix_lookup_tokens, 1))
+            reg.gauge("serving/prefix_cache_blocks",
+                      help="blocks pinned by the prefix cache").set(
+                          self.prefix.cached_blocks)
+        new_cow = self._cow_copies - self._published_cow
+        if new_cow:
+            reg.counter("serving/cow_copies",
+                        help="copy-on-write block duplications (first "
+                             "write into a shared block)").inc(new_cow)
+            self._published_cow = self._cow_copies
         new_preempt = self.sched.preemption_count \
             - self._published_preemptions
         if new_preempt:
@@ -527,17 +602,36 @@ class ServingEngine:
                 donate_argnums=(1,), expected_collectives=expected,
                 mesh=self.engine.mesh,
                 tags={"engine": "ServingEngine", "chunk": C,
-                      "max_blocks": MAXB,
+                      "max_blocks": MAXB, "paged_impl": self._paged_impl,
                       # one chunked-prefill run ingests C prompt tokens
                       "tokens_per_step": C})
             register_entry_point(
                 "serving/decode", build=build_decode, donate_argnums=(1,),
                 expected_collectives=expected, mesh=self.engine.mesh,
                 tags={"engine": "ServingEngine", "rows": R,
-                      "max_blocks": MAXB,
+                      "max_blocks": MAXB, "paged_impl": self._paged_impl,
                       # one decode iteration emits one token per row
                       "tokens_per_step": R})
-            return ["serving/prefill_chunk", "serving/decode"]
+
+            def build_cow():
+                eng = wself()
+                if eng is None:
+                    raise StaleEntryError("serving/cow_copy: engine gone")
+                i32 = jnp.int32
+                return (eng._cow, (eng._arena_sds(),
+                                   jax.ShapeDtypeStruct((), i32),
+                                   jax.ShapeDtypeStruct((), i32)), {})
+
+            # pure arena block copy: slice-select + slice-update along the
+            # (replicated) block axis — no resharding, hence no collectives
+            # regardless of the engine's TP/EP declarations
+            register_entry_point(
+                "serving/cow_copy", build=build_cow, donate_argnums=(0,),
+                expected_collectives=(), mesh=self.engine.mesh,
+                tags={"engine": "ServingEngine",
+                      "block_size": self.config.block_size})
+            return ["serving/prefill_chunk", "serving/decode",
+                    "serving/cow_copy"]
         except Exception:   # registration must never take serving down
             logger.warning("tpuaudit serving registration failed",
                            exc_info=True)

@@ -13,9 +13,15 @@ pool of fixed-size **blocks** (vLLM's PagedAttention, Kwon et al. SOSP '23):
   ``(rows, max_blocks)`` and per-row lengths are data, not shapes, so one
   compiled decode program serves every occupancy the scheduler produces
   (the jit-cache analog of the reference's CUDA-graph discipline). The
-  attention read gathers ``arena[block_table]`` — an XLA gather; a Pallas
-  paged-decode kernel with per-page async DMA is the TPU-native follow-up
-  (see ``docs/serving.md``).
+  attention read walks the block table: on TPU the Pallas paged kernels
+  (``ops/paged_decode_attention.py``) DMA only each row's RESIDENT pages;
+  ``paged_impl='gather'`` keeps the dense ``arena[block_table]`` view as
+  the A/B baseline (``serving.paged_kernel='off'``).
+* ``PrefixCache`` + refcounted ``BlockAllocator`` + ``build_cow_program``
+  — prefix sharing: full prompt blocks are content-hash cached, a new
+  request whose prompt prefix is cached maps those blocks into its table
+  (refcount++) and skips their prefill chunks entirely; the first write
+  into a shared block triggers a device-side copy-on-write.
 * ``sample_rows`` — per-row greedy/temperature/top-k/top-p sampling with
   *array-valued* knobs, so requests with different sampling settings share
   one decode program. The greedy path is bit-identical to
@@ -30,18 +36,21 @@ validity story.
 
 from __future__ import annotations
 
-from typing import List, Optional
+import hashlib
+from collections import OrderedDict
+from typing import Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..inference.kv_cache import (assert_block_divisible, blocks_for_tokens,
                                   init_paged_cache, paged_cache_memory_bytes)
 
-__all__ = ["BlockAllocator", "BlockAllocatorError", "blocks_for_tokens",
-           "assert_block_divisible", "init_paged_cache",
+__all__ = ["BlockAllocator", "BlockAllocatorError", "PrefixCache",
+           "blocks_for_tokens", "assert_block_divisible", "init_paged_cache",
            "paged_cache_memory_bytes", "build_prefill_program",
-           "build_decode_program", "sample_rows"]
+           "build_decode_program", "build_cow_program", "sample_rows"]
 
 
 class BlockAllocatorError(RuntimeError):
@@ -49,12 +58,20 @@ class BlockAllocatorError(RuntimeError):
 
 
 class BlockAllocator:
-    """Free-list allocator over the arena's allocatable blocks (1..capacity).
+    """Refcounted free-list allocator over the arena's allocatable blocks
+    (1..capacity).
+
+    Prefix sharing (copy-on-write block tables) makes one physical block
+    appear in several sequences' tables, so every allocated block carries a
+    reference count: ``alloc`` hands out blocks at refcount 1, ``incref``
+    adds a sharer, and ``free`` DROPS ONE REFERENCE — the block returns to
+    the free list only when its last reference is dropped. Callers that
+    never share (the pre-COW code paths) see the exact PR-6 semantics.
 
     Invariants (tested in tests/unit/test_serving.py):
       * ``blocks_in_use + blocks_free == capacity`` at all times;
-      * a block is never handed out twice without an intervening free;
-      * freeing a block that is not held raises (double free / foreign id);
+      * a block is never handed out twice without reaching refcount 0;
+      * dropping a reference that is not held raises (double free);
       * block 0 (scratch) is never allocated.
     """
 
@@ -64,40 +81,176 @@ class BlockAllocator:
         self.capacity = int(num_blocks)
         # LIFO free list, lowest ids first out — deterministic for tests
         self._free: List[int] = list(range(self.capacity, 0, -1))
-        self._held: set = set()
+        self._refs: Dict[int, int] = {}
         self.peak_in_use = 0
+        self.peak_shared = 0
         self.total_allocs = 0
 
     @property
     def blocks_in_use(self) -> int:
-        return len(self._held)
+        return len(self._refs)
 
     @property
     def blocks_free(self) -> int:
         return len(self._free)
 
+    @property
+    def blocks_shared(self) -> int:
+        """Blocks referenced by more than one holder (the sharing win)."""
+        return sum(1 for r in self._refs.values() if r > 1)
+
+    def refcount(self, block_id: int) -> int:
+        return self._refs.get(block_id, 0)
+
     def alloc(self, n: int) -> Optional[List[int]]:
-        """``n`` fresh block ids, or None when the pool can't satisfy the
-        request (caller decides whether to wait or preempt) — partial
-        allocations never happen."""
+        """``n`` fresh block ids at refcount 1, or None when the pool can't
+        satisfy the request (caller decides whether to wait, evict cached
+        prefixes, or preempt) — partial allocations never happen."""
         if n < 0:
             raise ValueError(f"alloc({n})")
         if n > len(self._free):
             return None
         ids = [self._free.pop() for _ in range(n)]
-        self._held.update(ids)
+        for b in ids:
+            self._refs[b] = 1
         self.total_allocs += n
-        self.peak_in_use = max(self.peak_in_use, len(self._held))
+        self.peak_in_use = max(self.peak_in_use, len(self._refs))
         return ids
 
-    def free(self, ids: List[int]) -> None:
+    def incref(self, ids: List[int]) -> None:
+        """Add one reference per id — a new sharer of an allocated block."""
         for b in ids:
-            if b not in self._held:
+            if b not in self._refs:
+                raise BlockAllocatorError(
+                    f"incref of block {b} which is not allocated")
+        for b in ids:
+            self._refs[b] += 1
+        self.peak_shared = max(self.peak_shared, self.blocks_shared)
+
+    def free(self, ids: List[int]) -> None:
+        """Drop one reference per id; a block is recycled only when its
+        LAST reference goes — freeing a shared block never takes it away
+        from the other holders."""
+        for b in ids:
+            if b not in self._refs:
                 raise BlockAllocatorError(
                     f"free of block {b} which is not allocated "
                     "(double free or foreign id)")
-            self._held.remove(b)
-            self._free.append(b)
+            self._refs[b] -= 1
+            if self._refs[b] == 0:
+                del self._refs[b]
+                self._free.append(b)
+
+
+class PrefixCache:
+    """Content-hashed prompt-prefix → physical-block cache (vLLM/SGLang
+    automatic prefix caching).
+
+    Keys are CHAIN hashes: block i's key digests block i-1's key plus block
+    i's tokens, so a block is reusable only under the exact same prefix.
+    Only FULL prompt blocks are cached — their KV content is immutable once
+    written (the arena layout is position-exact, so identical tokens at
+    identical positions produce identical KV bytes under fixed params).
+
+    The cache holds ONE pin reference per cached block. Entries whose block
+    no request references (allocator refcount == 1) are evictable LRU-first
+    under pool pressure; entries shared with live requests are pinned —
+    eviction never frees a block somebody still reads.
+    """
+
+    def __init__(self, allocator: BlockAllocator, block_size: int):
+        self.alloc = allocator
+        self.block_size = int(block_size)
+        self._entries: "OrderedDict[bytes, int]" = OrderedDict()
+        self.inserts = 0
+        self.evictions = 0
+
+    @property
+    def cached_blocks(self) -> int:
+        return len(self._entries)
+
+    @staticmethod
+    def _chain(prev: bytes, tokens: np.ndarray) -> bytes:
+        h = hashlib.blake2b(prev, digest_size=16)
+        h.update(np.ascontiguousarray(tokens, np.int32).tobytes())
+        return h.digest()
+
+    def chain_key(self, prompt: np.ndarray, prev: bytes,
+                  block_index: int) -> bytes:
+        """One incremental chain step: the key of block ``block_index``
+        given its predecessor's key — callers registering blocks in order
+        thread the digest instead of rehashing from block 0 (O(P) per
+        request, not O(P^2))."""
+        BS = self.block_size
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        return self._chain(prev, prompt[block_index * BS:
+                                        (block_index + 1) * BS])
+
+    def match(self, prompt) -> Tuple[List[int], int]:
+        """Longest cached chain of full blocks for ``prompt``. Returns
+        ``(block_ids, n_tokens)`` with ``n_tokens`` capped at
+        ``len(prompt) - 1``: at least one prompt token always re-prefills,
+        because the request's first sampled token needs the final prompt
+        position's logits. When the cap bites (every prompt block cached),
+        the last block is handed back SHARED and the re-prefilled token's
+        write triggers copy-on-write. Does NOT take references or count
+        hit statistics — the caller does both when it COMMITS to using
+        the blocks (a rolled-back admission must not inflate the hit
+        rate)."""
+        BS = self.block_size
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        ids: List[int] = []
+        key = b""
+        for i in range(int(prompt.size) // BS):
+            key = self._chain(key, prompt[i * BS:(i + 1) * BS])
+            bid = self._entries.get(key)
+            if bid is None:
+                break
+            self._entries.move_to_end(key)     # LRU recency
+            ids.append(bid)
+        n = min(len(ids) * BS, int(prompt.size) - 1)
+        if n < 1:
+            return [], 0
+        return ids, n
+
+    def insert_key(self, key: bytes, block_id: int) -> bool:
+        """Register a fully-prefilled block under its (caller-threaded)
+        chain key, pinning it with one cache reference. A key that is
+        already cached keeps its existing block (no double pin)."""
+        if key in self._entries:
+            self._entries.move_to_end(key)
+            return False
+        self.alloc.incref([block_id])
+        self._entries[key] = block_id
+        self.inserts += 1
+        return True
+
+    def insert(self, prompt: np.ndarray, block_index: int,
+               block_id: int) -> bool:
+        """Convenience form of ``insert_key`` that rehashes the chain from
+        block 0 — tests and one-off callers; the scheduler threads the
+        digest incrementally instead."""
+        key = b""
+        for i in range(block_index + 1):
+            key = self.chain_key(prompt, key, i)
+        return self.insert_key(key, block_id)
+
+    def evict(self, need: int) -> int:
+        """Drop up to ``need`` UNPINNED entries (blocks only the cache
+        holds), LRU-first, returning their blocks to the free list.
+        Returns the number actually freed — pinned entries (shared with a
+        live request) are never touched."""
+        freed = 0
+        for key in list(self._entries):
+            if freed >= need:
+                break
+            bid = self._entries[key]
+            if self.alloc.refcount(bid) == 1:
+                del self._entries[key]
+                self.alloc.free([bid])
+                freed += 1
+                self.evictions += 1
+        return freed
 
 
 # ---------------------------------------------------------------------------
@@ -148,7 +301,7 @@ def sample_rows(logits: jax.Array, base_key: jax.Array,
 # ---------------------------------------------------------------------------
 
 
-def build_prefill_program(cfg):
+def build_prefill_program(cfg, paged_impl: str = "auto"):
     """Jitted prefill-chunk program over the paged arena.
 
     Args (all shapes static per (C, max_blocks) pair):
@@ -176,7 +329,9 @@ def build_prefill_program(cfg):
         logits, cache, _ = model_forward(params, chunk, cfg, cache=cache,
                                          positions=pos,
                                          block_table=block_table,
-                                         paged_write_mask=write_mask)
+                                         paged_write_mask=write_mask,
+                                         paged_impl=paged_impl,
+                                         paged_chunk=True)
         last = jnp.take_along_axis(
             logits, jnp.maximum(n_valid - 1, 0)[None, None, None],
             axis=1)[:, 0].astype(jnp.float32)
@@ -187,7 +342,7 @@ def build_prefill_program(cfg):
     return jax.jit(prefill_chunk, donate_argnums=(1,))
 
 
-def build_decode_program(cfg):
+def build_decode_program(cfg, paged_impl: str = "auto"):
     """Jitted one-token decode step over the paged arena for a fixed row
     count R. Inactive rows carry an all-zero block table and length 0 — their
     writes land in the scratch block and their sampled tokens are ignored by
@@ -207,9 +362,25 @@ def build_decode_program(cfg):
         logits, cache, _ = model_forward(params, tokens[:, None], cfg,
                                          cache=cache,
                                          positions=lengths[:, None],
-                                         block_table=block_table)
+                                         block_table=block_table,
+                                         paged_impl=paged_impl)
         nxt = sample_rows(logits[:, -1], base_key, temperature, top_k,
                           top_p, seeds, steps)
         return nxt, cache
 
     return jax.jit(decode, donate_argnums=(1,))
+
+
+def build_cow_program():
+    """Jitted copy-on-write block copy: duplicate physical block ``src``
+    into ``dst`` across every layer of the (donated) arena. ``src``/``dst``
+    are traced int32 scalars, so ONE compiled program serves every copy —
+    the scheduler runs it before the first write into a block whose
+    refcount is > 1 (prefix sharing), giving the writer a private copy
+    while readers keep the original."""
+
+    def cow_copy(cache, src, dst):
+        return {"k": cache["k"].at[:, dst].set(cache["k"][:, src]),
+                "v": cache["v"].at[:, dst].set(cache["v"][:, src])}
+
+    return jax.jit(cow_copy, donate_argnums=(0,))

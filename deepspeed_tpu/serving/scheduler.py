@@ -21,6 +21,14 @@ Policies:
   immediately, and it re-queues in *recompute* mode (its re-prefill source
   is prompt + tokens generated so far; already-streamed tokens are never
   re-emitted). LIFO victim choice protects the oldest requests' latency.
+  Freeing drops REFERENCES — blocks shared through the prefix cache stay
+  resident for their other holders, and unpinned cache entries are evicted
+  before any running request is.
+* **Prefix sharing** — admission consults the content-hashed
+  ``PrefixCache``: cached full prompt blocks are mapped straight into the
+  new request's table (refcount++) and their prefill chunks never run.
+  Writes into a shared block go copy-on-write (``cow_block_indices`` +
+  ``alloc_for_cow``; the engine runs the device-side copy).
 """
 
 from __future__ import annotations
@@ -32,7 +40,7 @@ from typing import Callable, Dict, List, Optional
 
 import numpy as np
 
-from .paged_kv import BlockAllocator, blocks_for_tokens
+from .paged_kv import BlockAllocator, PrefixCache, blocks_for_tokens
 
 __all__ = ["Request", "SamplingParams", "Scheduler", "QueueFull",
            "QUEUED", "PREFILL", "DECODE", "FINISHED", "CANCELLED"]
@@ -82,6 +90,11 @@ class Request:
     generated: List[int] = dataclasses.field(default_factory=list)
     n_prompt: int = 0                        # ORIGINAL prompt length
     resume: bool = False                     # recompute after preemption
+    # incremental prefix-cache chain digest: key of the last registered
+    # block + how many prompt blocks it covers (rebuilt on mismatch, e.g.
+    # after preemption resets prefill_pos)
+    chain_key: bytes = b""
+    chain_blocks: int = 0
     preemptions: int = 0
     first_token_s: Optional[float] = None
     finish_s: Optional[float] = None
@@ -115,10 +128,12 @@ class Scheduler:
     """Owns the queue, the decode rows, and the block pool accounting."""
 
     def __init__(self, config, allocator: Optional[BlockAllocator] = None,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 prefix_cache: Optional[PrefixCache] = None):
         config.validate()
         self.config = config
         self.alloc = allocator or BlockAllocator(config.pool_blocks())
+        self.prefix = prefix_cache
         self.clock = clock
         self.queued: List[Request] = []
         self.running: Dict[int, Request] = {}      # row -> request
@@ -135,6 +150,9 @@ class Scheduler:
         self.preemption_count = 0
         self.finished_count = 0
         self.cancelled_count = 0
+        self.prefix_hits = 0           # admissions that reused ≥1 block
+        self.prefix_hit_tokens = 0     # prompt tokens whose prefill was skipped
+        self.prefix_lookup_tokens = 0  # prompt tokens of COMMITTED admissions
 
     # -- intake ------------------------------------------------------------
     def submit(self, req: Request) -> None:
@@ -217,18 +235,45 @@ class Scheduler:
 
     def admit(self) -> List[Request]:
         """Move queued requests onto free decode rows while their first
-        chunk's blocks fit in the FREE pool (admission never preempts — only
-        progress for already-admitted requests may evict)."""
+        chunk's blocks fit in the pool (admission never preempts a running
+        request — only progress for already-admitted requests may evict;
+        it MAY evict unpinned prefix-cache entries under pressure).
+
+        Prefix sharing: a request whose prompt prefix is content-cached
+        maps the cached blocks into its table (refcount++) and starts
+        prefill AFTER them — those chunks are never run. The cached blocks
+        are incref'd BEFORE the fresh allocation so cache-pressure eviction
+        can never free the very blocks the admission is about to use."""
         admitted: List[Request] = []
         while self._free_rows:
             req = self._pick_next()
             if req is None:
                 break
-            first = min(self.config.prefill_chunk, int(req.prompt.size))
-            need = blocks_for_tokens(first, self.config.block_size)
-            ids = self.alloc.alloc(need)
+            cached_ids: List[int] = []
+            n_cached = 0
+            if self.prefix is not None:
+                cached_ids, n_cached = self.prefix.match(req.prompt)
+            if cached_ids:
+                self.alloc.incref(cached_ids)
+            first_target = min(n_cached + self.config.prefill_chunk,
+                               int(req.prompt.size))
+            need = max(blocks_for_tokens(first_target, self.config.block_size)
+                       - len(cached_ids), 0)
+            ids = self._alloc_evicting_cache(need)
             if ids is None:
+                if cached_ids:
+                    self.alloc.free(cached_ids)   # roll the increfs back
                 break
+            if self.prefix is not None:
+                # stats at the COMMIT point only: a rolled-back admission
+                # re-matching every iteration must not inflate the rate
+                self.prefix_lookup_tokens += int(req.prompt.size)
+            if cached_ids:
+                req.blocks.extend(cached_ids)
+                req.prefill_pos = n_cached
+                req.length = n_cached
+                self.prefix_hits += 1
+                self.prefix_hit_tokens += n_cached
             req.blocks.extend(ids)
             self.queued.remove(req)
             req.row = self._free_rows.pop()
@@ -240,23 +285,85 @@ class Scheduler:
             admitted.append(req)
         return admitted
 
+    def _alloc_evicting_cache(self, n: int) -> Optional[List[int]]:
+        """Allocate ``n`` blocks, relieving pressure by evicting UNPINNED
+        prefix-cache entries (LRU-first) — never by preempting a running
+        request."""
+        while True:
+            ids = self.alloc.alloc(n)
+            if ids is not None:
+                return ids
+            if self.prefix is None:
+                return None
+            if self.prefix.evict(n - self.alloc.blocks_free) <= 0:
+                return None
+
     # -- block growth + preemption ----------------------------------------
     def ensure_blocks(self, req: Request, upto_tokens: int) -> bool:
         """Grow ``req``'s block list to cover positions [0, upto_tokens).
-        When the pool is dry, evicts the most recently admitted OTHER
-        request and retries; returns False when nothing can be evicted
-        (the caller skips this request for the iteration)."""
+        When the pool is dry, relieves pressure in order of cost: first
+        evict UNPINNED prefix-cache entries (no recompute anywhere), then
+        evict the most recently admitted OTHER request and retry; returns
+        False when nothing can be evicted (the caller skips this request
+        for the iteration). Preempting a victim whose blocks are all
+        shared may free nothing — the loop keeps evicting until the pool
+        yields or the running set is exhausted."""
         need = blocks_for_tokens(upto_tokens, self.config.block_size) \
             - len(req.blocks)
         if need <= 0:
             return True
         while True:
-            ids = self.alloc.alloc(need)
+            ids = self._alloc_evicting_cache(need)
             if ids is not None:
                 req.blocks.extend(ids)
                 return True
             if not self._preempt_one(exclude=req):
                 return False
+
+    def alloc_for_cow(self, req: Request) -> Optional[int]:
+        """One private block for a copy-on-write replacement in ``req``'s
+        table — same pressure ladder as ensure_blocks. Returns the block
+        id, or None when the pool cannot provide one this iteration."""
+        while True:
+            ids = self._alloc_evicting_cache(1)
+            if ids is not None:
+                return ids[0]
+            if not self._preempt_one(exclude=req):
+                return None
+
+    def cow_block_indices(self, req: Request, start: int, end: int
+                          ) -> List[int]:
+        """Positions [start, end) are about to be written: the table
+        indices whose physical block is SHARED (refcount > 1) and must be
+        copied first — a writer may only touch exclusively-owned blocks."""
+        if end <= start:
+            return []
+        bs = self.config.block_size
+        return [bi for bi in range(start // bs, (end - 1) // bs + 1)
+                if bi < len(req.blocks)
+                and self.alloc.refcount(req.blocks[bi]) > 1]
+
+    def note_prefill_progress(self, req: Request, old_pos: int,
+                              new_pos: int) -> None:
+        """Prefill advanced [old_pos → new_pos): register newly COMPLETED
+        full prompt blocks with the prefix cache (idempotent — an existing
+        chain key keeps its block). The chain digest threads through the
+        request (one hash step per block); a position reset (preemption
+        recompute) rebuilds it once."""
+        if self.prefix is None:
+            return
+        bs = self.config.block_size
+        first, last = old_pos // bs, new_pos // bs
+        if req.chain_blocks != first:
+            key = b""
+            for bi in range(first):
+                key = self.prefix.chain_key(req.prompt, key, bi)
+            req.chain_key, req.chain_blocks = key, first
+        for bi in range(first, last):
+            req.chain_key = self.prefix.chain_key(req.prompt,
+                                                  req.chain_key, bi)
+            req.chain_blocks = bi + 1
+            self.prefix.insert_key(req.chain_key, req.blocks[bi])
 
     def _preempt_one(self, exclude: Request) -> bool:
         victims = [r for r in self.running.values() if r is not exclude]

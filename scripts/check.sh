@@ -1,12 +1,14 @@
 #!/usr/bin/env bash
 # The unified static-analysis driver: lint (source) + audit (program
-# semantics) + cost (program cost) in one run, one exit code for CI.
+# semantics) + cost (program cost) + parity (serving kernel-path tests,
+# tier-1 marker set) in one run, one exit code for CI.
 #
-# All three analyzers share the same gate semantics (committed baseline,
+# The three analyzers share the same gate semantics (committed baseline,
 # stale-entry rot detection, the render_report tail in
 # tools/tpulint/baseline.py), so this script is just sequencing: every gate
-# runs even when an earlier one fails, and the exit code is the OR of the
-# three — CI output always shows the full picture, not the first failure.
+# runs even when an earlier one fails, and the exit code is the OR of
+# all of them — CI output always shows the full picture, not the first
+# failure.
 #
 # Usage: scripts/check.sh            # everything
 #        scripts/check.sh lint cost  # a subset
@@ -16,7 +18,7 @@ cd "$(dirname "$0")/.."
 
 selected=("$@")
 fail=0
-for gate in lint audit cost; do
+for gate in lint audit cost parity; do
     if [ "${#selected[@]}" -gt 0 ]; then
         case " ${selected[*]} " in
             *" $gate "*) ;;

@@ -454,8 +454,12 @@ class TestServingEngine:
 
     def test_preemption_recompute_bit_identical(self, tiny_engine):
         """Pool far too small for the load: eviction + recompute must not
-        change any output (greedy)."""
-        srv = serving(tiny_engine, num_blocks=10, max_seqs=4)
+        change any output (greedy). prefix_cache=False isolates the
+        preemption machinery — with sharing on, cache eviction relieves
+        most of the pressure before any request is preempted (tested
+        separately in TestPrefixSharing)."""
+        srv = serving(tiny_engine, num_blocks=10, max_seqs=4,
+                      prefix_cache=False)
         rng = np.random.RandomState(3)
         prompts = [rng.randint(0, 250, (rng.randint(20, 60),))
                    for _ in range(6)]
@@ -622,10 +626,227 @@ class TestServingAudit:
         from tools.tpuaudit.registry import get_entry_points
 
         srv = serving(tiny_engine)
-        eps = get_entry_points(["serving/prefill_chunk", "serving/decode"])
+        eps = get_entry_points(["serving/prefill_chunk", "serving/decode",
+                                "serving/cow_copy"])
         assert [ep.name for ep in eps] == ["serving/prefill_chunk",
-                                           "serving/decode"]
-        assert all(ep.donate_argnums == (1,) for ep in eps)  # arena donated
+                                           "serving/decode",
+                                           "serving/cow_copy"]
+        assert all(ep.donate_argnums == (1,) for ep in eps[:2])  # arena
+        assert eps[2].donate_argnums == (0,)
         findings = run_audit(eps, publish_metrics=False)
         assert findings == [], [f"{f.entry}:{f.check}" for f in findings]
         del srv
+
+
+# ---------------------------------------------------------------------------
+# prefix sharing: refcounts, COW, prefix-hit admission
+# ---------------------------------------------------------------------------
+
+
+class TestRefcountedAllocator:
+    def test_incref_free_lifecycle(self):
+        a = BlockAllocator(4)
+        ids = a.alloc(2)
+        a.incref(ids)                      # a second holder appears
+        assert a.blocks_shared == 2
+        a.free(ids)                        # first holder drops out
+        assert a.blocks_in_use == 2 and a.blocks_shared == 0
+        a.free(ids)                        # LAST reference → recycled
+        assert a.blocks_in_use == 0 and a.blocks_free == 4
+        with pytest.raises(BlockAllocatorError):
+            a.free([ids[0]])               # double free still raises
+
+    def test_incref_unallocated_raises(self):
+        a = BlockAllocator(2)
+        with pytest.raises(BlockAllocatorError):
+            a.incref([1])
+
+    def test_occupancy_invariant_under_sharing(self):
+        a = BlockAllocator(6)
+        ids = a.alloc(3)
+        a.incref(ids[:2])
+        a.free(ids)
+        a.incref(ids[:1])
+        assert a.blocks_in_use + a.blocks_free == 6
+        a.free(ids[:2])
+        a.free(ids[:1])
+        assert a.blocks_in_use == 0 and a.blocks_free == 6
+
+
+class TestPrefixCacheHost:
+    def _cache(self, cap=8, bs=4):
+        from deepspeed_tpu.serving import PrefixCache
+
+        alloc = BlockAllocator(cap)
+        return alloc, PrefixCache(alloc, bs)
+
+    def test_match_insert_chain(self):
+        alloc, pc = self._cache()
+        prompt = np.arange(12)             # 3 full blocks of 4
+        ids = alloc.alloc(3)
+        for i in range(3):
+            assert pc.insert(prompt, i, ids[i])
+        assert alloc.refcount(ids[0]) == 2   # owner + cache pin
+        got, n = pc.match(prompt)
+        assert got == ids and n == 11        # capped at len(prompt) - 1
+        # a different first token shares nothing (chain hash)
+        other = np.concatenate([[99], np.arange(1, 12)])
+        assert pc.match(other) == ([], 0)
+        # divergence after two blocks → only those two shared
+        part = np.concatenate([np.arange(8), [77, 77, 77, 77]])
+        got3, n3 = pc.match(part)
+        assert got3 == ids[:2] and n3 == 8
+
+    def test_insert_is_idempotent(self):
+        alloc, pc = self._cache()
+        prompt = np.arange(4)
+        ids = alloc.alloc(1)
+        assert pc.insert(prompt, 0, ids[0])
+        assert not pc.insert(prompt, 0, ids[0])   # no double pin
+        assert alloc.refcount(ids[0]) == 2
+
+    def test_evict_respects_pinned_blocks(self):
+        alloc, pc = self._cache(cap=4)
+        ids = alloc.alloc(2)
+        prompt = np.arange(8)
+        pc.insert(prompt, 0, ids[0])
+        pc.insert(prompt, 1, ids[1])
+        alloc.free([ids[1]])     # owner gone → cache is sole holder
+        # ids[0] still request-owned (refcount 2) → pinned, never evicted
+        assert pc.evict(5) == 1
+        assert alloc.refcount(ids[1]) == 0
+        assert alloc.refcount(ids[0]) == 2
+        assert pc.cached_blocks == 1
+
+
+class TestPrefixSharing:
+    def test_second_request_skips_shared_chunks(self, tiny_engine):
+        """The acceptance criterion: an identical cached prompt prefix
+        consumes ZERO new prefill chunks for the shared blocks — only the
+        capped final token re-prefills (and its shared block goes COW)."""
+        srv = serving(tiny_engine)
+        rng = np.random.RandomState(7)
+        prompt = rng.randint(0, 250, (48,))       # exactly 3 full blocks
+        h1 = srv.submit(prompt, max_new_tokens=6)
+        srv.run()
+        assert srv.prefix.cached_blocks == 3
+        h2 = srv.submit(prompt, max_new_tokens=6)
+        req = srv.sched.admit()[0]
+        # all shared blocks skipped: prefill restarts at the LAST prompt
+        # token (its logits seed the first sampled token)
+        assert req.prefill_pos == 47
+        assert srv.sched.prefix_hit_tokens == 47
+        assert srv.sched.prefix_hits == 1
+        prefill_steps = 0
+        while req.state == PREFILL:
+            assert srv._step_prefill()
+            prefill_steps += 1
+        assert prefill_steps == 1                  # 1 chunk, not 3
+        assert srv._cow_copies >= 1                # shared block was copied
+        srv.run()
+        want = np.asarray(tiny_engine.generate(prompt[None],
+                                               max_new_tokens=6))[0]
+        np.testing.assert_array_equal(h1.result(), want)
+        np.testing.assert_array_equal(h2.result(), want)
+
+    def test_partial_tail_block_stays_private(self, tiny_engine):
+        """A prompt with a partial tail block shares only the FULL blocks;
+        the tail re-prefills into a fresh private block — no COW needed."""
+        srv = serving(tiny_engine)
+        rng = np.random.RandomState(8)
+        prompt = rng.randint(0, 250, (40,))       # 2 full blocks + 8
+        h1 = srv.submit(prompt, max_new_tokens=4)
+        srv.run()
+        assert srv.prefix.cached_blocks == 2
+        h2 = srv.submit(prompt, max_new_tokens=4)
+        req = srv.sched.admit()[0]
+        assert req.prefill_pos == 32
+        cow_before = srv._cow_copies
+        srv.run()
+        assert srv._cow_copies == cow_before
+        want = np.asarray(tiny_engine.generate(prompt[None],
+                                               max_new_tokens=4))[0]
+        np.testing.assert_array_equal(h1.result(), want)
+        np.testing.assert_array_equal(h2.result(), want)
+
+    def test_cancel_releases_shared_blocks_exactly_once(self, tiny_engine):
+        srv = serving(tiny_engine)
+        rng = np.random.RandomState(9)
+        prompt = rng.randint(0, 250, (48,))
+        srv.submit(prompt, max_new_tokens=4)
+        srv.run()
+        h2 = srv.submit(prompt, max_new_tokens=4)
+        srv.step()                                 # admit + first chunk
+        shared = [b for b in h2._req.blocks if srv.alloc.refcount(b) > 1]
+        assert shared                              # really sharing
+        before = {b: srv.alloc.refcount(b) for b in shared}
+        assert h2.cancel()
+        for b in shared:
+            assert srv.alloc.refcount(b) == before[b] - 1   # exactly once
+        assert not h2.cancel()                     # second cancel: no-op
+        # cache pins survive the cancel; no block was force-freed
+        assert srv.alloc.blocks_in_use == srv.prefix.cached_blocks
+
+    def test_shared_pressure_stress_outputs_exact(self, tiny_engine):
+        """Six requests sharing a 2-block prefix through a pool too small
+        to hold them privately: cache eviction + preemption + COW all fire
+        and every output stays bit-identical to offline generate()."""
+        srv = serving(tiny_engine, num_blocks=14, max_seqs=4)
+        rng = np.random.RandomState(10)
+        shared = rng.randint(0, 250, (32,))
+        prompts = [np.concatenate([shared,
+                                   rng.randint(0, 250,
+                                               (rng.randint(1, 16),))])
+                   for _ in range(6)]
+        handles = [srv.submit(p, max_new_tokens=6) for p in prompts]
+        srv.run()
+        for i, (p, h) in enumerate(zip(prompts, handles)):
+            want = np.asarray(tiny_engine.generate(p[None],
+                                                   max_new_tokens=6))[0]
+            np.testing.assert_array_equal(h.result(), want,
+                                          err_msg=f"request {i} diverged")
+        # every request reference released — only cache pins remain
+        assert srv.alloc.blocks_in_use == srv.prefix.cached_blocks
+
+    def test_prefix_metrics_published(self, tiny_engine, obs_session):
+        srv = serving(tiny_engine)
+        rng = np.random.RandomState(11)
+        prompt = rng.randint(0, 250, (48,))
+        srv.submit(prompt, max_new_tokens=4)
+        srv.run()
+        srv.submit(prompt, max_new_tokens=4)
+        srv.run()
+        reg = get_registry()
+        assert reg.gauge("serving/prefix_hit_rate").value() > 0
+        assert reg.gauge("serving/prefix_cache_blocks").value() >= 3
+        assert reg.counter("serving/cow_copies").value() >= 1
+
+
+class TestPagedKernelAB:
+    def test_gather_vs_paged_outputs_identical(self, tiny_engine):
+        """The --paged-kernel A/B: the dense gather view
+        (paged_kernel='off') and the paged read path ('auto': Pallas
+        kernels on TPU, the GQA-native jnp reference here) produce
+        identical greedy outputs — the 16-request acceptance smoke re-run
+        on both paths."""
+        rng = np.random.RandomState(12)
+        prompts = [rng.randint(0, 250, (rng.randint(4, 40),))
+                   for _ in range(16)]
+        outs = {}
+        for mode in ("off", "auto"):
+            srv = serving(tiny_engine, paged_kernel=mode, num_blocks=64,
+                          max_seqs=8)
+            handles = []
+            for i, p in enumerate(prompts):
+                handles.append(srv.submit(p, max_new_tokens=8))
+                if i % 4 == 3:
+                    srv.step()
+            srv.run()
+            outs[mode] = [h.result() for h in handles]
+        for i, p in enumerate(prompts):
+            want = np.asarray(tiny_engine.generate(p[None],
+                                                   max_new_tokens=8))[0]
+            np.testing.assert_array_equal(outs["off"][i], want,
+                                          err_msg=f"gather {i} diverged")
+            np.testing.assert_array_equal(outs["auto"][i], want,
+                                          err_msg=f"paged {i} diverged")
