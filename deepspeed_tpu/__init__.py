@@ -5,10 +5,13 @@ idiomatically for JAX/XLA/Pallas: named-mesh sharding instead of NCCL process
 groups, SPMD ZeRO instead of hook-driven partitioning, Pallas kernels instead
 of CUDA. Public entry points mirror the reference (``deepspeed/__init__.py``):
 
-  initialize()       -> (engine, optimizer, dataloader, lr_scheduler)
-  init_inference()   -> InferenceEngine
-  init_serving()     -> ServingEngine (continuous batching, the MII analog)
-  comm               -> named-axis collective API
+  initialize()           -> (engine, optimizer, dataloader, lr_scheduler)
+  init_inference()       -> InferenceEngine
+  init_serving()         -> ServingEngine (continuous batching, the MII analog)
+  run_training_session() -> self-healing supervised training (rollback on
+                            numerics trips, hang escalation, straggler
+                            eviction via the elastic agent — docs/resilience.md)
+  comm                   -> named-axis collective API
 """
 
 __version__ = "0.1.0"
@@ -60,6 +63,19 @@ def init_inference(model=None, config=None, **kwargs):
     from .inference.engine import init_inference as _init_inference
 
     return _init_inference(model=model, config=config, **kwargs)
+
+
+def run_training_session(model=None, config=None, data_fn=None,
+                         total_steps=0, save_dir=None, **kwargs):
+    """Supervised self-healing training (runtime/session.py): the engine
+    lifecycle across failures — automatic rollback to the last verified
+    checkpoint on a numerics trip, hang escalation
+    (dump → soft restart → hard restart for the elastic agent), and
+    straggler eviction with membership shrink. See docs/resilience.md."""
+    from .runtime.session import run_training_session as _run
+
+    return _run(model=model, config=config, data_fn=data_fn,
+                total_steps=total_steps, save_dir=save_dir, **kwargs)
 
 
 def init_serving(model=None, serving_config=None, **kwargs):

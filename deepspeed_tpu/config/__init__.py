@@ -4,8 +4,8 @@ from .config import (AIOConfig, ActivationCheckpointingConfig, BF16Config,
                      Config, CurriculumConfig, DataEfficiencyConfig,
                      ElasticityConfig, FlopsProfilerConfig, FP16Config,
                      MonitorConfig, OffloadOptimizerConfig, OffloadParamConfig,
-                     OptimizerConfig, ParallelConfig, SchedulerConfig,
-                     ServingConfig, ZeroConfig, load_config)
+                     OptimizerConfig, ParallelConfig, ResilienceConfig,
+                     SchedulerConfig, ServingConfig, ZeroConfig, load_config)
 
 __all__ = [
     "ConfigError", "ConfigModel", "Config", "load_config",
@@ -14,5 +14,5 @@ __all__ = [
     "ParallelConfig", "ActivationCheckpointingConfig", "CommsLoggerConfig",
     "FlopsProfilerConfig", "MonitorConfig", "ElasticityConfig",
     "CurriculumConfig", "DataEfficiencyConfig", "CompressionConfig",
-    "AIOConfig", "CheckpointConfig", "ServingConfig",
+    "AIOConfig", "CheckpointConfig", "ServingConfig", "ResilienceConfig",
 ]

@@ -367,6 +367,66 @@ class ObservabilityConfig(ConfigModel):
 
 
 @dataclass
+class ResilienceConfig(ConfigModel):
+    """Self-healing training session policy (``runtime/session.py`` /
+    ``deepspeed_tpu.run_training_session``) — what the supervisor does when
+    the observability layer names a failure. The detection side lives in
+    :class:`ObservabilityConfig` (numerics sentinel, hang watchdog, fleet
+    health); this section is the remediation side: which policy each
+    failure class maps to, the rollback/restart budgets, and the
+    checkpoint cadence that bounds how much work a rollback loses. See
+    docs/resilience.md for the failure→policy table."""
+
+    save_dir: str = ""                 # checkpoint root ("" => the session's
+    #   save_dir argument is required)
+    checkpoint_every_steps: int = 50   # save cadence — the rollback horizon
+    verify_checkpoints: bool = True    # crc-verify on load; fall back to the
+    #   previous good tag on corruption (runtime/checkpoint.py)
+    on_numerics: str = "rollback"      # NumericsTrip (action='abort') →
+    #   rollback | skip | raise
+    on_crash: str = "raise"            # other train_batch exceptions →
+    #   rollback | raise (raise: a bug should fail loudly, not retry-loop)
+    on_hang: str = "escalate"          # hang-watchdog fires → escalate
+    #   (dump → soft restart → hard restart) | off (leave watchdog policy)
+    hang_soft_restarts: int = 1        # in-process soft-restart budget: a
+    #   hang past it escalates to the agent — RecoveryExhausted when
+    #   control returned (worker exits nonzero), the watchdog's own
+    #   hang_exit_code abort when it never did
+    max_rollbacks: int = 3             # rollback budget per incarnation —
+    #   past it the failure re-raises (a persistent fault must escalate to
+    #   the agent, not rollback-loop forever)
+    straggler_patience: int = 2        # consecutive fleet straggler verdicts
+    #   against the same rank before an eviction request
+    min_world: int = 1                 # never request eviction below this
+    #   world size (the agent's min_workers floors the actual shrink too)
+    record_losses: bool = True         # keep the per-step loss series on the
+    #   session (one host sync per step — disable for production runs)
+
+    def validate(self) -> None:
+        if self.checkpoint_every_steps < 1:
+            raise ConfigError(
+                "resilience.checkpoint_every_steps must be >= 1")
+        if self.on_numerics not in ("rollback", "skip", "raise"):
+            raise ConfigError(
+                "resilience.on_numerics must be rollback|skip|raise, "
+                f"got '{self.on_numerics}'")
+        if self.on_crash not in ("rollback", "raise"):
+            raise ConfigError("resilience.on_crash must be rollback|raise, "
+                              f"got '{self.on_crash}'")
+        if self.on_hang not in ("escalate", "off"):
+            raise ConfigError("resilience.on_hang must be escalate|off, "
+                              f"got '{self.on_hang}'")
+        if self.hang_soft_restarts < 0:
+            raise ConfigError("resilience.hang_soft_restarts must be >= 0")
+        if self.max_rollbacks < 0:
+            raise ConfigError("resilience.max_rollbacks must be >= 0")
+        if self.straggler_patience < 1:
+            raise ConfigError("resilience.straggler_patience must be >= 1")
+        if self.min_world < 1:
+            raise ConfigError("resilience.min_world must be >= 1")
+
+
+@dataclass
 class ServingConfig(ConfigModel):
     """Continuous-batching serving layer (``deepspeed_tpu/serving``) — the
     MII/FastGen analog: paged KV arena + iteration-level scheduler +
@@ -599,6 +659,7 @@ class Config(ConfigModel):
     monitor: MonitorConfig = field(default_factory=MonitorConfig)
     observability: ObservabilityConfig = field(
         default_factory=ObservabilityConfig)
+    resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
     elasticity: ElasticityConfig = field(default_factory=ElasticityConfig)
     curriculum_learning: CurriculumConfig = field(default_factory=CurriculumConfig)
     progressive_layer_drop: ProgressiveLayerDropConfig = field(

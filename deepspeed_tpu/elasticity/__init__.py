@@ -10,5 +10,6 @@ reshard-on-load by construction).
 """
 
 from .elasticity import (ElasticityConfig, ElasticityConfigError,
-                         ElasticityError, compute_elastic_config,
+                         ElasticityError, apply_elastic_env_overrides,
+                         compute_elastic_config,
                          elasticity_enabled)  # noqa: F401

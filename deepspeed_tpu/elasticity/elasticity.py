@@ -18,7 +18,8 @@ from __future__ import annotations
 
 import dataclasses
 import math
-from typing import Dict, List, Optional, Sequence, Tuple
+import os
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from ..utils.logging import logger
 
@@ -195,6 +196,43 @@ def _compatible_chips_v02(cfg: ElasticityConfig, current_chips: int
 
 def elasticity_enabled(config: Dict) -> bool:
     return bool(config.get("elasticity", {}).get("enabled", False))
+
+
+def apply_elastic_env_overrides(config: Any,
+                                env: Optional[Dict[str, str]] = None) -> Any:
+    """Fold the elastic agent's per-incarnation env contract into a framework
+    ``Config``: when ``DSTPU_ELASTIC_MICRO`` is set (the agent recomputed
+    the micro batch for the CURRENT — possibly shrunken — membership via
+    :func:`compute_elastic_config`), override the micro batch and clear the
+    gradient-accumulation count so the engine's batch-triad resolution
+    derives gas from the PRESERVED global batch under the new world size.
+    A worker that is not agent-spawned (env unset) gets its config back
+    untouched."""
+    env = os.environ if env is None else env
+    micro = env.get("DSTPU_ELASTIC_MICRO")
+    if not micro:
+        return config
+    micro = int(micro)
+    # the agent also ships the elastic GLOBAL batch: a config expressing
+    # its batch as micro+gas (train_batch_size unset) would otherwise lose
+    # the target when gas is cleared — the triad resolution would invent
+    # gas=1 and shrink the effective batch with the membership
+    batch = env.get("DSTPU_ELASTIC_BATCH")
+    tb = int(batch) if batch else config.train_batch_size
+    if not tb:
+        logger.warning(
+            "elasticity: DSTPU_ELASTIC_MICRO set without DSTPU_ELASTIC_BATCH "
+            "and no train_batch_size in the config — cannot preserve the "
+            "global batch across the membership change; leaving the config "
+            "untouched")
+        return config
+    logger.info(
+        f"elasticity: batch triad overridden to global={tb} micro={micro} "
+        f"by the elastic agent (restart {env.get('DSTPU_RESTART_COUNT', '0')}"
+        f", world {env.get('WORLD_SIZE', '?')}) — global batch preserved")
+    return config.replace(train_batch_size=tb,
+                          train_micro_batch_size_per_gpu=micro,
+                          gradient_accumulation_steps=0)
 
 
 def compute_elastic_config(config: Dict, world_size: int = 0,

@@ -16,23 +16,39 @@ worker failure it
      training script's own load_checkpoint(latest) — the same contract the
      reference's workers follow).
 
+Restart hardening (the self-healing arc, docs/resilience.md): respawns
+back off exponentially with jitter (a crash-looping worker can no longer
+hot-spin the host), a max-restarts-per-window circuit breaker stops the
+loop outright — tripping writes a flight-recorder bundle naming the last
+failure — and workers can *request* remediation through the agent control
+dir (``DSTPU_AGENT_DIR``): a straggler-eviction request from the fleet
+monitor restarts the group at the next smaller valid membership (bounded
+by ``min_workers``) exactly as if a worker had died.
+
 Env contract per worker (on top of launch.py's RANK/WORLD_SIZE/MASTER_*):
   DSTPU_RESTART_COUNT   how many times the group has been restarted
   DSTPU_ELASTIC_MICRO   per-worker micro batch for the CURRENT membership
                         (only when an elasticity config is given)
+  DSTPU_AGENT_DIR       control dir: workers drop eviction requests here
 """
 
 from __future__ import annotations
 
+import collections
 import dataclasses
+import json
 import os
+import random
 import subprocess
 import sys
+import tempfile
 import time
-from typing import Any, Dict, List, Optional, Sequence
+from typing import Any, Callable, Dict, List, Optional, Sequence
 
 from ..utils.logging import logger
 from .launch import build_rank_env
+
+EVICT_REQUEST_NAME = "evict.json"
 
 
 @dataclasses.dataclass
@@ -46,10 +62,49 @@ class ElasticAgentConfig:
     # changes recompute the micro batch so the global batch stays fixed
     elastic_config: Optional[Dict[str, Any]] = None
     cpu_devices_per_proc: int = 0       # testing: virtual CPU devices
+    # restart hardening: exponential backoff with jitter between respawns
+    # (sleep = min(base * 2^consecutive_failures, max) * (1 + jitter*U[0,1)))
+    backoff_base_s: float = 1.0
+    backoff_max_s: float = 30.0
+    backoff_jitter: float = 0.25
+    # circuit breaker: more than max_restarts_per_window respawns inside
+    # restart_window_s seconds trips the breaker — the agent dumps a
+    # flight-recorder bundle naming the last failure and raises instead of
+    # burning another incarnation (0 disables the window check; the total
+    # max_restarts cap always applies)
+    restart_window_s: float = 300.0
+    max_restarts_per_window: int = 0
+    # control dir workers reach the agent through (DSTPU_AGENT_DIR); None =>
+    # a fresh temp dir per agent
+    agent_dir: Optional[str] = None
 
 
 class WorkerGroupFailure(RuntimeError):
     pass
+
+
+def request_eviction(rank: int, reason: str = "", step: Optional[int] = None,
+                     agent_dir: Optional[str] = None) -> Optional[str]:
+    """Worker-side half of the eviction channel: ask the supervising agent
+    to restart the group at a smaller membership (kill + re-rendezvous
+    without the culprit). Returns the request path, or None when no agent
+    is listening (``DSTPU_AGENT_DIR`` unset — e.g. a directly launched
+    run). Atomic write+rename so the agent never reads a torn request."""
+    agent_dir = agent_dir or os.environ.get("DSTPU_AGENT_DIR")
+    if not agent_dir:
+        return None
+    payload = {"rank": int(rank), "reason": reason, "step": step,
+               "pid": os.getpid()}
+    path = os.path.join(agent_dir, EVICT_REQUEST_NAME)
+    tmp = f"{path}.{os.getpid()}.tmp"
+    try:
+        with open(tmp, "w") as fh:
+            json.dump(payload, fh)
+        os.replace(tmp, path)
+    except OSError:
+        logger.warning("eviction request write failed", exc_info=True)
+        return None
+    return path
 
 
 class ElasticAgent:
@@ -58,7 +113,10 @@ class ElasticAgent:
 
     def __init__(self, cmd: Sequence[str], nprocs: int,
                  config: Optional[ElasticAgentConfig] = None,
-                 env_base: Optional[Dict[str, str]] = None):
+                 env_base: Optional[Dict[str, str]] = None,
+                 clock: Callable[[], float] = time.monotonic,
+                 sleep_fn: Callable[[float], None] = time.sleep,
+                 rng: Optional[random.Random] = None):
         self.cmd = list(cmd)
         self.nprocs = int(nprocs)
         self.cfg = config or ElasticAgentConfig()
@@ -66,6 +124,27 @@ class ElasticAgent:
         self.restart_count = 0
         self.procs: List[subprocess.Popen] = []
         self._world = self.nprocs
+        # injectable time/sleep/rng so backoff + breaker tests run sleep-free
+        self._clock = clock
+        self._sleep = sleep_fn
+        self._rng = rng or random.Random()
+        self._consecutive_failures = 0
+        # sized PAST the window budget: a cap below it would evict the very
+        # timestamps the breaker counts and silently never trip
+        self._restart_times: collections.deque = collections.deque(
+            maxlen=max(64, 2 * self.cfg.max_restarts_per_window))
+        self.last_failure: Optional[Dict[str, Any]] = None
+        self.evictions = 0
+        self.agent_dir = self.cfg.agent_dir or tempfile.mkdtemp(
+            prefix="dstpu-agent-")
+        os.makedirs(self.agent_dir, exist_ok=True)
+        # a leftover request in a REUSED agent_dir (cfg.agent_dir pinned to
+        # a persistent path) is about a previous run's incarnation — acting
+        # on it would shrink a healthy fresh group at the first poll
+        try:
+            os.remove(os.path.join(self.agent_dir, EVICT_REQUEST_NAME))
+        except OSError:
+            pass
         if self.cfg.elastic_config is not None:
             # fail at CONSTRUCTION, not at first spawn: the starting world
             # size must be one of the elastic set or the micro-batch math
@@ -100,15 +179,19 @@ class ElasticAgent:
             candidate = valid[-1]
         return max(candidate, self.cfg.min_workers)
 
-    def _micro_for(self, world: int) -> Optional[int]:
+    def _elastic_for(self, world: int):
+        """(global_batch, micro) for ``world``, or (None, None) without an
+        elastic config. Both ride the worker env: micro alone cannot
+        preserve the global batch for configs that never set
+        train_batch_size explicitly."""
         if self.cfg.elastic_config is None:
-            return None
+            return None, None
         from ..elasticity import compute_elastic_config
 
-        _, _, micro = compute_elastic_config(self.cfg.elastic_config,
-                                             world_size=world,
-                                             return_microbatch=True)
-        return micro
+        batch, _, micro = compute_elastic_config(self.cfg.elastic_config,
+                                                 world_size=world,
+                                                 return_microbatch=True)
+        return batch, micro
 
     # -- lifecycle --------------------------------------------------------
     def _spawn(self) -> None:
@@ -116,15 +199,18 @@ class ElasticAgent:
         world_info = {"localhost": self._world}
         rank_envs = build_rank_env(world_info, "localhost",
                                    self.cfg.master_addr, port)
-        micro = self._micro_for(self._world)
+        batch, micro = self._elastic_for(self._world)
         self.procs = []
         for env_add in rank_envs:
             env = dict(os.environ)
             env.update(self.env_base)
             env.update(env_add)
             env["DSTPU_RESTART_COUNT"] = str(self.restart_count)
+            env["DSTPU_AGENT_DIR"] = self.agent_dir
             if micro is not None:
                 env["DSTPU_ELASTIC_MICRO"] = str(micro)
+            if batch is not None:
+                env["DSTPU_ELASTIC_BATCH"] = str(batch)
             if self.cfg.cpu_devices_per_proc:
                 env["JAX_PLATFORMS"] = "cpu"
                 flags = env.get("XLA_FLAGS", "")
@@ -149,9 +235,114 @@ class ElasticAgent:
                 p.kill()
                 p.wait()            # reap — no zombies across restarts
 
+    # -- restart hardening -------------------------------------------------
+    def _backoff_s(self) -> float:
+        """Exponential backoff with jitter for the NEXT respawn. Consecutive
+        failures double the base up to the cap; the jitter term decorrelates
+        a fleet of agents restarting off the same shared-storage hiccup."""
+        base = min(self.cfg.backoff_base_s
+                   * (2.0 ** max(self._consecutive_failures - 1, 0)),
+                   self.cfg.backoff_max_s)
+        return base * (1.0 + self.cfg.backoff_jitter * self._rng.random())
+
+    def _check_breaker(self) -> None:
+        """Trip when restarts inside the window exceed the budget: dump a
+        flight-recorder bundle naming the last failure, then raise. The
+        bundle is the post-mortem a crash-looping group otherwise never
+        leaves behind (each incarnation dies before telling anyone why)."""
+        if self.cfg.max_restarts_per_window <= 0:
+            return
+        now = self._clock()
+        recent = [t for t in self._restart_times
+                  if now - t <= self.cfg.restart_window_s]
+        # strictly MORE than the budget trips: N restarts inside the window
+        # are allowed, matching the config/CLI wording
+        if len(recent) <= self.cfg.max_restarts_per_window:
+            return
+        bundle = self._dump_bundle(
+            reason="restart-breaker",
+            extra={"restarts_in_window": len(recent),
+                   "window_s": self.cfg.restart_window_s,
+                   "max_restarts_per_window":
+                       self.cfg.max_restarts_per_window,
+                   "last_failure": self.last_failure})
+        raise WorkerGroupFailure(
+            f"restart circuit breaker tripped: {len(recent)} restarts in "
+            f"{self.cfg.restart_window_s:g}s (budget "
+            f"{self.cfg.max_restarts_per_window}); last failure "
+            f"{self.last_failure}"
+            + (f"; flight record at {bundle}" if bundle else ""))
+
+    def _dump_bundle(self, reason: str, extra: Dict[str, Any]) -> str:
+        """Agent-side crash bundle (lazy import — the agent process stays
+        jax-free; FlightRecorder is stdlib-only)."""
+        try:
+            from ..observability.flightrecorder import FlightRecorder
+
+            rec = FlightRecorder(capacity=64,
+                                 dump_dir=os.path.join(self.agent_dir,
+                                                       "crash"))
+            rec.record("agent_state", restart_count=self.restart_count,
+                       world=self._world, evictions=self.evictions,
+                       restart_times=[round(t, 3)
+                                      for t in self._restart_times])
+            return rec.dump(reason=reason, extra=extra)
+        except Exception:
+            logger.warning("agent bundle dump failed", exc_info=True)
+            return ""
+
+    def _poll_eviction_request(self) -> Optional[Dict[str, Any]]:
+        path = os.path.join(self.agent_dir, EVICT_REQUEST_NAME)
+        try:
+            with open(path) as fh:
+                req = json.load(fh)
+        except (OSError, ValueError):
+            return None
+        try:
+            os.remove(path)
+        except OSError:
+            pass
+        return req if isinstance(req, dict) else {"raw": req}
+
+    def _restart(self, reason: str, shrink: bool,
+                 deliberate: bool = False) -> None:
+        """Kill + re-rendezvous: breaker check, membership, backoff, spawn.
+        Raises WorkerGroupFailure when the restart budget is exhausted.
+        ``deliberate`` (eviction remediation): does not consume the
+        ``max_restarts`` CRASH budget or the breaker window — a long
+        healthy run that legitimately evicts stragglers must not be
+        mislabeled a crash loop (runaway eviction is bounded by the
+        min-world shrink floor and the session's once-per-incarnation
+        request gate)."""
+        self._terminate_all()
+        # a request written by the incarnation being torn down is stale the
+        # moment the group restarts — left behind, it would trigger a
+        # second, spurious shrink on the next healthy poll
+        try:
+            os.remove(os.path.join(self.agent_dir, EVICT_REQUEST_NAME))
+        except OSError:
+            pass
+        if not deliberate:
+            if self.restart_count - self.evictions >= self.cfg.max_restarts:
+                raise WorkerGroupFailure(
+                    f"worker group failed "
+                    f"{self.restart_count - self.evictions + 1} "
+                    f"times (max_restarts={self.cfg.max_restarts})")
+            self._restart_times.append(self._clock())
+            self._check_breaker()
+        self._world = self._next_membership(failed=shrink)
+        self.restart_count += 1
+        delay = self._backoff_s()
+        if delay > 0:
+            logger.info(f"elastic agent: backing off {delay:.2f}s before "
+                        f"respawn ({reason})")
+            self._sleep(delay)
+        self._spawn()
+
     def run(self) -> int:
         """Supervise until the group exits cleanly; returns the exit code.
-        Raises WorkerGroupFailure after max_restarts is exhausted."""
+        Raises WorkerGroupFailure after max_restarts is exhausted or the
+        restart-window circuit breaker trips."""
         import signal
 
         def _on_signal(signum, frame):
@@ -167,6 +358,7 @@ class ElasticAgent:
             except ValueError:
                 pass                 # non-main thread (tests): skip handlers
         self._spawn()
+        spawn_t = self._clock()
         try:
             while True:
                 rcs = [p.poll() for p in self.procs]
@@ -174,19 +366,54 @@ class ElasticAgent:
                     logger.info("elastic agent: worker group completed")
                     return 0
                 failed = [rc for rc in rcs if rc not in (None, 0)]
-                if failed:
-                    logger.error(
-                        f"elastic agent: worker failed rc={failed[0]} "
-                        f"(restart {self.restart_count}/"
-                        f"{self.cfg.max_restarts})")
-                    self._terminate_all()
-                    if self.restart_count >= self.cfg.max_restarts:
-                        raise WorkerGroupFailure(
-                            f"worker group failed {self.restart_count + 1} "
-                            f"times (max_restarts={self.cfg.max_restarts})")
-                    self._world = self._next_membership(failed=True)
-                    self.restart_count += 1
-                    self._spawn()
+                evict = None if failed else self._poll_eviction_request()
+                if evict is not None \
+                        and self._next_membership(failed=True) >= self._world:
+                    # honouring a request that cannot shrink (min_workers
+                    # unset, or already at the floor) would respawn the
+                    # SAME membership — straggler included — and the fresh
+                    # incarnation would re-request: an unbounded
+                    # kill/restart churn loop. Drop it instead.
+                    logger.warning(
+                        "elastic agent: eviction requested for rank "
+                        f"{evict.get('rank')} but membership cannot shrink "
+                        f"(world {self._world}, min_workers="
+                        f"{self.cfg.min_workers}) — ignoring")
+                    evict = None
+                if failed or evict is not None:
+                    # a group that ran a full window before failing is not
+                    # crash-looping: the backoff ladder restarts from rung 0
+                    if self._clock() - spawn_t > self.cfg.restart_window_s:
+                        self._consecutive_failures = 0
+                    if failed:
+                        self._consecutive_failures += 1
+                        self.last_failure = {"kind": "worker-exit",
+                                             "rc": failed[0],
+                                             "restart": self.restart_count,
+                                             "world": self._world}
+                        logger.error(
+                            f"elastic agent: worker failed rc={failed[0]} "
+                            f"(restart {self.restart_count}/"
+                            f"{self.cfg.max_restarts})")
+                        reason = f"worker exit rc={failed[0]}"
+                    else:
+                        # detection→action: the fleet monitor named a
+                        # straggler; honour the request as a deliberate
+                        # kill + re-rendezvous at the next smaller valid
+                        # membership (min_workers floors it)
+                        self._consecutive_failures = 0
+                        self.evictions += 1
+                        self.last_failure = {"kind": "eviction", **evict,
+                                             "restart": self.restart_count,
+                                             "world": self._world}
+                        logger.warning(
+                            "elastic agent: eviction requested for rank "
+                            f"{evict.get('rank')} ({evict.get('reason')}) — "
+                            "restarting with membership shrink")
+                        reason = f"eviction of rank {evict.get('rank')}"
+                    self._restart(reason, shrink=True,
+                                  deliberate=evict is not None)
+                    spawn_t = self._clock()
                 time.sleep(self.cfg.monitor_interval)
         finally:
             self._terminate_all()
@@ -208,6 +435,12 @@ def main(args: Optional[List[str]] = None) -> int:
     parser.add_argument("--master_addr", default="127.0.0.1")
     parser.add_argument("--master_port", type=int, default=29600)
     parser.add_argument("--cpu_devices_per_proc", type=int, default=0)
+    parser.add_argument("--backoff_base_s", type=float, default=1.0)
+    parser.add_argument("--backoff_max_s", type=float, default=30.0)
+    parser.add_argument("--restart_window_s", type=float, default=300.0)
+    parser.add_argument("--max_restarts_per_window", type=int, default=0,
+                        help="circuit breaker: restarts allowed inside the "
+                             "window before the agent gives up (0 disables)")
     parser.add_argument("--elastic_config", default=None,
                         help="JSON config file with an 'elasticity' section "
                              "(membership changes recompute the micro batch)")
@@ -227,6 +460,10 @@ def main(args: Optional[List[str]] = None) -> int:
             max_restarts=opts.max_restarts, min_workers=opts.min_workers,
             master_addr=opts.master_addr, master_port=opts.master_port,
             cpu_devices_per_proc=opts.cpu_devices_per_proc,
+            backoff_base_s=opts.backoff_base_s,
+            backoff_max_s=opts.backoff_max_s,
+            restart_window_s=opts.restart_window_s,
+            max_restarts_per_window=opts.max_restarts_per_window,
             elastic_config=elastic))
     try:
         return agent.run()

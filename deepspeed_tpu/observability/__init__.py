@@ -35,7 +35,11 @@ two TPU-specific watchers:
   rank;
 * :mod:`.numerics` — in-program numerics sentinel: a fused isfinite /
   loss-spike flag threaded through the jitted train step (no extra host
-  sync on the happy path) with configurable ``warn | skip_step | abort``.
+  sync on the happy path) with configurable ``warn | skip_step | abort``;
+* :mod:`.faultinject` — deterministic chaos harness: rank kills, synthetic
+  stragglers, NaN-poisoned params, and checkpoint truncation pinned to
+  (step, rank, incarnation), so the whole failure → detect → remediate →
+  resume loop is CI-testable on a CPU mesh (docs/resilience.md).
 
 Everything is **off by default** (``ObservabilityConfig.enabled``); a
 disabled session records nothing and writes no files, so tier-1 cost is zero.
@@ -47,6 +51,7 @@ from __future__ import annotations
 import os
 from typing import Any, Optional
 
+from .faultinject import Fault, FaultInjector
 from .fleethealth import FleetHealthMonitor, build_replica_checksum_probe
 from .flightrecorder import (FlightRecorder, find_latest_bundle,
                              install_sigusr1, uninstall_sigusr1)
@@ -71,6 +76,7 @@ __all__ = [
     "uninstall_sigusr1", "HangWatchdog", "GoodputAccountant",
     "FleetHealthMonitor", "build_replica_checksum_probe",
     "NumericsSentinel", "NumericsState", "NumericsTrip",
+    "Fault", "FaultInjector",
 ]
 
 

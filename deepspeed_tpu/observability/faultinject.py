@@ -1,0 +1,257 @@
+"""Deterministic fault injection — chaos testing for the self-healing loop.
+
+At production fleet sizes preemptions, stragglers, and silent data
+corruption are routine; what decides goodput is whether the
+failure → detect → remediate → resume loop actually closes. This module
+makes that loop CI-testable on a CPU mesh with **no TPU attached and no
+randomness**: every fault is pinned to (global step, rank, restart
+incarnation), so a chaos run is exactly reproducible and the post-recovery
+state can be compared bit-for-bit against a clean run.
+
+Fault kinds (``Fault.kind``):
+
+* ``rank_kill``     — SIGKILL the target rank's process at step N (the
+  preemption / hardware-loss case the elastic agent's restart-with-shrink
+  exists for). Default ``restart=0`` so the respawned incarnation does not
+  re-kill itself.
+* ``straggle``      — sleep ``sleep_s`` before each of ``steps`` steps on
+  the target rank (the slow-host case fleet-health straggler detection +
+  eviction exists for).
+* ``nan_params``    — overwrite the first floating-point parameter leaf
+  with NaN at step N (sharding preserved: ``leaf * nan``). The next step's
+  loss/grads go non-finite, tripping the in-program numerics sentinel —
+  the SDC / poisoned-step case rollback-to-checkpoint exists for.
+* ``ckpt_truncate`` — truncate a shard file of the newest committed
+  checkpoint tag after the next save (the torn-write / partial-upload case
+  checksum-verified load with previous-good-tag fallback exists for).
+
+Plumbing: a plan is a JSON list of fault dicts, passed directly
+(``FaultInjector(plan=[...])``) or through the environment
+(``DSTPU_FAULT_PLAN`` = JSON, or ``@/path/to/plan.json``) so workers
+spawned by the elastic agent pick it up; target rank defaults against
+``RANK`` and incarnation against ``DSTPU_RESTART_COUNT``. The kill / sleep
+primitives are injectable for sleep-free unit tests. Every applied fault
+publishes ``resilience/faults_injected{kind=}`` and drops a ring event, so
+a chaos run's report and crash bundles show what was done to it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import signal
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from ..utils.logging import logger
+
+FAULT_KINDS = ("rank_kill", "straggle", "nan_params", "ckpt_truncate")
+
+PLAN_ENV = "DSTPU_FAULT_PLAN"
+
+
+def _sigkill_self() -> None:
+    os.kill(os.getpid(), signal.SIGKILL)
+
+
+@dataclasses.dataclass
+class Fault:
+    """One scheduled fault. ``step`` is the engine's global step the fault
+    fires before (``ckpt_truncate``: the first save at/after ``step``);
+    ``restart`` gates on the elastic incarnation (None = any)."""
+
+    kind: str
+    step: int
+    rank: int = 0
+    restart: Optional[int] = 0
+    sleep_s: float = 0.0      # straggle: per-step added latency
+    steps: int = 1            # straggle: how many consecutive steps
+    shard_index: int = 0      # ckpt_truncate: which shard file to maim
+
+    def __post_init__(self):
+        if self.kind not in FAULT_KINDS:
+            raise ValueError(f"unknown fault kind '{self.kind}' "
+                             f"(known: {FAULT_KINDS})")
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "Fault":
+        known = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(d) - known
+        if unknown:
+            raise ValueError(f"fault spec has unknown keys {sorted(unknown)} "
+                             f"(known: {sorted(known)})")
+        return cls(**d)
+
+
+def load_plan(spec: Any) -> List[Fault]:
+    """Parse a plan from a list of dicts / ``Fault``s, a JSON string, or an
+    ``@/path`` file reference (the env-var forms)."""
+    if spec is None:
+        return []
+    if isinstance(spec, str):
+        if spec.startswith("@"):
+            with open(spec[1:]) as fh:
+                spec = json.load(fh)
+        else:
+            spec = json.loads(spec)
+    out = []
+    for item in spec:
+        out.append(item if isinstance(item, Fault) else Fault.from_dict(item))
+    return out
+
+
+class FaultInjector:
+    """Applies a fault plan at the supervisor's step/save hooks.
+
+    The :class:`~deepspeed_tpu.runtime.session.TrainingSession` calls
+    ``before_step(step, engine)`` ahead of every ``train_batch`` and
+    ``after_save(ckpt_dir)`` after every checkpoint commit. Faults are
+    one-shot: each ``Fault`` fires at most once per process (the respawned
+    incarnation re-parses the plan but the ``restart`` gate keeps already-
+    handled faults from replaying).
+    """
+
+    def __init__(self, plan: Any = None, rank: Optional[int] = None,
+                 restart: Optional[int] = None,
+                 sleep_fn: Callable[[float], None] = time.sleep,
+                 kill_fn: Callable[[], None] = _sigkill_self,
+                 registry: Optional[Any] = None,
+                 recorder: Optional[Any] = None):
+        self.plan = load_plan(plan)
+        if rank is None:
+            rank = int(os.environ.get("RANK", "0") or 0)
+        if restart is None:
+            restart = int(os.environ.get("DSTPU_RESTART_COUNT", "0") or 0)
+        self.rank = int(rank)
+        self.restart = int(restart)
+        self._sleep = sleep_fn
+        self._kill = kill_fn
+        self.registry = registry
+        self.recorder = recorder
+        self.applied: List[Dict[str, Any]] = []
+        self._done: set = set()
+        # straggle state: (until_step, sleep_s) while active
+        self._straggle_until = -1
+        self._straggle_sleep = 0.0
+
+    @classmethod
+    def from_env(cls, env: Optional[Dict[str, str]] = None,
+                 **kwargs: Any) -> Optional["FaultInjector"]:
+        """Injector from ``DSTPU_FAULT_PLAN`` — None when unset (the normal,
+        fault-free path costs nothing)."""
+        env = os.environ if env is None else env
+        spec = env.get(PLAN_ENV)
+        if not spec:
+            return None
+        return cls(plan=spec, **kwargs)
+
+    # -- bookkeeping -------------------------------------------------------
+    def _mine(self, fault: Fault) -> bool:
+        if fault.rank != self.rank:
+            return False
+        if fault.restart is not None and fault.restart != self.restart:
+            return False
+        return True
+
+    def _note(self, fault: Fault, step: int, **detail: Any) -> None:
+        info = {"kind": fault.kind, "step": step, "rank": self.rank,
+                "restart": self.restart, **detail}
+        self.applied.append(info)
+        logger.warning(f"FAULT INJECTED: {info}")
+        if self.registry is not None:
+            self.registry.counter(
+                "resilience/faults_injected",
+                help="chaos-harness faults applied").inc(kind=fault.kind)
+        if self.recorder is not None:
+            # "fault_kind": record()'s positional `kind` is the ring-event
+            # type (the numerics sentinel renames the same way)
+            ring = {("fault_kind" if k == "kind" else k): v
+                    for k, v in info.items()}
+            self.recorder.record("fault_injected", **ring)
+
+    # -- hooks -------------------------------------------------------------
+    def before_step(self, step: int, engine: Any = None) -> None:
+        """Apply any step-scheduled fault for (step, rank, restart). Called
+        by the session before each train_batch."""
+        if step <= self._straggle_until and self._straggle_sleep > 0:
+            self._sleep(self._straggle_sleep)
+        for i, fault in enumerate(self.plan):
+            if i in self._done or fault.kind == "ckpt_truncate" \
+                    or not self._mine(fault) or fault.step != step:
+                continue
+            self._done.add(i)
+            if fault.kind == "rank_kill":
+                self._note(fault, step)
+                self._kill()            # no return (SIGKILL) outside tests
+            elif fault.kind == "straggle":
+                self._straggle_until = step + max(fault.steps, 1) - 1
+                self._straggle_sleep = float(fault.sleep_s)
+                self._note(fault, step, sleep_s=fault.sleep_s,
+                           until_step=self._straggle_until)
+                if self._straggle_sleep > 0:
+                    self._sleep(self._straggle_sleep)
+            elif fault.kind == "nan_params":
+                self._note(fault, step)
+                if engine is not None:
+                    poison_params(engine)
+
+    def after_save(self, ckpt_dir: str, step: Optional[int] = None) -> None:
+        """Apply any pending ``ckpt_truncate`` fault to the newest committed
+        tag under ``ckpt_dir`` (the checkpoint root). Called by the session
+        after each save with the engine's global step; the fault fires on
+        the first save at/after its ``step`` (``step=None`` applies
+        immediately — direct harness use)."""
+        for i, fault in enumerate(self.plan):
+            if i in self._done or fault.kind != "ckpt_truncate" \
+                    or not self._mine(fault) \
+                    or (step is not None and step < fault.step):
+                continue
+            truncated = truncate_checkpoint_shard(ckpt_dir,
+                                                  fault.shard_index)
+            if truncated:
+                self._done.add(i)
+                self._note(fault, fault.step, file=truncated)
+
+
+def poison_params(engine: Any) -> None:
+    """Overwrite the first floating-point param leaf with NaN, preserving
+    its sharding (``leaf * nan`` keeps the layout; NaN propagates through
+    the next step's loss and grads, which is the point)."""
+    import jax
+    import jax.numpy as jnp
+
+    leaves, treedef = jax.tree.flatten(engine.params)
+    for i, leaf in enumerate(leaves):
+        if hasattr(leaf, "dtype") and jnp.issubdtype(leaf.dtype,
+                                                     jnp.floating):
+            leaves[i] = leaf * jnp.asarray(float("nan"), leaf.dtype)
+            break
+    else:
+        raise ValueError("nan_params: no floating-point leaf to poison")
+    engine.params = jax.tree.unflatten(treedef, leaves)
+
+
+def truncate_checkpoint_shard(ckpt_dir: str, shard_index: int = 0
+                              ) -> Optional[str]:
+    """Truncate one shard file of the newest committed tag to half its size
+    (a torn write / partial upload). Returns the maimed path, or None when
+    no committed tag exists yet."""
+    from ..runtime.checkpoint import read_latest_tag
+
+    tag = read_latest_tag(ckpt_dir)
+    if tag is None:
+        return None
+    arrays_dir = os.path.join(ckpt_dir, tag, "arrays")
+    try:
+        shards = sorted(os.listdir(arrays_dir))
+    except OSError:
+        return None
+    shards = [s for s in shards if s.endswith(".npy")]
+    if not shards:
+        return None
+    victim = os.path.join(arrays_dir, shards[shard_index % len(shards)])
+    size = os.path.getsize(victim)
+    with open(victim, "r+b") as fh:
+        fh.truncate(max(size // 2, 1))
+    return victim

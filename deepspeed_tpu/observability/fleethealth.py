@@ -152,6 +152,13 @@ class FleetHealthMonitor:
         self._dumped_divergences: set = set()
         # liveness hook (Observability wires the hang watchdog's heartbeat)
         self.heartbeat: Callable[[str], None] = lambda name: None
+        # detection→action hook (the self-healing TrainingSession wires its
+        # eviction policy here): called with (culprit_rank, info) on every
+        # straggler verdict — every rank computes the same verdict from the
+        # same gathered table, so the hook fires fleet-wide and the policy
+        # decides which rank acts
+        self.on_straggler: Optional[Callable[[int, Dict[str, Any]], None]] \
+            = None
 
     # -- feed (must stay O(1); called at span/step cadence) ----------------
     def note_step_time(self, secs: float) -> None:
@@ -289,6 +296,16 @@ class FleetHealthMonitor:
                 f"FLEET: rank {culprit} is straggling — rolling step time "
                 f"{times[culprit]:.4f}s > {self.straggler_factor:g} × fleet "
                 f"median {med:.4f}s (step {step})")
+        if self.on_straggler is not None:
+            try:
+                self.on_straggler(culprit, {
+                    "step": step,
+                    "step_time_s": float(times[culprit]),
+                    "fleet_median_s": med,
+                    "factor": self.straggler_factor})
+            except Exception:   # remediation hooks must not kill detection
+                logger.warning("fleet on_straggler hook failed",
+                               exc_info=True)
 
     # -- divergence --------------------------------------------------------
     def _max_deviation_culprit(self, values):

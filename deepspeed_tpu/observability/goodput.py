@@ -14,6 +14,14 @@ plus the recompile watchdog and hang watchdog:
 * ``input_wait`` — host-to-device batch transfer (``train_batch/h2d``) plus
   the gaps *between* step spans (the data loader / host preprocessing time);
 * ``stall``      — seconds attributed by the hang watchdog when it fires;
+* ``recovery``   — failure remediation: ``recovery/*`` spans opened by the
+  self-healing :class:`~deepspeed_tpu.runtime.session.TrainingSession`
+  around rollback / engine rebuild / re-rendezvous work. The whole span
+  counts as recovery — spans *nested inside it* (the rollback's
+  ``checkpoint/load``, reload compiles) are swallowed rather than
+  double-bucketed, so "time lost to failures" is one number. Steps
+  *replayed* after a rollback are ordinary compute (they are real device
+  work; the lost first attempt already burned its own wall time);
 * ``other``      — the remainder (engine python, logging, unattributed).
 
 Derived gauges, published through the MetricsRegistry at step cadence:
@@ -38,7 +46,7 @@ import time
 from typing import Any, Callable, Dict, Optional
 
 BUCKETS = ("compute", "recompile", "checkpoint", "input_wait", "stall",
-           "other")
+           "recovery", "other")
 
 # span name -> bucket classification (step spans are the cadence markers and
 # are NOT buckets themselves: their children + gaps are)
@@ -47,6 +55,7 @@ COMPUTE_SPANS = frozenset({"train_batch/dispatch", "fwd", "bwd", "step",
                            "eval", "inference/prefill", "inference/decode"})
 INPUT_SPANS = frozenset({"train_batch/h2d"})
 CHECKPOINT_PREFIX = "checkpoint/"
+RECOVERY_PREFIX = "recovery/"                 # failure remediation (session)
 BUILD_SPANS = frozenset({"pipeline/build"})   # program construction: badput,
 #   recompile-shaped (it exists to make a new executable)
 
@@ -81,6 +90,10 @@ class GoodputAccountant:
         # inter-step gap so they are not double-counted as input_wait
         self._in_step = False
         self._gap_attributed = 0.0
+        # open recovery/* span nesting depth: while > 0, classified inner
+        # spans are swallowed (the outermost recovery span owns the whole
+        # duration — one "lost to failures" number, no double bucketing)
+        self._recovery_depth = 0
         self.steps = 0
         # workload shape (set once by the engine; None => mfu/tokens gauges
         # are skipped, buckets still publish)
@@ -117,6 +130,8 @@ class GoodputAccountant:
                 self._t0 = t - (dur_s if phase == "end" else 0.0)
             self._last_t = max(self._last_t, t)
             if phase == "begin":
+                if name.startswith(RECOVERY_PREFIX):
+                    self._recovery_depth += 1
                 if name in STEP_SPANS:
                     if self._last_step_end is not None:
                         # only the UNATTRIBUTED part of the gap is input
@@ -130,11 +145,26 @@ class GoodputAccountant:
                     self._in_step = True
                 return
             # phase == "end"
+            if name.startswith(RECOVERY_PREFIX):
+                self._recovery_depth = max(self._recovery_depth - 1, 0)
+                if self._recovery_depth > 0:
+                    return              # inner recovery span: outermost owns it
+                self._buckets["recovery"] += dur_s
+                if not self._in_step:
+                    self._gap_attributed += dur_s
+                return
             if name in STEP_SPANS:
+                # step bookkeeping runs even inside a recovery region (the
+                # begin already set _in_step; swallowing the end would wedge
+                # the gap attribution for the rest of the run) — only the
+                # bucket classification below is recovery-swallowed
                 self.steps += 1
                 self._last_step_end = t
                 self._in_step = False
                 return
+            if self._recovery_depth > 0:
+                return   # span inside a recovery region: swallowed (the
+                #   enclosing recovery span's duration already covers it)
             if name in COMPUTE_SPANS:
                 take = min(dur_s, self._compute_unattributed)
                 self._compute_unattributed -= take
@@ -160,13 +190,17 @@ class GoodputAccountant:
         init compile time IS badput in a goodput report."""
         now = self._clock()
         with self._lock:
-            self._buckets["recompile"] += secs
-            if where in COMPUTE_SPANS:
-                self._compute_unattributed += secs
-            if not self._in_step:
-                # a between-step compile (eval build, warmup) must not be
-                # re-counted as input_wait by the next gap computation
-                self._gap_attributed += secs
+            if self._recovery_depth == 0:
+                self._buckets["recompile"] += secs
+                if where in COMPUTE_SPANS:
+                    self._compute_unattributed += secs
+                if not self._in_step:
+                    # a between-step compile (eval build, warmup) must not be
+                    # re-counted as input_wait by the next gap computation
+                    self._gap_attributed += secs
+            # a compile inside a recovery span is swallowed into the
+            # recovery bucket (the enclosing span's duration covers it) —
+            # but it still extends the accounted wall window
             if self._t0 is None:
                 self._t0 = now - secs   # the compile started ~secs earlier
             self._last_t = max(self._last_t, now)

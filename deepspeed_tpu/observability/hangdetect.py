@@ -67,6 +67,13 @@ class HangWatchdog:
         self.poll_interval_s = float(poll_interval_s)
         self.abort = bool(abort)
         self.exit_code = int(exit_code)
+        # escalation threshold: with abort on, only the Nth fire (and later)
+        # actually aborts — earlier fires dump evidence and leave the
+        # process alive so a supervisor can attempt a SOFT restart when (if)
+        # control returns. 1 = every fire aborts (the pre-escalation
+        # behavior); the TrainingSession's dump→soft-restart→hard-restart
+        # ladder sets this to hang_soft_restarts + 1.
+        self.abort_after_fires = 1
         self.on_fire = on_fire
         # optional () -> dict merged into the fire dump's extra — the fleet
         # monitor uses it to say "blocked in the step-N gather, rank R never
@@ -156,12 +163,13 @@ class HangWatchdog:
             self.registry.counter(
                 "hang/watchdog_fired",
                 help="hang watchdog deadline expiries").inc(span=stalled_span)
+        aborting = self.abort and self.fired >= self.abort_after_fires
         logger.error(
             f"HANG WATCHDOG: no heartbeat for {waited:.1f}s "
             f"(deadline {deadline:.1f}s) — last activity was span "
             f"'{stalled_span}'"
             + (f"; flight record at {bundle}" if bundle else "")
-            + (f"; aborting with exit code {self.exit_code}" if self.abort
+            + (f"; aborting with exit code {self.exit_code}" if aborting
                else ""))
         if self.on_fire is not None:
             try:
@@ -170,7 +178,7 @@ class HangWatchdog:
             except Exception:
                 logger.warning("hang watchdog on_fire hook failed",
                                exc_info=True)
-        if self.abort:
+        if aborting:
             # os._exit, not sys.exit: the whole point is escaping a process
             # whose main thread is wedged inside a dispatch — atexit hooks
             # touching the device would hang exactly the same way. The

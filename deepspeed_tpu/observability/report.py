@@ -263,6 +263,78 @@ def summarize_serving(records: List[Dict[str, Any]]) -> str:
     return "\n".join(lines)
 
 
+def summarize_resilience(records: List[Dict[str, Any]]) -> str:
+    """``== resilience ==`` — recovery events (kind × policy), time to
+    recover, eviction requests, injected faults (chaos runs), and goodput
+    across failures (the ``recovery`` wall-time bucket next to the overall
+    goodput fraction), from the resilience/* metrics the self-healing
+    TrainingSession publishes."""
+    recs = [r for r in records
+            if str(r.get("name", "")).startswith("resilience/")]
+    if not recs:
+        return ""
+    latest: Dict[Tuple[str, str], Dict[str, Any]] = {}
+    for r in recs:
+        latest[(r["name"], _label_str(r.get("labels", {})))] = r
+    lines = ["== resilience =="]
+    events = [(r.get("labels", {}), r["value"]) for (n, _), r in latest.items()
+              if n == "resilience/recovery_events"]
+    if events:
+        rows = [[str(lbl.get("kind", "?")), str(lbl.get("policy", "?")),
+                 f"{v:.0f}"]
+                for lbl, v in sorted(events, key=lambda kv: -kv[1])]
+        lines.append(_fmt_table(["failure", "policy", "count"], rows))
+
+    def gauge(name: str) -> Any:
+        r = latest.get((name, "-"))
+        return r["value"] if r is not None else None
+
+    def counter_total(name: str) -> float:
+        return sum(r["value"] for (n, _), r in latest.items()
+                   if n == name and r.get("type") == "counter")
+
+    total_s = counter_total("resilience/recovery_seconds")
+    last_s = gauge("resilience/last_recovery_s")
+    if total_s or last_s is not None:
+        parts = [f"total={total_s:.3f}s"]
+        if last_s is not None:
+            parts.append(f"last={last_s:.3f}s")
+        n_events = sum(v for _, v in events)
+        if n_events:
+            parts.append(f"mean={total_s / n_events:.3f}s")
+        lines.append("  time to recover: " + "  ".join(parts))
+    evictions = counter_total("resilience/evictions_requested")
+    if evictions:
+        lines.append(f"  eviction requests: {evictions:.0f}")
+    faults = [(r.get("labels", {}).get("kind", "?"), r["value"])
+              for (n, _), r in latest.items()
+              if n == "resilience/faults_injected"]
+    if faults:
+        lines.append("  injected faults: " + "  ".join(
+            f"{k}={v:.0f}" for k, v in sorted(faults)))
+    # goodput across failures: recovery bucket + overall fraction
+    gp: Dict[str, float] = {}
+    for r in records:
+        if r.get("type") != "gauge":
+            continue
+        if r.get("name") == "goodput/seconds" \
+                and r.get("labels", {}).get("bucket") == "recovery":
+            gp["recovery_s"] = r["value"]
+        elif r.get("name") == "goodput/wall_seconds":
+            gp["wall_s"] = r["value"]
+        elif r.get("name") == "goodput/goodput_fraction":
+            gp["fraction"] = r["value"]
+    if "recovery_s" in gp:
+        wall = gp.get("wall_s", 0.0)
+        share = gp["recovery_s"] / wall if wall > 0 else 0.0
+        line = (f"  goodput across failures: recovery bucket "
+                f"{gp['recovery_s']:.3f}s ({share:.1%} of wall)")
+        if "fraction" in gp:
+            line += f", goodput_fraction = {gp['fraction']:.4f}"
+        lines.append(line)
+    return "\n".join(lines)
+
+
 def summarize_cost(records: List[Dict[str, Any]]) -> str:
     """``== cost ==`` — the static cost vectors tpucost publishes as
     ``tpucost/<entry>/<metric>`` gauges: per-entry flops / bytes / peak HBM /
@@ -368,6 +440,7 @@ def report(paths: List[str]) -> str:
     sections = [s for s in (summarize_spans(records),
                             summarize_metrics(records),
                             summarize_goodput(records),
+                            summarize_resilience(records),
                             summarize_cost(records),
                             summarize_serving(records),
                             summarize_fleet(records),

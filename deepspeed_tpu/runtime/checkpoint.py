@@ -16,7 +16,17 @@ reshape pipeline for this):
     dp/tp/pp topology "just works";
   * file writes run on a background thread; the ``latest`` tag is committed
     only after all writes land (the Nebula commit() semantics), so a crash
-    mid-save never corrupts the restore point.
+    mid-save never corrupts the restore point;
+  * saves are **atomic at the directory level**: everything lands in
+    ``.<tag>.tmp`` first and the finished tree is renamed into place before
+    ``latest`` moves, so a partially written tag directory can never be
+    mistaken for a checkpoint (crash-consistency for the self-healing
+    session's rollback path);
+  * every shard file carries a **crc32 content checksum** in the format-2
+    metadata; ``load_checkpoint(..., verify=True)`` re-hashes the shards
+    before restoring and falls back to the newest *previous* tag that
+    verifies clean — a truncated or bit-flipped shard (SDC, torn write)
+    degrades to an older restore point instead of resuming from garbage.
 
 Layout:
     <dir>/<tag>/metadata.json                  shapes/dtypes/shard map + client state
@@ -29,7 +39,9 @@ from __future__ import annotations
 import json
 import os
 import re
+import shutil
 import threading
+import zlib
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
@@ -116,6 +128,15 @@ def _fname(full_key: str, shard_id: int) -> str:
     return f"{safe}.s{shard_id}.npy"
 
 
+def _tmp_name(tag: str) -> str:
+    return f".{tag}.tmp"
+
+
+class CheckpointCorruption(RuntimeError):
+    """Raised by ``load_checkpoint(verify=True)`` when no tag in the
+    directory verifies clean."""
+
+
 def wait_pending() -> None:
     """Block until an in-flight async save has committed."""
     global _PENDING
@@ -145,7 +166,17 @@ def save_checkpoint(save_dir: str, tag: str, params: Any, opt_state: Any = None,
     files."""
     wait_pending()
     _validate_tag(tag, tag_validation)
-    ckpt_dir = os.path.join(save_dir, tag)
+    final_dir = os.path.join(save_dir, tag)
+    # atomic-save staging: every byte lands under .<tag>.tmp and the whole
+    # tree is renamed into place by process 0 only after the cross-process
+    # commit barrier — a crash mid-save leaves a .tmp dir (cleaned on the
+    # next save), never a half-written tag dir that read_latest_tag or a
+    # rollback could pick up
+    ckpt_dir = os.path.join(save_dir, _tmp_name(tag))
+    if jax.process_count() == 1 and os.path.isdir(ckpt_dir):
+        shutil.rmtree(ckpt_dir)   # stale tmp from a crashed save (single-
+        #   process only: in multi-process runs another rank may already be
+        #   writing into it for THIS save — same-named files just overwrite)
     arrays_dir = os.path.join(ckpt_dir, "arrays")
     os.makedirs(arrays_dir, exist_ok=True)
 
@@ -205,47 +236,114 @@ def save_checkpoint(save_dir: str, tag: str, params: Any, opt_state: Any = None,
     else:
         nonce = local_nonce
     stamp = f"{cs.get('global_steps', '')}:{cs.get('micro_steps', '')}:{nonce}"
+    meta["save_stamp"] = stamp
     try:
         os.remove(os.path.join(ckpt_dir, f".done.{proc}"))
     except FileNotFoundError:
         pass
 
     def commit():
+        crcs: Dict[str, int] = {}
         for path, data in writes:
             np.save(path, data, allow_pickle=False)
+            # content checksum over the array bytes (what a loader gets
+            # back), not the .npy file bytes — verify re-hashes through
+            # np.load so header changes across numpy versions don't matter
+            crcs[os.path.basename(path)] = zlib.crc32(
+                np.ascontiguousarray(data).tobytes())
         # cross-process commit barrier over the shared filesystem: every
         # process drops a done-marker; process 0 publishes `latest` only
         # once ALL markers (with THIS save's stamp) exist, so a crash
         # mid-save can never leave `latest` pointing at a tag with
-        # missing shards
+        # missing shards. The marker also carries the writer's per-shard
+        # checksums — process 0 merges them into the format-2 metadata.
         with open(os.path.join(ckpt_dir, f".done.{proc}"), "w") as fh:
-            fh.write(stamp)
+            json.dump({"stamp": stamp, "crc": crcs}, fh)
 
-        def marker_ok(p):
+        def marker_read(p):
             path = os.path.join(ckpt_dir, f".done.{p}")
             try:
                 with open(path) as fh:
-                    return fh.read() == stamp
-            except OSError:
-                return False
+                    data = json.load(fh)
+            except (OSError, ValueError):
+                return None
+            return data if data.get("stamp") == stamp else None
 
         if proc == 0:
             import time as _time
 
             deadline = _time.time() + 600
             while _time.time() < deadline:
-                if all(marker_ok(p) for p in range(n_proc)):
+                markers = [marker_read(p) for p in range(n_proc)]
+                if all(m is not None for m in markers):
                     break
                 _time.sleep(0.2)
             else:
                 raise TimeoutError(
                     f"checkpoint '{tag}': not all {n_proc} processes wrote "
                     "their shards within 600s — 'latest' NOT updated")
+            all_crcs: Dict[str, int] = {}
+            for m in markers:
+                all_crcs.update(m.get("crc", {}))
+            for info in meta["arrays"].values():
+                for shard in info["shards"]:
+                    crc = all_crcs.get(shard["file"])
+                    if crc is not None:
+                        shard["crc32"] = crc
+            # prune orphans before publishing: a multi-process crashed save
+            # may have left shards from an OLD topology in the reused
+            # staging dir (the stale-tmp rmtree is single-process only —
+            # another rank may already be writing for THIS save). All
+            # writers are done here (markers present), so pruning anything
+            # the metadata does not reference is race-free.
+            referenced = {shard["file"] for info in meta["arrays"].values()
+                          for shard in info["shards"]}
+            for name in os.listdir(arrays_dir):
+                if name not in referenced:
+                    try:
+                        os.remove(os.path.join(arrays_dir, name))
+                    except OSError:
+                        pass
             with open(os.path.join(ckpt_dir, "metadata.json"), "w") as fh:
                 json.dump(meta, fh, indent=1)
+            # publish: tmp tree -> final tag dir, THEN latest. A re-save of
+            # an existing tag swaps the old tree aside first; dir renames
+            # are not exchangeable atomically, so a crash in the tiny
+            # window between the two renames leaves the old tree in
+            # <tag>.replaced.tmp — read_latest_tag restores it on the next
+            # lookup, and verified loads fall back past the missing tag
+            # regardless.
+            trash = None
+            if os.path.isdir(final_dir):
+                trash = final_dir + ".replaced.tmp"
+                if os.path.isdir(trash):
+                    shutil.rmtree(trash)
+                os.rename(final_dir, trash)
+            os.rename(ckpt_dir, final_dir)
+            if trash is not None:
+                shutil.rmtree(trash, ignore_errors=True)
             if save_latest:
                 with open(os.path.join(save_dir, "latest"), "w") as fh:
                     fh.write(tag)
+        else:
+            # wait for process 0's rename: callers (the NVMe snapshot, the
+            # supervisor's immediate verify-load) write into / read from the
+            # FINAL tag dir as soon as save returns on every rank
+            import time as _time
+
+            meta_path = os.path.join(final_dir, "metadata.json")
+            deadline = _time.time() + 600
+            while _time.time() < deadline:
+                try:
+                    with open(meta_path) as fh:
+                        if json.load(fh).get("save_stamp") == stamp:
+                            return
+                except (OSError, ValueError):
+                    pass
+                _time.sleep(0.2)
+            raise TimeoutError(
+                f"checkpoint '{tag}': process 0 never published the tag "
+                "within 600s")
 
     if async_save:
         global _PENDING
@@ -256,16 +354,109 @@ def save_checkpoint(save_dir: str, tag: str, params: Any, opt_state: Any = None,
         t.start()
     else:
         commit()
-    return ckpt_dir
+    return final_dir
+
+
+def verify_checkpoint(load_dir: str, tag: str) -> List[str]:
+    """Integrity check of one tag: every shard file in the format-2 metadata
+    must exist, load, and match its recorded crc32 content checksum.
+    Returns the list of problems (empty == verified clean). Shards saved
+    before checksums existed (no ``crc32`` key) check existence/loadability
+    only."""
+    ckpt_dir = os.path.join(load_dir, tag)
+    meta_path = os.path.join(ckpt_dir, "metadata.json")
+    try:
+        with open(meta_path) as fh:
+            meta = json.load(fh)
+    except (OSError, ValueError) as e:
+        return [f"{tag}: unreadable metadata.json ({e})"]
+    arrays_dir = os.path.join(ckpt_dir, "arrays")
+    problems: List[str] = []
+    for full_key, info in meta.get("arrays", {}).items():
+        for shard in info.get("shards", []):
+            path = os.path.join(arrays_dir, shard["file"])
+            try:
+                data = np.load(path, allow_pickle=False)
+            except (OSError, ValueError) as e:
+                problems.append(
+                    f"{tag}: shard '{shard['file']}' of '{full_key}' "
+                    f"unreadable ({type(e).__name__}: {e})")
+                continue
+            want = shard.get("crc32")
+            if want is None:
+                continue
+            got = zlib.crc32(np.ascontiguousarray(data).tobytes())
+            if got != want:
+                problems.append(
+                    f"{tag}: shard '{shard['file']}' of '{full_key}' "
+                    f"checksum mismatch (crc32 {got} != recorded {want})")
+    return problems
+
+
+def list_tags(load_dir: str) -> List[str]:
+    """Committed tags in ``load_dir``, newest first (by metadata mtime).
+    Staging/trash dirs from interrupted saves are excluded."""
+    out = []
+    try:
+        names = os.listdir(load_dir)
+    except OSError:
+        return []
+    for name in names:
+        if name.startswith(".") or name.endswith(".tmp"):
+            continue
+        meta = os.path.join(load_dir, name, "metadata.json")
+        if os.path.isfile(meta):
+            out.append((os.path.getmtime(meta), name))
+    return [name for _, name in sorted(out, reverse=True)]
+
+
+def find_verified_tag(load_dir: str, tag: Optional[str] = None) -> str:
+    """``tag`` (or latest) if it verifies clean, else the newest PREVIOUS
+    tag that does — the self-healing session's rollback target discovery.
+    Raises :class:`CheckpointCorruption` when nothing verifies."""
+    tried: List[str] = []
+    first = tag or read_latest_tag(load_dir)
+    candidates = [first] if first else []
+    candidates += [t for t in list_tags(load_dir) if t not in candidates]
+    for cand in candidates:
+        problems = verify_checkpoint(load_dir, cand)
+        if not problems:
+            if tried:
+                logger.error(
+                    f"checkpoint: tag(s) {tried} failed verification — "
+                    f"falling back to previous good tag '{cand}'")
+            return cand
+        for p in problems[:3]:
+            logger.error(f"checkpoint verify: {p}")
+        tried.append(cand)
+    raise CheckpointCorruption(
+        f"no checkpoint tag in {load_dir} verifies clean "
+        f"(tried {tried or '<none>'})")
 
 
 def read_latest_tag(load_dir: str) -> Optional[str]:
     wait_pending()
     latest = os.path.join(load_dir, "latest")
-    if os.path.exists(latest):
-        with open(latest) as fh:
-            return fh.read().strip()
-    return None
+    if not os.path.exists(latest):
+        return None
+    with open(latest) as fh:
+        tag = fh.read().strip()
+    # crash recovery for an interrupted same-tag re-save: the publisher
+    # renames final -> <tag>.replaced.tmp before renaming the new tree into
+    # place, so dying between the two renames leaves `latest` naming a
+    # missing dir while the old GOOD tree sits in the trash name — restore
+    # it (only the publisher ever creates these)
+    if tag and not os.path.isdir(os.path.join(load_dir, tag)):
+        trash = os.path.join(load_dir, tag + ".replaced.tmp")
+        if os.path.isfile(os.path.join(trash, "metadata.json")):
+            try:
+                os.rename(trash, os.path.join(load_dir, tag))
+                logger.warning(
+                    f"checkpoint: recovered tag '{tag}' from an "
+                    "interrupted re-save swap")
+            except OSError:
+                pass
+    return tag
 
 
 def _assemble_slice(arrays_dir: str, info: Dict, want: List[List[int]],
@@ -296,6 +487,29 @@ def _assemble_slice(arrays_dir: str, info: Dict, want: List[List[int]],
     return out
 
 
+def _owned_copy(arr: jax.Array) -> jax.Array:
+    """Defensive ownership copy of a restored array ON CPU BACKENDS.
+
+    ``jax.device_put`` of a numpy piece on the CPU backend may alias the
+    host buffer zero-copy; the jitted train step then DONATES restored
+    params/opt buffers, and XLA reclaiming an externally owned allocation
+    corrupts the process heap. Observed (pre-existing, exposed by the
+    chaos harness's kill→resume loop): resuming from a checkpoint written
+    by an interrupted run nondeterministically produced NaN losses, subtly
+    wrong trailing steps, or glibc aborts — with byte-identical checkpoint
+    files. An eager ``jnp.copy`` routes the leaf through a real XLA
+    computation whose output buffer the runtime owns, making donation
+    safe. TPU/GPU device_put always copies host→device, so those backends
+    skip the extra hop."""
+    try:
+        devs = arr.devices() if hasattr(arr, "devices") else ()
+        if any(d.platform == "cpu" for d in devs):
+            return jnp.copy(arr)
+    except Exception:
+        pass
+    return arr
+
+
 def _restore_leaf(arrays_dir: str, info: Dict, template, sharding
                   ) -> jax.Array:
     shape = tuple(info["shape"])
@@ -312,7 +526,7 @@ def _restore_leaf(arrays_dir: str, info: Dict, template, sharding
     if sharding is None:
         full = _assemble_slice(arrays_dir, info, [[0, d] for d in shape],
                                target_dtype)
-        return jnp.asarray(full)
+        return _owned_copy(jnp.asarray(full))
     imap = sharding.devices_indices_map(shape)
     singles = []
     devs = []
@@ -323,16 +537,29 @@ def _restore_leaf(arrays_dir: str, info: Dict, template, sharding
         piece = _assemble_slice(arrays_dir, info, bounds, target_dtype)
         singles.append(jax.device_put(piece, dev))
         devs.append(dev)
-    return jax.make_array_from_single_device_arrays(shape, sharding, singles)
+    return _owned_copy(
+        jax.make_array_from_single_device_arrays(shape, sharding, singles))
 
 
 def load_checkpoint(load_dir: str, tag: Optional[str] = None,
                     params_template: Optional[Tuple[Any, Any]] = None,
-                    opt_template: Optional[Tuple[Any, Any]] = None
+                    opt_template: Optional[Tuple[Any, Any]] = None,
+                    verify: bool = False
                     ) -> Optional[Tuple[Any, Any, Dict]]:
     """Restore (params, opt_state, client_state). Templates are
     (current_tree, shardings_tree); every process reads only the slices its
-    devices need, under ANY new topology (universal checkpoint semantics)."""
+    devices need, under ANY new topology (universal checkpoint semantics).
+
+    ``verify=True`` re-hashes every shard against the recorded crc32 first
+    and silently degrades to the newest previous tag that verifies clean
+    (:func:`find_verified_tag`); raises :class:`CheckpointCorruption` when
+    no tag does."""
+    if verify:
+        if tag is None and read_latest_tag(load_dir) is None \
+                and not list_tags(load_dir):
+            logger.warning(f"no checkpoints in {load_dir}; nothing restored")
+            return None
+        tag = find_verified_tag(load_dir, tag)
     tag = tag or read_latest_tag(load_dir)
     if tag is None:
         logger.warning(f"no 'latest' file in {load_dir}; nothing restored")
@@ -368,7 +595,12 @@ def load_checkpoint(load_dir: str, tag: Optional[str] = None,
 
     params = restore("params", params_template) if params_template else None
     opt_state = restore("opt", opt_template) if opt_template else None
-    return params, opt_state, meta.get("client_state", {})
+    client_state = dict(meta.get("client_state", {}))
+    # name the tag actually restored — under verify-fallback it may not be
+    # the one the caller asked for, and the supervisor's recovery event
+    # records which restore point the run rolled back to
+    client_state.setdefault("_checkpoint_tag", tag)
+    return params, opt_state, client_state
 
 
 def _write_flat_npz(path: str, flat: Dict[str, np.ndarray],

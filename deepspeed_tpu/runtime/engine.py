@@ -1834,7 +1834,15 @@ class TrainEngine:
             # the swap files ARE the optimizer state — snapshot them into the
             # checkpoint (reference use_node_local_storage semantics); one
             # dir per process, since each swap dir holds only that process's
-            # addressable state regions
+            # addressable state regions. Under async_save the returned path
+            # is the FINAL tag dir, which only exists once the background
+            # commit renames the staging tree into place — wait for it, or
+            # the snapshot would create the final dir early and the rename
+            # would sweep it aside as a replaced-tag leftover.
+            if async_save:
+                from .checkpoint import wait_pending
+
+                wait_pending()
             self._nvme_swapper.snapshot_to(
                 os.path.join(path, f"nvme_state_p{jax.process_index()}"))
         log_dist(f"saved checkpoint {path}")
@@ -1842,7 +1850,8 @@ class TrainEngine:
 
     def load_checkpoint(self, load_dir: str, tag: Optional[str] = None,
                         load_optimizer_states: bool = True,
-                        load_lr_scheduler_states: bool = True) -> Tuple[Optional[str], Dict]:
+                        load_lr_scheduler_states: bool = True,
+                        verify: bool = False) -> Tuple[Optional[str], Dict]:
         from .checkpoint import load_checkpoint as _load
 
         if self._param_offload is not None:
@@ -1867,7 +1876,7 @@ class TrainEngine:
             with mesh_mod.ambient(self.mesh):
                 result = _load(load_dir, tag,
                                params_template=(ptree, psh),
-                               opt_template=opt_tpl)
+                               opt_template=opt_tpl, verify=verify)
             if result is None:
                 return None, {}
             params, opt_state, client_state = result
@@ -1902,7 +1911,8 @@ class TrainEngine:
                 result = _load(load_dir, tag,
                                params_template=(self.params, self.param_shardings),
                                opt_template=((self.opt_state, opt_shardings)
-                                             if load_resident_opt else None))
+                                             if load_resident_opt else None),
+                               verify=verify)
         if result is None:
             return None, {}
         params, opt_state, client_state = result
@@ -1911,7 +1921,12 @@ class TrainEngine:
             self.opt_state = opt_state
         if load_optimizer_states and self._nvme_swapper is not None:
             snap = f"nvme_state_p{jax.process_index()}"
-            base = os.path.join(load_dir, tag or client_state.get("tag", ""))
+            # _checkpoint_tag names the tag _load ACTUALLY restored — under
+            # verify-fallback that may be an older tag than 'latest', and
+            # the swap snapshot must come from the same tag as the params
+            base = os.path.join(load_dir,
+                                tag or client_state.get("_checkpoint_tag",
+                                                        ""))
             if not os.path.isdir(os.path.join(base, snap)):
                 # resolve via 'latest' the same way _load did
                 latest = os.path.join(load_dir, "latest")

@@ -1,0 +1,466 @@
+"""Self-healing training sessions — detection wired to remediation.
+
+The observability arc (flight recorder, hang watchdog, fleet health,
+numerics sentinel) can *name* a failure: the straggling rank, the NaN
+step, the stalled span. This module is the layer that *acts* on the name —
+the MegaScale-style goodput story where recovery latency, not human
+response time, bounds lost wall-clock. A :class:`TrainingSession` owns the
+engine lifecycle across failures:
+
+====================  =========================================================
+failure               remediation policy (ResilienceConfig)
+====================  =========================================================
+numerics trip         ``on_numerics``: **rollback** to the last verified
+(``NumericsTrip``,    universal checkpoint (crc-checked, previous-good-tag
+sentinel abort)       fallback) and replay | skip (log + continue) | raise
+hang watchdog fire    ``on_hang='escalate'``: fire 1..N dump evidence and —
+                      when control returns — trigger a **soft restart**
+                      (rebuild the engine in-process, reload the
+                      checkpoint); fire N+1 hard-exits with
+                      ``hang_exit_code`` so the elastic agent respawns the
+                      group (``HangWatchdog.abort_after_fires``)
+fleet straggler       after ``straggler_patience`` consecutive verdicts
+verdict               against the same rank, an **eviction request** goes to
+                      the supervising :class:`ElasticAgent`
+                      (``DSTPU_AGENT_DIR``), which kills + re-rendezvouses
+                      at the next smaller valid membership (min-world
+                      floored); the respawned workers resume from the
+                      latest checkpoint under the new topology — the
+                      format-2 universal checkpoint reshards on load, and
+                      the agent's recomputed ``DSTPU_ELASTIC_MICRO``
+                      (``apply_elastic_env_overrides``) preserves the
+                      global batch
+worker death          the agent's jurisdiction: backoff + restart (with
+(SIGKILL, OOM,        shrink), and this session's resume-from-latest at
+preemption)           startup makes the respawn transparent
+checkpoint            ``verify_checkpoints``: corrupted tags (truncated
+corruption            shard, crc mismatch) fall back to the newest previous
+                      tag that verifies clean
+====================  =========================================================
+
+Every recovery publishes ``resilience/*`` metrics (events by kind×policy,
+time-to-recover) into the registry, drops a ring event for crash bundles,
+and wraps its work in a ``recovery/*`` span so goodput accounting
+attributes the lost seconds to the ``recovery`` bucket (bucket sums still
+equal wall). The whole loop is chaos-testable without hardware via
+:mod:`deepspeed_tpu.observability.faultinject` (``scripts/chaos.sh``).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from ..utils.logging import logger
+
+FAILURE_KINDS = ("numerics", "crash", "hang", "straggler", "worker_death",
+                 "checkpoint")
+
+
+class RecoveryExhausted(RuntimeError):
+    """The session's remediation budget ran out (``max_rollbacks``) — the
+    original failure is chained as ``__cause__``; escalation belongs to the
+    elastic agent now."""
+
+
+class TrainingSession:
+    """Supervised engine lifecycle: build → resume → step loop →
+    classify-and-remediate → (re)build, under a :class:`ResilienceConfig`
+    policy. One per worker process; the cross-process half (respawn,
+    membership shrink, backoff, breaker) is the :class:`ElasticAgent`
+    supervising the process tree.
+
+    ``engine_factory``: zero-arg callable returning a fresh engine (the
+    soft-restart path rebuilds through it). ``data_fn(step)``: the batch
+    for global step ``step`` — MUST be a pure function of the step (and
+    rank) so replay after a rollback feeds bit-identical data; in
+    multi-process runs it returns the process-local share.
+    """
+
+    def __init__(self, engine_factory: Callable[[], Any],
+                 data_fn: Callable[[int], Any], total_steps: int,
+                 save_dir: Optional[str] = None,
+                 resilience: Optional[Any] = None,
+                 injector: Optional[Any] = None,
+                 on_step: Optional[Callable[[int, float], None]] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        from ..config.config import ResilienceConfig
+
+        self.engine_factory = engine_factory
+        self.data_fn = data_fn
+        self.total_steps = int(total_steps)
+        self.cfg = resilience or ResilienceConfig()
+        self.save_dir = save_dir or self.cfg.save_dir
+        if not self.save_dir:
+            raise ValueError("TrainingSession needs a checkpoint root: pass "
+                             "save_dir= or set resilience.save_dir")
+        self.injector = injector
+        self.on_step = on_step
+        self._clock = clock
+        self.engine: Optional[Any] = None
+        self._obs: Optional[Any] = None
+        self.recoveries: List[Dict[str, Any]] = []
+        self.rollbacks = 0
+        self.soft_restarts = 0
+        self.evictions_requested = 0
+        self.losses: List[float] = []
+        self._last_save_step = -1
+        self._hang_fires_handled = 0
+        self._straggler_streak: Dict[str, Any] = {"rank": -1, "count": 0}
+        self._eviction_sent = False
+
+    # -- wiring ------------------------------------------------------------
+    def _registry(self):
+        if self._obs is not None:
+            return self._obs.registry
+        from ..observability import get_registry
+
+        return get_registry()
+
+    def _recorder(self):
+        return getattr(self._obs, "recorder", None)
+
+    def _wire(self, engine: Any) -> None:
+        """Attach the session's remediation hooks to the engine's
+        observability session (re-run after every engine rebuild — the
+        rebuild installs a fresh session)."""
+        from ..observability import get_session
+
+        self.engine = engine
+        self._obs = getattr(engine, "_obs", None) or get_session()
+        hang = getattr(self._obs, "hang", None)
+        # baseline at the CURRENT fire count: a fresh watchdog starts at 0,
+        # and a rebuild that reuses a session must not re-handle old fires
+        self._hang_fires_handled = getattr(hang, "fired", 0)
+        if hang is not None and self.cfg.on_hang == "escalate":
+            # dump → soft-restart → hard-restart: fires 1..N leave the
+            # process alive (evidence dumped; the step loop soft-restarts
+            # when control returns); fire N+1 exits with the distinct hang
+            # code so the agent respawns the whole group
+            hang.abort = True
+            hang.abort_after_fires = self.cfg.hang_soft_restarts + 1
+        fleet = getattr(self._obs, "fleet", None)
+        if fleet is not None:
+            fleet.on_straggler = self._on_straggler
+        if self.injector is not None:
+            if getattr(self.injector, "registry", None) is None:
+                self.injector.registry = self._registry()
+            if getattr(self.injector, "recorder", None) is None:
+                self.injector.recorder = self._recorder()
+
+    # -- recovery bookkeeping ---------------------------------------------
+    def _record_recovery(self, kind: str, policy: str, wall_s: float,
+                         **detail: Any) -> None:
+        info = {"kind": kind, "policy": policy,
+                "wall_s": round(wall_s, 6), **detail}
+        self.recoveries.append(info)
+        reg = self._registry()
+        reg.counter("resilience/recovery_events",
+                    help="remediated failures").inc(kind=kind, policy=policy)
+        reg.counter("resilience/recovery_seconds",
+                    help="wall seconds spent remediating").inc(max(wall_s,
+                                                                  0.0))
+        reg.gauge("resilience/last_recovery_s",
+                  help="wall seconds of the last recovery").set(wall_s)
+        rec = self._recorder()
+        if rec is not None:
+            # "failure_kind": record()'s positional `kind` is the ring-event
+            # type
+            ring = {("failure_kind" if k == "kind" else k): v
+                    for k, v in info.items()}
+            rec.record("recovery", **ring)
+        logger.warning(f"RECOVERY: {kind} handled by {policy} in "
+                       f"{wall_s:.3f}s ({detail})")
+
+    # -- checkpointing -----------------------------------------------------
+    def _save(self, engine: Any) -> str:
+        path = engine.save_checkpoint(self.save_dir)
+        self._last_save_step = engine.global_steps
+        if self.injector is not None:
+            self.injector.after_save(self.save_dir,
+                                     step=engine.global_steps)
+        return path
+
+    def _resume(self, engine: Any) -> bool:
+        """Load the latest (verified) checkpoint into ``engine``; False when
+        there is no restore point yet."""
+        path, _ = engine.load_checkpoint(
+            self.save_dir, verify=self.cfg.verify_checkpoints)
+        if path is not None:
+            self._last_save_step = engine.global_steps
+        return path is not None
+
+    # -- remediation paths -------------------------------------------------
+    def _rollback(self, kind: str, exc: BaseException) -> None:
+        if self.rollbacks >= self.cfg.max_rollbacks:
+            raise RecoveryExhausted(
+                f"rollback budget exhausted ({self.rollbacks}/"
+                f"{self.cfg.max_rollbacks}) — last failure: {exc}") from exc
+        engine = self.engine
+        t0 = self._clock()
+        failed_step = engine.global_steps
+        sp = self._obs.span("recovery/rollback", kind=kind) \
+            if self._obs is not None else None
+        if sp is not None:
+            sp.begin()
+        try:
+            path, client = engine.load_checkpoint(
+                self.save_dir, verify=self.cfg.verify_checkpoints)
+        finally:
+            if sp is not None:
+                sp.end()
+        if path is None:
+            # nothing to roll back to: the failure stands
+            raise exc
+        # the restored tag IS the last good save — re-anchor the cadence
+        # horizon there (under verify-fallback it may be OLDER than the
+        # last save this incarnation made)
+        self._last_save_step = engine.global_steps
+        self.rollbacks += 1
+        self._registry().counter(
+            "resilience/rollbacks",
+            help="rollback-to-checkpoint recoveries").inc()
+        self._record_recovery(
+            kind, "rollback", self._clock() - t0,
+            failed_step=failed_step, resumed_step=engine.global_steps,
+            tag=client.get("_checkpoint_tag"),
+            error=f"{type(exc).__name__}: {str(exc)[:200]}")
+
+    def _soft_restart(self) -> None:
+        """In-process engine rebuild + reload: the remediation for a hang
+        that eventually returned control (wedged collective that drained, a
+        transient backend stall) — a fresh engine means fresh executables
+        and a fresh dispatch queue, without losing the process or the
+        rendezvous. The rebuild REPLACES the observability session
+        mid-remediation, so the ``recovery/*`` span opens on the NEW
+        session around the reload only — a span on the old session would
+        end on a discarded accountant, and feeding the whole rebuild
+        duration separately would double-count the reload/compile seconds
+        the new accountant already buckets (rebuild compiles legitimately
+        land in `recompile`)."""
+        if self.soft_restarts >= self.cfg.hang_soft_restarts:
+            # the in-process rung of the ladder is exhausted: a recurring
+            # hang must escalate to the agent — exit the worker nonzero so
+            # the group restarts (the watchdog's own abort_after_fires only
+            # covers fires of ONE watchdog; each rebuild installs a fresh
+            # one, so the budget is enforced here)
+            raise RecoveryExhausted(
+                f"hang soft-restart budget exhausted "
+                f"({self.soft_restarts}/{self.cfg.hang_soft_restarts}) — "
+                "escalating to the supervising agent")
+        t0 = self._clock()
+        old_steps = self.engine.global_steps
+        engine = self.engine_factory()
+        self._wire(engine)
+        sp = self._obs.span("recovery/soft_restart")
+        sp.begin()
+        try:
+            self._resume(engine)
+        finally:
+            sp.end()
+        dt = self._clock() - t0
+        self.soft_restarts += 1
+        self._record_recovery(
+            "hang", "soft_restart", dt,
+            stalled_at_step=old_steps, resumed_step=engine.global_steps)
+
+    def _handle_failure(self, kind: str, policy: str,
+                        exc: BaseException) -> None:
+        if policy == "raise":
+            raise exc
+        if policy == "skip":
+            # log-and-continue — the trip is ACCEPTED, not undone: by the
+            # time a NumericsTrip reaches the session (sentinel action
+            # 'abort'), the step's update has already landed, so after a
+            # nonfinite trip the params may be permanently poisoned (use
+            # 'rollback', or the sentinel's own 'skip_step' action which
+            # drops the update on device). 'skip' is for trips that do NOT
+            # corrupt state — a loss-spike abort the operator chooses to
+            # tolerate.
+            self._record_recovery(
+                kind, "skip", 0.0, step=self.engine.global_steps,
+                error=f"{type(exc).__name__}: {str(exc)[:200]}")
+            return
+        self._rollback(kind, exc)
+
+    # -- detection→action hooks -------------------------------------------
+    def _on_straggler(self, rank: int, info: Dict[str, Any]) -> None:
+        """Fleet-health verdict hook (every rank sees the same verdict).
+        ``straggler_patience`` consecutive verdicts against the same rank
+        escalate to an eviction request; rank 0 writes it (one request per
+        fleet), the agent kills + re-rendezvouses at the smaller
+        membership."""
+        streak = self._straggler_streak
+        if rank == streak["rank"]:
+            streak["count"] += 1
+        else:
+            self._straggler_streak = streak = {"rank": rank, "count": 1}
+        if streak["count"] < self.cfg.straggler_patience \
+                or self._eviction_sent:
+            return
+        fleet = getattr(self._obs, "fleet", None)
+        world = getattr(fleet, "world", 1)
+        if world <= self.cfg.min_world:
+            if getattr(fleet, "rank", 0) == 0:
+                logger.warning(
+                    f"straggler rank {rank} persists but world {world} is at "
+                    f"the min_world floor ({self.cfg.min_world}) — not "
+                    "requesting eviction")
+            return
+        self._eviction_sent = True   # once per incarnation: the restart
+        #   that follows resets the whole process anyway
+        if getattr(fleet, "rank", 0) != 0:
+            return
+        from ..launcher.elastic_agent import request_eviction
+
+        path = request_eviction(
+            rank, reason=f"straggler x{streak['count']} "
+            f"(step_time {info.get('step_time_s', 0):.4f}s vs fleet median "
+            f"{info.get('fleet_median_s', 0):.4f}s)",
+            step=info.get("step"))
+        if path is None:
+            # not delivered — counting it would mask exactly the
+            # misconfiguration this warning points at
+            logger.warning(
+                f"straggler rank {rank}: no elastic agent listening "
+                "(DSTPU_AGENT_DIR unset) — eviction request dropped")
+            return
+        self.evictions_requested += 1
+        self._registry().counter(
+            "resilience/evictions_requested",
+            help="straggler evictions requested from the elastic "
+                 "agent").inc(rank=rank)
+        rec = self._recorder()
+        if rec is not None:
+            rec.record("eviction_requested", rank=rank, **info)
+        logger.warning(f"straggler rank {rank}: eviction requested at "
+                       f"{path}; expecting group restart")
+
+    def _pending_soft_restart(self) -> bool:
+        hang = getattr(self._obs, "hang", None)
+        if hang is None or self.cfg.on_hang != "escalate":
+            return False
+        if hang.fired > self._hang_fires_handled:
+            self._hang_fires_handled = hang.fired
+            return True
+        return False
+
+    # -- the supervised loop ----------------------------------------------
+    def run(self) -> Dict[str, Any]:
+        from ..observability import NumericsTrip
+
+        engine = self.engine_factory()
+        self._wire(engine)
+        resumed = self._resume(engine)
+        if resumed:
+            logger.info(f"session: resumed at step {engine.global_steps} "
+                        f"(restart "
+                        f"{os.environ.get('DSTPU_RESTART_COUNT', '0')})")
+        else:
+            # step-0 baseline: a failure before the first cadence save must
+            # still have a rollback target
+            self._save(engine)
+        record = self.cfg.record_losses or self.on_step is not None
+        while self.engine.global_steps < self.total_steps:
+            if self._pending_soft_restart():
+                self._soft_restart()
+                continue
+            engine = self.engine
+            step = engine.global_steps
+            if self.injector is not None:
+                self.injector.before_step(step, engine)
+            batch = self.data_fn(step)
+            try:
+                loss = engine.train_batch(batch=batch)
+            except NumericsTrip as e:
+                self._handle_failure("numerics", self.cfg.on_numerics, e)
+                continue
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except Exception as e:
+                self._handle_failure("crash", self.cfg.on_crash, e)
+                continue
+            if record:
+                loss_f = float(loss)
+                if self.cfg.record_losses:
+                    self.losses.append(loss_f)
+                if self.on_step is not None:
+                    self.on_step(step, loss_f)
+            # horizon-based, not modulo: a failure consumed exactly ON a
+            # cadence boundary (skip policy) must not silently widen the
+            # rollback horizon to 2x by stepping past the multiple
+            if engine.global_steps - self._last_save_step \
+                    >= self.cfg.checkpoint_every_steps:
+                self._save(engine)
+        if self.engine.global_steps > self._last_save_step:
+            self._save(self.engine)
+        return self.summary()
+
+    def summary(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {
+            "steps": self.engine.global_steps if self.engine else 0,
+            "total_steps": self.total_steps,
+            "completed": bool(self.engine
+                              and self.engine.global_steps
+                              >= self.total_steps),
+            "recoveries": list(self.recoveries),
+            "rollbacks": self.rollbacks,
+            "soft_restarts": self.soft_restarts,
+            "evictions_requested": self.evictions_requested,
+        }
+        if self.cfg.record_losses:
+            out["losses"] = list(self.losses)
+        if self.injector is not None:
+            out["faults_injected"] = list(self.injector.applied)
+        return out
+
+
+def run_training_session(model: Any = None, config: Any = None,
+                         data_fn: Optional[Callable[[int], Any]] = None,
+                         total_steps: int = 0,
+                         save_dir: Optional[str] = None,
+                         mesh: Any = None, optimizer: Any = None,
+                         lr_scheduler: Any = None,
+                         engine_factory: Optional[Callable[[], Any]] = None,
+                         injector: Optional[Any] = None,
+                         on_step: Optional[Callable[[int, float],
+                                                    None]] = None
+                         ) -> Dict[str, Any]:
+    """Build and run a supervised session — ``deepspeed_tpu``'s top-level
+    self-healing entry point.
+
+    Exactly one of ``model`` / ``engine_factory`` is required. The config's
+    ``resilience`` section is the policy; the elastic agent's env contract
+    (``DSTPU_ELASTIC_MICRO`` after a membership shrink, ``DSTPU_FAULT_PLAN``
+    under the chaos harness) is folded in automatically. Returns the
+    session summary dict."""
+    from ..config import load_config
+    from ..elasticity import apply_elastic_env_overrides
+
+    if data_fn is None:
+        raise ValueError("run_training_session requires data_fn(step)")
+    if total_steps <= 0:
+        raise ValueError("run_training_session requires total_steps > 0")
+    cfg = apply_elastic_env_overrides(load_config(config))
+    if engine_factory is None:
+        if model is None:
+            raise ValueError("run_training_session requires model= (or an "
+                             "engine_factory)")
+
+        def engine_factory():
+            from .engine import initialize
+
+            engine, *_ = initialize(model=model, config=cfg, mesh=mesh,
+                                    optimizer=optimizer,
+                                    lr_scheduler=lr_scheduler)
+            return engine
+
+    if injector is None:
+        from ..observability.faultinject import FaultInjector
+
+        injector = FaultInjector.from_env()
+    session = TrainingSession(engine_factory, data_fn, total_steps,
+                              save_dir=save_dir, resilience=cfg.resilience,
+                              injector=injector, on_step=on_step)
+    return session.run()

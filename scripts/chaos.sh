@@ -1,0 +1,31 @@
+#!/usr/bin/env bash
+# Chaos gate: the fault-injection recovery smoke (docs/resilience.md).
+#
+# Two halves of the self-healing acceptance loop, both CPU-only:
+#   (a) in-process: a supervised session on the 8-virtual-device mesh
+#       survives an injected NaN step via numerics-sentinel abort →
+#       rollback-to-verified-checkpoint, with the post-recovery loss
+#       sequence bit-identical to a clean run restarted from the same
+#       checkpoint, the lost time attributed to the goodput `recovery`
+#       bucket (bucket sums == wall), and the report CLI showing the
+#       recovery event;
+#   (b) multi-process: the real ElasticAgent + run_training_session on an
+#       8-process mesh survives an injected rank SIGKILL (DSTPU_FAULT_PLAN)
+#       — kill → membership shrink 8→6 through the elastic batch math →
+#       re-rendezvous → per-rank resume — and the post-recovery losses are
+#       bit-identical to a clean control run from the same restore point.
+#
+# Plus the durability + hardening satellites: checkpoint truncation /
+# bit-flip → crc verify → previous-good-tag fallback, and the agent's
+# backoff / circuit-breaker / eviction-channel behavior.
+#
+# Usage: scripts/chaos.sh [extra pytest args...]
+set -euo pipefail
+
+cd "$(dirname "$0")/.."
+
+JAX_PLATFORMS=cpu python -m pytest \
+    "tests/unit/test_session.py" \
+    "tests/unit/test_checkpoint_v2.py::TestDurability" \
+    "tests/unit/test_launcher.py::TestAgentRestartHardening" \
+    -q -p no:cacheprovider "$@"

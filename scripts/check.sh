@@ -1,7 +1,8 @@
 #!/usr/bin/env bash
 # The unified static-analysis driver: lint (source) + audit (program
 # semantics) + cost (program cost) + parity (serving kernel-path tests,
-# tier-1 marker set) in one run, one exit code for CI.
+# tier-1 marker set) + chaos (fault-injection recovery smoke) in one run,
+# one exit code for CI.
 #
 # The three analyzers share the same gate semantics (committed baseline,
 # stale-entry rot detection, the render_report tail in
@@ -18,7 +19,7 @@ cd "$(dirname "$0")/.."
 
 selected=("$@")
 fail=0
-for gate in lint audit cost parity; do
+for gate in lint audit cost parity chaos; do
     if [ "${#selected[@]}" -gt 0 ]; then
         case " ${selected[*]} " in
             *" $gate "*) ;;

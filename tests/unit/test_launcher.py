@@ -312,7 +312,197 @@ class TestElasticAgent:
 
         agent = ElasticAgent(
             [sys.executable, "-c", "import sys; sys.exit(3)"], nprocs=1,
-            config=ElasticAgentConfig(max_restarts=1, master_port=29550))
+            config=ElasticAgentConfig(max_restarts=1, master_port=29550,
+                                      backoff_base_s=0.01))
         with pytest.raises(WorkerGroupFailure, match="max_restarts"):
             agent.run()
         assert agent.restart_count == 1
+
+
+class TestAgentRestartHardening:
+    """PR-9 satellite: exponential backoff with jitter between respawns and
+    the max-restarts-per-window circuit breaker (with a flight-recorder
+    bundle naming the last failure on trip), plus the eviction-request
+    control channel the fleet-health straggler policy drives."""
+
+    def _agent(self, tmp_path, cmd=None, nprocs=1, clock=None, **cfg):
+        from deepspeed_tpu.launcher.elastic_agent import (ElasticAgent,
+                                                          ElasticAgentConfig)
+        import random
+
+        cfg.setdefault("master_port", 29555)
+        cfg.setdefault("agent_dir", str(tmp_path / "agent"))
+        sleeps = []
+        agent = ElasticAgent(
+            cmd or [sys.executable, "-c", "import sys; sys.exit(3)"],
+            nprocs=nprocs, config=ElasticAgentConfig(**cfg),
+            clock=clock or (lambda: 0.0),
+            sleep_fn=sleeps.append, rng=random.Random(0))
+        agent._test_sleeps = sleeps
+        return agent
+
+    def test_backoff_ladder_with_jitter(self, tmp_path):
+        from deepspeed_tpu.launcher.elastic_agent import WorkerGroupFailure
+
+        agent = self._agent(tmp_path, max_restarts=4, backoff_base_s=1.0,
+                            backoff_max_s=3.0, backoff_jitter=0.25)
+        with pytest.raises(WorkerGroupFailure, match="max_restarts"):
+            agent.run()
+        sleeps = agent._test_sleeps
+        assert len(sleeps) == 4
+        # exponential ladder 1, 2, 3(cap), 3(cap) — each with up to +25%
+        for got, base in zip(sleeps, (1.0, 2.0, 3.0, 3.0)):
+            assert base <= got <= base * 1.25, (sleeps)
+        # jitter actually applied (not all exactly at base)
+        assert any(got > base for got, base in zip(sleeps,
+                                                   (1.0, 2.0, 3.0, 3.0)))
+
+    def test_circuit_breaker_trips_with_bundle(self, tmp_path):
+        from deepspeed_tpu.launcher.elastic_agent import WorkerGroupFailure
+
+        agent = self._agent(tmp_path, max_restarts=10, backoff_base_s=0.0,
+                            restart_window_s=60.0,
+                            max_restarts_per_window=3)
+        with pytest.raises(WorkerGroupFailure, match="circuit breaker"):
+            agent.run()
+        # 3 respawns inside the window are ALLOWED; the 4th attempt trips
+        assert agent.restart_count == 3
+        # the bundle names the last failure
+        crash_dir = tmp_path / "agent" / "crash"
+        bundles = list(crash_dir.glob("crash-*restart-breaker*"))
+        assert bundles, list(crash_dir.iterdir())
+        manifest = json.loads((bundles[0] / "MANIFEST.json").read_text())
+        assert manifest["reason"] == "restart-breaker"
+        extra = manifest["extra"]
+        assert extra["last_failure"]["rc"] == 3
+        assert extra["restarts_in_window"] == 4
+
+    def test_breaker_window_slides(self, tmp_path):
+        """Restarts spread WIDER than the window never trip the breaker."""
+        t = [0.0]
+
+        def clock():
+            t[0] += 100.0   # each poll/restart 100s apart > 60s window
+            return t[0]
+
+        agent = self._agent(tmp_path, max_restarts=4, backoff_base_s=0.0,
+                            restart_window_s=60.0,
+                            max_restarts_per_window=2, clock=clock)
+        from deepspeed_tpu.launcher.elastic_agent import WorkerGroupFailure
+
+        # exhausts max_restarts (the total budget) WITHOUT a breaker trip
+        with pytest.raises(WorkerGroupFailure, match="max_restarts"):
+            agent.run()
+
+    @pytest.mark.parametrize("max_restarts", [2, 0])
+    def test_eviction_request_restarts_with_shrink(self, tmp_path,
+                                                   max_restarts):
+        """An evict.json dropped into the agent dir (what
+        session.TrainingSession's straggler policy writes via
+        request_eviction) kills + re-rendezvouses at a smaller
+        membership. max_restarts=0: a DELIBERATE eviction does not consume
+        the crash budget — remediation must work even with no crash
+        restarts left."""
+        import json as _json
+        import threading
+        import time as _time
+
+        from deepspeed_tpu.launcher.elastic_agent import (ElasticAgent,
+                                                          ElasticAgentConfig,
+                                                          request_eviction)
+
+        agent_dir = tmp_path / "agent"
+        agent_dir.mkdir()
+        log = tmp_path / "probe.jsonl"
+        # workers: finish instantly at world <= 2, otherwise linger
+        probe = tmp_path / "probe.py"
+        probe.write_text(
+            "import json, os, sys, time\n"
+            "with open(sys.argv[1], 'a') as fh:\n"
+            "    fh.write(json.dumps({'world': os.environ['WORLD_SIZE'],\n"
+            "        'agent_dir': os.environ.get('DSTPU_AGENT_DIR')})\n"
+            "        + chr(10))\n"
+            "if int(os.environ['WORLD_SIZE']) <= 2:\n"
+            "    sys.exit(0)\n"
+            "time.sleep(30)\n")
+        agent = ElasticAgent(
+            [sys.executable, str(probe), str(log)], nprocs=3,
+            config=ElasticAgentConfig(
+                max_restarts=max_restarts, min_workers=1, master_port=29556,
+                monitor_interval=0.05, backoff_base_s=0.01,
+                agent_dir=str(agent_dir)))
+
+        def drop_request():
+            _time.sleep(0.7)   # let the 3-worker group spawn and linger
+            request_eviction(1, reason="test straggler", step=7,
+                             agent_dir=str(agent_dir))
+
+        t = threading.Thread(target=drop_request)
+        t.start()
+        rc = agent.run()
+        t.join()
+        assert rc == 0
+        assert agent.evictions == 1 and agent.restart_count == 1
+        assert agent._world == 2
+        assert agent.last_failure["kind"] == "eviction"
+        assert agent.last_failure["rank"] == 1
+        lines = [_json.loads(l) for l in log.read_text().splitlines()]
+        assert lines[0]["world"] == "3" and lines[-1]["world"] == "2"
+        # workers saw the control-channel contract
+        assert lines[0]["agent_dir"] == str(agent_dir)
+
+    def test_eviction_ignored_when_membership_cannot_shrink(self, tmp_path):
+        """min_workers unset (the default): honouring an eviction would
+        respawn the same membership — straggler included — and churn
+        forever; the agent must drop the request instead."""
+        import threading
+        import time as _time
+
+        from deepspeed_tpu.launcher.elastic_agent import (ElasticAgent,
+                                                          ElasticAgentConfig,
+                                                          request_eviction)
+
+        agent_dir = tmp_path / "agent"
+        agent_dir.mkdir()
+        agent = ElasticAgent(
+            [sys.executable, "-c", "import time; time.sleep(2)"], nprocs=2,
+            config=ElasticAgentConfig(
+                max_restarts=2, master_port=29558, monitor_interval=0.05,
+                agent_dir=str(agent_dir)))
+
+        def drop():
+            _time.sleep(0.4)
+            request_eviction(1, reason="slow", agent_dir=str(agent_dir))
+
+        t = threading.Thread(target=drop)
+        t.start()
+        rc = agent.run()
+        t.join()
+        assert rc == 0
+        assert agent.evictions == 0 and agent.restart_count == 0
+        assert agent._world == 2
+
+    def test_request_eviction_without_agent_is_dropped(self, monkeypatch):
+        from deepspeed_tpu.launcher.elastic_agent import request_eviction
+
+        monkeypatch.delenv("DSTPU_AGENT_DIR", raising=False)
+        assert request_eviction(3, reason="no agent") is None
+
+    def test_stale_eviction_request_cleared_on_failure_restart(self,
+                                                               tmp_path):
+        """An evict.json racing a worker crash must not survive the crash
+        restart — left behind it would trigger a second, spurious shrink
+        on the next healthy poll."""
+        from deepspeed_tpu.launcher.elastic_agent import request_eviction
+
+        agent = self._agent(
+            tmp_path, cmd=[sys.executable, "-c", "import sys; sys.exit(0)"],
+            max_restarts=3, backoff_base_s=0.0)
+        request_eviction(1, reason="raced by a crash",
+                         agent_dir=agent.agent_dir)
+        req = os.path.join(agent.agent_dir, "evict.json")
+        assert os.path.exists(req)
+        agent._restart("worker exit rc=7", shrink=True)   # the CRASH path
+        agent._terminate_all()
+        assert not os.path.exists(req)
+        assert agent.evictions == 0   # the stale request was never honoured

@@ -1,0 +1,885 @@
+"""Self-healing training session tests (runtime/session.py +
+observability/faultinject.py + the goodput `recovery` bucket + the report
+CLI's resilience section).
+
+Policy/plumbing tests run against a fake engine with fake clocks — no
+sleeps, no devices. The real-engine smoke (8 virtual CPU devices, numerics
+sentinel on abort, NaN fault injected) exercises the acceptance loop:
+failure → detect → rollback → replay, with the post-recovery loss sequence
+bit-identical to a clean run restarted from the same checkpoint. The
+multi-process kill→shrink→resume end-to-end lives in TestChaosEndToEnd
+(slow marker; scripts/chaos.sh runs it as the CI chaos gate).
+"""
+
+import json
+import os
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.config.config import ResilienceConfig
+from deepspeed_tpu.observability import NumericsTrip
+from deepspeed_tpu.observability.faultinject import (Fault, FaultInjector,
+                                                     load_plan)
+from deepspeed_tpu.observability.metrics import MetricsRegistry
+from deepspeed_tpu.runtime.session import RecoveryExhausted, TrainingSession
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+class FakeEngine:
+    """Minimal engine surface for supervisor policy tests: step counter,
+    tag-addressed checkpoint state, scripted failures."""
+
+    def __init__(self, fail=None):
+        self.global_steps = 0
+        self.fail = dict(fail or {})   # step -> exception to raise once
+        self.params = {"w": 0.0}
+        self._tags = {}
+        self.loads = 0
+
+    def train_batch(self, batch=None):
+        exc = self.fail.pop(self.global_steps, None)
+        if exc is not None:
+            raise exc
+        self.global_steps += 1
+        return float(self.global_steps)
+
+    def save_checkpoint(self, save_dir, **kw):
+        tag = f"step{self.global_steps}"
+        self._tags[tag] = self.global_steps
+        os.makedirs(save_dir, exist_ok=True)
+        with open(os.path.join(save_dir, "latest"), "w") as fh:
+            fh.write(tag)
+        return os.path.join(save_dir, tag)
+
+    def load_checkpoint(self, load_dir, verify=False, **kw):
+        latest = os.path.join(load_dir, "latest")
+        if not os.path.exists(latest):
+            return None, {}
+        with open(latest) as fh:
+            tag = fh.read()
+        self.loads += 1
+        self.global_steps = self._tags[tag]
+        return load_dir, {"_checkpoint_tag": tag,
+                          "global_steps": self.global_steps}
+
+
+def make_session(tmp_path, engine, **cfg):
+    cfg.setdefault("checkpoint_every_steps", 2)
+    return TrainingSession(lambda: engine, lambda step: {"step": step},
+                           total_steps=8, save_dir=str(tmp_path),
+                           resilience=ResilienceConfig(**cfg))
+
+
+class TestPolicySelection:
+    def test_numerics_rollback(self, tmp_path):
+        eng = FakeEngine(fail={5: NumericsTrip("nan")})
+        s = make_session(tmp_path, eng)
+        out = s.run()
+        assert out["completed"] and out["rollbacks"] == 1
+        ev = out["recoveries"][0]
+        assert ev["kind"] == "numerics" and ev["policy"] == "rollback"
+        # rollback landed on the last cadence save before the failure
+        assert ev["failed_step"] == 5 and ev["resumed_step"] == 4
+        assert ev["tag"] == "step4"
+
+    def test_numerics_skip_continues_without_rollback(self, tmp_path):
+        eng = FakeEngine(fail={5: NumericsTrip("nan")})
+        s = make_session(tmp_path, eng, on_numerics="skip")
+        out = s.run()
+        assert out["completed"] and out["rollbacks"] == 0
+        assert out["recoveries"][0]["policy"] == "skip"
+        assert eng.loads == 0
+
+    def test_numerics_raise(self, tmp_path):
+        eng = FakeEngine(fail={5: NumericsTrip("nan")})
+        s = make_session(tmp_path, eng, on_numerics="raise")
+        with pytest.raises(NumericsTrip):
+            s.run()
+
+    def test_crash_raises_by_default(self, tmp_path):
+        eng = FakeEngine(fail={3: RuntimeError("boom")})
+        s = make_session(tmp_path, eng)
+        with pytest.raises(RuntimeError, match="boom"):
+            s.run()
+
+    def test_crash_rollback_when_configured(self, tmp_path):
+        eng = FakeEngine(fail={3: RuntimeError("boom")})
+        s = make_session(tmp_path, eng, on_crash="rollback")
+        out = s.run()
+        assert out["completed"]
+        assert out["recoveries"][0]["kind"] == "crash"
+
+    def test_rollback_budget_exhausted(self, tmp_path):
+        eng = FakeEngine()
+        # persistent failure: every attempt at step 3 trips again
+        orig = eng.train_batch
+
+        def always_fail(batch=None):
+            if eng.global_steps == 3:
+                raise NumericsTrip("sticky nan")
+            return orig(batch)
+
+        eng.train_batch = always_fail
+        s = make_session(tmp_path, eng, max_rollbacks=2)
+        with pytest.raises(RecoveryExhausted) as ei:
+            s.run()
+        assert s.rollbacks == 2
+        assert isinstance(ei.value.__cause__, NumericsTrip)
+
+    def test_rollback_without_restore_point_reraises(self, tmp_path):
+        eng = FakeEngine(fail={1: NumericsTrip("nan")})
+        eng.save_checkpoint = lambda *a, **k: (_ for _ in ()).throw(
+            AssertionError("no saves in this test"))
+        s = TrainingSession(lambda: eng, lambda step: {}, total_steps=4,
+                            save_dir=str(tmp_path),
+                            resilience=ResilienceConfig())
+        s._wire(eng)
+        with pytest.raises(NumericsTrip):
+            s._rollback("numerics", NumericsTrip("nan"))
+
+    def test_resume_from_existing_checkpoint(self, tmp_path):
+        eng = FakeEngine()
+        s = make_session(tmp_path, eng)
+        s.run()
+        eng2 = FakeEngine()
+        eng2._tags = dict(eng._tags)
+        s2 = make_session(tmp_path, eng2)
+        out = s2.run()
+        # nothing to do: resumed at step 8 == total
+        assert out["completed"] and eng2.global_steps == 8
+        assert eng2.loads == 1
+
+    def test_recovery_metrics_published(self, tmp_path):
+        eng = FakeEngine(fail={5: NumericsTrip("nan")})
+        s = make_session(tmp_path, eng)
+        reg = MetricsRegistry()
+        s._registry = lambda: reg
+        s.run()
+        events = reg.counter("resilience/recovery_events").series()
+        assert sum(events.values()) == 1
+        (labels,) = events.keys()
+        assert dict(labels)["kind"] == "numerics"
+        assert dict(labels)["policy"] == "rollback"
+        assert sum(reg.counter(
+            "resilience/recovery_seconds").series().values()) >= 0
+
+
+class TestHangEscalation:
+    class _Hang:
+        def __init__(self):
+            self.abort = False
+            self.abort_after_fires = 1
+            self.fired = 0
+
+    class _Obs:
+        def __init__(self):
+            self.hang = TestHangEscalation._Hang()
+            self.fleet = None
+            self.recorder = None
+            from deepspeed_tpu.observability.metrics import MetricsRegistry
+            self.registry = MetricsRegistry()
+
+        def span(self, name, **kw):
+            from deepspeed_tpu.observability.spans import SpanTracer
+            return SpanTracer(enabled=False).span(name)
+
+    def test_wire_sets_escalation_ladder(self, tmp_path):
+        eng = FakeEngine()
+        eng._obs = self._Obs()
+        s = make_session(tmp_path, eng, hang_soft_restarts=2)
+        s._wire(eng)
+        assert eng._obs.hang.abort is True
+        assert eng._obs.hang.abort_after_fires == 3
+
+    def test_fire_triggers_soft_restart_on_return(self, tmp_path):
+        """A watchdog fire during a step that EVENTUALLY returns control is
+        remediated by an in-process engine rebuild + reload at the next
+        loop iteration (the dump→soft-restart rungs of the ladder)."""
+        obs = self._Obs()
+        first = FakeEngine()
+        first._obs = obs
+        fresh = FakeEngine()
+        fresh._obs = obs
+        built = []
+
+        def factory():
+            eng = first if not built else fresh
+            eng._tags = dict(first._tags)   # share the checkpoint store
+            built.append(eng)
+            return eng
+
+        orig = FakeEngine.train_batch
+
+        def slow_step(batch=None):
+            if first.global_steps == 3 and obs.hang.fired == 0:
+                obs.hang.fired += 1   # the watchdog fired mid-stall...
+            return orig(first, batch)  # ...but the step returned
+
+        first.train_batch = slow_step
+        s = TrainingSession(factory, lambda step: {}, total_steps=6,
+                            save_dir=str(tmp_path),
+                            resilience=ResilienceConfig(
+                                checkpoint_every_steps=2))
+        out = s.run()
+        assert out["soft_restarts"] == 1 and out["completed"]
+        ev = [r for r in out["recoveries"] if r["policy"] == "soft_restart"]
+        assert ev and ev[0]["kind"] == "hang"
+        assert built == [first, fresh]   # the rebuild used the factory
+        assert fresh.global_steps == 6   # the fresh engine finished the run
+
+    def test_soft_restart_budget_escalates(self, tmp_path):
+        """Each rebuild installs a FRESH watchdog, so the ladder's hard rung
+        is enforced session-side: past hang_soft_restarts the session
+        raises RecoveryExhausted (worker exits nonzero → agent restart)."""
+        obs = self._Obs()
+        engines = []
+
+        def factory():
+            eng = FakeEngine()
+            eng._obs = obs
+            if engines:
+                eng._tags = dict(engines[0]._tags)
+            engines.append(eng)
+            return eng
+
+        s = TrainingSession(factory, lambda step: {}, total_steps=64,
+                            save_dir=str(tmp_path),
+                            resilience=ResilienceConfig(
+                                checkpoint_every_steps=2,
+                                hang_soft_restarts=1))
+        s._wire(factory())
+        s._resume(s.engine)
+        s.engine.save_checkpoint(str(tmp_path))
+        s._soft_restart()               # rung 1: within budget
+        assert s.soft_restarts == 1
+        with pytest.raises(RecoveryExhausted, match="soft-restart budget"):
+            s._soft_restart()           # rung 2: escalate to the agent
+        assert s.soft_restarts == 1
+
+
+class TestStragglerEviction:
+    class _Fleet:
+        def __init__(self, rank=0, world=8):
+            self.rank, self.world = rank, world
+            self.on_straggler = None
+
+    class _Obs:
+        def __init__(self, fleet):
+            self.hang = None
+            self.fleet = fleet
+            self.recorder = None
+            self.registry = MetricsRegistry()
+
+        def span(self, name, **kw):
+            from deepspeed_tpu.observability.spans import SpanTracer
+            return SpanTracer(enabled=False).span(name)
+
+    def _session(self, tmp_path, fleet, **cfg):
+        eng = FakeEngine()
+        eng._obs = self._Obs(fleet)
+        cfg.setdefault("straggler_patience", 2)
+        s = make_session(tmp_path, eng, **cfg)
+        s._wire(eng)
+        return s
+
+    def test_patience_then_request(self, tmp_path, monkeypatch):
+        agent_dir = tmp_path / "agent"
+        agent_dir.mkdir()
+        monkeypatch.setenv("DSTPU_AGENT_DIR", str(agent_dir))
+        fleet = self._Fleet()
+        s = self._session(tmp_path, fleet)
+        fleet.on_straggler(3, {"step": 10, "step_time_s": 0.9,
+                               "fleet_median_s": 0.1})
+        assert not (agent_dir / "evict.json").exists()   # patience 2
+        fleet.on_straggler(3, {"step": 20, "step_time_s": 0.9,
+                               "fleet_median_s": 0.1})
+        req = json.loads((agent_dir / "evict.json").read_text())
+        assert req["rank"] == 3 and "straggler" in req["reason"]
+        assert s.evictions_requested == 1
+        # once per incarnation
+        fleet.on_straggler(3, {"step": 30})
+        assert s.evictions_requested == 1
+
+    def test_streak_resets_on_different_rank(self, tmp_path, monkeypatch):
+        agent_dir = tmp_path / "agent"
+        agent_dir.mkdir()
+        monkeypatch.setenv("DSTPU_AGENT_DIR", str(agent_dir))
+        fleet = self._Fleet()
+        s = self._session(tmp_path, fleet)
+        fleet.on_straggler(3, {"step": 10})
+        fleet.on_straggler(5, {"step": 20})
+        fleet.on_straggler(3, {"step": 30})
+        assert not (agent_dir / "evict.json").exists()
+        assert s.evictions_requested == 0
+
+    def test_min_world_floor_blocks_eviction(self, tmp_path, monkeypatch):
+        agent_dir = tmp_path / "agent"
+        agent_dir.mkdir()
+        monkeypatch.setenv("DSTPU_AGENT_DIR", str(agent_dir))
+        fleet = self._Fleet(world=4)
+        s = self._session(tmp_path, fleet, min_world=4)
+        for step in (10, 20, 30):
+            fleet.on_straggler(2, {"step": step})
+        assert not (agent_dir / "evict.json").exists()
+        assert s.evictions_requested == 0
+
+    def test_only_rank0_writes(self, tmp_path, monkeypatch):
+        agent_dir = tmp_path / "agent"
+        agent_dir.mkdir()
+        monkeypatch.setenv("DSTPU_AGENT_DIR", str(agent_dir))
+        fleet = self._Fleet(rank=1)
+        s = self._session(tmp_path, fleet)
+        for step in (10, 20):
+            fleet.on_straggler(3, {"step": step})
+        assert not (agent_dir / "evict.json").exists()
+
+
+class TestFaultInjector:
+    def test_plan_parsing(self, tmp_path):
+        plan = load_plan('[{"kind": "rank_kill", "step": 3, "rank": 2}]')
+        assert plan[0].kind == "rank_kill" and plan[0].restart == 0
+        p = tmp_path / "plan.json"
+        p.write_text('[{"kind": "straggle", "step": 1, "sleep_s": 0.5}]')
+        plan = load_plan(f"@{p}")
+        assert plan[0].sleep_s == 0.5
+        with pytest.raises(ValueError, match="unknown fault kind"):
+            load_plan('[{"kind": "meteor", "step": 1}]')
+        with pytest.raises(ValueError, match="unknown keys"):
+            load_plan('[{"kind": "rank_kill", "step": 1, "zap": true}]')
+
+    def test_from_env(self, monkeypatch):
+        monkeypatch.delenv("DSTPU_FAULT_PLAN", raising=False)
+        assert FaultInjector.from_env() is None
+        monkeypatch.setenv("DSTPU_FAULT_PLAN",
+                           '[{"kind": "rank_kill", "step": 2, "rank": 1}]')
+        monkeypatch.setenv("RANK", "1")
+        monkeypatch.setenv("DSTPU_RESTART_COUNT", "0")
+        inj = FaultInjector.from_env()
+        assert inj is not None and inj.rank == 1 and inj.restart == 0
+
+    def test_rank_kill_targets_step_rank_restart(self):
+        kills = []
+        inj = FaultInjector(
+            plan=[Fault(kind="rank_kill", step=3, rank=2, restart=0)],
+            rank=2, restart=0, kill_fn=lambda: kills.append(True))
+        inj.before_step(2)
+        assert not kills
+        inj.before_step(3)
+        assert len(kills) == 1
+        # wrong rank / wrong incarnation never fire
+        for rank, restart in ((1, 0), (2, 1)):
+            other = FaultInjector(
+                plan=[Fault(kind="rank_kill", step=3, rank=2, restart=0)],
+                rank=rank, restart=restart,
+                kill_fn=lambda: kills.append(True))
+            other.before_step(3)
+        assert len(kills) == 1
+
+    def test_straggle_sleeps_for_duration(self):
+        sleeps = []
+        inj = FaultInjector(
+            plan=[Fault(kind="straggle", step=2, rank=0, sleep_s=0.25,
+                        steps=3)],
+            rank=0, restart=0, sleep_fn=sleeps.append)
+        for step in range(7):
+            inj.before_step(step)
+        assert sleeps == [0.25, 0.25, 0.25]
+        assert inj.applied[0]["kind"] == "straggle"
+
+    def test_nan_params_poisons_first_float_leaf(self):
+        import jax.numpy as jnp
+
+        class E:
+            params = {"a": jnp.ones((4,), jnp.int32),
+                      "b": jnp.ones((2, 2), jnp.float32),
+                      "c": jnp.ones((3,), jnp.float32)}
+
+        eng = E()
+        inj = FaultInjector(plan=[Fault(kind="nan_params", step=1, rank=0)],
+                            rank=0, restart=0)
+        inj.before_step(1, engine=eng)
+        assert np.isnan(np.asarray(eng.params["b"])).all()
+        assert np.isfinite(np.asarray(eng.params["c"])).all()
+        assert np.asarray(eng.params["a"]).sum() == 4   # int leaf untouched
+
+    def test_ckpt_truncate_maims_latest_tag(self, tmp_path, devices8):
+        import jax.numpy as jnp
+        from jax.sharding import (Mesh, NamedSharding, PartitionSpec as P)
+
+        from deepspeed_tpu.runtime.checkpoint import (save_checkpoint,
+                                                      verify_checkpoint)
+
+        mesh = Mesh(np.array(devices8), ("data",))
+        params = {"w": jax.device_put(jnp.ones((8, 8)),
+                                      NamedSharding(mesh, P("data", None)))}
+        save_checkpoint(str(tmp_path), "t1", params)
+        inj = FaultInjector(
+            plan=[Fault(kind="ckpt_truncate", step=0, rank=0)],
+            rank=0, restart=0)
+        inj.after_save(str(tmp_path))
+        assert verify_checkpoint(str(tmp_path), "t1")   # problems found
+        assert inj.applied[0]["kind"] == "ckpt_truncate"
+        # one-shot: a second save is not re-maimed
+        save_checkpoint(str(tmp_path), "t2", params)
+        inj.after_save(str(tmp_path))
+        assert not verify_checkpoint(str(tmp_path), "t2")
+
+
+class TestGoodputRecoveryBucket:
+    def _accountant(self):
+        from deepspeed_tpu.observability.goodput import GoodputAccountant
+
+        t = [1000.0]
+        acc = GoodputAccountant(registry=MetricsRegistry(),
+                                clock=lambda: t[-1])
+        return acc, t
+
+    def test_recovery_span_swallows_nested_buckets(self):
+        acc, _ = self._accountant()
+        # a normal checkpoint span -> checkpoint bucket
+        acc.on_span("begin", "checkpoint/save", 10.0)
+        acc.on_span("end", "checkpoint/save", 12.0, dur_s=2.0)
+        # a rollback: recovery span with the reload's checkpoint span inside
+        acc.on_span("begin", "recovery/rollback", 20.0)
+        acc.on_span("begin", "checkpoint/load", 20.5)
+        acc.on_span("end", "checkpoint/load", 23.5, dur_s=3.0)
+        acc.on_compile(1.0, where="train_batch/dispatch")
+        acc.on_span("end", "recovery/rollback", 25.0, dur_s=5.0)
+        tot = acc.totals()
+        assert tot["buckets"]["recovery"] == pytest.approx(5.0)
+        assert tot["buckets"]["checkpoint"] == pytest.approx(2.0)
+        # the nested load + compile were swallowed, not double-bucketed
+        assert tot["buckets"]["recompile"] == pytest.approx(0.0)
+        assert sum(tot["buckets"].values()) == pytest.approx(tot["wall_s"])
+
+    def test_bucket_sums_equal_wall_with_recovery_between_steps(self):
+        acc, _ = self._accountant()
+        acc.on_span("begin", "train_batch", 0.0)
+        acc.on_span("begin", "train_batch/dispatch", 0.1)
+        acc.on_span("end", "train_batch/dispatch", 0.9, dur_s=0.8)
+        acc.on_span("end", "train_batch", 1.0, dur_s=1.0)
+        acc.on_span("begin", "recovery/rollback", 1.2)
+        acc.on_span("end", "recovery/rollback", 2.2, dur_s=1.0)
+        acc.on_span("begin", "train_batch", 2.5)
+        acc.on_span("begin", "train_batch/dispatch", 2.6)
+        acc.on_span("end", "train_batch/dispatch", 3.4, dur_s=0.8)
+        acc.on_span("end", "train_batch", 3.5, dur_s=1.0)
+        tot = acc.totals()
+        assert tot["buckets"]["recovery"] == pytest.approx(1.0)
+        # the recovery second is NOT re-counted as input_wait in the
+        # inter-step gap (only the 0.2s + 0.3s of unattributed gap is)
+        assert tot["buckets"]["input_wait"] == pytest.approx(0.5)
+        assert sum(tot["buckets"].values()) == pytest.approx(tot["wall_s"])
+        assert tot["steps"] == 2
+
+    def test_recovery_in_buckets_constant(self):
+        from deepspeed_tpu.observability.goodput import BUCKETS
+
+        assert "recovery" in BUCKETS
+
+    def test_step_span_ending_inside_recovery_keeps_gap_math(self):
+        """A step span whose end lands inside a recovery region must still
+        reset the in-step flag, or input_wait attribution wedges for the
+        rest of the run."""
+        acc, _ = self._accountant()
+        acc.on_span("begin", "train_batch", 0.0)
+        acc.on_span("begin", "recovery/rollback", 0.5)
+        acc.on_span("end", "train_batch", 0.9, dur_s=0.9)   # swallowed end
+        acc.on_span("end", "recovery/rollback", 1.5, dur_s=1.0)
+        acc.on_span("begin", "train_batch", 2.0)
+        acc.on_span("end", "train_batch", 3.0, dur_s=1.0)
+        tot = acc.totals()
+        assert tot["steps"] == 2
+        # gap 0.9→2.0 minus the 1.0s recovery tail = 0.1s of input wait
+        assert tot["buckets"]["input_wait"] == pytest.approx(0.1)
+        assert sum(tot["buckets"].values()) == pytest.approx(tot["wall_s"])
+
+
+class TestReportResilience:
+    def _records(self):
+        return [
+            {"type": "counter", "name": "resilience/recovery_events",
+             "labels": {"kind": "numerics", "policy": "rollback"},
+             "value": 2},
+            {"type": "counter", "name": "resilience/recovery_events",
+             "labels": {"kind": "hang", "policy": "soft_restart"},
+             "value": 1},
+            {"type": "counter", "name": "resilience/recovery_seconds",
+             "labels": {}, "value": 4.5},
+            {"type": "gauge", "name": "resilience/last_recovery_s",
+             "labels": {}, "value": 1.5},
+            {"type": "counter", "name": "resilience/evictions_requested",
+             "labels": {"rank": 3}, "value": 1},
+            {"type": "counter", "name": "resilience/faults_injected",
+             "labels": {"kind": "rank_kill"}, "value": 1},
+            {"type": "gauge", "name": "goodput/seconds",
+             "labels": {"bucket": "recovery"}, "value": 4.5},
+            {"type": "gauge", "name": "goodput/wall_seconds",
+             "labels": {}, "value": 45.0},
+            {"type": "gauge", "name": "goodput/goodput_fraction",
+             "labels": {}, "value": 0.8},
+        ]
+
+    def test_section_renders(self):
+        from deepspeed_tpu.observability.report import summarize_resilience
+
+        text = summarize_resilience(self._records())
+        assert "== resilience ==" in text
+        assert "numerics" in text and "rollback" in text
+        assert "soft_restart" in text
+        assert "eviction requests: 1" in text
+        assert "rank_kill=1" in text
+        assert "total=4.500s" in text and "mean=1.500s" in text
+        assert "recovery bucket 4.500s (10.0% of wall)" in text
+        assert "goodput_fraction = 0.8000" in text
+
+    def test_report_includes_section(self):
+        from deepspeed_tpu.observability.report import report
+
+        import tempfile
+
+        with tempfile.NamedTemporaryFile("w", suffix=".jsonl",
+                                         delete=False) as fh:
+            for r in self._records():
+                fh.write(json.dumps(r) + "\n")
+            path = fh.name
+        try:
+            out = report([path])
+            assert "== resilience ==" in out
+        finally:
+            os.unlink(path)
+
+    def test_absent_without_metrics(self):
+        from deepspeed_tpu.observability.report import summarize_resilience
+
+        assert summarize_resilience([{"type": "gauge", "name": "goodput/mfu",
+                                      "labels": {}, "value": 0.5}]) == ""
+
+
+class TestElasticEnvOverrides:
+    def test_noop_without_env(self):
+        from deepspeed_tpu.config import Config
+        from deepspeed_tpu.elasticity import apply_elastic_env_overrides
+
+        cfg = Config(train_batch_size=16,
+                     train_micro_batch_size_per_gpu=2)
+        out = apply_elastic_env_overrides(cfg, env={})
+        assert out is cfg
+
+    def test_override_replaces_micro_and_clears_gas(self):
+        from deepspeed_tpu.config import Config
+        from deepspeed_tpu.elasticity import apply_elastic_env_overrides
+
+        cfg = Config(train_batch_size=16, train_micro_batch_size_per_gpu=2,
+                     gradient_accumulation_steps=1)
+        out = apply_elastic_env_overrides(
+            cfg, env={"DSTPU_ELASTIC_MICRO": "4"})
+        assert out.train_micro_batch_size_per_gpu == 4
+        assert out.gradient_accumulation_steps == 0
+        assert out.train_batch_size == 16
+        # the engine's triad resolution now derives gas for the new world
+        assert out.resolve_batch_sizes(2).gradient_accumulation_steps == 2
+
+    def test_micro_gas_config_preserves_global_batch_via_batch_env(self):
+        """A config expressing its batch as micro+gas (no train_batch_size)
+        must still preserve the GLOBAL batch across a shrink — the agent
+        ships it as DSTPU_ELASTIC_BATCH."""
+        from deepspeed_tpu.config import Config
+        from deepspeed_tpu.elasticity import apply_elastic_env_overrides
+
+        cfg = Config(train_micro_batch_size_per_gpu=4,
+                     gradient_accumulation_steps=8)
+        out = apply_elastic_env_overrides(
+            cfg, env={"DSTPU_ELASTIC_MICRO": "2",
+                      "DSTPU_ELASTIC_BATCH": "48"})
+        assert out.train_batch_size == 48
+        assert out.resolve_batch_sizes(6).gradient_accumulation_steps == 4
+        # without the batch env and no tb, the override cannot preserve the
+        # global batch: it must refuse rather than silently shrink it
+        out2 = apply_elastic_env_overrides(
+            cfg, env={"DSTPU_ELASTIC_MICRO": "2"})
+        assert out2 is cfg
+
+    def test_agent_exports_elastic_batch_env(self, tmp_path):
+        from deepspeed_tpu.launcher.elastic_agent import (ElasticAgent,
+                                                          ElasticAgentConfig)
+
+        elastic = {"elasticity": {
+            "enabled": True, "max_train_batch_size": 48,
+            "micro_batch_sizes": [2], "min_gpus": 1, "max_gpus": 8,
+            "version": 0.1}}
+        probe = tmp_path / "p.py"
+        probe.write_text(
+            "import json, os, sys\n"
+            "with open(sys.argv[1], 'w') as fh:\n"
+            "    fh.write(json.dumps({'b': os.environ['DSTPU_ELASTIC_BATCH'],"
+            " 'm': os.environ['DSTPU_ELASTIC_MICRO']}))\n")
+        out = tmp_path / "env.json"
+        agent = ElasticAgent(
+            [sys.executable, str(probe), str(out)], nprocs=8,
+            config=ElasticAgentConfig(master_port=29557,
+                                      monitor_interval=0.05,
+                                      elastic_config=elastic))
+        assert agent.run() == 0
+        env = json.loads(out.read_text())
+        assert env == {"b": "48", "m": "2"}
+
+
+import jax  # noqa: E402  (after the conftest env setup)
+
+
+class TestSessionEngineSmoke:
+    """The in-process half of the chaos acceptance: a supervised session on
+    the 8-device CPU mesh survives an injected NaN step via sentinel-abort →
+    rollback, and the post-recovery losses are bit-identical to a clean run
+    restarted from the same checkpoint."""
+
+    def _build(self, tmp_path, obs_dir, inj=None, numerics=True):
+        import deepspeed_tpu as ds
+        from deepspeed_tpu.models.transformer import (TransformerConfig,
+                                                      build_model)
+
+        model = build_model(TransformerConfig(
+            vocab_size=64, hidden_size=16, num_layers=2, num_heads=2,
+            max_seq_len=16))
+        cfg = {
+            "train_micro_batch_size_per_gpu": 1, "steps_per_print": 1000,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+            "observability": {
+                "enabled": True, "output_dir": str(obs_dir),
+                "numerics_sentinel": numerics, "numerics_action": "abort",
+                "numerics_check_steps": 1},
+            "resilience": {"checkpoint_every_steps": 2, "max_rollbacks": 2},
+        }
+        return ds, model, cfg
+
+    @staticmethod
+    def _data_fn(step):
+        r = np.random.default_rng(1234 + step)
+        return {"input_ids": r.integers(0, 64, (1, 8, 16))}
+
+    def test_nan_rollback_bit_continuity(self, tmp_path):
+        ds, model, cfg = self._build(tmp_path / "ck", tmp_path / "obs")
+        inj = FaultInjector(
+            plan=[Fault(kind="nan_params", step=5, rank=0)],
+            rank=0, restart=0)
+        steps = []
+        out = ds.run_training_session(
+            model=model, config=cfg, data_fn=self._data_fn, total_steps=8,
+            save_dir=str(tmp_path / "ck"), injector=inj,
+            on_step=lambda step, loss: steps.append((step, loss)))
+        from deepspeed_tpu.observability import get_session, reset_session
+
+        try:
+            assert out["completed"] and out["rollbacks"] == 1
+            ev = out["recoveries"][0]
+            assert ev["kind"] == "numerics" and ev["policy"] == "rollback"
+            assert ev["tag"] == "global_step4"
+            assert all(np.isfinite(l) for _, l in steps)
+            # goodput: the lost time landed in `recovery`; sums == wall
+            tot = get_session().goodput.totals()
+            assert tot["buckets"]["recovery"] > 0
+            assert sum(tot["buckets"].values()) == pytest.approx(
+                tot["wall_s"])
+            # the report CLI surfaces the event
+            mpath = get_session().dump_metrics(
+                str(tmp_path / "metrics.jsonl"))
+            from deepspeed_tpu.observability.report import report
+
+            text = report([mpath])
+            assert "== resilience ==" in text
+            assert "numerics" in text and "rollback" in text
+        finally:
+            reset_session()
+
+        # control: a fresh engine restarted from the SAME checkpoint the
+        # rollback used, replaying the same data — bit-identical losses
+        chaos_after = [(s, l) for s, l in steps[-4:]]   # steps 4..7 replayed
+        assert [s for s, _ in chaos_after] == [4, 5, 6, 7]
+        engine, *_ = ds.initialize(model=model, config={
+            "train_micro_batch_size_per_gpu": 1, "steps_per_print": 1000,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-3}}})
+        try:
+            engine.load_checkpoint(str(tmp_path / "ck"), tag="global_step4",
+                                   verify=True)
+            assert engine.global_steps == 4
+            control = []
+            while engine.global_steps < 8:
+                step = engine.global_steps
+                control.append(
+                    (step,
+                     float(engine.train_batch(batch=self._data_fn(step)))))
+            assert control == chaos_after   # BIT-identical, not allclose
+        finally:
+            reset_session()
+
+
+@pytest.mark.slow
+class TestChaosEndToEnd:
+    """kill → shrink → re-rendezvous → resume end-to-end, driven through the
+    real ElasticAgent + run_training_session on an 8-process CPU mesh. The
+    fault plan (DSTPU_FAULT_PLAN, exactly as scripts/chaos.sh passes it)
+    SIGKILLs rank 2 at step 3 of incarnation 0; the agent shrinks
+    membership 8→6 through the elastic batch math (DSTPU_ELASTIC_MICRO
+    recomputed, global batch preserved) and the respawned sessions resume
+    from their latest checkpoints. A control run (6 processes, no faults)
+    restarted from a snapshot of the same restore point must produce a
+    BIT-identical post-recovery loss sequence.
+
+    NOTE: this container's jaxlib cannot compile cross-process SPMD
+    programs on the CPU backend ("Multiprocess computations aren't
+    implemented"), so — like the seed's elastic-agent test — each worker
+    runs an independent single-device engine with a per-rank checkpoint
+    dir; the supervision loop (agent, fault plan, kill, shrink, elastic
+    micro recompute, per-rank resume, bit-continuity) is fully real."""
+
+    WORKER = textwrap.dedent("""
+        import json, os, shutil, sys
+        sys.path.insert(0, %r)
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        import numpy as np
+        import deepspeed_tpu as ds
+        from deepspeed_tpu.models.transformer import (TransformerConfig,
+                                                      build_model)
+
+        ckpt_root, log_path, total = (sys.argv[1], sys.argv[2],
+                                      int(sys.argv[3]))
+        ctrl_copy = os.environ.get("CHAOS_CTRL_COPY", "")
+        rank = int(os.environ["RANK"])
+        world = int(os.environ["WORLD_SIZE"])
+        restart = int(os.environ.get("DSTPU_RESTART_COUNT", "0"))
+        ckpt = os.path.join(ckpt_root, f"rank{rank}")
+        # per-rank independent engines (this container cannot compile
+        # cross-process SPMD on CPU): the fleet-level global batch does
+        # not apply — keep the local micro-only batch
+        os.environ.pop("DSTPU_ELASTIC_BATCH", None)
+        if ctrl_copy and restart == 1 and os.path.isdir(ckpt):
+            # snapshot MY restore point before the engine touches it — the
+            # control run replays from this exact state (each rank copies
+            # only its own quiescent dir: no cross-process races)
+            dst = os.path.join(ctrl_copy, f"rank{rank}")
+            if not os.path.isdir(dst):
+                shutil.copytree(ckpt, dst)
+
+        def data_fn(step):
+            # pure function of (step, rank): replay after resume — and the
+            # control run — feeds bit-identical data
+            r = np.random.default_rng(777 + 1000 * rank + step)
+            return {"input_ids": r.integers(0, 64, (1, 2, 16))}
+
+        def on_step(step, loss):
+            # append-per-step so a SIGKILL loses nothing already logged
+            with open(log_path, "a") as fh:
+                fh.write(json.dumps({
+                    "rank": rank, "restart": restart, "world": world,
+                    "micro": os.environ.get("DSTPU_ELASTIC_MICRO"),
+                    "step": step, "loss": repr(loss)}) + chr(10))
+
+        model = build_model(TransformerConfig(
+            vocab_size=64, hidden_size=16, num_layers=2, num_heads=2,
+            max_seq_len=16))
+        out = ds.run_training_session(
+            model=model,
+            config={"train_micro_batch_size_per_gpu": 2,
+                    "steps_per_print": 1000,
+                    "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+                    "resilience": {"checkpoint_every_steps": 1}},
+            data_fn=data_fn, total_steps=total, save_dir=ckpt,
+            on_step=on_step)
+        assert out["completed"], out
+        print("WORKER-DONE", rank, flush=True)
+        sys.stdout.flush()
+        os._exit(0)   # skip interpreter teardown: a jax atexit segfault
+        #   would read as a worker failure and trigger a spurious restart
+    """ % REPO)
+
+    # batch 48 / micro 2 => 24 replicas, valid worlds {1,2,3,4,6,8}: the
+    # shrink from 8 (min_workers=4) lands on 6, the largest valid below 8
+    ELASTIC = {"elasticity": {
+        "enabled": True, "max_train_batch_size": 48,
+        "micro_batch_sizes": [2], "min_gpus": 1, "max_gpus": 8,
+        "version": 0.1}}
+
+    def _agent(self, script, args, nprocs, port, env=None, plan=None):
+        from deepspeed_tpu.launcher.elastic_agent import (ElasticAgent,
+                                                          ElasticAgentConfig)
+
+        env_base = {"PYTHONPATH": REPO, "JAX_PLATFORMS": "cpu",
+                    "XLA_FLAGS": "--xla_force_host_platform_device_count=1"}
+        if env:
+            env_base.update(env)
+        if plan is not None:
+            env_base["DSTPU_FAULT_PLAN"] = json.dumps(plan)
+        return ElasticAgent(
+            [sys.executable, str(script)] + [str(a) for a in args],
+            nprocs=nprocs,
+            config=ElasticAgentConfig(
+                max_restarts=2, min_workers=4, master_port=port,
+                monitor_interval=0.05, backoff_base_s=0.05,
+                elastic_config=self.ELASTIC),
+            env_base=env_base)
+
+    def test_kill_shrink_resume_bit_continuity(self, tmp_path):
+        script = tmp_path / "worker.py"
+        script.write_text(self.WORKER)
+        ckpt, log = tmp_path / "ck", tmp_path / "chaos.jsonl"
+        ctrl = tmp_path / "ck_ctrl"
+        ctrl.mkdir()
+        total = 6
+        agent = self._agent(
+            script, [ckpt, log, total], nprocs=8, port=29560,
+            env={"CHAOS_CTRL_COPY": str(ctrl)},
+            plan=[{"kind": "rank_kill", "step": 3, "rank": 2,
+                   "restart": 0}])
+        rc = agent.run()
+        assert rc == 0
+        # >= / in: tolerate ONE unrelated spurious worker crash adding an
+        # extra restart (CPU-jax teardown flakes) — the recovery story
+        # below (shrink, resume continuity, bit-identical control) is
+        # still asserted in full
+        assert agent.restart_count >= 1
+        assert agent._world in (6, 4)
+        lines = [json.loads(l) for l in log.read_text().splitlines()]
+        r0 = [l for l in lines if l["rank"] == 0]
+        inc0 = [l for l in r0 if l["restart"] == 0]
+        inc1 = [l for l in r0 if l["restart"] == 1]
+        post = [l for l in r0 if l["restart"] >= 1]
+        assert all(l["world"] == 8 for l in inc0)
+        assert all(l["world"] == 6 for l in inc1)
+        # the shrunken incarnation got the recomputed elastic micro batch
+        assert all(l["micro"] == "2" for l in inc1)
+        # incarnation 0 died around the rank-2 kill at step 3; incarnation
+        # 1 RESUMED from rank 0's last committed checkpoint, not step 0.
+        # (The group teardown races rank 0's own post-step save: resume is
+        # at the last logged step when the save did not commit, or one
+        # past it when it did.)
+        # (no `>= 3` floor: ranks are NOT lockstepped here — rank 2 can hit
+        # its step-3 kill while rank 0 is still mid-step-2/3, so rank 0's
+        # resume point is whatever ITS last commit covered)
+        assert inc1[0]["step"] in (inc0[-1]["step"], inc0[-1]["step"] + 1)
+        assert post[-1]["step"] == total - 1
+
+        # control: a clean 6-process run restarted from the snapshot the
+        # post-kill incarnation took of its own restore point
+        log2 = tmp_path / "control.jsonl"
+        assert (ctrl / "rank0").is_dir(), "control snapshot was not taken"
+        agent2 = self._agent(script, [ctrl, log2, total], nprocs=6,
+                             port=29575)
+        assert agent2.run() == 0
+        ctrl_lines = [json.loads(l) for l in log2.read_text().splitlines()]
+        ctrl_r0 = {l["step"]: l["loss"] for l in ctrl_lines
+                   if l["rank"] == 0}
+        # by-step map over ALL post-kill incarnations: replays re-log the
+        # same step with (asserted below) identical losses
+        chaos_r0 = {l["step"]: l["loss"] for l in post}
+        assert set(chaos_r0) == set(ctrl_r0), (chaos_r0, ctrl_r0)
+        for step, loss in chaos_r0.items():
+            assert loss == ctrl_r0[step], (
+                f"step {step}: chaos {loss} != control {ctrl_r0[step]} — "
+                "post-recovery training is not bit-continuous")
