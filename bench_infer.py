@@ -14,11 +14,19 @@ dumped to BENCH_metrics_serve.jsonl. ``--paged-kernel on|off`` pins one
 read path; unset runs the A/B (Pallas paged kernels vs dense gather view)
 over the same trace plus a prefix-reuse workload (shared 1k-token system
 prompt, two rounds), recording the TTFT/TPOT deltas and each arm's tpucost
-arena-read bytes. Knobs (env): BENCH_SERVE_REQUESTS, BENCH_SERVE_RATE
-(req/s), BENCH_SERVE_PROMPT (max prompt len), BENCH_SERVE_NEW,
-BENCH_SERVE_ROWS, BENCH_SERVE_BLOCK, BENCH_SERVE_BLOCKS, BENCH_SERVE_LEN,
-BENCH_SERVE_CHUNK, BENCH_SERVE_SYS (shared-prefix len),
-BENCH_SERVE_PREFIX_REQS, BENCH_SERVE_PAGED_KERNEL (= the flag).
+arena-read bytes. ``--spec ngram|draft`` runs a speculative-decoding A/B
+instead (that drafter vs spec-off, SAME trace with a repetitive-text
+share): acceptance rate, proposed-vs-emitted tokens,
+emitted-per-target-dispatch, drafter time share, TTFT/TPOT deltas and the
+per-arm verify-program tpucost land in the record and
+BENCH_metrics_serve.jsonl. Knobs (env): BENCH_SERVE_REQUESTS,
+BENCH_SERVE_RATE (req/s), BENCH_SERVE_PROMPT (max prompt len),
+BENCH_SERVE_NEW, BENCH_SERVE_ROWS, BENCH_SERVE_BLOCK, BENCH_SERVE_BLOCKS,
+BENCH_SERVE_LEN, BENCH_SERVE_CHUNK, BENCH_SERVE_SYS (shared-prefix len),
+BENCH_SERVE_PREFIX_REQS, BENCH_SERVE_PAGED_KERNEL (= the flag),
+BENCH_SERVE_SPEC (= --spec), BENCH_SERVE_SPEC_K (draft tokens/iteration),
+BENCH_SERVE_DRAFT_MODEL (draft-arm model), BENCH_SERVE_REPEAT
+(repetitive-prompt fraction; default 0.5 when speculating, else 0).
 
 Decode is HBM-bandwidth-bound: the roofline is
     BW / (param_bytes + live-KV bytes per token);
@@ -242,8 +250,10 @@ def _configure_bench_obs():
 
 
 def _serve_one_mode(engine, scfg_kwargs, paged_kernel, prompts, arrivals,
-                    prefix_prompts, n_new, block, enable_obs=False):
-    """One A/B arm: build a ServingEngine with ``paged_kernel``, run the
+                    prefix_prompts, n_new, block, enable_obs=False,
+                    spec_mode="off", draft_engine=None):
+    """One A/B arm: build a ServingEngine with ``paged_kernel`` (and
+    optionally a speculative-decoding arm via ``spec_mode``), run the
     Poisson load, then the prefix-reuse workload (every request shares one
     long system prompt — round 2 should hit the prefix cache). Returns the
     arm's stats dict. ``enable_obs`` turns the observability session on
@@ -255,8 +265,14 @@ def _serve_one_mode(engine, scfg_kwargs, paged_kernel, prompts, arrivals,
     from deepspeed_tpu.serving import ServingConfig, ServingEngine
     from deepspeed_tpu.serving.api import _percentile as p
 
+    spec_cfg = {"mode": spec_mode}
+    if spec_mode != "off":
+        spec_cfg["num_draft_tokens"] = int(
+            os.environ.get("BENCH_SERVE_SPEC_K", 4))
     srv = ServingEngine(engine, ServingConfig(paged_kernel=paged_kernel,
-                                              **scfg_kwargs))
+                                              speculative=spec_cfg,
+                                              **scfg_kwargs),
+                        draft_engine=draft_engine)
     # warmup: compile the serving programs off the clock, BEFORE the
     # observability session exists
     srv.submit(prompts[0][: max(block, 8)], max_new_tokens=2).result()
@@ -279,6 +295,24 @@ def _serve_one_mode(engine, scfg_kwargs, paged_kernel, prompts, arrivals,
             srv.alloc.peak_in_use / srv.alloc.capacity, 4),
         "preemptions": srv.sched.preemption_count,
     }
+    if spec_mode != "off":
+        # the proposed-vs-emitted ledger: how many tokens each target
+        # dispatch actually bought (> 1 is the speculative win)
+        stats["spec"] = {
+            "mode": spec_mode,
+            "proposed_tokens": srv._spec_proposed,
+            "accepted_tokens": srv._spec_accepted,
+            "acceptance_rate": round(
+                srv._spec_accepted / max(srv._spec_proposed, 1), 4),
+            "emitted_tokens": srv._spec_emitted,
+            "verify_dispatches": srv._spec_dispatches,
+            "emitted_per_dispatch": round(
+                srv._spec_emitted / max(srv._spec_dispatches, 1), 3),
+            "draft_time_share": round(
+                srv._spec_draft_s
+                / max(srv._spec_draft_s + srv._spec_verify_s, 1e-9), 4),
+            "pressure_disabled_rows": srv._spec_disabled_rows,
+        }
     # prefix-reuse workload: round 1 populates the cache, round 2 (same
     # shared system prompt, fresh tails) should skip the shared chunks —
     # the TTFT ratio IS the prefix-sharing win
@@ -310,6 +344,13 @@ def _serve_one_mode(engine, scfg_kwargs, paged_kernel, prompts, arrivals,
         cost = cost_vector_record("serving/decode")
         if cost is not None:
             stats["tpucost"] = cost
+        if spec_mode != "off":
+            # the R×(K+1) verify program this arm actually dispatched —
+            # its static cost against the R×1 decode is the speculative
+            # FLOPs overhead the acceptance rate has to amortize
+            vcost = cost_vector_record("serving/verify")
+            if vcost is not None:
+                stats["tpucost_verify"] = vcost
     srv.close()
     return stats
 
@@ -342,6 +383,15 @@ def serving_main() -> None:
     ab_flag = os.environ.get("BENCH_SERVE_PAGED_KERNEL", "")
     # primary arm LAST: the observability session turns on just before it
     modes = {"on": ["auto"], "off": ["off"]}.get(ab_flag, ["off", "auto"])
+    spec_flag = os.environ.get("BENCH_SERVE_SPEC", "off")
+    if spec_flag not in ("off", "ngram", "draft"):
+        raise SystemExit("--spec must be 'off', 'ngram' or 'draft'")
+    if spec_flag != "off":
+        # the speculative A/B replaces the paged-kernel A/B: both spec
+        # arms run the SAME read path (primary) over the SAME trace
+        modes = modes[-1:]
+    repeat_frac = float(os.environ.get(
+        "BENCH_SERVE_REPEAT", 0.5 if spec_flag != "off" else 0.0))
 
     import jax.numpy as jnp
 
@@ -362,6 +412,13 @@ def serving_main() -> None:
         lens = rng.randint(max(prompt_max // 4, 1), prompt_max + 1,
                            size=n_requests)
         prompts = [rng.randint(0, cfg.vocab_size, (int(n),)) for n in lens]
+        # repetitive-text share (speculation workload: prompt-lookup and
+        # draft acceptance both feed on repeated structure) — same trace
+        # for every arm, so deltas are apples-to-apples
+        for i in range(int(round(repeat_frac * n_requests))):
+            pat = rng.randint(0, cfg.vocab_size, (rng.randint(4, 12),))
+            prompts[i] = np.tile(pat, -(-int(lens[i]) // pat.size)
+                                 )[:int(lens[i])]
         arrivals = np.cumsum(rng.exponential(1.0 / rate, size=n_requests))
         # prefix-reuse workload: a shared system prompt + short unique
         # tails, two rounds with DIFFERENT tails (only the prefix repeats)
@@ -383,13 +440,30 @@ def serving_main() -> None:
 
     obs_wanted = os.environ.get("BENCH_OBS", "1") == "1"
     arms = {}
-    for i, mode in enumerate(modes):
-        label = "on" if mode == "auto" else "off"
-        arms[label] = _serve_one_mode(engine, scfg_kwargs, mode, prompts,
-                                      arrivals, prefix_prompts, n_new,
-                                      block,
-                                      enable_obs=(obs_wanted
-                                                  and i == len(modes) - 1))
+    spec_arms = {}
+    if spec_flag != "off":
+        draft_engine = None
+        if spec_flag == "draft":
+            draft_name = os.environ.get("BENCH_SERVE_DRAFT_MODEL",
+                                        model_name)
+            draft_engine = init_inference(draft_name, dtype=dtype,
+                                          max_out_tokens=max_len)
+        # both speculative arms ride the primary read path over the SAME
+        # trace; the speculative arm runs LAST (it owns the obs session)
+        for i, sm in enumerate(["off", spec_flag]):
+            spec_arms[sm] = _serve_one_mode(
+                engine, scfg_kwargs, modes[0], prompts, arrivals,
+                prefix_prompts if sm == spec_flag else [], n_new, block,
+                enable_obs=(obs_wanted and i == 1), spec_mode=sm,
+                draft_engine=(draft_engine if sm == "draft" else None))
+        arms["on" if modes[0] == "auto" else "off"] = spec_arms[spec_flag]
+    else:
+        for i, mode in enumerate(modes):
+            label = "on" if mode == "auto" else "off"
+            arms[label] = _serve_one_mode(
+                engine, scfg_kwargs, mode, prompts, arrivals,
+                prefix_prompts, n_new, block,
+                enable_obs=(obs_wanted and i == len(modes) - 1))
 
     primary = arms.get("on") or arms["off"]
 
@@ -409,10 +483,27 @@ def serving_main() -> None:
         "unit": "ms",
         "vs_baseline": None,
         "paged_kernel": "on" if "on" in arms else "off",
+        "spec": spec_flag,
     }
     record.update({k: v for k, v in primary.items() if k != "tpucost"})
     if primary.get("tpucost") is not None:
         record["tpucost"] = primary["tpucost"]
+    if spec_arms:
+        off, on = spec_arms["off"], spec_arms[spec_flag]
+        ab = {"off": off, spec_flag: on,
+              "ttft_p50_delta_pct": round(
+                  100.0 * (off["p50_ttft_ms"] - on["p50_ttft_ms"])
+                  / max(off["p50_ttft_ms"], 1e-9), 2)}
+        if on.get("tpot_ms") and off.get("tpot_ms"):
+            # the speculative headline: TPOT bought per target dispatch
+            ab["tpot_delta_pct"] = round(
+                100.0 * (off["tpot_ms"] - on["tpot_ms"])
+                / max(off["tpot_ms"], 1e-9), 2)
+        if on.get("tpucost_verify") and off.get("tpucost"):
+            ab["verify_vs_decode_flops"] = {
+                "verify": on["tpucost_verify"].get("flops"),
+                "decode": off["tpucost"].get("flops")}
+        record["spec_ab"] = ab
     if len(arms) == 2:
         on, off = arms["on"], arms["off"]
         ab = {"on": on, "off": off,
@@ -441,9 +532,19 @@ if __name__ == "__main__":
             os.environ["BENCH_SERVE_PAGED_KERNEL"] = argv[i + 1]
         elif a.startswith("--paged-kernel="):
             os.environ["BENCH_SERVE_PAGED_KERNEL"] = a.split("=", 1)[1]
+        # --spec ngram|draft runs that speculative arm vs spec-off over
+        # the SAME Poisson trace (acceptance rate, proposed-vs-emitted,
+        # per-arm verify tpucost); 'off'/unset keeps speculation out
+        elif a == "--spec" and i + 1 < len(argv):
+            os.environ["BENCH_SERVE_SPEC"] = argv[i + 1]
+        elif a.startswith("--spec="):
+            os.environ["BENCH_SERVE_SPEC"] = a.split("=", 1)[1]
     if os.environ.get("BENCH_SERVE_PAGED_KERNEL", "") not in ("", "on",
                                                               "off"):
         raise SystemExit("--paged-kernel must be 'on' or 'off'")
+    if os.environ.get("BENCH_SERVE_SPEC", "off") not in ("off", "ngram",
+                                                         "draft"):
+        raise SystemExit("--spec must be 'off', 'ngram' or 'draft'")
     if os.environ.get("BENCH_PREDICT") == "1":
         predict_main()
     elif os.environ.get("BENCH_CHILD") == "1":

@@ -5,7 +5,8 @@ from .config import (AIOConfig, ActivationCheckpointingConfig, BF16Config,
                      ElasticityConfig, FlopsProfilerConfig, FP16Config,
                      MonitorConfig, OffloadOptimizerConfig, OffloadParamConfig,
                      OptimizerConfig, ParallelConfig, ResilienceConfig,
-                     SchedulerConfig, ServingConfig, ZeroConfig, load_config)
+                     SchedulerConfig, ServingConfig, SpeculativeConfig,
+                     ZeroConfig, load_config)
 
 __all__ = [
     "ConfigError", "ConfigModel", "Config", "load_config",
@@ -14,5 +15,6 @@ __all__ = [
     "ParallelConfig", "ActivationCheckpointingConfig", "CommsLoggerConfig",
     "FlopsProfilerConfig", "MonitorConfig", "ElasticityConfig",
     "CurriculumConfig", "DataEfficiencyConfig", "CompressionConfig",
-    "AIOConfig", "CheckpointConfig", "ServingConfig", "ResilienceConfig",
+    "AIOConfig", "CheckpointConfig", "ServingConfig", "SpeculativeConfig",
+    "ResilienceConfig",
 ]

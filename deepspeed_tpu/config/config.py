@@ -427,6 +427,46 @@ class ResilienceConfig(ConfigModel):
 
 
 @dataclass
+class SpeculativeConfig(ConfigModel):
+    """Speculative decoding (``deepspeed_tpu/serving/speculative.py``):
+    a drafter proposes up to ``num_draft_tokens`` continuation tokens per
+    request per iteration and the target model scores them all in ONE
+    R×(K+1) verify dispatch. Acceptance is lossless AND bit-stable: every
+    position samples with the request's (engine seed, request seed,
+    output-token-index) key — the exact key the non-speculative decode
+    would use — so speculation changes latency, never tokens.
+    ``num_draft_tokens`` is the only SHAPE parameter (the verify program's
+    token width); everything else — per-row proposal counts, acceptance
+    mixes, pressure-disabled rows — is data."""
+
+    mode: str = "off"                  # 'off' | 'ngram' | 'draft'
+    num_draft_tokens: int = 4          # K: verify program width is K+1
+    ngram_max: int = 3                 # prompt-lookup match length (tried
+    ngram_min: int = 1                 # longest-first down to ngram_min)
+    min_free_blocks: int = 0           # below this many free pool blocks,
+    #   no row proposes (global pressure guard); per-row disable is
+    #   automatic when a row's speculative block extension cannot be
+    #   allocated without preempting — speculation never preempts
+    draft_chunk: int = 0               # draft-model prefill catch-up chunk
+    #   (tokens); 0 => the serving prefill_chunk
+
+    def validate(self) -> None:
+        if self.mode not in ("off", "ngram", "draft"):
+            raise ConfigError("speculative.mode must be 'off', 'ngram' or "
+                              f"'draft', got '{self.mode}'")
+        if self.num_draft_tokens < 1:
+            raise ConfigError("speculative.num_draft_tokens must be >= 1")
+        if not 1 <= self.ngram_min <= self.ngram_max:
+            raise ConfigError(
+                f"speculative ngram lengths need 1 <= ngram_min "
+                f"({self.ngram_min}) <= ngram_max ({self.ngram_max})")
+        if self.min_free_blocks < 0:
+            raise ConfigError("speculative.min_free_blocks must be >= 0")
+        if self.draft_chunk < 0:
+            raise ConfigError("speculative.draft_chunk must be >= 0")
+
+
+@dataclass
 class ServingConfig(ConfigModel):
     """Continuous-batching serving layer (``deepspeed_tpu/serving``) — the
     MII/FastGen analog: paged KV arena + iteration-level scheduler +
@@ -464,6 +504,10 @@ class ServingConfig(ConfigModel):
     #   sharing: cached full blocks join a new request's table by refcount
     #   (copy-on-write on first divergent write) and their prefill chunks
     #   are skipped entirely
+    speculative: SpeculativeConfig = field(
+        default_factory=SpeculativeConfig)  # draft/verify speculative
+    #   decoding over the same arena; 'draft' mode additionally needs a
+    #   draft model passed to ServingEngine/init_serving
 
     def blocks_per_seq(self) -> int:
         return self.max_model_len // self.block_size
@@ -474,6 +518,11 @@ class ServingConfig(ConfigModel):
                 else self.max_seqs * self.blocks_per_seq())
 
     def validate(self) -> None:
+        if isinstance(self.speculative, dict):
+            # direct-constructor convenience: ServingConfig(speculative=
+            # {"mode": "ngram"}) — from_dict coerces nested configs, the
+            # plain dataclass constructor does not
+            self.speculative = SpeculativeConfig.from_dict(self.speculative)
         if self.block_size < 1:
             raise ConfigError("serving.block_size must be >= 1")
         if self.max_model_len < 1:
@@ -507,6 +556,22 @@ class ServingConfig(ConfigModel):
         if self.paged_kernel not in ("auto", "off"):
             raise ConfigError("serving.paged_kernel must be 'auto' or "
                               f"'off', got '{self.paged_kernel}'")
+        self.speculative.validate()
+        if (self.speculative.mode != "off"
+                and self.speculative.num_draft_tokens + 1
+                > self.max_model_len):
+            raise ConfigError(
+                f"speculative.num_draft_tokens="
+                f"{self.speculative.num_draft_tokens} cannot exceed "
+                f"serving.max_model_len={self.max_model_len} - 1 — the "
+                "verify program's token width would outgrow every "
+                "sequence budget")
+        if (self.speculative.mode == "draft"
+                and self.speculative.draft_chunk % self.block_size != 0):
+            raise ConfigError(
+                f"speculative.draft_chunk={self.speculative.draft_chunk} "
+                f"must be a multiple of block_size={self.block_size} "
+                "(the draft prefill chunks the same block-aligned arena)")
 
 
 @dataclass

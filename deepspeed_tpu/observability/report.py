@@ -238,8 +238,48 @@ def summarize_serving(records: List[Dict[str, Any]]) -> str:
         v = gauge(name)
         if v is not None:
             sharing.append(f"{label}={v:.6g}")
+    # speculative decoding: acceptance, amortization, drafter overhead
+    spec_counts = {}
+    for name in ("serving/spec_proposed_tokens",
+                 "serving/spec_accepted_tokens",
+                 "serving/spec_verify_dispatches",
+                 "serving/spec_disabled_rows", "serving/forks"):
+        spec_counts[name] = sum(
+            r["value"] for (n, _), r in latest.items()
+            if n == name and r.get("type") == "counter")
+    speculated = bool(spec_counts["serving/spec_verify_dispatches"])
+    if spec_counts["serving/forks"] and not speculated:
+        # parallel-sampling forks without speculation are COW sharing,
+        # not draft/verify — keep them off the speculation line
+        sharing.append(f"forks={spec_counts['serving/forks']:.0f}")
     if sharing:
         lines.append("  sharing: " + "  ".join(sharing))
+    if speculated:
+        spec = []
+        p50 = gauge("serving/spec_acceptance_p50")
+        if p50 is not None:
+            spec.append(f"acceptance_p50={p50:.3f}")
+        rate = gauge("serving/spec_acceptance_rate")
+        if rate is not None:
+            spec.append(f"acceptance={rate:.3f}")
+        epd = gauge("serving/spec_emitted_per_dispatch")
+        if epd is not None:
+            spec.append(f"emitted_per_dispatch={epd:.3g}")
+        if spec_counts["serving/spec_proposed_tokens"]:
+            spec.append(
+                f"proposed={spec_counts['serving/spec_proposed_tokens']:.0f}"
+                f" accepted="
+                f"{spec_counts['serving/spec_accepted_tokens']:.0f}")
+        share = gauge("serving/spec_draft_time_share")
+        if share is not None:
+            spec.append(f"draft_overhead={share:.3f}")
+        if spec_counts["serving/spec_disabled_rows"]:
+            spec.append("pressure_disabled_rows="
+                        f"{spec_counts['serving/spec_disabled_rows']:.0f}")
+        if spec_counts["serving/forks"]:
+            spec.append(f"forks={spec_counts['serving/forks']:.0f}")
+        if spec:
+            lines.append("  speculation: " + "  ".join(spec))
     counts = []
     preempt = 0.0
     for name, label in (("serving/requests_submitted", "submitted"),

@@ -10,24 +10,31 @@ rebuilt on the repo's inference substrate under jit-cache discipline:
                  prefill, multi-tenant fair queueing + deadlines,
                  preemption by block eviction (device-free, injectable
                  clock)
-  session.py     RequestHandle: incremental token streaming, cancellation
+  session.py     RequestHandle: incremental token streaming, cancellation,
+                 mid-stream parallel-sampling fork
+  speculative.py drafters for speculative decoding: n-gram prompt lookup
+                 (host-side) + draft model (own arena, shared block pool);
+                 lossless bit-stable acceptance over the R×(K+1) verify
   api.py         ServingEngine.submit()/stream()/step()/run(), metrics
                  into the observability registry, tpuaudit registration
 
 See docs/serving.md for the architecture and the block-table layout.
 """
 
-from ..config.config import ServingConfig  # noqa: F401
+from ..config.config import ServingConfig, SpeculativeConfig  # noqa: F401
 from .api import ServingEngine, init_serving  # noqa: F401
 from .paged_kv import (BlockAllocator, BlockAllocatorError,  # noqa: F401
                        PrefixCache)
 from .scheduler import (QueueFull, Request, SamplingParams,  # noqa: F401
                         Scheduler)
 from .session import RequestCancelled, RequestHandle  # noqa: F401
+from .speculative import (Drafter, DraftModelDrafter,  # noqa: F401
+                          NgramDrafter)
 
 __all__ = [
-    "ServingConfig", "ServingEngine", "init_serving",
+    "ServingConfig", "SpeculativeConfig", "ServingEngine", "init_serving",
     "BlockAllocator", "BlockAllocatorError", "PrefixCache",
     "Scheduler", "Request", "SamplingParams", "QueueFull",
     "RequestHandle", "RequestCancelled",
+    "Drafter", "NgramDrafter", "DraftModelDrafter",
 ]

@@ -33,7 +33,8 @@ from ..observability import get_session
 from ..parallel import mesh as mesh_mod
 from ..utils.logging import log_dist, logger
 from . import paged_kv
-from .scheduler import DECODE, Request, SamplingParams, Scheduler
+from .scheduler import (CANCELLED, DECODE, Request, SamplingParams,
+                        Scheduler)
 from .session import RequestHandle
 
 __all__ = ["ServingEngine", "init_serving"]
@@ -45,10 +46,15 @@ def _percentile(samples: List[float], q: float) -> float:
 
 
 class ServingEngine:
-    """Continuous-batching serving over an ``InferenceEngine``'s params."""
+    """Continuous-batching serving over an ``InferenceEngine``'s params.
+
+    ``draft_engine`` (an ``InferenceEngine`` over a smaller model) is
+    required only for ``speculative.mode='draft'`` — its paged KV shares
+    this engine's block pool (see ``speculative.py``)."""
 
     def __init__(self, engine, config: Optional[ServingConfig] = None,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 draft_engine=None):
         self.engine = engine
         self.config = config or ServingConfig()
         self.config.validate()
@@ -98,6 +104,33 @@ class ServingEngine:
         self._cow = paged_kv.build_cow_program()
         self._cow_copies = 0
         self._published_cow = 0
+        # -- speculative decoding (off → the plain R×1 decode path) --
+        from .speculative import make_drafter
+
+        self._drafter = make_drafter(self.config, engine, self.alloc,
+                                     self.blocks_per_seq,
+                                     draft_engine=draft_engine,
+                                     paged_impl=self._paged_impl)
+        self._verify = None
+        if self._drafter is not None:
+            self._verify = paged_kv.build_verify_program(
+                cfg, self.config.speculative.num_draft_tokens + 1,
+                self._paged_impl)
+            # one release point covers finish/cancel/preempt: the drafter
+            # must drop its draft-arena blocks whenever the scheduler
+            # releases the request's target blocks, or a preempted
+            # request's draft KV would squat on the pool from the queue
+            self.sched.on_release = self._drafter.release
+        self._spec_dispatches = 0
+        self._spec_emitted = 0
+        self._spec_proposed = 0
+        self._spec_accepted = 0
+        self._spec_disabled_rows = 0
+        self._spec_draft_s = 0.0
+        self._spec_verify_s = 0.0
+        self._forks = 0
+        self._published_spec = (0, 0, 0, 0)   # proposed/accepted/disp/disabled
+        self._published_forks = 0
         import jax
 
         self._base_rng = jax.random.PRNGKey(self.config.seed)
@@ -114,6 +147,11 @@ class ServingEngine:
 
         self._ttft_samples = collections.deque(maxlen=8192)
         self._tpot_samples = collections.deque(maxlen=8192)
+        # per-request acceptance rates, recorded at finish (report p50)
+        self._accept_samples = collections.deque(maxlen=8192)
+        # parent rid -> sibling Requests awaiting the COW fork point
+        # (parent prefill completion)
+        self._pending_forks: Dict[int, List[Request]] = {}
         self._tokens_out = 0
         self._started_s = clock()
         self._thread: Optional[threading.Thread] = None
@@ -137,7 +175,7 @@ class ServingEngine:
                temperature: float = 0.0, top_k: int = 0, top_p: float = 1.0,
                eos_token_id: Optional[int] = None, tenant: str = "default",
                deadline_s: Optional[float] = None,
-               seed: int = 0) -> RequestHandle:
+               seed: int = 0, n: int = 1):
         """Enqueue one prompt; returns a streaming handle immediately.
         ``deadline_s`` is relative to now (scheduler-clock seconds) and
         drives EDF ordering within the tenant. ``seed`` selects the
@@ -147,18 +185,47 @@ class ServingEngine:
         preemption/recompute. Raises ``scheduler.QueueFull`` past
         ``serving.max_queue`` in-flight requests (backpressure) and
         ``ValueError`` for prompts that cannot fit the ``max_model_len``
-        budget."""
+        budget.
+
+        ``n > 1`` is parallel sampling: ONE prefill serves all ``n``
+        samples — when it completes, ``n-1`` siblings fork the request's
+        block table through the refcounted COW machinery (shared blocks,
+        incref on fork; the first divergent write copies exactly one
+        block). Sibling ``i`` samples with ``seed + i``, so each sample is
+        bit-identical to a separately submitted request with that seed.
+        Returns a list of ``n`` handles instead of one."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if n < 1:
+            raise ValueError(f"submit(n={n}): need n >= 1")
         with self._lock:
-            req = Request(
-                rid=self._rid, prompt=prompt,
-                max_new_tokens=(max_new_tokens if max_new_tokens is not None
-                                else self.config.default_max_new_tokens),
-                sampling=SamplingParams(temperature=float(temperature),
-                                        top_k=int(top_k), top_p=float(top_p)),
-                eos_token_id=eos_token_id, tenant=tenant, seed=seed,
-                deadline_s=(self.clock() + deadline_s
-                            if deadline_s is not None else None))
+            # pending (not-yet-forked) siblings hold real queue capacity:
+            # submit_forked bypasses the scheduler's max_queue check, so
+            # the reservation must be enforced here, against scheduler
+            # occupancy PLUS every sibling still waiting for its fork
+            in_flight = self.sched.in_flight() + self._pending_fork_count()
+            if in_flight + n > self.config.max_queue:
+                from .scheduler import QueueFull
+
+                raise QueueFull(
+                    f"serving queue cannot take {n} more request(s) "
+                    f"({in_flight} in flight incl. pending forks, "
+                    f"max_queue={self.config.max_queue})")
+
+            def make(rid, sd, fork_of=None):
+                return Request(
+                    rid=rid, prompt=prompt.copy(),
+                    max_new_tokens=(max_new_tokens
+                                    if max_new_tokens is not None
+                                    else self.config.default_max_new_tokens),
+                    sampling=SamplingParams(temperature=float(temperature),
+                                            top_k=int(top_k),
+                                            top_p=float(top_p)),
+                    eos_token_id=eos_token_id, tenant=tenant, seed=sd,
+                    fork_of=fork_of,
+                    deadline_s=(self.clock() + deadline_s
+                                if deadline_s is not None else None))
+
+            req = make(self._rid, seed)
             self.sched.submit(req)   # raises before rid is consumed
             self._rid += 1
             handle = RequestHandle(self, req)
@@ -168,25 +235,74 @@ class ServingEngine:
                 obs.registry.counter(
                     "serving/requests_submitted",
                     help="requests accepted into the serving queue").inc(
-                        tenant=tenant)
-            return handle
+                        n, tenant=tenant)
+            if n == 1:
+                return handle
+            sibs, handles = [], [handle]
+            for i in range(1, n):
+                sib = make(self._rid, seed + i, fork_of=req.rid)
+                sib.arrival_s = req.arrival_s   # TTFT from the client's
+                #   submit — the wait through the parent's prefill counts
+                self._rid += 1
+                sibs.append(sib)
+                h = RequestHandle(self, sib)
+                self._handles[sib.rid] = h
+                handles.append(h)
+            self._pending_forks[req.rid] = sibs
+            return handles
 
     def cancel(self, handle: RequestHandle) -> bool:
+        cancelled = 0   # every cancellation this call caused — pre-fork
+        #   siblings and parent-cascaded siblings included, so the
+        #   requests_{submitted,completed,cancelled} ledger balances
         with self._lock:
-            ok = self.sched.cancel(handle._req)
-            self._handles.pop(handle._req.rid, None)
-        if ok:
+            req = handle._req
+            # a sibling cancelled before its fork point never reached the
+            # scheduler — cancel it directly
+            if req.fork_of is not None:
+                sibs = self._pending_forks.get(req.fork_of, [])
+                if req in sibs:
+                    sibs.remove(req)
+                    req.state = CANCELLED
+                    req.finish_s = self.clock()
+                    self.sched.cancelled_count += 1
+                    self._handles.pop(req.rid, None)
+                    self._count_cancelled(1)
+                    handle._wake()
+                    return True
+            ok = self.sched.cancel(req)
+            cancelled += int(ok)
+            # a cancelled parent takes its un-forked siblings with it
+            for sib in self._pending_forks.pop(req.rid, []):
+                sh = self._handles.pop(sib.rid, None)
+                sib.state = CANCELLED
+                sib.finish_s = self.clock()
+                self.sched.cancelled_count += 1
+                cancelled += 1
+                if sh is not None:
+                    sh._wake()
+            self._handles.pop(req.rid, None)
+        self._count_cancelled(cancelled)
+        handle._wake()
+        return ok
+
+    @staticmethod
+    def _count_cancelled(n: int) -> None:
+        if n:
             obs = get_session()
             if obs.enabled:
                 obs.registry.counter(
                     "serving/requests_cancelled",
-                    help="requests cancelled before completion").inc()
-        handle._wake()
-        return ok
+                    help="requests cancelled before completion").inc(n)
+
+    def _pending_fork_count(self) -> int:
+        return sum(len(v) for v in self._pending_forks.values())
 
     def in_flight(self) -> int:
+        """Requests holding queue capacity: queued + running + parallel-
+        sampling siblings still waiting for their parent's fork point."""
         with self._lock:
-            return self.sched.in_flight()
+            return self.sched.in_flight() + self._pending_fork_count()
 
     # -- the iteration -----------------------------------------------------
     def step(self) -> bool:
@@ -195,7 +311,8 @@ class ServingEngine:
         with self._lock:
             progress = bool(self.sched.admit())
             progress |= self._step_prefill()
-            progress |= self._step_decode()
+            progress |= (self._step_verify() if self._drafter is not None
+                         else self._step_decode())
             self._publish_iteration()
             self._iterations += 1
             return progress
@@ -216,7 +333,8 @@ class ServingEngine:
                 np.asarray([r.sampling.top_p for r in reqs], np.float32),
                 np.asarray([r.seed for r in reqs], np.int32))
 
-    def _make_writable(self, req: Request, start: int, end: int) -> bool:
+    def _make_writable(self, req: Request, start: int, end: int,
+                       optional: bool = False) -> bool:
         """Copy-on-write: every block covering write positions
         [start, end) must be exclusively owned before the jitted program
         scatters into it. Shared blocks (prefix sharing, refcount > 1) are
@@ -224,9 +342,16 @@ class ServingEngine:
         sharers keep the original. Returns False when the pool can't
         provide a private copy this iteration — the caller skips the
         request; copies already made stay (they are real private blocks,
-        the retry skips them)."""
+        the retry skips them). ``optional`` marks speculative work: the
+        copy comes from plain allocation only — no cache eviction, no
+        preemption — because speculation must never cost anyone else
+        their blocks."""
         for bi in self.sched.cow_block_indices(req, start, end):
-            nid = self.sched.alloc_for_cow(req)
+            if optional:
+                ids = self.alloc.alloc(1)
+                nid = ids[0] if ids else None
+            else:
+                nid = self.sched.alloc_for_cow(req)
             if nid is None:
                 return False
             old = req.blocks[bi]
@@ -274,6 +399,11 @@ class ServingEngine:
         self.sched.note_service(req, n_valid)
         if req.prefill_pos == int(src.size):
             req.state = DECODE
+            # the COW fork point for submit(n=...): siblings share the
+            # freshly prefilled blocks BEFORE the parent can finish (a
+            # max_new_tokens=1 parent releases its refs in _emit below;
+            # the siblings' increfs keep the blocks alive)
+            self._submit_pending_forks(req)
             if req.resume:
                 # recompute after preemption: the stored pending token is
                 # authoritative (identical under greedy; under temperature
@@ -284,10 +414,93 @@ class ServingEngine:
                 self._emit(req, int(tok[0]), first=True)
         return True
 
-    def _step_decode(self) -> bool:
+    # -- parallel-sampling fork (COW) --------------------------------------
+    def _submit_pending_forks(self, req: Request) -> None:
+        """Parent finished prefill: attach each waiting sibling to the
+        SAME physical blocks (incref — shared until first divergent write)
+        and hand it to the scheduler, fully prefilled. The sibling's
+        ``pending_token`` is the final prompt token at ``length =
+        n_prompt - 1``: its first decode re-runs only that one position —
+        a COW copy of at most one block — and samples its own first token
+        with its own seed at output-token index 0, bit-identical to a
+        separately submitted request."""
+        sibs = self._pending_forks.pop(req.rid, None)
+        if not sibs:
+            return
+        for sib in sibs:
+            sib.blocks = list(req.blocks)
+            self.alloc.incref(sib.blocks)
+            sib.prefill_pos = int(sib.prompt.size)
+            sib.length = sib.n_prompt - 1
+            sib.pending_token = int(sib.prompt[-1])
+            self.sched.submit_forked(sib)
+            self._forks += 1
+
+    def fork(self, handle: RequestHandle, n: int,
+             seeds: Optional[List[int]] = None) -> List[RequestHandle]:
+        """Mid-stream fork: ``n`` new samples branching off ``handle``'s
+        request AT ITS CURRENT POSITION — shared prompt AND
+        generated-so-far blocks (incref; first divergent write goes
+        copy-on-write), inherited emitted tokens, divergence from the next
+        token on (each sibling samples output-token index
+        ``len(generated)`` with its own seed). The parent must be actively
+        decoding. Returns the new handles."""
+        if n < 1:
+            raise ValueError(f"fork(n={n}): need n >= 1")
+        if seeds is not None and len(seeds) < n:
+            raise ValueError(f"fork(n={n}): seeds has {len(seeds)} "
+                             "entries — need one per sibling")
+        with self._lock:
+            req = handle._req
+            if req.state != DECODE:
+                raise ValueError(
+                    f"request {req.rid}: fork requires an actively "
+                    f"decoding request (state='{req.state}')")
+            if (self.sched.in_flight() + self._pending_fork_count() + n
+                    > self.config.max_queue):
+                from .scheduler import QueueFull
+
+                raise QueueFull(
+                    f"serving queue cannot take {n} forked samples")
+            out: List[RequestHandle] = []
+            now = self.clock()
+            for i in range(n):
+                sib = Request(
+                    rid=self._rid, prompt=req.prompt.copy(),
+                    max_new_tokens=req.max_new_tokens,
+                    sampling=req.sampling, eos_token_id=req.eos_token_id,
+                    tenant=req.tenant,
+                    seed=(seeds[i] if seeds is not None
+                          else req.seed + i + 1),
+                    fork_of=req.rid, n_prompt=req.n_prompt)
+                self._rid += 1
+                sib.generated = list(req.generated)
+                sib.pending_token = req.pending_token
+                sib.length = req.length
+                sib.prefill_pos = int(sib.prompt.size)
+                sib.blocks = list(req.blocks)
+                self.alloc.incref(sib.blocks)
+                if sib.generated:
+                    sib.first_token_s = now   # inherited tokens are
+                    #   already streamed below — TTFT is fork-time
+                self.sched.submit_forked(sib)
+                h = RequestHandle(self, sib)
+                for t in sib.generated:
+                    h._push(t)
+                self._handles[sib.rid] = h
+                out.append(h)
+                self._forks += 1
+            return out
+
+    def _ready_decode_rows(self) -> List[Request]:
+        """The decode-readiness discipline shared by the plain and
+        speculative iterations: guarantee the pending token's block for
+        every decoding row (this may evict), then keep only rows that are
+        still DECODE, have block coverage for the incoming write, and
+        whose write block is exclusively owned."""
         dec = self.sched.decode_requests()
         if not dec:
-            return False
+            return []
         for r in dec:
             # re-check state INSIDE the loop: an earlier ensure_blocks may
             # have evicted this very request — growing a now-QUEUED request
@@ -307,7 +520,10 @@ class ServingEngine:
                 continue
             ready.append(r)
         # a later row's COW may have preempted an earlier accepted row
-        ready = [r for r in ready if r.state == DECODE]
+        return [r for r in ready if r.state == DECODE]
+
+    def _step_decode(self) -> bool:
+        ready = self._ready_decode_rows()
         if not ready:
             return False
         R = self.config.max_seqs
@@ -344,10 +560,131 @@ class ServingEngine:
             self._emit(r, int(nxt[r.row]))
         return True
 
+    def _step_verify(self) -> bool:
+        """The speculative iteration: one R×(K+1) verify dispatch replaces
+        the R×1 decode. Every decoding row rides it — rows with no (or
+        pressure-disabled) proposals verify only their pending token,
+        which IS the plain decode — so per-row proposal counts and
+        acceptance mixes are data under ONE compiled program. Accepted
+        tokens advance lengths/blocks on the host; rejected draft KV rolls
+        back by position (whole blocks past the accepted length return to
+        the pool)."""
+        # the guaranteed (pending-token) block may evict via
+        # _ready_decode_rows — speculation itself never does
+        ready = self._ready_decode_rows()
+        if not ready:
+            return False
+        spec = self.config.speculative
+        K = spec.num_draft_tokens
+        S = K + 1
+        # per-row proposal budget: output budget (the verify emits up to
+        # cap+1 tokens), model-length budget, and the global pool guard
+        low_pool = self.alloc.blocks_free < spec.min_free_blocks
+        caps = []
+        for r in ready:
+            cap = min(K,
+                      r.max_new_tokens - len(r.generated) - 1,
+                      self.config.max_model_len - r.length - 1)
+            caps.append(0 if low_pool else max(cap, 0))
+        t0 = self.clock()
+        proposals = self._drafter.propose(ready, caps)
+        self._spec_draft_s += self.clock() - t0
+        # speculating may preempt nothing, but the drafter's catch-up runs
+        # under the engine lock with live state — re-check anyway
+        plan = []
+        for r, cap, prop in zip(ready, caps, proposals):
+            prop = np.asarray(prop, np.int32).reshape(-1)[:cap]
+            n = int(prop.size)
+            if n > 0 and not self.sched.try_extend_blocks(
+                    r, r.length + 1 + n):
+                # pool says no: speculate only as far as already-held
+                # blocks reach (possibly 0) — never evict for speculation
+                held = len(r.blocks) * self.config.block_size \
+                    - (r.length + 1)
+                n = max(min(n, held), 0)
+                self._spec_disabled_rows += 1
+            if n > 0 and not self._make_writable(
+                    r, r.length + 1, r.length + 1 + n, optional=True):
+                n = 0   # shared draft-range block with no COW budget
+            plan.append((r, prop[:n]))
+        # a later row's COW/extension bookkeeping may have preempted an
+        # earlier planned row — plan only rows still decoding
+        plan = [(r, p) for r, p in plan if r.state == DECODE]
+        if not plan:
+            return False
+        R = self.config.max_seqs
+        bt = np.zeros((R, self.blocks_per_seq), np.int32)
+        lengths = np.zeros((R,), np.int32)
+        tokens = np.zeros((R, S), np.int32)
+        n_valid = np.zeros((R,), np.int32)
+        temps = np.zeros((R,), np.float32)
+        topks = np.zeros((R,), np.int32)
+        topps = np.ones((R,), np.float32)
+        seeds = np.zeros((R,), np.int32)
+        steps = np.zeros((R,), np.int32)
+        for r, prop in plan:
+            row = r.row
+            bt[row, :len(r.blocks)] = r.blocks
+            lengths[row] = r.length
+            tokens[row, 0] = r.pending_token
+            if prop.size:
+                tokens[row, 1:1 + prop.size] = prop
+            n_valid[row] = 1 + prop.size
+            temps[row] = r.sampling.temperature
+            topks[row] = r.sampling.top_k
+            topps[row] = r.sampling.top_p
+            seeds[row] = r.seed
+            steps[row] = len(r.generated)   # first output-token index of
+            #   this dispatch — position j samples index steps+j, the
+            #   exact key the non-speculative path uses
+        obs = get_session()
+        t0 = self.clock()
+        with mesh_mod.ambient(self.engine.mesh):
+            with obs.span("serving/verify", batch=len(plan),
+                          tokens=int(n_valid.sum())):
+                sampled, self._arena = self._verify(
+                    self.engine.params, self._arena, bt, lengths, tokens,
+                    n_valid, temps, topks, topps, seeds, steps,
+                    self._base_rng)
+                sampled = np.asarray(sampled)  # the iteration's one sync
+        self._spec_verify_s += self.clock() - t0
+        self._spec_dispatches += 1
+        for r, prop in plan:
+            x = sampled[r.row]
+            a = 0   # accepted drafts: x[j] (the sample after draft j) must
+            #   CONFIRM draft j — first mismatch emits x[a] as the
+            #   correction, full acceptance emits x[cap] as the bonus
+            while a < prop.size and int(x[a]) == int(prop[a]):
+                a += 1
+            r.spec_proposed += int(prop.size)
+            r.spec_accepted += a
+            self._spec_proposed += int(prop.size)
+            self._spec_accepted += a
+            for t in x[:a + 1]:
+                r.length += 1
+                self.sched.note_service(r, 1)
+                self._emit(r, int(t))
+                self._spec_emitted += 1
+                if r.done:
+                    break   # EOS/budget mid-verify: later samples are
+                    #   beyond the request's end — never emitted
+            if not r.done:
+                # positional rollback: whole blocks past the accepted
+                # length go back to the pool; the drafter rolls its arena
+                # back the same way
+                self.sched.truncate_blocks(r, r.length)
+                self._drafter.commit(r)
+        return True
+
     def _emit(self, req: Request, token: int, first: bool = False) -> None:
         now = self.clock()
         obs = get_session()
-        if first:
+        # ``first`` marks the prefill-completion emit; a submit(n=...)
+        # sibling skips prefill entirely (admitted straight to DECODE with
+        # the parent's KV) and its first token arrives through the
+        # decode/verify path — catch it by the unset timestamp so TTFT/
+        # TPOT cover forked samples too
+        if first or req.first_token_s is None:
             req.first_token_s = now
             if obs.enabled:
                 ttft_ms = (now - req.arrival_s) * 1e3
@@ -367,6 +704,9 @@ class ServingEngine:
                         and token == req.eos_token_id))
         if finished:
             self.sched.finish(req)
+            if self._drafter is not None and req.spec_proposed:
+                self._accept_samples.append(
+                    req.spec_accepted / req.spec_proposed)
             if obs.enabled:
                 obs.registry.counter(
                     "serving/requests_completed",
@@ -434,6 +774,50 @@ class ServingEngine:
                         help="requests evicted from the arena "
                              "(recompute on re-admission)").inc(new_preempt)
             self._published_preemptions = self.sched.preemption_count
+        new_forks = self._forks - self._published_forks
+        if new_forks:
+            reg.counter("serving/forks",
+                        help="parallel-sampling siblings forked through "
+                             "the COW block tables").inc(new_forks)
+            self._published_forks = self._forks
+        if self._drafter is not None:
+            p0, a0, d0, x0 = self._published_spec
+            dp = self._spec_proposed - p0
+            da = self._spec_accepted - a0
+            dd = self._spec_dispatches - d0
+            dx = self._spec_disabled_rows - x0
+            if dp:
+                reg.counter("serving/spec_proposed_tokens",
+                            help="draft tokens sent to verify").inc(dp)
+            if da:
+                reg.counter("serving/spec_accepted_tokens",
+                            help="draft tokens the verify confirmed").inc(da)
+            if dd:
+                reg.counter("serving/spec_verify_dispatches",
+                            help="R×(K+1) verify program dispatches").inc(dd)
+            if dx:
+                reg.counter("serving/spec_disabled_rows",
+                            help="row-iterations that skipped speculation "
+                                 "under pool pressure").inc(dx)
+            self._published_spec = (self._spec_proposed,
+                                    self._spec_accepted,
+                                    self._spec_dispatches,
+                                    self._spec_disabled_rows)
+            reg.gauge("serving/spec_acceptance_rate",
+                      help="accepted / proposed draft tokens").set(
+                          self._spec_accepted
+                          / max(self._spec_proposed, 1))
+            reg.gauge("serving/spec_emitted_per_dispatch",
+                      help="tokens emitted per target verify dispatch "
+                           "(> 1 is the speculative win)").set(
+                          self._spec_emitted
+                          / max(self._spec_dispatches, 1))
+            spent = self._spec_draft_s + self._spec_verify_s
+            if spent > 0:
+                reg.gauge("serving/spec_draft_time_share",
+                          help="drafter wall share of the speculative "
+                               "decode loop").set(self._spec_draft_s
+                                                  / spent)
         # steady-state marker for the recompile watchdog: past warmup, a
         # recompile under a serving span is a shape-discipline bug
         obs.note_step(self._iterations)
@@ -495,6 +879,8 @@ class ServingEngine:
             return
         self._closed = True
         self.stop()
+        if self._drafter is not None:
+            self._drafter.close()
         self.publish_latency_gauges()
 
     def publish_latency_gauges(self) -> None:
@@ -513,6 +899,11 @@ class ServingEngine:
                     _percentile(list(samples), 0.50))
                 reg.gauge(f"serving/{name}_p99_ms").set(
                     _percentile(list(samples), 0.99))
+        if self._accept_samples:
+            reg.gauge("serving/spec_acceptance_p50",
+                      help="per-request draft acceptance rate, median "
+                           "over finished requests").set(
+                          _percentile(list(self._accept_samples), 0.50))
         wall = max(self.clock() - self._started_s, 1e-9)
         reg.gauge("serving/tokens_per_sec",
                   help="generated tokens / wall seconds").set(
@@ -522,12 +913,28 @@ class ServingEngine:
         """Drop the host-side latency reservoirs and restart the
         tokens/s window — benches call this after their warmup request so
         the published p50/p99/tokens_per_sec describe the measured load,
-        not program compilation."""
+        not program compilation. The speculative ledger resets too: the
+        warmup's verify/draft dispatches JIT-compile inside the timed
+        accumulators, which would otherwise dominate draft_time_share and
+        skew acceptance/emitted-per-dispatch."""
         with self._lock:
             self._ttft_samples.clear()
             self._tpot_samples.clear()
+            self._accept_samples.clear()
             self._tokens_out = 0
             self._started_s = self.clock()
+            self._spec_dispatches = 0
+            self._spec_emitted = 0
+            self._spec_proposed = 0
+            self._spec_accepted = 0
+            self._spec_disabled_rows = 0
+            self._spec_draft_s = 0.0
+            self._spec_verify_s = 0.0
+            self._forks = 0
+            # published snapshots must rewind with the raw counts or the
+            # next _publish_iteration would compute negative counter deltas
+            self._published_spec = (0, 0, 0, 0)
+            self._published_forks = 0
 
     # -- tpuaudit ----------------------------------------------------------
     def _audit_args_prefill(self):
@@ -630,20 +1037,122 @@ class ServingEngine:
                 expected_collectives=(), mesh=self.engine.mesh,
                 tags={"engine": "ServingEngine",
                       "block_size": self.config.block_size})
-            return ["serving/prefill_chunk", "serving/decode",
-                    "serving/cow_copy"]
+            names = ["serving/prefill_chunk", "serving/decode",
+                     "serving/cow_copy"]
+            if self._drafter is not None:
+                names += self._register_spec_audit_entries(
+                    register_entry_point, StaleEntryError, wself, expected)
+            return names
         except Exception:   # registration must never take serving down
             logger.warning("tpuaudit serving registration failed",
                            exc_info=True)
             return []
 
+    def _register_spec_audit_entries(self, register_entry_point,
+                                     StaleEntryError, wself,
+                                     expected) -> List[str]:
+        import jax
+        import jax.numpy as jnp
+
+        R, MAXB = self.config.max_seqs, self.blocks_per_seq
+        S = self.config.speculative.num_draft_tokens + 1
+        i32, f32 = jnp.int32, jnp.float32
+
+        def build_verify():
+            eng = wself()
+            if eng is None:
+                raise StaleEntryError("serving/verify: engine gone")
+            args = (eng.engine._params_sds(), eng._arena_sds(),
+                    jax.ShapeDtypeStruct((R, MAXB), i32),
+                    jax.ShapeDtypeStruct((R,), i32),
+                    jax.ShapeDtypeStruct((R, S), i32),
+                    jax.ShapeDtypeStruct((R,), i32),
+                    jax.ShapeDtypeStruct((R,), f32),
+                    jax.ShapeDtypeStruct((R,), i32),
+                    jax.ShapeDtypeStruct((R,), f32),
+                    jax.ShapeDtypeStruct((R,), i32),
+                    jax.ShapeDtypeStruct((R,), i32),
+                    jax.ShapeDtypeStruct((2,), jnp.uint32))
+            return eng._verify, args, {}
+
+        register_entry_point(
+            "serving/verify", build=build_verify, donate_argnums=(1,),
+            expected_collectives=expected, mesh=self.engine.mesh,
+            tags={"engine": "ServingEngine", "rows": R, "spec_tokens": S,
+                  "max_blocks": MAXB, "paged_impl": self._paged_impl,
+                  # conservative floor: one verify dispatch emits AT LEAST
+                  # one token per row (acceptance only adds to this)
+                  "tokens_per_step": R})
+        names = ["serving/verify"]
+        drafter = self._drafter
+        if not hasattr(drafter, "_decode"):    # host-side drafter: no
+            return names                       # device programs to audit
+        from ..inference.kv_cache import paged_cache_shape_struct
+
+        dcfg = drafter.engine.model.config
+        dexp = drafter.engine._audit_expected_collectives()
+        C = drafter.draft_chunk
+
+        def draft_arena_sds(eng):
+            return paged_cache_shape_struct(
+                dcfg, self.config.pool_blocks() + 1,
+                self.config.block_size, eng._drafter._dtype)
+
+        def build_draft_decode():
+            eng = wself()
+            if eng is None:
+                raise StaleEntryError("serving/draft_decode: engine gone")
+            args = (eng._drafter.engine._params_sds(), draft_arena_sds(eng),
+                    jax.ShapeDtypeStruct((R, MAXB), i32),
+                    jax.ShapeDtypeStruct((R,), i32),
+                    jax.ShapeDtypeStruct((R,), i32),
+                    jax.ShapeDtypeStruct((R,), f32),
+                    jax.ShapeDtypeStruct((R,), i32),
+                    jax.ShapeDtypeStruct((R,), f32),
+                    jax.ShapeDtypeStruct((R,), i32),
+                    jax.ShapeDtypeStruct((R,), i32),
+                    jax.ShapeDtypeStruct((2,), jnp.uint32))
+            return eng._drafter._decode, args, {}
+
+        def build_draft_prefill():
+            eng = wself()
+            if eng is None:
+                raise StaleEntryError("serving/draft_prefill: engine gone")
+            args = (eng._drafter.engine._params_sds(), draft_arena_sds(eng),
+                    jax.ShapeDtypeStruct((1, MAXB), i32),
+                    jax.ShapeDtypeStruct((1, C), i32),
+                    jax.ShapeDtypeStruct((), i32),
+                    jax.ShapeDtypeStruct((), i32),
+                    jax.ShapeDtypeStruct((1,), f32),
+                    jax.ShapeDtypeStruct((1,), i32),
+                    jax.ShapeDtypeStruct((1,), f32),
+                    jax.ShapeDtypeStruct((1,), i32),
+                    jax.ShapeDtypeStruct((2,), jnp.uint32))
+            return eng._drafter._prefill, args, {}
+
+        register_entry_point(
+            "serving/draft_decode", build=build_draft_decode,
+            donate_argnums=(1,), expected_collectives=dexp,
+            mesh=drafter.engine.mesh,
+            tags={"engine": "ServingEngine", "rows": R,
+                  "draft_model": True, "tokens_per_step": R})
+        register_entry_point(
+            "serving/draft_prefill", build=build_draft_prefill,
+            donate_argnums=(1,), expected_collectives=dexp,
+            mesh=drafter.engine.mesh,
+            tags={"engine": "ServingEngine", "chunk": C,
+                  "draft_model": True, "tokens_per_step": C})
+        return names + ["serving/draft_decode", "serving/draft_prefill"]
+
 
 def init_serving(model=None, serving_config: Optional[Any] = None,
                  clock: Callable[[], float] = time.monotonic,
-                 **init_inference_kwargs) -> ServingEngine:
+                 draft_model=None, **init_inference_kwargs) -> ServingEngine:
     """Build an ``InferenceEngine`` (same surface as ``init_inference``) and
     wrap it in a ``ServingEngine``. ``serving_config``: a ``ServingConfig``
-    or plain dict."""
+    or plain dict. ``draft_model`` (for ``speculative.mode='draft'``): a
+    model name/instance for the drafter — built on the same dtype so its
+    paged arena shares the serving block pool cleanly."""
     from ..inference import init_inference
 
     if isinstance(serving_config, dict):
@@ -653,4 +1162,10 @@ def init_serving(model=None, serving_config: Optional[Any] = None,
     # serve generate() calls — keep its budget at least the serving budget
     init_inference_kwargs.setdefault("max_out_tokens", scfg.max_model_len)
     engine = init_inference(model=model, **init_inference_kwargs)
-    return ServingEngine(engine, scfg, clock=clock)
+    draft_engine = None
+    if draft_model is not None:
+        draft_engine = init_inference(
+            model=draft_model, dtype=engine.config.dtype,
+            max_out_tokens=scfg.max_model_len)
+    return ServingEngine(engine, scfg, clock=clock,
+                         draft_engine=draft_engine)

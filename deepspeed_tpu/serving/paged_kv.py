@@ -50,7 +50,9 @@ from ..inference.kv_cache import (assert_block_divisible, blocks_for_tokens,
 __all__ = ["BlockAllocator", "BlockAllocatorError", "PrefixCache",
            "blocks_for_tokens", "assert_block_divisible", "init_paged_cache",
            "paged_cache_memory_bytes", "build_prefill_program",
-           "build_decode_program", "build_cow_program", "sample_rows"]
+           "build_decode_program", "build_verify_program",
+           "build_cow_program", "sample_rows", "extend_block_list",
+           "truncate_block_list"]
 
 
 class BlockAllocatorError(RuntimeError):
@@ -140,6 +142,41 @@ class BlockAllocator:
             if self._refs[b] == 0:
                 del self._refs[b]
                 self._free.append(b)
+
+
+def extend_block_list(alloc: BlockAllocator, blocks: List[int],
+                      upto_tokens: int, block_size: int) -> bool:
+    """Grow ``blocks`` (a block-table list) to cover ``upto_tokens``
+    positions by PLAIN pool allocation — no cache eviction, no preemption.
+    This is the optional-work discipline shared by the speculative verify
+    extension and the draft arena: speculation must never cost anyone
+    else their blocks. Returns False when the pool says no (the per-row
+    auto-disable signal); ``blocks`` is unchanged in that case."""
+    need = blocks_for_tokens(upto_tokens, block_size) - len(blocks)
+    if need <= 0:
+        return True
+    ids = alloc.alloc(need)
+    if ids is None:
+        return False
+    blocks.extend(ids)
+    return True
+
+
+def truncate_block_list(alloc: BlockAllocator, blocks: List[int],
+                        upto_tokens: int, block_size: int) -> int:
+    """Positional rollback shared by the target and draft arenas: drop one
+    reference on every block of ``blocks`` past the ones covering
+    positions [0, upto_tokens) — rejected speculative KV beyond the
+    accepted length is dead weight (never read: causality over true
+    positions). A shared (prefix-cache/fork) block stays resident for its
+    other holders. Returns the number of references dropped."""
+    keep = blocks_for_tokens(upto_tokens, block_size)
+    dropped = len(blocks) - keep
+    if dropped > 0:
+        alloc.free(blocks[keep:])
+        del blocks[keep:]
+        return dropped
+    return 0
 
 
 class PrefixCache:
@@ -369,6 +406,65 @@ def build_decode_program(cfg, paged_impl: str = "auto"):
         return nxt, cache
 
     return jax.jit(decode, donate_argnums=(1,))
+
+
+def build_verify_program(cfg, num_tokens: int, paged_impl: str = "auto"):
+    """Jitted speculative-decoding verify step: the R×1 decode program
+    generalized to R×S (S = ``num_tokens`` = K+1 draft slots + the pending
+    token). Row r feeds ``tokens[r] = [pending, d_1 .. d_K]`` at absolute
+    positions ``lengths[r] + 0..S-1`` — the left-aligned column==position
+    invariant makes the causal read over drafted positions exact — and the
+    target model scores ALL of them in one dispatch.
+
+    Speculation is data, not shape: ``n_valid`` (R,) int32 is each row's
+    real token count this iteration (1 = plain decode, 1+k = k proposed
+    drafts, 0 = inactive row riding scratch); positions past ``n_valid``
+    write to the scratch block and their samples are ignored by the host.
+    One compiled program serves every per-row proposal/acceptance mix.
+
+    Sampling: position j of row r draws through the SAME
+    ``fold_in(fold_in(base_key, seeds[r]), steps[r] + j)`` key the
+    non-speculative decode would use for that output-token index — so the
+    host's accept rule (keep sampled tokens while they equal the draft,
+    emit the first divergence as the correction) is lossless rejection
+    sampling whose emitted stream is BIT-IDENTICAL to the non-speculative
+    path at any temperature, greedy included (see
+    ``serving/speculative.py`` for the acceptance math).
+
+    Args: params, cache (DONATED), block_table (R, MAXB), lengths (R,)
+    int32, tokens (R, S) int32, n_valid (R,) int32,
+    temperature/top_k/top_p/seeds (R,), steps (R,) int32 (each row's FIRST
+    output-token index this iteration), base_key.
+    Returns (sampled (R, S) int32, cache): ``sampled[r, j]`` is the target
+    sample after token j — the host emits ``sampled[r, 0..a]`` where ``a``
+    is the accepted-draft count.
+    """
+    from ..models.transformer import forward as model_forward
+
+    def verify(params, cache, block_table, lengths, tokens, n_valid,
+               temperature, top_k, top_p, seeds, steps, base_key):
+        R, S = tokens.shape
+        offs = jnp.arange(S, dtype=jnp.int32)
+        pos = lengths[:, None] + offs[None]
+        write_mask = offs[None] < n_valid[:, None]
+        logits, cache, _ = model_forward(params, tokens, cfg, cache=cache,
+                                         positions=pos,
+                                         block_table=block_table,
+                                         paged_write_mask=write_mask,
+                                         paged_impl=paged_impl,
+                                         paged_chunk=True)
+        flat = logits.reshape(R * S, logits.shape[-1]).astype(jnp.float32)
+        sampled = sample_rows(flat, base_key,
+                              jnp.repeat(temperature, S),
+                              jnp.repeat(top_k, S), jnp.repeat(top_p, S),
+                              jnp.repeat(seeds, S),
+                              (steps[:, None] + offs[None]).reshape(-1))
+        return sampled.reshape(R, S), cache
+
+    if num_tokens < 2:
+        raise ValueError(f"build_verify_program(num_tokens={num_tokens}): "
+                         "need the pending token plus >= 1 draft slot")
+    return jax.jit(verify, donate_argnums=(1,))
 
 
 def build_cow_program():

@@ -40,7 +40,8 @@ from typing import Callable, Dict, List, Optional
 
 import numpy as np
 
-from .paged_kv import BlockAllocator, PrefixCache, blocks_for_tokens
+from .paged_kv import (BlockAllocator, PrefixCache, blocks_for_tokens,
+                       extend_block_list, truncate_block_list)
 
 __all__ = ["Request", "SamplingParams", "Scheduler", "QueueFull",
            "QUEUED", "PREFILL", "DECODE", "FINISHED", "CANCELLED"]
@@ -98,6 +99,14 @@ class Request:
     preemptions: int = 0
     first_token_s: Optional[float] = None
     finish_s: Optional[float] = None
+    # -- parallel-sampling fork (COW) --
+    prefilled: bool = False            # blocks/KV pre-attached at fork:
+    #   admission skips allocation AND prefill (straight to DECODE);
+    #   cleared on preemption (recompute goes the normal path)
+    fork_of: Optional[int] = None      # parent rid, for metrics/debugging
+    # -- speculative decoding accounting (engine-owned) --
+    spec_proposed: int = 0             # draft tokens this request verified
+    spec_accepted: int = 0             # ... and accepted
 
     def __post_init__(self):
         self.prompt = np.asarray(self.prompt, np.int32).reshape(-1)
@@ -137,6 +146,9 @@ class Scheduler:
         self.clock = clock
         self.queued: List[Request] = []
         self.running: Dict[int, Request] = {}      # row -> request
+        # called with the request on EVERY release (finish/cancel/preempt)
+        # — the speculative drafter's device-state teardown hook
+        self.on_release: Optional[Callable[[Request], None]] = None
         self._free_rows: List[int] = list(range(config.max_seqs))[::-1]
         self.service: Dict[str, float] = {}        # tenant -> tokens served
         self._admit_seq = 0
@@ -176,6 +188,20 @@ class Scheduler:
         req.state = QUEUED
         self.queued.append(req)
 
+    def submit_forked(self, req: Request) -> None:
+        """Enqueue a COW-forked sibling: its blocks (shared, incref'd by
+        the caller) and KV are already attached, so admission only needs a
+        free decode row. Bypasses the ``max_queue`` check — the engine
+        reserved fork capacity when the parent's ``submit(n=...)`` was
+        accepted (pending siblings count toward its in_flight). A caller
+        that pre-set ``arrival_s`` keeps it: a submit(n=...) sibling's
+        TTFT clock starts at the client's submit, not the fork point."""
+        if req.arrival_s == 0.0:
+            req.arrival_s = self.clock()
+        req.state = QUEUED
+        req.prefilled = True
+        self.queued.append(req)
+
     def cancel(self, req: Request) -> bool:
         if req.done:
             return False
@@ -211,6 +237,8 @@ class Scheduler:
             self.alloc.free(req.blocks)
             req.blocks = []
         self._admit_index.pop(req.rid, None)
+        if self.on_release is not None:
+            self.on_release(req)
 
     def finish(self, req: Request) -> None:
         self._release(req)
@@ -249,6 +277,18 @@ class Scheduler:
             req = self._pick_next()
             if req is None:
                 break
+            if req.prefilled:
+                # COW-forked sibling: KV and (shared) blocks already
+                # attached — it only needs the row
+                self.queued.remove(req)
+                req.row = self._free_rows.pop()
+                req.state = DECODE
+                self.running[req.row] = req
+                self._admit_index[req.rid] = self._admit_seq
+                self._admit_seq += 1
+                self.admitted_log.append(req.rid)
+                admitted.append(req)
+                continue
             cached_ids: List[int] = []
             n_cached = 0
             if self.prefix is not None:
@@ -320,6 +360,22 @@ class Scheduler:
             if not self._preempt_one(exclude=req):
                 return False
 
+    def try_extend_blocks(self, req: Request, upto_tokens: int) -> bool:
+        """Best-effort block growth for OPTIONAL work (the speculative
+        verify extension): plain pool allocation — no cache eviction, no
+        preemption. Speculation must never cost anyone else their blocks;
+        a False here is the per-row auto-disable signal."""
+        return extend_block_list(self.alloc, req.blocks, upto_tokens,
+                                 self.config.block_size)
+
+    def truncate_blocks(self, req: Request, upto_tokens: int) -> int:
+        """Positional rollback: free blocks past the ones covering
+        positions [0, upto_tokens) — rejected speculative KV beyond the
+        accepted length returns to the pool (see
+        ``paged_kv.truncate_block_list``). Returns references dropped."""
+        return truncate_block_list(self.alloc, req.blocks, upto_tokens,
+                                   self.config.block_size)
+
     def alloc_for_cow(self, req: Request) -> Optional[int]:
         """One private block for a copy-on-write replacement in ``req``'s
         table — same pressure ladder as ensure_blocks. Returns the block
@@ -390,6 +446,7 @@ class Scheduler:
             req.resume = True
         req.prefill_pos = 0
         req.length = 0
+        req.prefilled = False   # a forked sibling recomputes like anyone
         req.state = QUEUED
         self.queued.append(req)
 

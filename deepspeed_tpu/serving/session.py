@@ -80,6 +80,24 @@ class RequestHandle:
     def preemptions(self) -> int:
         return self._req.preemptions
 
+    @property
+    def spec_acceptance_rate(self) -> Optional[float]:
+        """Accepted / proposed draft tokens for this request (None until
+        speculation proposed anything)."""
+        if self._req.spec_proposed == 0:
+            return None
+        return self._req.spec_accepted / self._req.spec_proposed
+
+    def fork(self, n: int, seeds: Optional[List[int]] = None
+             ) -> List["RequestHandle"]:
+        """Branch ``n`` parallel samples off this request at its current
+        position: the siblings share every block (prompt AND generated)
+        through the refcounted COW tables, inherit the tokens streamed so
+        far, and diverge from the next token on — sibling ``i`` samples
+        with ``seeds[i]`` (default ``seed + i + 1``). The request must be
+        actively decoding."""
+        return self._engine.fork(self, n, seeds=seeds)
+
     def cancel(self) -> bool:
         """Cancel the request; returns False when it already finished."""
         return self._engine.cancel(self)

@@ -143,6 +143,11 @@ def run_section_serving(section: Dict[str, Any]) -> List[str]:
     kw = {k: section[k] for k in ("tensor_parallel", "expert_parallel",
                                   "dtype") if k in section}
     scfg = ServingConfig.from_dict(section.get("config") or {})
+    # "draft_model": "<preset>" turns the section speculative (the config
+    # should set speculative.mode='draft') so the verify + draft-model
+    # programs register and the audit/cost gates budget them
+    if "draft_model" in section:
+        kw["draft_model"] = section["draft_model"]
     engine = init_serving(model=spec["name"], serving_config=scfg,
                           **kw, **overrides)
     _KEEPALIVE.append(engine)
