@@ -19,7 +19,12 @@ instead (that drafter vs spec-off, SAME trace with a repetitive-text
 share): acceptance rate, proposed-vs-emitted tokens,
 emitted-per-target-dispatch, drafter time share, TTFT/TPOT deltas and the
 per-arm verify-program tpucost land in the record and
-BENCH_metrics_serve.jsonl. Knobs (env): BENCH_SERVE_REQUESTS,
+BENCH_metrics_serve.jsonl. ``--fleet N`` routes the same trace through a
+``FleetRouter`` over N serving replicas instead: a routing-policy A/B
+(round-robin vs KV-occupancy-aware) against a single-engine baseline,
+with per-replica peak occupancy, routing decisions by reason, and —
+with ``--disagg`` (prefill/decode pools + KV block handoff) — the
+handoff latency p50/p99 in the record. Knobs (env): BENCH_SERVE_REQUESTS,
 BENCH_SERVE_RATE (req/s), BENCH_SERVE_PROMPT (max prompt len),
 BENCH_SERVE_NEW, BENCH_SERVE_ROWS, BENCH_SERVE_BLOCK, BENCH_SERVE_BLOCKS,
 BENCH_SERVE_LEN, BENCH_SERVE_CHUNK, BENCH_SERVE_SYS (shared-prefix len),
@@ -249,6 +254,24 @@ def _configure_bench_obs():
                                   "bench_results/obs_serve")))
 
 
+def _load_stats(handles, wall):
+    """Latency/throughput aggregation shared by the single-engine and
+    fleet arms — one implementation so the numbers the fleet record is
+    compared against are computed identically."""
+    from deepspeed_tpu.serving.api import _percentile as p
+
+    ttfts = sorted(h.ttft_s for h in handles)
+    tpots = sorted(h.tpot_s for h in handles if h.tpot_s is not None)
+    total_tokens = sum(len(h.tokens) for h in handles)
+    return {
+        "p50_ttft_ms": round(p(ttfts, 0.50) * 1e3, 2),
+        "p99_ttft_ms": round(p(ttfts, 0.99) * 1e3, 2),
+        "tpot_ms": round(p(tpots, 0.50) * 1e3, 3) if tpots else None,
+        "tokens_per_sec": round(total_tokens / wall, 1),
+        "requests_per_sec": round(len(handles) / wall, 2),
+    }
+
+
 def _serve_one_mode(engine, scfg_kwargs, paged_kernel, prompts, arrivals,
                     prefix_prompts, n_new, block, enable_obs=False,
                     spec_mode="off", draft_engine=None):
@@ -281,20 +304,13 @@ def _serve_one_mode(engine, scfg_kwargs, paged_kernel, prompts, arrivals,
     srv.reset_latency_stats()
 
     handles, wall = _serve_load(srv, prompts, arrivals, n_new)
-    ttfts = sorted(h.ttft_s for h in handles)
-    tpots = sorted(h.tpot_s for h in handles if h.tpot_s is not None)
-    total_tokens = sum(len(h.tokens) for h in handles)
-    stats = {
-        "p50_ttft_ms": round(p(ttfts, 0.50) * 1e3, 2),
-        "p99_ttft_ms": round(p(ttfts, 0.99) * 1e3, 2),
-        "tpot_ms": round(p(tpots, 0.50) * 1e3, 3) if tpots else None,
-        "tokens_per_sec": round(total_tokens / wall, 1),
-        "requests_per_sec": round(len(handles) / wall, 2),
+    stats = _load_stats(handles, wall)
+    stats.update({
         "arena_peak_blocks": srv.alloc.peak_in_use,
         "arena_peak_occupancy": round(
             srv.alloc.peak_in_use / srv.alloc.capacity, 4),
         "preemptions": srv.sched.preemption_count,
-    }
+    })
     if spec_mode != "off":
         # the proposed-vs-emitted ledger: how many tokens each target
         # dispatch actually bought (> 1 is the speculative win)
@@ -355,6 +371,65 @@ def _serve_one_mode(engine, scfg_kwargs, paged_kernel, prompts, arrivals,
     return stats
 
 
+def _serve_fleet_arm(engine, scfg_kwargs, paged_kernel, n, policy, disagg,
+                     prompts, arrivals, n_new, block, enable_obs=False):
+    """One fleet arm: N serving replicas behind a FleetRouter under
+    ``policy`` (optionally split into prefill/decode pools), driven through
+    the SAME Poisson trace — and the same ``paged_kernel`` read path — as
+    the single-engine baseline. Returns the arm's stats dict: fleet-level
+    TTFT/TPOT/throughput, per-replica peak occupancy, routing decisions by
+    reason, and (disagg) the KV-handoff latency histogram."""
+    from deepspeed_tpu.config.config import FleetConfig
+    from deepspeed_tpu.serving import ServingConfig
+    from deepspeed_tpu.serving.api import _percentile as p
+    from deepspeed_tpu.serving.fleet import (ROLE_DECODE, ROLE_PREFILL,
+                                             FleetRouter, build_replicas)
+
+    roles = None
+    if disagg:
+        n_prefill = max(n // 2, 1)
+        roles = ([ROLE_PREFILL] * n_prefill
+                 + [ROLE_DECODE] * (n - n_prefill))
+    replicas = build_replicas(
+        engine, ServingConfig(paged_kernel=paged_kernel, **scfg_kwargs), n,
+        roles=roles)
+    router = FleetRouter(replicas, FleetConfig(policy=policy))
+    # warmup: compile the serving (and, disagg, the kv_export/kv_import
+    # handoff) programs off the clock, BEFORE the observability session
+    router.submit(prompts[0][: max(block, 8)], max_new_tokens=2).result()
+    if enable_obs:
+        _configure_bench_obs()
+    # drops the warmup handoff's compile-scale latency sample too
+    router.reset_latency_stats()
+
+    handles, wall = _serve_load(router, prompts, arrivals, n_new)
+    stats = _load_stats(handles, wall)
+    stats.update({
+        "policy": policy,
+        "per_replica": [
+            {"replica": r.index, "role": r.role,
+             "peak_blocks": r.engine.alloc.peak_in_use,
+             "peak_occupancy": round(
+                 r.engine.alloc.peak_in_use / r.engine.alloc.capacity, 4),
+             "preemptions": r.engine.sched.preemption_count,
+             "handoffs_out": r.engine.sched.handoffs_out}
+            for r in replicas],
+        "routing_decisions": {
+            f"{pol}/{reason}": int(c)
+            for (pol, reason), c in sorted(router._decisions.items())},
+    })
+    if disagg:
+        xs = sorted(router._handoff_ms)
+        stats["handoffs"] = {
+            "count": len(xs),
+            "fallbacks": router._handoff_fallbacks,
+            "p50_ms": round(p(xs, 0.50), 3) if xs else None,
+            "p99_ms": round(p(xs, 0.99), 3) if xs else None,
+        }
+    router.close()
+    return stats
+
+
 def serving_main() -> None:
     """Continuous-batching load test: Poisson arrivals over a synthetic
     request trace, real-time injected between scheduler iterations.
@@ -386,6 +461,17 @@ def serving_main() -> None:
     spec_flag = os.environ.get("BENCH_SERVE_SPEC", "off")
     if spec_flag not in ("off", "ngram", "draft"):
         raise SystemExit("--spec must be 'off', 'ngram' or 'draft'")
+    fleet_n = int(os.environ.get("BENCH_SERVE_FLEET", "0"))
+    disagg = os.environ.get("BENCH_SERVE_DISAGG", "0") == "1"
+    if fleet_n < 0:
+        raise SystemExit("--fleet needs N >= 0 (0, the default, disables "
+                         "fleet mode)")
+    if disagg and fleet_n < 2:
+        raise SystemExit("--disagg needs --fleet N with N >= 2 "
+                         "(at least one prefill and one decode replica)")
+    if fleet_n and spec_flag != "off":
+        raise SystemExit("--fleet and --spec are separate A/Bs — "
+                         "run them in two invocations")
     if spec_flag != "off":
         # the speculative A/B replaces the paged-kernel A/B: both spec
         # arms run the SAME read path (primary) over the SAME trace
@@ -439,6 +525,62 @@ def serving_main() -> None:
         raise
 
     obs_wanted = os.environ.get("BENCH_OBS", "1") == "1"
+    if fleet_n:
+        # fleet mode: single-engine baseline, then the routing-policy A/B
+        # (round-robin vs occupancy-aware) over the SAME trace; the
+        # occupancy arm runs LAST and owns the obs session, so the metrics
+        # JSONL carries the fleet_serving/* per-replica gauges
+        primary_mode = modes[-1]
+        metric = (f"{model_name}_{dtype_name}_fleet{fleet_n}"
+                  f"{'_disagg' if disagg else ''}_serving_p50_ttft_ms")
+        single = _serve_one_mode(engine, scfg_kwargs, primary_mode,
+                                 prompts, arrivals, [], n_new, block)
+        fleet_arms = {}
+        for i, policy in enumerate(("round_robin", "kv_occupancy")):
+            fleet_arms[policy] = _serve_fleet_arm(
+                engine, scfg_kwargs, primary_mode, fleet_n, policy, disagg,
+                prompts, arrivals, n_new, block,
+                enable_obs=(obs_wanted and i == 1))
+        primary = fleet_arms["kv_occupancy"]
+
+        from deepspeed_tpu.observability import get_session
+
+        obs = get_session()
+        if obs.enabled:
+            obs.dump_metrics(path=os.environ.get("BENCH_METRICS_JSONL",
+                                                 "BENCH_metrics_serve"
+                                                 ".jsonl"),
+                             metric=metric)
+            obs.export_chrome_trace()
+            obs.close(export=False)
+        rr = fleet_arms["round_robin"]
+        record = {
+            "metric": metric,
+            "value": primary["p50_ttft_ms"],
+            "unit": "ms",
+            "vs_baseline": None,
+            "fleet": fleet_n,
+            "disagg": disagg,
+            "paged_kernel": "on" if primary_mode == "auto" else "off",
+            "single_engine": single,
+            "fleet_ab": {
+                "round_robin": rr,
+                "kv_occupancy": primary,
+                # occupancy-aware routing's win over blind round-robin
+                "ttft_p50_delta_pct": round(
+                    100.0 * (rr["p50_ttft_ms"] - primary["p50_ttft_ms"])
+                    / max(rr["p50_ttft_ms"], 1e-9), 2),
+            },
+            # the scale-out headline: fleet throughput / one engine's
+            "tokens_per_sec_vs_single": round(
+                primary["tokens_per_sec"]
+                / max(single["tokens_per_sec"], 1e-9), 3),
+            "ttft_p50_vs_single_pct": round(
+                100.0 * (single["p50_ttft_ms"] - primary["p50_ttft_ms"])
+                / max(single["p50_ttft_ms"], 1e-9), 2),
+        }
+        print(json.dumps(record))
+        return
     arms = {}
     spec_arms = {}
     if spec_flag != "off":
@@ -539,6 +681,16 @@ if __name__ == "__main__":
             os.environ["BENCH_SERVE_SPEC"] = argv[i + 1]
         elif a.startswith("--spec="):
             os.environ["BENCH_SERVE_SPEC"] = a.split("=", 1)[1]
+        # --fleet N routes the trace through a FleetRouter over N serving
+        # replicas (routing-policy A/B vs a single-engine baseline);
+        # --disagg splits the replicas into prefill/decode pools with KV
+        # block handoff between them
+        elif a == "--fleet" and i + 1 < len(argv):
+            os.environ["BENCH_SERVE_FLEET"] = argv[i + 1]
+        elif a.startswith("--fleet="):
+            os.environ["BENCH_SERVE_FLEET"] = a.split("=", 1)[1]
+        elif a == "--disagg":
+            os.environ["BENCH_SERVE_DISAGG"] = "1"
     if os.environ.get("BENCH_SERVE_PAGED_KERNEL", "") not in ("", "on",
                                                               "off"):
         raise SystemExit("--paged-kernel must be 'on' or 'off'")

@@ -575,6 +575,41 @@ class ServingConfig(ConfigModel):
 
 
 @dataclass
+class FleetConfig(ConfigModel):
+    """Serving fleet (``deepspeed_tpu/serving/fleet``): a data-plane router
+    over N ``ServingEngine`` replicas, optionally split into prefill and
+    decode pools (DistServe-style disaggregation with KV block handoff)."""
+
+    policy: str = "kv_occupancy"   # routing policy: 'round_robin' |
+    #   'least_queue' (fewest in-flight requests) | 'kv_occupancy' (lowest
+    #   arena occupancy, tie-broken by queue) | 'affinity' (prefix-cache
+    #   locality: requests sharing a first prompt block follow earlier
+    #   ones to the replica whose prefix cache is warm)
+    affinity_overload: float = 0.85  # arena occupancy above which an
+    #   affinity-warm replica is skipped (locality never beats liveness)
+    max_resubmits: int = 3         # per-request resubmission budget across
+    #   replica deaths; exhausting it cancels the request
+    handoff_retry_iterations: int = 0  # reserved: 0 = a handoff the decode
+    #   pool cannot take right now falls back to decoding on the prefill
+    #   replica (degraded but live)
+
+    def validate(self) -> None:
+        if self.policy not in ("round_robin", "least_queue",
+                               "kv_occupancy", "affinity"):
+            raise ConfigError(
+                "fleet.policy must be 'round_robin', 'least_queue', "
+                f"'kv_occupancy' or 'affinity', got '{self.policy}'")
+        if not 0.0 < self.affinity_overload <= 1.0:
+            raise ConfigError("fleet.affinity_overload must be in (0, 1], "
+                              f"got {self.affinity_overload}")
+        if self.max_resubmits < 0:
+            raise ConfigError("fleet.max_resubmits must be >= 0")
+        if self.handoff_retry_iterations < 0:
+            raise ConfigError(
+                "fleet.handoff_retry_iterations must be >= 0")
+
+
+@dataclass
 class ElasticityConfig(ConfigModel):
     """Reference: elasticity/config.py — pure batch/world-size math."""
 

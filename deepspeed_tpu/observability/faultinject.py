@@ -24,6 +24,10 @@ Fault kinds (``Fault.kind``):
 * ``ckpt_truncate`` — truncate a shard file of the newest committed
   checkpoint tag after the next save (the torn-write / partial-upload case
   checksum-verified load with previous-good-tag fallback exists for).
+* ``replica_kill``  — mark serving-fleet replica ``replica`` dead at
+  router iteration ``step`` (the engine-loss case the fleet router's
+  drain + bit-exact resubmission exists for; ``serving/fleet/router.py``
+  calls ``before_router_step`` between scheduler iterations).
 
 Plumbing: a plan is a JSON list of fault dicts, passed directly
 (``FaultInjector(plan=[...])``) or through the environment
@@ -46,7 +50,8 @@ from typing import Any, Callable, Dict, List, Optional
 
 from ..utils.logging import logger
 
-FAULT_KINDS = ("rank_kill", "straggle", "nan_params", "ckpt_truncate")
+FAULT_KINDS = ("rank_kill", "straggle", "nan_params", "ckpt_truncate",
+               "replica_kill")
 
 PLAN_ENV = "DSTPU_FAULT_PLAN"
 
@@ -68,6 +73,7 @@ class Fault:
     sleep_s: float = 0.0      # straggle: per-step added latency
     steps: int = 1            # straggle: how many consecutive steps
     shard_index: int = 0      # ckpt_truncate: which shard file to maim
+    replica: int = 0          # replica_kill: fleet replica index to kill
 
     def __post_init__(self):
         if self.kind not in FAULT_KINDS:
@@ -177,7 +183,8 @@ class FaultInjector:
         if step <= self._straggle_until and self._straggle_sleep > 0:
             self._sleep(self._straggle_sleep)
         for i, fault in enumerate(self.plan):
-            if i in self._done or fault.kind == "ckpt_truncate" \
+            if i in self._done \
+                    or fault.kind in ("ckpt_truncate", "replica_kill") \
                     or not self._mine(fault) or fault.step != step:
                 continue
             self._done.add(i)
@@ -195,6 +202,21 @@ class FaultInjector:
                 self._note(fault, step)
                 if engine is not None:
                     poison_params(engine)
+
+    def before_router_step(self, iteration: int,
+                           kill_fn: Callable[[int], None]) -> None:
+        """Apply any ``replica_kill`` fault scheduled for this fleet-router
+        iteration: ``kill_fn(replica_index)`` is the router's kill switch
+        (marks the replica dead; the router's next drain pass resubmits its
+        in-flight requests elsewhere). Called by
+        ``serving/fleet/router.FleetRouter.step`` before replicas run."""
+        for i, fault in enumerate(self.plan):
+            if i in self._done or fault.kind != "replica_kill" \
+                    or not self._mine(fault) or fault.step != iteration:
+                continue
+            self._done.add(i)
+            self._note(fault, iteration, replica=fault.replica)
+            kill_fn(fault.replica)
 
     def after_save(self, ckpt_dir: str, step: Optional[int] = None) -> None:
         """Apply any pending ``ckpt_truncate`` fault to the newest committed

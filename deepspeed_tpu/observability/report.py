@@ -303,6 +303,119 @@ def summarize_serving(records: List[Dict[str, Any]]) -> str:
     return "\n".join(lines)
 
 
+def summarize_fleet_serving(records: List[Dict[str, Any]]) -> str:
+    """``== fleet serving ==`` — the serving-fleet router's view: per-replica
+    occupancy/queue table, routing decisions by policy reason, prefill→decode
+    KV handoffs with p50/p99 latency, and death/resubmission incidents, from
+    the fleet_serving/* metrics (``serving/fleet/router.py``)."""
+    recs = [r for r in records
+            if str(r.get("name", "")).startswith("fleet_serving/")]
+    if not recs:
+        return ""
+    latest: Dict[Tuple[str, str], Dict[str, Any]] = {}
+    for r in recs:
+        latest[(r["name"], _label_str(r.get("labels", {})))] = r
+    lines = ["== fleet serving =="]
+
+    def gauge(name: str) -> Any:
+        r = latest.get((name, "-"))
+        return r["value"] if r is not None else None
+
+    alive = gauge("fleet_serving/replicas_alive")
+    if alive is not None:
+        lines[0] += f"  replicas_alive={alive:.0f}"
+    in_flight = gauge("fleet_serving/requests_in_flight")
+    if in_flight is not None:
+        lines[0] += f"  in_flight={in_flight:.0f}"
+    # per-replica load table (the router's _publish gauges carry
+    # replica=/role= labels)
+    per_replica: Dict[Tuple[int, str], Dict[str, float]] = {}
+    for col, name in (("queue", "fleet_serving/queue_depth"),
+                      ("in_flight", "fleet_serving/in_flight"),
+                      ("arena_occ", "fleet_serving/arena_occupancy"),
+                      ("decode_occ", "fleet_serving/decode_batch_occupancy"),
+                      ("kv_blocks", "fleet_serving/kv_blocks_in_use")):
+        for (n, _), r in latest.items():
+            if n != name:
+                continue
+            labels = r.get("labels", {})
+            key = (int(labels.get("replica", -1)),
+                   str(labels.get("role", "?")))
+            per_replica.setdefault(key, {})[col] = r["value"]
+    if per_replica:
+        rows = []
+        for (idx, role), vals in sorted(per_replica.items()):
+            rows.append([str(idx), role,
+                         f"{vals.get('queue', 0):.0f}",
+                         f"{vals.get('in_flight', 0):.0f}",
+                         f"{vals.get('arena_occ', 0):.2f}",
+                         f"{vals.get('decode_occ', 0):.2f}",
+                         f"{vals.get('kv_blocks', 0):.0f}"])
+        lines.append(_fmt_table(
+            ["replica", "role", "queue", "in_flight", "arena_occ",
+             "decode_occ", "kv_blocks"], rows))
+    # routing decisions by (policy, reason, replica)
+    decisions = [(r.get("labels", {}), r["value"])
+                 for (n, _), r in latest.items()
+                 if n == "fleet_serving/routing_decisions"]
+    if decisions:
+        by_reason: Dict[Tuple[str, str], float] = {}
+        for labels, v in decisions:
+            key = (str(labels.get("policy", "?")),
+                   str(labels.get("reason", "?")))
+            by_reason[key] = by_reason.get(key, 0.0) + v
+        parts = [f"{policy}/{reason}={v:.0f}"
+                 for (policy, reason), v in
+                 sorted(by_reason.items(), key=lambda kv: -kv[1])]
+        lines.append("  routing: " + "  ".join(parts))
+    # fleet-level TTFT
+    ttft = latest.get(("fleet_serving/ttft_ms", "-"))
+    if ttft is not None and ttft.get("type") == "histogram":
+        lines.append(f"  ttft_ms: n={int(ttft.get('count', 0))} "
+                     f"mean={ttft.get('mean', 0):.2f} "
+                     f"min={ttft.get('min', 0):.2f} "
+                     f"max={ttft.get('max', 0):.2f}")
+    # prefill→decode KV handoffs
+    handoffs = sum(r["value"] for (n, _), r in latest.items()
+                   if n == "fleet_serving/handoffs"
+                   and r.get("type") == "counter")
+    if handoffs:
+        parts = [f"count={handoffs:.0f}"]
+        hist = latest.get(("fleet_serving/handoff_ms", "-"))
+        if hist is not None and hist.get("type") == "histogram":
+            parts.append(f"mean={hist.get('mean', 0):.2f}ms")
+        p50 = gauge("fleet_serving/handoff_p50_ms")
+        p99 = gauge("fleet_serving/handoff_p99_ms")
+        if p50 is not None:
+            parts.append(f"p50={p50:.2f}ms")
+        if p99 is not None:
+            parts.append(f"p99={p99:.2f}ms")
+        fallbacks = sum(r["value"] for (n, _), r in latest.items()
+                        if n == "fleet_serving/handoff_fallbacks"
+                        and r.get("type") == "counter")
+        if fallbacks:
+            parts.append(f"fallbacks={fallbacks:.0f}")
+        lines.append("  handoffs: " + "  ".join(parts))
+    # resilience incidents: deaths by reason, resubmissions
+    deaths = [(r.get("labels", {}).get("reason", "?"), r["value"])
+              for (n, _), r in latest.items()
+              if n == "fleet_serving/replica_deaths"
+              and r.get("type") == "counter"]
+    resubmits = sum(r["value"] for (n, _), r in latest.items()
+                    if n == "fleet_serving/resubmits"
+                    and r.get("type") == "counter")
+    if deaths:
+        total = sum(v for _, v in deaths)
+        by = "  ".join(f"{reason}={v:.0f}"
+                       for reason, v in sorted(deaths, key=lambda kv: -kv[1]))
+        lines.append(f"  !! {total:.0f} replica death(s) ({by}) — "
+                     f"{resubmits:.0f} in-flight request(s) resubmitted "
+                     "with bit-exact recompute")
+    elif resubmits:
+        lines.append(f"  resubmits={resubmits:.0f}")
+    return "\n".join(lines)
+
+
 def summarize_resilience(records: List[Dict[str, Any]]) -> str:
     """``== resilience ==`` — recovery events (kind × policy), time to
     recover, eviction requests, injected faults (chaos runs), and goodput
@@ -483,6 +596,7 @@ def report(paths: List[str]) -> str:
                             summarize_resilience(records),
                             summarize_cost(records),
                             summarize_serving(records),
+                            summarize_fleet_serving(records),
                             summarize_fleet(records),
                             summarize_recompiles(records)) if s]
     if not sections:

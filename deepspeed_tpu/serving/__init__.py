@@ -17,11 +17,15 @@ rebuilt on the repo's inference substrate under jit-cache discipline:
                  lossless bit-stable acceptance over the R×(K+1) verify
   api.py         ServingEngine.submit()/stream()/step()/run(), metrics
                  into the observability registry, tpuaudit registration
+  fleet/         the deployment layer: data-plane router over N replicas,
+                 prefill/decode disaggregation with KV block handoff,
+                 replica-death drain + bit-exact resubmission
 
 See docs/serving.md for the architecture and the block-table layout.
 """
 
-from ..config.config import ServingConfig, SpeculativeConfig  # noqa: F401
+from ..config.config import (FleetConfig, ServingConfig,  # noqa: F401
+                             SpeculativeConfig)
 from .api import ServingEngine, init_serving  # noqa: F401
 from .paged_kv import (BlockAllocator, BlockAllocatorError,  # noqa: F401
                        PrefixCache)
@@ -30,6 +34,9 @@ from .scheduler import (QueueFull, Request, SamplingParams,  # noqa: F401
 from .session import RequestCancelled, RequestHandle  # noqa: F401
 from .speculative import (Drafter, DraftModelDrafter,  # noqa: F401
                           NgramDrafter)
+from .fleet import (ArenaHandoff, FleetHandle, FleetRouter,  # noqa: F401
+                    FleetUnavailable, KVHandoff, Replica, ReplicaHealth,
+                    build_replicas)
 
 __all__ = [
     "ServingConfig", "SpeculativeConfig", "ServingEngine", "init_serving",
@@ -37,4 +44,7 @@ __all__ = [
     "Scheduler", "Request", "SamplingParams", "QueueFull",
     "RequestHandle", "RequestCancelled",
     "Drafter", "NgramDrafter", "DraftModelDrafter",
+    "FleetConfig", "FleetRouter", "FleetHandle", "FleetUnavailable",
+    "Replica", "ReplicaHealth", "build_replicas",
+    "KVHandoff", "ArenaHandoff",
 ]

@@ -154,6 +154,13 @@ class ServingEngine:
         self._pending_forks: Dict[int, List[Request]] = {}
         self._tokens_out = 0
         self._started_s = clock()
+        # fleet seam (serving/fleet): called with the request right after
+        # its LAST prefill chunk completed and the first token was emitted,
+        # while the engine lock is held. The disaggregation router uses it
+        # to hand the sequence's KV blocks to a decode-pool engine; the
+        # hook may release the request from this engine entirely
+        # (``release_for_handoff``). None = single-engine serving.
+        self.on_prefill_complete: Optional[Callable[[Request], None]] = None
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self._closed = False
@@ -304,6 +311,116 @@ class ServingEngine:
         with self._lock:
             return self.sched.in_flight() + self._pending_fork_count()
 
+    # -- fleet seams (serving/fleet: router resubmission + KV handoff) -----
+    def submit_recovered(self, prompt, generated, *,
+                         max_new_tokens: int, temperature: float = 0.0,
+                         top_k: int = 0, top_p: float = 1.0,
+                         eos_token_id: Optional[int] = None,
+                         tenant: str = "default",
+                         deadline_s: Optional[float] = None,
+                         seed: int = 0) -> RequestHandle:
+        """Resubmit a request that was mid-stream on a DEAD engine: enqueue
+        it in exactly the state the preemption machinery leaves a
+        recompute-mode request in — prefill source is the original prompt
+        plus every already-streamed token except the last, which becomes
+        the authoritative ``pending_token`` — so decode resumes at
+        output-token index ``len(generated)`` under the identical
+        (engine seed, request seed, token index) sampling stream and the
+        continued output is bit-identical to an uninterrupted run.
+        Already-streamed tokens are never re-emitted (the fleet handle
+        holds them); does NOT count ``serving/requests_submitted`` — the
+        dead engine already did, and the fleet-wide ledger must balance."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        generated = [int(t) for t in generated]
+        with self._lock:
+            if (self.sched.in_flight() + self._pending_fork_count() + 1
+                    > self.config.max_queue):
+                from .scheduler import QueueFull
+
+                raise QueueFull(
+                    "serving queue cannot take the recovered request "
+                    f"(max_queue={self.config.max_queue})")
+            req = Request(
+                rid=self._rid, prompt=prompt.copy(),
+                max_new_tokens=max_new_tokens,
+                sampling=SamplingParams(temperature=float(temperature),
+                                        top_k=int(top_k),
+                                        top_p=float(top_p)),
+                eos_token_id=eos_token_id, tenant=tenant, seed=seed,
+                deadline_s=(self.clock() + deadline_s
+                            if deadline_s is not None else None))
+            if generated:
+                req.prompt = np.concatenate(
+                    [prompt, np.asarray(generated[:-1],
+                                        np.int32)]).astype(np.int32)
+                req.generated = list(generated)
+                req.pending_token = generated[-1]
+                req.resume = True
+            self.sched.submit(req)    # raises before rid is consumed
+            self._rid += 1
+            if generated:
+                # TTFT already happened on the dead engine — the unset-
+                # timestamp catch in _emit must not restamp it here
+                req.first_token_s = req.arrival_s
+            handle = RequestHandle(self, req)
+            self._handles[req.rid] = handle
+            return handle
+
+    def adopt_prefilled(self, *, prompt, n_prompt: int, generated,
+                        pending_token: int, length: int, blocks: List[int],
+                        seed: int, sampling: SamplingParams,
+                        max_new_tokens: int,
+                        eos_token_id: Optional[int] = None,
+                        tenant: str = "default",
+                        deadline_s: Optional[float] = None) -> RequestHandle:
+        """Adopt a request whose KV already sits in THIS engine's arena
+        (fleet KV handoff): ``blocks`` must be blocks of this engine's
+        allocator, freshly imported with the request's first ``length``
+        positions resident. The request joins the queue fully prefilled —
+        admission only needs a decode row — and its decode continues at
+        output-token index ``len(generated)``, bit-identical to never
+        having moved. ``prompt`` is the ORIGINAL prompt (a later preemption
+        rebuilds the recompute source from prompt[:n_prompt] + generated).
+        Raises ``QueueFull`` when this engine cannot take the request —
+        the caller still owns ``blocks`` and must free them."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        with self._lock:
+            if (self.sched.in_flight() + self._pending_fork_count() + 1
+                    > self.config.max_queue):
+                from .scheduler import QueueFull
+
+                raise QueueFull(
+                    "serving queue cannot adopt the handed-off request "
+                    f"(max_queue={self.config.max_queue})")
+            req = Request(
+                rid=self._rid, prompt=prompt.copy(),
+                max_new_tokens=max_new_tokens, sampling=sampling,
+                eos_token_id=eos_token_id, tenant=tenant, seed=seed,
+                n_prompt=int(n_prompt),
+                deadline_s=(self.clock() + deadline_s
+                            if deadline_s is not None else None))
+            self._rid += 1
+            req.generated = [int(t) for t in generated]
+            req.pending_token = int(pending_token)
+            req.length = int(length)
+            req.prefill_pos = int(req.prompt.size)
+            req.blocks = list(blocks)
+            # every emitted token (incl. the prefill-completion one) was
+            # streamed by the source engine; TTFT belongs to it
+            req.first_token_s = self.clock()
+            self.sched.submit_forked(req)
+            handle = RequestHandle(self, req)
+            self._handles[req.rid] = handle
+            return handle
+
+    def release_for_handoff(self, req: Request) -> None:
+        """Release a request whose KV was exported to another engine:
+        terminal for this engine (row/blocks freed, handle dropped)
+        without touching the completion ledger."""
+        with self._lock:
+            self.sched.release_handoff(req)
+            self._handles.pop(req.rid, None)
+
     # -- the iteration -----------------------------------------------------
     def step(self) -> bool:
         """One continuous-batching iteration; returns True when any request
@@ -412,6 +529,11 @@ class ServingEngine:
                 req.resume = False
             else:
                 self._emit(req, int(tok[0]), first=True)
+            if (self.on_prefill_complete is not None
+                    and req.state == DECODE):
+                # still DECODE: a max_new_tokens=1 request already finished
+                # in _emit above and has nothing left to hand off
+                self.on_prefill_complete(req)
         return True
 
     # -- parallel-sampling fork (COW) --------------------------------------

@@ -1,0 +1,32 @@
+"""Serving fleet — scale the single-arena serving stack out.
+
+One ``ServingEngine`` is one arena on one mesh; this package is the
+deployment layer over N of them (the DeepSpeed-MII/FastGen analog taken
+past one engine, ROADMAP item 2):
+
+  replica.py   Replica + the cheap ReplicaHealth snapshot the router
+               polls between scheduler iterations
+  router.py    FleetRouter: same submit()/stream()/result()/cancel()
+               surface as ServingEngine, pluggable routing policies
+               (queue-depth / KV-occupancy / prefix-affinity with
+               cross-replica admission hints), replica-death drain +
+               bit-exact resubmission
+  disagg.py    prefill/decode disaggregation: the KVHandoff seam and the
+               in-HBM ArenaHandoff (jitted block gather/scatter —
+               serving/kv_export + serving/kv_import)
+
+See docs/serving.md ("Fleet serving & disaggregation").
+"""
+
+from .disagg import (ArenaHandoff, HandoffGeometryError,  # noqa: F401
+                     KVHandoff)
+from .replica import (ROLE_DECODE, ROLE_MIXED, ROLE_PREFILL,  # noqa: F401
+                      Replica, ReplicaDead, ReplicaHealth, build_replicas)
+from .router import FleetHandle, FleetRouter, FleetUnavailable  # noqa: F401
+
+__all__ = [
+    "FleetRouter", "FleetHandle", "FleetUnavailable",
+    "Replica", "ReplicaHealth", "ReplicaDead", "build_replicas",
+    "ROLE_MIXED", "ROLE_PREFILL", "ROLE_DECODE",
+    "KVHandoff", "ArenaHandoff", "HandoffGeometryError",
+]

@@ -1,0 +1,134 @@
+"""Replica — one ``ServingEngine`` behind the fleet router.
+
+A ``Replica`` wraps a serving engine with the three things the router
+needs that the engine itself does not expose: an identity + role (mixed /
+prefill / decode for disaggregation), a liveness flag the chaos harness
+can flip (``replica_kill``) and real death detection hooks onto, and a
+cheap host-side :class:`ReplicaHealth` snapshot the router polls between
+scheduler iterations — every field is a host counter read, no device sync.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional
+
+__all__ = ["Replica", "ReplicaHealth", "ReplicaDead",
+           "ROLE_MIXED", "ROLE_PREFILL", "ROLE_DECODE", "build_replicas"]
+
+ROLE_MIXED = "mixed"
+ROLE_PREFILL = "prefill"
+ROLE_DECODE = "decode"
+
+
+class ReplicaDead(RuntimeError):
+    """The replica is not serving (killed by fault injection, a crashed
+    driver thread, or an explicit drain)."""
+
+
+@dataclasses.dataclass
+class ReplicaHealth:
+    """Cheap load/occupancy snapshot of one replica — the router's routing
+    and drain decisions read THIS, never the engine's internals."""
+
+    index: int
+    role: str
+    alive: bool
+    queue_depth: int = 0            # requests waiting for admission
+    in_flight: int = 0              # queued + running (+ pending forks)
+    kv_blocks_in_use: int = 0
+    kv_blocks_free: int = 0
+    arena_occupancy: float = 0.0    # allocated fraction of the block pool
+    decode_batch_occupancy: float = 0.0   # decoding rows / max_seqs
+
+    @property
+    def load_key(self):
+        """Stable comparison key for occupancy-aware routing: fullest
+        metric first, then queue pressure, then index (determinism)."""
+        return (self.arena_occupancy, self.in_flight, self.index)
+
+
+class Replica:
+    """One fleet member. ``role`` partitions the fleet for prefill/decode
+    disaggregation (``ROLE_MIXED`` replicas serve both phases)."""
+
+    def __init__(self, engine, index: int, role: str = ROLE_MIXED):
+        if role not in (ROLE_MIXED, ROLE_PREFILL, ROLE_DECODE):
+            raise ValueError(f"unknown replica role '{role}'")
+        self.engine = engine
+        self.index = int(index)
+        self.role = role
+        self.alive = True
+        self.drained = False        # router bookkeeping: dead AND resubmitted
+        self.death_reason: Optional[str] = None
+
+    def kill(self, reason: str = "killed") -> None:
+        """Mark the replica dead. The router stops stepping it and its
+        in-flight requests are resubmitted elsewhere on the next router
+        iteration; the engine object's host state is NOT consulted again —
+        a real process death leaves nothing to consult."""
+        if self.alive:
+            self.alive = False
+            self.death_reason = reason
+
+    def step(self) -> bool:
+        if not self.alive:
+            raise ReplicaDead(
+                f"replica {self.index} is dead ({self.death_reason})")
+        return self.engine.step()
+
+    def health(self) -> ReplicaHealth:
+        if not self.alive:
+            return ReplicaHealth(index=self.index, role=self.role,
+                                 alive=False)
+        eng = self.engine
+        alloc = eng.alloc
+        sched = eng.sched
+        return ReplicaHealth(
+            index=self.index, role=self.role, alive=True,
+            queue_depth=sched.queue_depth(),
+            in_flight=eng.in_flight(),
+            kv_blocks_in_use=alloc.blocks_in_use,
+            kv_blocks_free=alloc.blocks_free,
+            arena_occupancy=alloc.blocks_in_use / max(alloc.capacity, 1),
+            decode_batch_occupancy=(len(sched.decode_requests())
+                                    / eng.config.max_seqs))
+
+
+def build_replicas(engine, serving_config, n: int,
+                   roles: Optional[List[str]] = None,
+                   clock=None, draft_engine=None) -> List[Replica]:
+    """N serving replicas over ONE set of weights (the in-process fleet the
+    tests and bench drive; a multi-host fleet builds one ServingEngine per
+    host and wraps each the same way). The replicas share the underlying
+    ``InferenceEngine``'s params and — since their arena/program shapes are
+    identical — the first replica's compiled serving programs, so a fleet
+    costs one compile set plus N arenas, not N compile sets."""
+    import copy
+
+    from ..api import ServingEngine
+
+    if n < 1:
+        raise ValueError(f"build_replicas(n={n}): need n >= 1")
+    if roles is not None and len(roles) != n:
+        raise ValueError(f"build_replicas: {len(roles)} roles for {n} "
+                         "replicas")
+    replicas: List[Replica] = []
+    first = None
+    for i in range(n):
+        kw = {"clock": clock} if clock is not None else {}
+        srv = ServingEngine(engine, copy.deepcopy(serving_config),
+                            draft_engine=draft_engine, **kw)
+        if first is None:
+            first = srv
+        else:
+            # identical (cfg, shapes) → the jitted callables are
+            # interchangeable; sharing them collapses N compiles into 1
+            srv._prefill = first._prefill
+            srv._decode = first._decode
+            srv._cow = first._cow
+            if srv._verify is not None:
+                srv._verify = first._verify
+        replicas.append(Replica(srv, index=i,
+                                role=roles[i] if roles else ROLE_MIXED))
+    return replicas

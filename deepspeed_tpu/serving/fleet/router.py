@@ -1,0 +1,762 @@
+"""FleetRouter — the data-plane front end over N serving replicas.
+
+Exposes the same ``submit()/stream()/result()/cancel()`` surface as one
+``ServingEngine`` and dispatches to a fleet of them:
+
+* **Routing** — pluggable policies over the per-replica
+  :class:`~.replica.ReplicaHealth` snapshot the router polls between
+  scheduler iterations: ``round_robin``, ``least_queue`` (fewest in-flight
+  requests), ``kv_occupancy`` (lowest arena occupancy) and ``affinity``
+  (prefix-cache locality: the router remembers which replica served each
+  first-prompt-block hash, so requests sharing a system prompt follow the
+  warm prefix cache instead of re-prefilling it N times — the
+  cross-replica prefix-cache admission hint). Every decision is counted by
+  reason in ``fleet_serving/routing_decisions``.
+* **Disaggregation** — replicas carry roles (``prefill`` / ``decode``):
+  a request prefills on the prefill pool, then its KV blocks move to a
+  decode replica through the :class:`~.disagg.KVHandoff` seam and decoding
+  continues there, bit-identically (the sampling stream depends only on
+  (engine seed, request seed, token index), never on which engine runs
+  it). A handoff the decode pool cannot take falls back to decoding in
+  place — degraded but live.
+* **Resilience** — a dead replica (chaos ``replica_kill`` fault, or an
+  exception out of its scheduler iteration) is drained: every in-flight
+  request resubmits to a surviving replica in recompute mode
+  (``ServingEngine.submit_recovered``), which re-prefills prompt +
+  streamed-tokens and continues the stream bit-exactly — the per-engine
+  preemption guarantee promoted to the fleet.
+
+The router DRIVES its replicas (one scheduler iteration per replica per
+``step()``); replica engines must not run their own driver threads.
+``start()`` provides the fleet's background thread.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Any, Callable, Dict, Iterator, List, Optional
+
+import numpy as np
+
+from ...config.config import FleetConfig
+from ...observability import get_session
+from ...utils.logging import log_dist, logger
+from ..scheduler import FINISHED, QueueFull
+from .disagg import ArenaHandoff, KVHandoff, register_handoff_audit_entries
+from .replica import (ROLE_DECODE, ROLE_MIXED, ROLE_PREFILL, Replica,
+                      ReplicaDead)
+
+__all__ = ["FleetRouter", "FleetHandle", "FleetUnavailable"]
+
+RUNNING = "running"
+F_FINISHED = "finished"
+F_CANCELLED = "cancelled"
+
+
+class FleetUnavailable(RuntimeError):
+    """No alive replica can take the request."""
+
+
+class _FleetRequest:
+    """Router-side record of one client request: the original submission
+    (the resubmit source of truth) plus the CURRENT engine binding."""
+
+    def __init__(self, fid: int, prompt: np.ndarray, seed: int,
+                 kwargs: Dict[str, Any], arrival_s: float):
+        self.fid = fid
+        self.prompt = prompt
+        self.seed = seed
+        self.kwargs = kwargs          # max_new_tokens/sampling/eos/tenant
+        self.deadline_abs: Optional[float] = None
+        self.state = RUNNING
+        self.replica: Optional[Replica] = None
+        self.u_req = None             # bound engine-side Request
+        self.u_handle = None          # ... and its RequestHandle
+        self.consumed = 0             # tokens drained off u_handle so far
+        self.resubmits = 0
+        self.handoffs = 0
+        self.arrival_s = arrival_s
+        self.first_token_s: Optional[float] = None
+        self.finish_s: Optional[float] = None
+        self.handle: Optional["FleetHandle"] = None
+
+    def bind(self, replica: Replica, u_handle) -> None:
+        self.replica = replica
+        self.u_handle = u_handle
+        self.u_req = u_handle._req
+        self.consumed = 0
+
+    @property
+    def done(self) -> bool:
+        return self.state in (F_FINISHED, F_CANCELLED)
+
+
+class FleetHandle:
+    """Client view of one fleet request: the same incremental streaming
+    surface as ``RequestHandle``, stable across KV handoffs and replica
+    deaths (the router rebinds the engine side underneath it)."""
+
+    def __init__(self, router: "FleetRouter", fr: _FleetRequest):
+        self._router = router
+        self._fr = fr
+        self._cond = threading.Condition()
+        self._tokens: List[int] = []
+
+    # -- router-side -------------------------------------------------------
+    def _push(self, token: int) -> None:
+        with self._cond:
+            self._tokens.append(int(token))
+            self._cond.notify_all()
+
+    def _wake(self) -> None:
+        with self._cond:
+            self._cond.notify_all()
+
+    # -- client-side -------------------------------------------------------
+    @property
+    def request_id(self) -> int:
+        return self._fr.fid
+
+    @property
+    def state(self) -> str:
+        return self._fr.state
+
+    @property
+    def done(self) -> bool:
+        return self._fr.done
+
+    @property
+    def tokens(self) -> List[int]:
+        with self._cond:
+            return list(self._tokens)
+
+    @property
+    def ttft_s(self) -> Optional[float]:
+        if self._fr.first_token_s is None:
+            return None
+        return self._fr.first_token_s - self._fr.arrival_s
+
+    @property
+    def tpot_s(self) -> Optional[float]:
+        fr = self._fr
+        if (fr.finish_s is None or fr.first_token_s is None
+                or len(self._tokens) < 2):
+            return None
+        return (fr.finish_s - fr.first_token_s) / (len(self._tokens) - 1)
+
+    @property
+    def resubmits(self) -> int:
+        return self._fr.resubmits
+
+    @property
+    def handoffs(self) -> int:
+        return self._fr.handoffs
+
+    def cancel(self) -> bool:
+        return self._router.cancel(self)
+
+    def stream(self, timeout_s: Optional[float] = None) -> Iterator[int]:
+        """Yield tokens as generated; in step-driven mode this drives the
+        ROUTER (one fleet iteration per starved pass)."""
+        from ..session import drive_stream
+
+        rt = self._router
+        yield from drive_stream(
+            self._cond, self._tokens, lambda: self._fr.done, rt.clock,
+            lambda: rt.threaded, rt.step, lambda: rt._starvation_limit,
+            f"fleet request {self._fr.fid}",
+            "fleet stalled — no replica can make progress", timeout_s)
+
+    def result(self, timeout_s: Optional[float] = None) -> np.ndarray:
+        for _ in self.stream(timeout_s=timeout_s):
+            pass
+        if self._fr.state == F_CANCELLED:
+            from ..session import RequestCancelled
+
+            raise RequestCancelled(
+                f"fleet request {self._fr.fid} was cancelled")
+        return np.asarray(self.tokens, np.int32)
+
+
+class FleetRouter:
+    """Data-plane router over N serving replicas (see module docstring)."""
+
+    def __init__(self, replicas: List[Replica],
+                 config: Optional[FleetConfig] = None,
+                 clock: Callable[[], float] = time.monotonic,
+                 fault_plan: Any = None,
+                 handoff: Optional[KVHandoff] = None):
+        if not replicas:
+            raise ValueError("FleetRouter needs at least one replica")
+        self.replicas = list(replicas)
+        self.config = config or FleetConfig()
+        self.config.validate()
+        self.clock = clock
+        geoms = {(r.engine.config.block_size, r.engine.config.max_model_len)
+                 for r in self.replicas}
+        if len(geoms) > 1:
+            raise ValueError(
+                f"fleet replicas disagree on block geometry {sorted(geoms)}"
+                " — affinity keys and KV handoffs need one (block_size, "
+                "max_model_len)")
+        self._block_size = self.replicas[0].engine.config.block_size
+        roles = {r.role for r in self.replicas}
+        self.disagg = roles != {ROLE_MIXED}
+        self.prefill_pool = [r for r in self.replicas
+                             if r.role in (ROLE_PREFILL, ROLE_MIXED)]
+        self.decode_pool = [r for r in self.replicas
+                            if r.role in (ROLE_DECODE, ROLE_MIXED)]
+        if self.disagg and (not self.prefill_pool or not self.decode_pool):
+            raise ValueError(
+                "disaggregated fleet needs at least one prefill and one "
+                f"decode replica (roles: {sorted(roles)})")
+        self.handoff = handoff or (ArenaHandoff() if self.disagg else None)
+        if self.disagg:
+            for r in self.prefill_pool:
+                if r.role != ROLE_PREFILL:
+                    continue
+                r.engine.on_prefill_complete = (
+                    lambda req, _r=r: self._handoff_from(_r, req))
+            register_handoff_audit_entries(self.replicas[0].engine,
+                                           self.handoff)
+        self._lock = threading.RLock()
+        self._fid = 0
+        self._iterations = 0
+        # fid -> live request; terminal requests are pruned (the client
+        # keeps its handle) so a long-running router stays bounded
+        self._requests: Dict[int, _FleetRequest] = {}
+        self._by_engine: Dict[tuple, int] = {}   # (replica_idx, rid) -> fid
+        # first-prompt-block hash -> replica index (bounded LRU): the
+        # cross-replica prefix-cache admission hint
+        self._affinity: "collections.OrderedDict[bytes, int]" = \
+            collections.OrderedDict()
+        self._rr = 0
+        # host-side (policy, reason) -> count mirror of the
+        # fleet_serving/routing_decisions counter, for obs-less callers
+        # (the bench A/B reads this)
+        self._decisions: "collections.Counter" = collections.Counter()
+        self._handoff_ms = collections.deque(maxlen=8192)
+        self._resubmit_count = 0
+        self._death_count = 0
+        self._handoff_fallbacks = 0
+        self._starvation_limit = 2 * sum(
+            r.engine.config.max_queue for r in self.replicas) + 8
+        self._injector = None
+        if fault_plan is not None:
+            from ...observability.faultinject import FaultInjector
+
+            obs = get_session()
+            self._injector = FaultInjector(
+                plan=fault_plan, rank=0, restart=0,
+                registry=obs.registry if obs.enabled else None)
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._closed = False
+        log_dist(f"fleet router ready: {len(self.replicas)} replicas "
+                 f"(policy={self.config.policy}, "
+                 f"disagg={'on' if self.disagg else 'off'})")
+
+    # -- client API --------------------------------------------------------
+    @property
+    def threaded(self) -> bool:
+        return self._thread is not None
+
+    def in_flight(self) -> int:
+        with self._lock:
+            return len(self._requests)
+
+    def submit(self, prompt, max_new_tokens: Optional[int] = None,
+               temperature: float = 0.0, top_k: int = 0, top_p: float = 1.0,
+               eos_token_id: Optional[int] = None, tenant: str = "default",
+               deadline_s: Optional[float] = None, seed: int = 0,
+               n: int = 1):
+        """Route and enqueue one prompt; returns a :class:`FleetHandle`
+        (a list of ``n`` for parallel sampling, non-disaggregated fleets
+        only — a fork's shared blocks cannot span a handoff)."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if n < 1:
+            raise ValueError(f"submit(n={n}): need n >= 1")
+        if n > 1 and self.disagg:
+            raise NotImplementedError(
+                "parallel sampling (n > 1) is per-replica COW sharing — "
+                "not supported through a disaggregated fleet")
+        with self._lock:
+            pool = self.prefill_pool if self.disagg else self.replicas
+            replica, reason = self._pick(pool, prompt)
+            if replica is None:
+                raise FleetUnavailable("no alive replica to route to")
+            self._count_decision(reason, replica)
+            handles = replica.engine.submit(
+                prompt, max_new_tokens=max_new_tokens,
+                temperature=temperature, top_k=top_k, top_p=top_p,
+                eos_token_id=eos_token_id, tenant=tenant,
+                deadline_s=deadline_s, seed=seed, n=n)
+            if n == 1:
+                handles = [handles]
+            now = self.clock()
+            out = []
+            for i, h in enumerate(handles):
+                fr = _FleetRequest(
+                    fid=self._fid, prompt=prompt.copy(), seed=seed + i,
+                    kwargs=dict(
+                        max_new_tokens=h._req.max_new_tokens,
+                        temperature=float(temperature), top_k=int(top_k),
+                        top_p=float(top_p), eos_token_id=eos_token_id,
+                        tenant=tenant),
+                    arrival_s=now)
+                if deadline_s is not None:
+                    fr.deadline_abs = now + deadline_s
+                self._fid += 1
+                fr.bind(replica, h)
+                fr.handle = FleetHandle(self, fr)
+                self._requests[fr.fid] = fr
+                self._by_engine[(replica.index, h._req.rid)] = fr.fid
+                out.append(fr.handle)
+            return out[0] if n == 1 else out
+
+    def cancel(self, handle: FleetHandle) -> bool:
+        with self._lock:
+            fr = handle._fr
+            if fr.done:
+                return False
+            self._drain_tokens(fr)
+            if fr.u_req.done:        # finished just before the cancel
+                self._settle(fr)
+                return False
+            if fr.replica.alive:
+                fr.replica.engine.cancel(fr.u_handle)
+            self._finish_fr(fr, F_CANCELLED)
+            return True
+
+    # -- the fleet iteration ----------------------------------------------
+    def step(self) -> bool:
+        """One fleet iteration: apply scheduled faults, drain dead
+        replicas (resubmitting their requests), run one scheduler
+        iteration on every alive replica with work, then poll health and
+        stream out newly emitted tokens."""
+        with self._lock:
+            if self._injector is not None:
+                self._injector.before_router_step(self._iterations,
+                                                  self.kill_replica)
+            self._drain_dead()
+            progress = False
+            for r in self.replicas:
+                if not r.alive or not r.engine.in_flight():
+                    continue
+                try:
+                    progress |= r.step()
+                except ReplicaDead:
+                    pass
+                except Exception:
+                    # a replica whose iteration raises is as dead as a
+                    # crashed process: drain + resubmit next pass
+                    logger.exception(
+                        f"fleet replica {r.index} iteration failed — "
+                        "marking dead")
+                    self.kill_replica(r.index, reason="step-exception")
+            for fr in list(self._requests.values()):
+                if fr.replica.alive:
+                    self._drain_tokens(fr)
+                    self._settle(fr)
+            self._publish()
+            self._iterations += 1
+            return progress
+
+    def reset_latency_stats(self) -> None:
+        """Drop the router's handoff/decision/resubmit tallies AND every
+        replica's latency reservoirs — benches call this after warmup so
+        the published numbers (incl. the warmup handoff, which JIT-compiles
+        kv_export/kv_import inside its timed span) describe the measured
+        load, not compilation."""
+        with self._lock:
+            self._handoff_ms.clear()
+            self._handoff_fallbacks = 0
+            self._decisions.clear()
+            self._resubmit_count = 0
+        for r in self.replicas:
+            if r.alive:
+                r.engine.reset_latency_stats()
+                r.engine.sched.handoffs_out = 0
+
+    def kill_replica(self, index: int, reason: str = "fault") -> None:
+        """Mark a replica dead (chaos harness / health verdicts). Its
+        in-flight requests resubmit on the next ``step()``."""
+        if not 0 <= index < len(self.replicas):
+            raise ValueError(
+                f"kill_replica({index}): fleet has "
+                f"{len(self.replicas)} replicas (indices 0.."
+                f"{len(self.replicas) - 1})")
+        with self._lock:
+            r = self.replicas[index]
+            if not r.alive:
+                return
+            r.kill(reason)
+            self._death_count += 1
+            obs = get_session()
+            if obs.enabled:
+                obs.registry.counter(
+                    "fleet_serving/replica_deaths",
+                    help="replicas the router declared dead").inc(
+                        reason=reason)
+            logger.warning(f"fleet replica {index} dead ({reason}); "
+                           "draining its requests")
+
+    # -- internals ---------------------------------------------------------
+    def _count_decision(self, reason: str, replica: Replica) -> None:
+        self._decisions[(self.config.policy, reason)] += 1
+        obs = get_session()
+        if obs.enabled:
+            obs.registry.counter(
+                "fleet_serving/routing_decisions",
+                help="requests routed, by policy decision reason").inc(
+                    policy=self.config.policy, reason=reason,
+                    replica=str(replica.index))
+
+    def _affinity_key(self, prompt: np.ndarray) -> Optional[bytes]:
+        if int(prompt.size) < self._block_size:
+            return None
+        import hashlib
+
+        return hashlib.blake2b(
+            np.ascontiguousarray(prompt[:self._block_size],
+                                 np.int32).tobytes(),
+            digest_size=16).digest()
+
+    def _pick(self, pool: List[Replica], prompt: np.ndarray):
+        """(replica, decision reason) under the configured policy; an
+        empty/dead pool degrades to any alive replica (live beats pure)."""
+        alive = [r for r in pool if r.alive]
+        degraded = not alive
+        if degraded:
+            alive = [r for r in self.replicas if r.alive]
+        if not alive:
+            return None, "no_replica"
+        policy = self.config.policy
+        health = {r.index: r.health() for r in alive}
+        reason = policy
+        if policy == "round_robin":
+            pick = alive[self._rr % len(alive)]
+            self._rr += 1
+        elif policy == "least_queue":
+            pick = min(alive, key=lambda r: (health[r.index].in_flight,
+                                             r.index))
+        elif policy == "kv_occupancy":
+            pick = min(alive, key=lambda r: health[r.index].load_key)
+        else:   # affinity
+            key = self._affinity_key(prompt)
+            pick = None
+            if key is None:
+                reason = "affinity_short"
+            else:
+                warm = self._affinity.get(key)
+                if warm is None:
+                    reason = "affinity_cold"
+                else:
+                    cand = self.replicas[warm]
+                    if cand not in alive:
+                        reason = "affinity_dead"
+                    elif (health[cand.index].arena_occupancy
+                          > self.config.affinity_overload):
+                        reason = "affinity_overload"
+                    else:
+                        pick, reason = cand, "affinity_warm"
+            if pick is None:
+                pick = min(alive, key=lambda r: health[r.index].load_key)
+            if key is not None:
+                # the admission hint: later requests with this prefix
+                # follow the replica whose cache is (about to be) warm
+                self._affinity[key] = pick.index
+                self._affinity.move_to_end(key)
+                while len(self._affinity) > 4096:
+                    self._affinity.popitem(last=False)
+        if degraded:
+            reason += "_degraded"
+        return pick, reason
+
+    def _drain_tokens(self, fr: _FleetRequest) -> None:
+        """Move newly emitted tokens from the bound engine handle into the
+        fleet handle (and stamp the fleet-level TTFT)."""
+        toks = fr.u_handle.tokens
+        new = toks[fr.consumed:]
+        if not new:
+            return
+        if fr.first_token_s is None:
+            fr.first_token_s = self.clock()
+            obs = get_session()
+            if obs.enabled:
+                obs.registry.histogram(
+                    "fleet_serving/ttft_ms",
+                    help="fleet submit → first streamed token, "
+                         "wall ms").observe(
+                             (fr.first_token_s - fr.arrival_s) * 1e3)
+        for t in new:
+            fr.handle._push(t)
+        fr.consumed = len(toks)
+
+    def _settle(self, fr: _FleetRequest) -> None:
+        """Terminal-state propagation for the CURRENT binding."""
+        if fr.done or not fr.u_req.done:
+            return
+        self._finish_fr(fr, F_FINISHED if fr.u_req.state == FINISHED
+                        else F_CANCELLED)
+
+    def _finish_fr(self, fr: _FleetRequest, state: str) -> None:
+        fr.state = state
+        fr.finish_s = self.clock()
+        self._requests.pop(fr.fid, None)
+        if fr.replica is not None and fr.u_req is not None:
+            self._by_engine.pop((fr.replica.index, fr.u_req.rid), None)
+        fr.handle._wake()
+
+    def _drain_dead(self) -> None:
+        """Resubmit every request stranded on a dead replica: recompute
+        from original prompt + streamed tokens on a surviving replica —
+        the same bit-exactness contract as per-engine preemption."""
+        for r in self.replicas:
+            if r.alive or r.drained:
+                continue
+            r.drained = True
+            victims = [fr for fr in self._requests.values()
+                       if fr.replica is r and not fr.done]
+            for fr in victims:
+                self._resubmit(fr)
+
+    def _resubmit(self, fr: _FleetRequest) -> None:
+        fr.resubmits += 1
+        obs = get_session()
+        if fr.resubmits > self.config.max_resubmits:
+            logger.error(f"fleet request {fr.fid}: resubmission budget "
+                         f"({self.config.max_resubmits}) exhausted — "
+                         "cancelling")
+            self._finish_fr(fr, F_CANCELLED)
+            return
+        tokens = fr.handle.tokens      # everything streamed IS recoverable
+        # phase-matched pool preference: a request already decoding goes
+        # back to the decode pool, one still prefilling to the prefill pool
+        pool = ((self.decode_pool if tokens else self.prefill_pool)
+                if self.disagg else self.replicas)
+        deadline_s = (max(fr.deadline_abs - self.clock(), 0.0)
+                      if fr.deadline_abs is not None else None)
+        cands = ([r for r in pool if r.alive]
+                 or [r for r in self.replicas if r.alive])
+        for target in sorted(cands, key=lambda r: r.health().load_key):
+            try:
+                h2 = target.engine.submit_recovered(
+                    fr.prompt, tokens, seed=fr.seed,
+                    deadline_s=deadline_s, **fr.kwargs)
+            except QueueFull:
+                continue
+            self._by_engine.pop((fr.replica.index, fr.u_req.rid), None)
+            fr.bind(target, h2)
+            # streamed tokens live engine-side in req.generated but were
+            # never pushed to the NEW handle — nothing to re-drain
+            self._by_engine[(target.index, h2._req.rid)] = fr.fid
+            self._resubmit_count += 1
+            self._count_decision("resubmit", target)
+            if obs.enabled:
+                obs.registry.counter(
+                    "fleet_serving/resubmits",
+                    help="requests resubmitted after a replica "
+                         "death").inc()
+            return
+        logger.error(f"fleet request {fr.fid}: no replica can take the "
+                     "resubmission — cancelling")
+        self._finish_fr(fr, F_CANCELLED)
+
+    # -- disaggregation: the prefill-complete hook -------------------------
+    def _handoff_from(self, src: Replica, req) -> None:
+        """Called by a prefill replica (engine lock held, inside this
+        router's ``step``) the moment a request's last prefill chunk
+        completed: move its KV blocks to a decode replica and rebind the
+        fleet request there. Failure falls back to decoding in place."""
+        fid = self._by_engine.get((src.index, req.rid))
+        fr = self._requests.get(fid) if fid is not None else None
+        if fr is None or fr.done:
+            return
+        cands = sorted((r for r in self.decode_pool
+                        if r.alive and r.engine is not src.engine),
+                       key=lambda r: r.health().load_key)
+        t0 = self.clock()
+        for dst in cands:
+            dst_ids = self.handoff.transfer(src.engine, dst.engine,
+                                            req.blocks)
+            if dst_ids is None:
+                continue            # decode pool dry on this replica
+            # the remaining deadline crosses the handoff (like _resubmit's)
+            # or the adopted request would sort last in the decode pool's
+            # EDF queue behind every deadline-bearing arrival
+            deadline_s = (max(fr.deadline_abs - self.clock(), 0.0)
+                          if fr.deadline_abs is not None else None)
+            try:
+                h2 = dst.engine.adopt_prefilled(
+                    prompt=req.prompt[:req.n_prompt],
+                    n_prompt=req.n_prompt, generated=req.generated,
+                    pending_token=req.pending_token, length=req.length,
+                    blocks=dst_ids, seed=req.seed, sampling=req.sampling,
+                    max_new_tokens=req.max_new_tokens,
+                    eos_token_id=req.eos_token_id, tenant=req.tenant,
+                    deadline_s=deadline_s)
+            except QueueFull:
+                dst.engine.alloc.free(dst_ids)
+                continue
+            # tokens emitted on the source (the prefill-completion first
+            # token) must reach the fleet handle BEFORE the rebinding
+            self._drain_tokens(fr)
+            self._by_engine.pop((src.index, req.rid), None)
+            fr.bind(dst, h2)
+            fr.handoffs += 1
+            self._by_engine[(dst.index, h2._req.rid)] = fr.fid
+            src.engine.release_for_handoff(req)
+            ms = (self.clock() - t0) * 1e3
+            self._handoff_ms.append(ms)
+            self._count_decision("disagg_decode", dst)
+            obs = get_session()
+            if obs.enabled:
+                obs.registry.counter(
+                    "fleet_serving/handoffs",
+                    help="prefill→decode KV block handoffs").inc()
+                obs.registry.histogram(
+                    "fleet_serving/handoff_ms",
+                    help="KV export+import+adopt wall ms").observe(ms)
+            return
+        # nobody could take it: the request decodes on the prefill replica
+        self._handoff_fallbacks += 1
+        obs = get_session()
+        if obs.enabled:
+            obs.registry.counter(
+                "fleet_serving/handoff_fallbacks",
+                help="handoffs the decode pool refused (request decodes "
+                     "on its prefill replica)").inc()
+
+    # -- telemetry ---------------------------------------------------------
+    def _publish(self) -> None:
+        obs = get_session()
+        if not obs.enabled:
+            return
+        reg = obs.registry
+        alive = 0
+        for r in self.replicas:
+            h = r.health()
+            alive += int(h.alive)
+            lbl = {"replica": str(r.index), "role": r.role}
+            reg.gauge("fleet_serving/queue_depth",
+                      help="per-replica admission queue depth").set(
+                          h.queue_depth, **lbl)
+            reg.gauge("fleet_serving/in_flight",
+                      help="per-replica in-flight requests").set(
+                          h.in_flight, **lbl)
+            reg.gauge("fleet_serving/arena_occupancy",
+                      help="per-replica allocated arena fraction").set(
+                          round(h.arena_occupancy, 4), **lbl)
+            reg.gauge("fleet_serving/decode_batch_occupancy",
+                      help="per-replica decoding rows / max_seqs").set(
+                          round(h.decode_batch_occupancy, 4), **lbl)
+            reg.gauge("fleet_serving/kv_blocks_in_use",
+                      help="per-replica allocated arena blocks").set(
+                          h.kv_blocks_in_use, **lbl)
+        reg.gauge("fleet_serving/replicas_alive",
+                  help="replicas the router considers serving").set(alive)
+        reg.gauge("fleet_serving/requests_in_flight",
+                  help="fleet requests not yet terminal").set(
+                      len(self._requests))
+
+    def publish_latency_gauges(self) -> None:
+        """Close-time percentile gauges over the handoff reservoir — the
+        ``report`` CLI's ``== fleet serving ==`` latency inputs."""
+        obs = get_session()
+        if not obs.enabled or not self._handoff_ms:
+            return
+        from ..api import _percentile
+
+        xs = list(self._handoff_ms)
+        obs.registry.gauge("fleet_serving/handoff_p50_ms").set(
+            _percentile(xs, 0.50))
+        obs.registry.gauge("fleet_serving/handoff_p99_ms").set(
+            _percentile(xs, 0.99))
+
+    # -- drivers -----------------------------------------------------------
+    def run(self, max_steps: Optional[int] = None) -> int:
+        """Step until every fleet request is terminal (tests/benches)."""
+        steps = 0
+        starved = 0
+        while self.in_flight():
+            progress = self.step()
+            steps += 1
+            if max_steps is not None and steps >= max_steps:
+                break
+            if progress:
+                starved = 0
+            else:
+                starved += 1
+                if starved > self._starvation_limit:
+                    raise RuntimeError(
+                        "fleet stalled: no replica can make progress "
+                        f"({self.in_flight()} fleet requests in flight)")
+        return steps
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._drive,
+                                        name="dstpu-fleet", daemon=True)
+        self._thread.start()
+
+    def _drive(self) -> None:
+        while not self._stop.is_set():
+            try:
+                if self.in_flight():
+                    self.step()
+                else:
+                    self._stop.wait(0.002)
+            except Exception:
+                logger.exception("fleet driver step failed")
+                get_session().crash_dump("fleet-step-exception")
+                self._stop.wait(0.05)
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=5.0)
+            self._thread = None
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self.stop()
+        self.publish_latency_gauges()
+        # pool the replicas' latency reservoirs BEFORE their close()
+        # publishes: each ServingEngine.close() sets the same unlabeled
+        # serving/ttft_p50_ms / tpot / tokens_per_sec gauges, so the last
+        # replica closed would otherwise stand in for the whole fleet
+        ttft, tpot, tokens_out, wall = [], [], 0, 0.0
+        for r in self.replicas:
+            eng = r.engine
+            ttft.extend(eng._ttft_samples)
+            tpot.extend(eng._tpot_samples)
+            tokens_out += eng._tokens_out
+            wall = max(wall, eng.clock() - eng._started_s)
+            try:
+                eng.close()
+            except Exception:
+                logger.warning(f"fleet replica {r.index} close failed",
+                               exc_info=True)
+        obs = get_session()
+        if obs.enabled:
+            from ..api import _percentile
+
+            reg = obs.registry
+            for name, samples in (("ttft", ttft), ("tpot", tpot)):
+                if samples:
+                    reg.gauge(f"serving/{name}_p50_ms").set(
+                        _percentile(samples, 0.50))
+                    reg.gauge(f"serving/{name}_p99_ms").set(
+                        _percentile(samples, 0.99))
+            if tokens_out:
+                reg.gauge("serving/tokens_per_sec",
+                          help="generated tokens / wall seconds").set(
+                              tokens_out / max(wall, 1e-9))

@@ -51,7 +51,8 @@ __all__ = ["BlockAllocator", "BlockAllocatorError", "PrefixCache",
            "blocks_for_tokens", "assert_block_divisible", "init_paged_cache",
            "paged_cache_memory_bytes", "build_prefill_program",
            "build_decode_program", "build_verify_program",
-           "build_cow_program", "sample_rows", "extend_block_list",
+           "build_cow_program", "build_kv_export_program",
+           "build_kv_import_program", "sample_rows", "extend_block_list",
            "truncate_block_list"]
 
 
@@ -465,6 +466,37 @@ def build_verify_program(cfg, num_tokens: int, paged_impl: str = "auto"):
         raise ValueError(f"build_verify_program(num_tokens={num_tokens}): "
                          "need the pending token plus >= 1 draft slot")
     return jax.jit(verify, donate_argnums=(1,))
+
+
+def build_kv_export_program():
+    """Jitted KV-handoff export: gather one request's resident blocks out of
+    the (NOT donated — other requests keep reading it) source arena into a
+    dense ``(L, MAXB, BLOCK, K, D)`` transfer buffer, one program for any
+    block count. ``ids`` is the request's block list padded to MAXB with the
+    scratch block 0 — pad lanes carry scratch garbage the import writes
+    straight back into the destination's scratch block, so residency is
+    data, never shape. On a shared mesh this plus ``build_kv_import_program``
+    is an in-HBM copy; a cross-host transport later replaces only the
+    buffer's journey between the two programs (the ``KVHandoff`` seam in
+    ``serving/fleet/disagg.py``)."""
+
+    def kv_export(cache, ids):
+        return cache["k"][:, ids], cache["v"][:, ids]
+
+    return jax.jit(kv_export)
+
+
+def build_kv_import_program():
+    """Jitted KV-handoff import: scatter an exported transfer buffer into
+    freshly allocated blocks of the (donated) destination arena. ``ids`` is
+    the destination block list padded to MAXB with scratch 0 — duplicate
+    pad writes land in the scratch block, whose content is never read."""
+
+    def kv_import(cache, buf_k, buf_v, ids):
+        return {"k": cache["k"].at[:, ids].set(buf_k),
+                "v": cache["v"].at[:, ids].set(buf_v)}
+
+    return jax.jit(kv_import, donate_argnums=(0,))
 
 
 def build_cow_program():

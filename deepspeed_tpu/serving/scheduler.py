@@ -162,6 +162,7 @@ class Scheduler:
         self.preemption_count = 0
         self.finished_count = 0
         self.cancelled_count = 0
+        self.handoffs_out = 0          # requests handed to another engine
         self.prefix_hits = 0           # admissions that reused ≥1 block
         self.prefix_hit_tokens = 0     # prompt tokens whose prefill was skipped
         self.prefix_lookup_tokens = 0  # prompt tokens of COMMITTED admissions
@@ -245,6 +246,17 @@ class Scheduler:
         req.state = FINISHED
         req.finish_s = self.clock()
         self.finished_count += 1
+
+    def release_handoff(self, req: Request) -> None:
+        """Terminal release for a request whose KV was handed to ANOTHER
+        engine (fleet prefill/decode disaggregation): frees this engine's
+        row/blocks like ``finish`` but counts as a handoff, not a
+        completion — the destination engine finishes the request and owns
+        its completion ledger entry."""
+        self._release(req)
+        req.state = FINISHED
+        req.finish_s = self.clock()
+        self.handoffs_out += 1
 
     # -- admission ---------------------------------------------------------
     def _pick_next(self) -> Optional[Request]:

@@ -30,6 +30,51 @@ class RequestCancelled(RuntimeError):
     """Raised by ``result()`` when the request was cancelled."""
 
 
+def drive_stream(cond: threading.Condition, tokens: List[int], is_done,
+                 clock, threaded, step, starvation_limit, label: str,
+                 stall_msg: str,
+                 timeout_s: Optional[float]) -> Iterator[int]:
+    """The drive-or-wait streaming loop shared by ``RequestHandle`` and the
+    fleet's ``FleetHandle``: yield tokens from ``tokens`` (a live list
+    guarded by ``cond``) as they appear; in step-driven mode each starved
+    pass runs one ``step()``, in threaded mode block on ``cond``. Raises
+    TimeoutError past ``timeout_s`` without a token and RuntimeError with
+    ``stall_msg`` after ``starvation_limit()`` consecutive progress-free
+    steps. ``is_done``/``threaded``/``starvation_limit`` are callables —
+    all three can change while the stream is live (request finishing, a
+    driver thread starting, config reload)."""
+    i = 0
+    deadline = clock() + timeout_s if timeout_s is not None else None
+    starved = 0
+    while True:
+        tok = None
+        with cond:
+            if i < len(tokens):
+                tok = tokens[i]
+                i += 1
+            elif is_done():
+                return
+            elif threaded():
+                if not cond.wait(timeout=timeout_s):
+                    raise TimeoutError(
+                        f"{label}: no token within {timeout_s}s")
+                continue
+        if tok is not None:
+            deadline = clock() + timeout_s if timeout_s is not None else None
+            starved = 0
+            yield tok
+            continue
+        # step-driven: advance the driver outside the condition lock
+        if deadline is not None and clock() > deadline:
+            raise TimeoutError(f"{label}: no token within {timeout_s}s")
+        if step():
+            starved = 0
+        else:
+            starved += 1
+            if starved > starvation_limit():
+                raise RuntimeError(f"{label}: {stall_msg}")
+
+
 class RequestHandle:
     """Incremental, thread-safe view of one request's generated tokens."""
 
@@ -110,43 +155,14 @@ class RequestHandle:
         ``timeout_s`` without a token (engine clock in step-driven mode),
         and RuntimeError when the engine stops making progress entirely
         (the same starvation guard as ``ServingEngine.run``)."""
-        i = 0
-        deadline = (self._engine.clock() + timeout_s
-                    if timeout_s is not None else None)
-        starved = 0
-        while True:
-            tok = None
-            with self._cond:
-                if i < len(self._tokens):
-                    tok = self._tokens[i]
-                    i += 1
-                elif self._req.done:
-                    return
-                elif self._engine.threaded:
-                    if not self._cond.wait(timeout=timeout_s):
-                        raise TimeoutError(
-                            f"request {self._req.rid}: no token within "
-                            f"{timeout_s}s")
-                    continue
-            if tok is not None:
-                deadline = (self._engine.clock() + timeout_s
-                            if timeout_s is not None else None)
-                starved = 0
-                yield tok
-                continue
-            # step-driven: advance the engine outside our condition lock
-            if deadline is not None and self._engine.clock() > deadline:
-                raise TimeoutError(
-                    f"request {self._req.rid}: no token within {timeout_s}s")
-            if self._engine.step():
-                starved = 0
-            else:
-                starved += 1
-                if starved > 2 * self._engine.config.max_queue + 4:
-                    raise RuntimeError(
-                        f"request {self._req.rid}: serving stalled — no "
-                        "request can make progress (block pool or row "
-                        "count too small for the workload)")
+        eng = self._engine
+        yield from drive_stream(
+            self._cond, self._tokens, lambda: self._req.done, eng.clock,
+            lambda: eng.threaded, eng.step,
+            lambda: 2 * eng.config.max_queue + 4,
+            f"request {self._req.rid}",
+            "serving stalled — no request can make progress (block pool "
+            "or row count too small for the workload)", timeout_s)
 
     def result(self, timeout_s: Optional[float] = None) -> np.ndarray:
         """Block (or drive) until the request finishes; returns the full

@@ -433,7 +433,20 @@ class TestAgentRestartHardening:
                 agent_dir=str(agent_dir)))
 
         def drop_request():
-            _time.sleep(0.7)   # let the 3-worker group spawn and linger
+            # DEFLAKED (was: a fixed 0.7s sleep): on a loaded box spawning
+            # 3 interpreters can take longer than any fixed sleep, and a
+            # request dropped before every worker has written its probe
+            # line makes the `lines[0]["world"] == "3"` assertion race the
+            # restart. Wait for the OBSERVABLE condition instead — all 3
+            # incarnation-0 workers logged — before requesting eviction.
+            deadline = _time.monotonic() + 30.0
+            while _time.monotonic() < deadline:
+                try:
+                    if len(log.read_text().splitlines()) >= 3:
+                        break
+                except OSError:
+                    pass
+                _time.sleep(0.05)
             request_eviction(1, reason="test straggler", step=7,
                              agent_dir=str(agent_dir))
 

@@ -153,7 +153,19 @@ def run_section_serving(section: Dict[str, Any]) -> List[str]:
     _KEEPALIVE.append(engine)
     # construction registered the entries; the explicit call returns their
     # names for the CLI (idempotent — latest registration wins)
-    return engine._register_audit_entries()
+    names = engine._register_audit_entries()
+    if section.get("fleet"):
+        # "fleet": true registers the prefill/decode KV-handoff program
+        # pair (serving/kv_export + serving/kv_import) against this
+        # engine's arena shapes, exactly as a disaggregated FleetRouter
+        # does at construction — so the audit/cost gates budget them
+        from deepspeed_tpu.serving.fleet.disagg import (
+            ArenaHandoff, register_handoff_audit_entries)
+
+        handoff = ArenaHandoff()
+        _KEEPALIVE.append(handoff)
+        names += register_handoff_audit_entries(engine, handoff)
+    return names
 
 
 def build_from_config(config: Dict[str, Any]) -> List[str]:
