@@ -24,7 +24,13 @@ BENCH_metrics_serve.jsonl. ``--fleet N`` routes the same trace through a
 (round-robin vs KV-occupancy-aware) against a single-engine baseline,
 with per-replica peak occupancy, routing decisions by reason, and —
 with ``--disagg`` (prefill/decode pools + KV block handoff) — the
-handoff latency p50/p99 in the record. Knobs (env): BENCH_SERVE_REQUESTS,
+handoff latency p50/p99 in the record. ``--chaos plan.json`` (fleet mode
+only) arms the same deterministic fault plans the chaos_serve gate uses
+(replica_kill / replica_slow / replica_flap / handoff_fail, steps =
+post-warmup router iterations) and records the self-healing ledger —
+deaths, quarantines, revivals, mean time-to-revival (iterations), shed
+rate — per arm; ``--deadline S`` gives every request a deadline so
+admission-control shedding engages. Knobs (env): BENCH_SERVE_REQUESTS,
 BENCH_SERVE_RATE (req/s), BENCH_SERVE_PROMPT (max prompt len),
 BENCH_SERVE_NEW, BENCH_SERVE_ROWS, BENCH_SERVE_BLOCK, BENCH_SERVE_BLOCKS,
 BENCH_SERVE_LEN, BENCH_SERVE_CHUNK, BENCH_SERVE_SYS (shared-prefix len),
@@ -221,11 +227,17 @@ def main() -> None:
     print(json.dumps(record))
 
 
-def _serve_load(srv, prompts, arrivals, n_new):
-    """Drive one Poisson-arrival load through a ServingEngine. Returns
-    (handles, wall_seconds)."""
+def _serve_load(srv, prompts, arrivals, n_new, deadline_s=None):
+    """Drive one Poisson-arrival load through a ServingEngine (or a
+    FleetRouter — same surface). Returns (handles, wall_seconds,
+    admission_sheds): with ``deadline_s`` set, a fleet under pressure may
+    shed deadline-infeasible submissions with ``Overloaded`` — those count
+    as sheds, not handles."""
+    from deepspeed_tpu.serving.fleet import Overloaded
+
     t0 = time.perf_counter()
     handles = []
+    sheds = 0
     i = 0
     n_requests = len(prompts)
     while i < n_requests or srv.in_flight():
@@ -234,14 +246,18 @@ def _serve_load(srv, prompts, arrivals, n_new):
         # fenced by construction, the linter just can't see through step()
         now = time.perf_counter() - t0  # tpulint: disable=wallclock-timing-without-sync
         while i < n_requests and arrivals[i] <= now:
-            handles.append(srv.submit(prompts[i], max_new_tokens=n_new))
+            try:
+                handles.append(srv.submit(prompts[i], max_new_tokens=n_new,
+                                          deadline_s=deadline_s))
+            except Overloaded:
+                sheds += 1
             i += 1
         if srv.in_flight():
             srv.step()
         elif i < n_requests:
             time.sleep(min(arrivals[i] - now, 0.01))
     wall = time.perf_counter() - t0  # tpulint: disable=wallclock-timing-without-sync
-    return handles, wall
+    return handles, wall, sheds
 
 
 def _configure_bench_obs():
@@ -257,15 +273,17 @@ def _configure_bench_obs():
 def _load_stats(handles, wall):
     """Latency/throughput aggregation shared by the single-engine and
     fleet arms — one implementation so the numbers the fleet record is
-    compared against are computed identically."""
+    compared against are computed identically. Requests that never
+    streamed a token (shed from the queue / expired deadlines under a
+    chaos plan) have no TTFT and stay out of the percentiles."""
     from deepspeed_tpu.serving.api import _percentile as p
 
-    ttfts = sorted(h.ttft_s for h in handles)
+    ttfts = sorted(h.ttft_s for h in handles if h.ttft_s is not None)
     tpots = sorted(h.tpot_s for h in handles if h.tpot_s is not None)
     total_tokens = sum(len(h.tokens) for h in handles)
     return {
-        "p50_ttft_ms": round(p(ttfts, 0.50) * 1e3, 2),
-        "p99_ttft_ms": round(p(ttfts, 0.99) * 1e3, 2),
+        "p50_ttft_ms": round(p(ttfts, 0.50) * 1e3, 2) if ttfts else None,
+        "p99_ttft_ms": round(p(ttfts, 0.99) * 1e3, 2) if ttfts else None,
         "tpot_ms": round(p(tpots, 0.50) * 1e3, 3) if tpots else None,
         "tokens_per_sec": round(total_tokens / wall, 1),
         "requests_per_sec": round(len(handles) / wall, 2),
@@ -274,7 +292,7 @@ def _load_stats(handles, wall):
 
 def _serve_one_mode(engine, scfg_kwargs, paged_kernel, prompts, arrivals,
                     prefix_prompts, n_new, block, enable_obs=False,
-                    spec_mode="off", draft_engine=None):
+                    spec_mode="off", draft_engine=None, deadline_s=None):
     """One A/B arm: build a ServingEngine with ``paged_kernel`` (and
     optionally a speculative-decoding arm via ``spec_mode``), run the
     Poisson load, then the prefix-reuse workload (every request shares one
@@ -303,8 +321,11 @@ def _serve_one_mode(engine, scfg_kwargs, paged_kernel, prompts, arrivals,
         _configure_bench_obs()
     srv.reset_latency_stats()
 
-    handles, wall = _serve_load(srv, prompts, arrivals, n_new)
+    handles, wall, _ = _serve_load(srv, prompts, arrivals, n_new,
+                                   deadline_s=deadline_s)
     stats = _load_stats(handles, wall)
+    if deadline_s is not None:
+        stats["deadline_exceeded"] = srv.sched.deadline_exceeded_count
     stats.update({
         "arena_peak_blocks": srv.alloc.peak_in_use,
         "arena_peak_occupancy": round(
@@ -337,10 +358,10 @@ def _serve_one_mode(engine, scfg_kwargs, paged_kernel, prompts, arrivals,
         # workload alone, not the (mostly-miss) Poisson load before it
         hit0 = srv.sched.prefix_hit_tokens
         look0 = srv.sched.prefix_lookup_tokens
-        r1, _ = _serve_load(srv, prefix_prompts[0],
-                            np.zeros(len(prefix_prompts[0])), n_new)
-        r2, _ = _serve_load(srv, prefix_prompts[1],
-                            np.zeros(len(prefix_prompts[1])), n_new)
+        r1, _, _ = _serve_load(srv, prefix_prompts[0],
+                               np.zeros(len(prefix_prompts[0])), n_new)
+        r2, _, _ = _serve_load(srv, prefix_prompts[1],
+                               np.zeros(len(prefix_prompts[1])), n_new)
         ttft1 = sorted(h.ttft_s for h in r1)
         ttft2 = sorted(h.ttft_s for h in r2)
         stats["prefix_reuse"] = {
@@ -372,13 +393,19 @@ def _serve_one_mode(engine, scfg_kwargs, paged_kernel, prompts, arrivals,
 
 
 def _serve_fleet_arm(engine, scfg_kwargs, paged_kernel, n, policy, disagg,
-                     prompts, arrivals, n_new, block, enable_obs=False):
+                     prompts, arrivals, n_new, block, enable_obs=False,
+                     chaos_plan=None, deadline_s=None):
     """One fleet arm: N serving replicas behind a FleetRouter under
     ``policy`` (optionally split into prefill/decode pools), driven through
     the SAME Poisson trace — and the same ``paged_kernel`` read path — as
     the single-engine baseline. Returns the arm's stats dict: fleet-level
     TTFT/TPOT/throughput, per-replica peak occupancy, routing decisions by
-    reason, and (disagg) the KV-handoff latency histogram."""
+    reason, and (disagg) the KV-handoff latency histogram.
+
+    ``chaos_plan`` (``--chaos plan.json``) arms the router's fault
+    injector AFTER warmup — plan steps are post-warmup router iterations —
+    and the arm's record gains the self-healing ledger: deaths,
+    quarantines, time-to-revival (iterations dead), shed rate."""
     from deepspeed_tpu.config.config import FleetConfig
     from deepspeed_tpu.serving import ServingConfig
     from deepspeed_tpu.serving.api import _percentile as p
@@ -401,9 +428,49 @@ def _serve_fleet_arm(engine, scfg_kwargs, paged_kernel, n, policy, disagg,
         _configure_bench_obs()
     # drops the warmup handoff's compile-scale latency sample too
     router.reset_latency_stats()
+    if chaos_plan is not None:
+        # armed strictly after warmup, with the iteration counter zeroed:
+        # plan steps mean "measured-load iterations", never compile time
+        from deepspeed_tpu.observability.faultinject import FaultInjector
 
-    handles, wall = _serve_load(router, prompts, arrivals, n_new)
+        router._injector = FaultInjector(plan=chaos_plan, rank=0,
+                                         restart=0)
+        router._iterations = 0
+    # ledger baseline: the warmup submit is pre-measurement traffic and
+    # must stay out of the chaos shed-rate denominator
+    submitted0 = router.submitted_count
+
+    handles, wall, admission_sheds = _serve_load(
+        router, prompts, arrivals, n_new, deadline_s=deadline_s)
     stats = _load_stats(handles, wall)
+    if chaos_plan is not None:
+        # drive the healing loop to quiescence so time-to-revival and the
+        # ledger describe a CLOSED loop, not a snapshot mid-remediation
+        for _ in range(256):
+            router.step()
+            if all(r.alive or r.retired for r in router.replicas):
+                break
+        attempts = (router.submitted_count - submitted0) + admission_sheds
+        stats["chaos"] = {
+            "deaths": router._death_count,
+            "quarantines": router._quarantine_count,
+            "revivals": router._revival_count,
+            "graduations": router._graduation_count,
+            "retirements": sum(r.retired for r in router.replicas),
+            "resubmits": router._resubmit_count,
+            "handoff_failures": router._handoff_failures,
+            "time_to_revival_iters": (
+                round(sum(router._revive_iters)
+                      / len(router._revive_iters), 1)
+                if router._revive_iters else None),
+            "shed": {
+                "admission": admission_sheds,
+                "degraded": router.shed_count_total,
+                "rate": round((admission_sheds
+                               + router.shed_count_total)
+                              / max(attempts, 1), 4)},
+            "degraded_mode_final": router.degraded_mode,
+        }
     stats.update({
         "policy": policy,
         "per_replica": [
@@ -472,6 +539,20 @@ def serving_main() -> None:
     if fleet_n and spec_flag != "off":
         raise SystemExit("--fleet and --spec are separate A/Bs — "
                          "run them in two invocations")
+    chaos_spec = os.environ.get("BENCH_SERVE_CHAOS", "")
+    chaos_plan = None
+    if chaos_spec:
+        if not fleet_n:
+            raise SystemExit("--chaos drives the FLEET's self-healing "
+                             "loop — pair it with --fleet N")
+        from deepspeed_tpu.observability.faultinject import load_plan
+
+        # validates the plan up front; a bare path means @path
+        chaos_plan = load_plan(
+            chaos_spec if chaos_spec.startswith(("@", "[", "{"))
+            else "@" + chaos_spec)
+    deadline_env = os.environ.get("BENCH_SERVE_DEADLINE", "")
+    deadline_s = float(deadline_env) if deadline_env else None
     if spec_flag != "off":
         # the speculative A/B replaces the paged-kernel A/B: both spec
         # arms run the SAME read path (primary) over the SAME trace
@@ -534,13 +615,15 @@ def serving_main() -> None:
         metric = (f"{model_name}_{dtype_name}_fleet{fleet_n}"
                   f"{'_disagg' if disagg else ''}_serving_p50_ttft_ms")
         single = _serve_one_mode(engine, scfg_kwargs, primary_mode,
-                                 prompts, arrivals, [], n_new, block)
+                                 prompts, arrivals, [], n_new, block,
+                                 deadline_s=deadline_s)
         fleet_arms = {}
         for i, policy in enumerate(("round_robin", "kv_occupancy")):
             fleet_arms[policy] = _serve_fleet_arm(
                 engine, scfg_kwargs, primary_mode, fleet_n, policy, disagg,
                 prompts, arrivals, n_new, block,
-                enable_obs=(obs_wanted and i == 1))
+                enable_obs=(obs_wanted and i == 1),
+                chaos_plan=chaos_plan, deadline_s=deadline_s)
         primary = fleet_arms["kv_occupancy"]
 
         from deepspeed_tpu.observability import get_session
@@ -561,6 +644,7 @@ def serving_main() -> None:
             "vs_baseline": None,
             "fleet": fleet_n,
             "disagg": disagg,
+            "chaos": bool(chaos_plan),
             "paged_kernel": "on" if primary_mode == "auto" else "off",
             "single_engine": single,
             "fleet_ab": {
@@ -597,7 +681,8 @@ def serving_main() -> None:
                 engine, scfg_kwargs, modes[0], prompts, arrivals,
                 prefix_prompts if sm == spec_flag else [], n_new, block,
                 enable_obs=(obs_wanted and i == 1), spec_mode=sm,
-                draft_engine=(draft_engine if sm == "draft" else None))
+                draft_engine=(draft_engine if sm == "draft" else None),
+                deadline_s=deadline_s)
         arms["on" if modes[0] == "auto" else "off"] = spec_arms[spec_flag]
     else:
         for i, mode in enumerate(modes):
@@ -605,7 +690,8 @@ def serving_main() -> None:
             arms[label] = _serve_one_mode(
                 engine, scfg_kwargs, mode, prompts, arrivals,
                 prefix_prompts, n_new, block,
-                enable_obs=(obs_wanted and i == len(modes) - 1))
+                enable_obs=(obs_wanted and i == len(modes) - 1),
+                deadline_s=deadline_s)
 
     primary = arms.get("on") or arms["off"]
 
@@ -691,6 +777,19 @@ if __name__ == "__main__":
             os.environ["BENCH_SERVE_FLEET"] = a.split("=", 1)[1]
         elif a == "--disagg":
             os.environ["BENCH_SERVE_DISAGG"] = "1"
+        # --chaos plan.json drives the fleet arms through a deterministic
+        # fault plan (replica_kill/slow/flap, handoff_fail) and records
+        # the self-healing ledger: time-to-revival, shed rate, ...
+        elif a == "--chaos" and i + 1 < len(argv):
+            os.environ["BENCH_SERVE_CHAOS"] = argv[i + 1]
+        elif a.startswith("--chaos="):
+            os.environ["BENCH_SERVE_CHAOS"] = a.split("=", 1)[1]
+        # --deadline S gives every benched request a deadline, engaging
+        # admission-control shedding under pressure
+        elif a == "--deadline" and i + 1 < len(argv):
+            os.environ["BENCH_SERVE_DEADLINE"] = argv[i + 1]
+        elif a.startswith("--deadline="):
+            os.environ["BENCH_SERVE_DEADLINE"] = a.split("=", 1)[1]
     if os.environ.get("BENCH_SERVE_PAGED_KERNEL", "") not in ("", "on",
                                                               "off"):
         raise SystemExit("--paged-kernel must be 'on' or 'off'")

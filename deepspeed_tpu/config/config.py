@@ -589,9 +589,60 @@ class FleetConfig(ConfigModel):
     #   affinity-warm replica is skipped (locality never beats liveness)
     max_resubmits: int = 3         # per-request resubmission budget across
     #   replica deaths; exhausting it cancels the request
-    handoff_retry_iterations: int = 0  # reserved: 0 = a handoff the decode
-    #   pool cannot take right now falls back to decoding on the prefill
-    #   replica (degraded but live)
+    handoff_retries: int = 1       # a handoff whose TRANSFER fails (chaos
+    #   handoff_fail / kv_import raising) retries on this many other decode
+    #   replicas before falling back to decoding in place (a handoff the
+    #   decode pool cannot TAKE falls back immediately — degraded but live)
+    # -- replica health verdicts (router-measured, host-side) --
+    health_window: int = 8         # rolling step-time samples per replica
+    #   a verdict needs before the slow detector trusts the median
+    health_warmup_steps: int = 4   # per-incarnation measured steps to
+    #   DISCARD before sampling begins: the first dispatches JIT-compile
+    #   inside the measured span, and compile jitter must never convict
+    #   a healthy replica
+    slow_factor: float = 3.0       # quarantine a replica whose rolling
+    #   median step time exceeds factor × the median of the OTHER alive
+    #   replicas' medians (relative straggler detection, like
+    #   fleet_straggler_factor on the training side)
+    slow_min_step_s: float = 0.25  # absolute floor for the RELATIVE slow
+    #   verdict: a replica under this median is never convicted by ratio
+    #   alone — at sub-floor step times, scheduler noise makes any ratio
+    #   meaningless (3ms vs 1ms is not a straggler)
+    step_time_slo_s: float = 0.0   # absolute per-iteration SLO: a replica
+    #   whose rolling median step time exceeds this is quarantined
+    #   regardless of the fleet (0 = off)
+    ttft_slo_s: float = 0.0        # fleet TTFT SLO: a first token arriving
+    #   later than this after submit counts a health breach against the
+    #   serving replica and quarantines it (0 = off)
+    # -- quarantine / revival ladder (iteration-denominated: deterministic
+    #    under the injectable clock AND under the real driver thread) --
+    quarantine_iterations: int = 16  # base quarantine length; doubles per
+    #   repeat offense (the elastic agent's backoff ladder, in router
+    #   iterations instead of seconds)
+    auto_revive: bool = True       # dead replicas are rebuilt (shared
+    #   weights + already-compiled programs) and re-admitted via probation
+    revive_after_iterations: int = 8   # death → revival-attempt backoff
+    #   base, doubling per death of the same replica
+    breaker_incidents: int = 4     # per-replica circuit breaker: more than
+    #   this many incidents (deaths + quarantines) retires the replica
+    #   permanently — a flapping replica must not flap forever
+    probation_requests: int = 3    # clean completions a revived/
+    #   un-quarantined replica needs before regaining full routing weight
+    probation_share: float = 0.25  # max fraction of the fleet's in-flight
+    #   requests a probation replica may hold (floor of one)
+    # -- overload control --
+    admission_control: bool = True  # deadline-infeasibility shedding in
+    #   submit(): a request whose deadline cannot be met at current queue
+    #   depth + measured TPOT raises Overloaded(retry_after_s=...) instead
+    #   of being admitted to die
+    overload_occupancy: float = 0.92   # mean alive-replica arena occupancy
+    #   that counts as overload pressure
+    overload_queue_depth: int = 0  # fleet-wide queued (unadmitted) requests
+    #   that count as pressure (0 = occupancy signal only)
+    overload_up_iterations: int = 4    # consecutive pressured iterations
+    #   per degraded-ladder rung up
+    overload_down_iterations: int = 8  # consecutive calm iterations per
+    #   rung down (hysteresis: recovery is slower than degradation)
 
     def validate(self) -> None:
         if self.policy not in ("round_robin", "least_queue",
@@ -604,9 +655,38 @@ class FleetConfig(ConfigModel):
                               f"got {self.affinity_overload}")
         if self.max_resubmits < 0:
             raise ConfigError("fleet.max_resubmits must be >= 0")
-        if self.handoff_retry_iterations < 0:
+        if self.handoff_retries < 0:
+            raise ConfigError("fleet.handoff_retries must be >= 0")
+        if self.health_window < 2:
+            raise ConfigError("fleet.health_window must be >= 2")
+        if self.health_warmup_steps < 0:
+            raise ConfigError("fleet.health_warmup_steps must be >= 0")
+        if self.slow_factor <= 1.0:
+            raise ConfigError("fleet.slow_factor must be > 1.0 — a factor "
+                              "at/below 1 quarantines the median replica")
+        if self.slow_min_step_s < 0:
+            raise ConfigError("fleet.slow_min_step_s must be >= 0")
+        if self.step_time_slo_s < 0 or self.ttft_slo_s < 0:
+            raise ConfigError("fleet SLOs must be >= 0 (0 = off)")
+        if self.quarantine_iterations < 1:
+            raise ConfigError("fleet.quarantine_iterations must be >= 1")
+        if self.revive_after_iterations < 1:
+            raise ConfigError("fleet.revive_after_iterations must be >= 1")
+        if self.breaker_incidents < 1:
+            raise ConfigError("fleet.breaker_incidents must be >= 1")
+        if self.probation_requests < 1:
+            raise ConfigError("fleet.probation_requests must be >= 1")
+        if not 0.0 < self.probation_share <= 1.0:
+            raise ConfigError("fleet.probation_share must be in (0, 1], "
+                              f"got {self.probation_share}")
+        if not 0.0 < self.overload_occupancy <= 1.0:
+            raise ConfigError("fleet.overload_occupancy must be in (0, 1]")
+        if self.overload_queue_depth < 0:
+            raise ConfigError("fleet.overload_queue_depth must be >= 0")
+        if self.overload_up_iterations < 1 \
+                or self.overload_down_iterations < 1:
             raise ConfigError(
-                "fleet.handoff_retry_iterations must be >= 0")
+                "fleet.overload_{up,down}_iterations must be >= 1")
 
 
 @dataclass

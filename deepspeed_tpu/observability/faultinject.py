@@ -28,6 +28,19 @@ Fault kinds (``Fault.kind``):
   router iteration ``step`` (the engine-loss case the fleet router's
   drain + bit-exact resubmission exists for; ``serving/fleet/router.py``
   calls ``before_router_step`` between scheduler iterations).
+* ``replica_slow``  — for ``steps`` router iterations starting at
+  ``step``, inflate replica ``replica``'s router-measured iteration wall
+  time by ``sleep_s`` (no real sleep: the penalty rides the health
+  data-plane, so slow-verdict tests and the serving chaos gate stay
+  fast AND deterministic). The straggler case quarantine exists for.
+* ``replica_flap``  — kill replica ``replica`` at every router iteration
+  in ``[step, step + steps)`` where it is alive: each auto-revival is
+  promptly re-killed — the flapping case the per-replica circuit
+  breaker (retirement) exists for.
+* ``handoff_fail``  — make the next prefill→decode KV handoff transfer
+  at/after router iteration ``step`` fail mid-flight (after export,
+  before import commits). The lost-transfer case the router's
+  retry-on-another-replica + decode-in-place fallback exists for.
 
 Plumbing: a plan is a JSON list of fault dicts, passed directly
 (``FaultInjector(plan=[...])``) or through the environment
@@ -51,7 +64,13 @@ from typing import Any, Callable, Dict, List, Optional
 from ..utils.logging import logger
 
 FAULT_KINDS = ("rank_kill", "straggle", "nan_params", "ckpt_truncate",
-               "replica_kill")
+               "replica_kill", "replica_slow", "replica_flap",
+               "handoff_fail")
+
+# serving-fleet faults: applied by the router's hooks, never by the
+# training session's before_step
+ROUTER_KINDS = ("replica_kill", "replica_slow", "replica_flap",
+                "handoff_fail")
 
 PLAN_ENV = "DSTPU_FAULT_PLAN"
 
@@ -184,7 +203,8 @@ class FaultInjector:
             self._sleep(self._straggle_sleep)
         for i, fault in enumerate(self.plan):
             if i in self._done \
-                    or fault.kind in ("ckpt_truncate", "replica_kill") \
+                    or fault.kind == "ckpt_truncate" \
+                    or fault.kind in ROUTER_KINDS \
                     or not self._mine(fault) or fault.step != step:
                 continue
             self._done.add(i)
@@ -205,18 +225,64 @@ class FaultInjector:
 
     def before_router_step(self, iteration: int,
                            kill_fn: Callable[[int], None]) -> None:
-        """Apply any ``replica_kill`` fault scheduled for this fleet-router
+        """Apply the kill-shaped fleet faults scheduled for this router
         iteration: ``kill_fn(replica_index)`` is the router's kill switch
         (marks the replica dead; the router's next drain pass resubmits its
-        in-flight requests elsewhere). Called by
-        ``serving/fleet/router.FleetRouter.step`` before replicas run."""
+        in-flight requests elsewhere). ``replica_kill`` fires once at its
+        iteration; ``replica_flap`` fires at EVERY iteration in its
+        ``[step, step + steps)`` window — the router's kill switch is a
+        no-op on an already-dead replica, so each firing only lands on a
+        freshly revived incarnation (noted once, at window entry). Called
+        by ``serving/fleet/router.FleetRouter.step`` before replicas run."""
         for i, fault in enumerate(self.plan):
-            if i in self._done or fault.kind != "replica_kill" \
-                    or not self._mine(fault) or fault.step != iteration:
+            if not self._mine(fault):
+                continue
+            if fault.kind == "replica_kill" and i not in self._done \
+                    and fault.step == iteration:
+                self._done.add(i)
+                self._note(fault, iteration, replica=fault.replica)
+                kill_fn(fault.replica)
+            elif fault.kind == "replica_flap" \
+                    and fault.step <= iteration \
+                    < fault.step + max(fault.steps, 1):
+                if i not in self._done:
+                    self._done.add(i)
+                    self._note(fault, iteration, replica=fault.replica,
+                               until_step=fault.step + max(fault.steps, 1))
+                kill_fn(fault.replica)
+
+    def slow_penalty(self, iteration: int, replica: int) -> float:
+        """Synthetic step-time inflation for ``replica`` at this router
+        iteration — the sum of every active ``replica_slow`` fault's
+        ``sleep_s``. The router adds it to the measured iteration wall
+        time: the slowness is injected into the health data-plane, not the
+        wall clock, so chaos runs stay fast and sleep-free."""
+        penalty = 0.0
+        for i, fault in enumerate(self.plan):
+            if fault.kind != "replica_slow" or not self._mine(fault) \
+                    or fault.replica != replica:
+                continue
+            if fault.step <= iteration < fault.step + max(fault.steps, 1):
+                if i not in self._done:
+                    self._done.add(i)
+                    self._note(fault, iteration, replica=fault.replica,
+                               sleep_s=fault.sleep_s,
+                               until_step=fault.step + max(fault.steps, 1))
+                penalty += float(fault.sleep_s)
+        return penalty
+
+    def take_handoff_fail(self, iteration: int) -> bool:
+        """Consume one pending ``handoff_fail`` fault whose iteration has
+        arrived — the router arms the handoff's failure seam with it
+        (``KVHandoff.inject_fail_next``) just before the transfer."""
+        for i, fault in enumerate(self.plan):
+            if i in self._done or fault.kind != "handoff_fail" \
+                    or not self._mine(fault) or fault.step > iteration:
                 continue
             self._done.add(i)
-            self._note(fault, iteration, replica=fault.replica)
-            kill_fn(fault.replica)
+            self._note(fault, iteration)
+            return True
+        return False
 
     def after_save(self, ckpt_dir: str, step: Optional[int] = None) -> None:
         """Apply any pending ``ckpt_truncate`` fault to the newest committed

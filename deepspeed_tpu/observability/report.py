@@ -285,6 +285,8 @@ def summarize_serving(records: List[Dict[str, Any]]) -> str:
     for name, label in (("serving/requests_submitted", "submitted"),
                         ("serving/requests_completed", "completed"),
                         ("serving/requests_cancelled", "cancelled"),
+                        ("serving/requests_deadline_exceeded",
+                         "deadline_exceeded"),
                         ("serving/cow_copies", "cow_copies"),
                         ("serving/preemptions", "preemptions")):
         total = sum(r["value"] for (n, _), r in latest.items()
@@ -334,7 +336,8 @@ def summarize_fleet_serving(records: List[Dict[str, Any]]) -> str:
                       ("in_flight", "fleet_serving/in_flight"),
                       ("arena_occ", "fleet_serving/arena_occupancy"),
                       ("decode_occ", "fleet_serving/decode_batch_occupancy"),
-                      ("kv_blocks", "fleet_serving/kv_blocks_in_use")):
+                      ("kv_blocks", "fleet_serving/kv_blocks_in_use"),
+                      ("state", "fleet_serving/health_state")):
         for (n, _), r in latest.items():
             if n != name:
                 continue
@@ -342,6 +345,8 @@ def summarize_fleet_serving(records: List[Dict[str, Any]]) -> str:
             key = (int(labels.get("replica", -1)),
                    str(labels.get("role", "?")))
             per_replica.setdefault(key, {})[col] = r["value"]
+    _STATES = {0: "dead", 1: "serving", 2: "quarantined", 3: "probation",
+               4: "retired"}
     if per_replica:
         rows = []
         for (idx, role), vals in sorted(per_replica.items()):
@@ -350,10 +355,11 @@ def summarize_fleet_serving(records: List[Dict[str, Any]]) -> str:
                          f"{vals.get('in_flight', 0):.0f}",
                          f"{vals.get('arena_occ', 0):.2f}",
                          f"{vals.get('decode_occ', 0):.2f}",
-                         f"{vals.get('kv_blocks', 0):.0f}"])
+                         f"{vals.get('kv_blocks', 0):.0f}",
+                         _STATES.get(int(vals.get("state", 1)), "?")])
         lines.append(_fmt_table(
             ["replica", "role", "queue", "in_flight", "arena_occ",
-             "decode_occ", "kv_blocks"], rows))
+             "decode_occ", "kv_blocks", "state"], rows))
     # routing decisions by (policy, reason, replica)
     decisions = [(r.get("labels", {}), r["value"])
                  for (n, _), r in latest.items()
@@ -413,6 +419,54 @@ def summarize_fleet_serving(records: List[Dict[str, Any]]) -> str:
                      "with bit-exact recompute")
     elif resubmits:
         lines.append(f"  resubmits={resubmits:.0f}")
+    # self-healing: verdicts → quarantines → revivals → graduations, plus
+    # the circuit-breaker retirements (the detect → remediate → verify
+    # ledger of the serving fleet)
+    def counter_total(name: str) -> float:
+        return sum(r["value"] for (n, _), r in latest.items()
+                   if n == name and r.get("type") == "counter")
+
+    health = []
+    verdicts = [(r.get("labels", {}).get("verdict", "?"), r["value"])
+                for (n, _), r in latest.items()
+                if n == "fleet_serving/health_verdicts"
+                and r.get("type") == "counter"]
+    if verdicts:
+        health.append("verdicts: " + "  ".join(
+            f"{v}={c:.0f}" for v, c in sorted(verdicts,
+                                              key=lambda kv: -kv[1])))
+    for name, label in (("fleet_serving/quarantines", "quarantines"),
+                        ("fleet_serving/revivals", "revivals"),
+                        ("fleet_serving/probation_graduations",
+                         "graduations"),
+                        ("fleet_serving/replica_retirements",
+                         "retirements"),
+                        ("fleet_serving/health_ttft_breaches",
+                         "ttft_breaches"),
+                        ("fleet_serving/handoff_failures",
+                         "handoff_failures")):
+        total = counter_total(name)
+        if total:
+            health.append(f"{label}={total:.0f}")
+    if health:
+        lines.append("  health: " + "  ".join(health))
+    # overload: the degraded-mode rung and the shed ledger
+    sheds = [(r.get("labels", {}).get("reason", "?"), r["value"])
+             for (n, _), r in latest.items()
+             if n == "fleet_serving/shed" and r.get("type") == "counter"]
+    rung = gauge("fleet_serving/degraded_mode")
+    if sheds:
+        total = sum(v for _, v in sheds)
+        by = "  ".join(f"{reason}={v:.0f}"
+                       for reason, v in sorted(sheds, key=lambda kv: -kv[1]))
+        lines.append(f"  !! {total:.0f} request(s) shed under overload "
+                     f"({by}) — clients told retry_after_s")
+    if rung is not None and rung > 0:
+        names = {1: "speculation suspended", 2: "affinity hints off",
+                 3: "shedding queued work"}
+        lines.append(f"  !! degraded_mode={rung:.0f} "
+                     f"({names.get(int(rung), '?')}) — the overload "
+                     "ladder has not stepped back down")
     return "\n".join(lines)
 
 

@@ -31,20 +31,21 @@ from .paged_kv import (BlockAllocator, BlockAllocatorError,  # noqa: F401
                        PrefixCache)
 from .scheduler import (QueueFull, Request, SamplingParams,  # noqa: F401
                         Scheduler)
-from .session import RequestCancelled, RequestHandle  # noqa: F401
+from .session import (DeadlineExceeded, RequestCancelled,  # noqa: F401
+                      RequestHandle)
 from .speculative import (Drafter, DraftModelDrafter,  # noqa: F401
                           NgramDrafter)
 from .fleet import (ArenaHandoff, FleetHandle, FleetRouter,  # noqa: F401
-                    FleetUnavailable, KVHandoff, Replica, ReplicaHealth,
-                    build_replicas)
+                    FleetUnavailable, KVHandoff, Overloaded, Replica,
+                    ReplicaHealth, build_replicas)
 
 __all__ = [
     "ServingConfig", "SpeculativeConfig", "ServingEngine", "init_serving",
     "BlockAllocator", "BlockAllocatorError", "PrefixCache",
     "Scheduler", "Request", "SamplingParams", "QueueFull",
-    "RequestHandle", "RequestCancelled",
+    "RequestHandle", "RequestCancelled", "DeadlineExceeded",
     "Drafter", "NgramDrafter", "DraftModelDrafter",
     "FleetConfig", "FleetRouter", "FleetHandle", "FleetUnavailable",
-    "Replica", "ReplicaHealth", "build_replicas",
+    "Overloaded", "Replica", "ReplicaHealth", "build_replicas",
     "KVHandoff", "ArenaHandoff",
 ]

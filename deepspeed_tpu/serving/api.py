@@ -107,6 +107,13 @@ class ServingEngine:
         # -- speculative decoding (off → the plain R×1 decode path) --
         from .speculative import make_drafter
 
+        # kept for fleet replica revival: a rebuilt engine needs the same
+        # drafter inputs the original was constructed with
+        self._draft_engine = draft_engine
+        # fleet degraded-mode rung 1: True skips the drafter (the verify
+        # path with zero proposals IS the plain decode, so flipping this
+        # mid-stream is bit-exact by construction)
+        self.spec_suspended = False
         self._drafter = make_drafter(self.config, engine, self.alloc,
                                      self.blocks_per_seq,
                                      draft_engine=draft_engine,
@@ -424,15 +431,53 @@ class ServingEngine:
     # -- the iteration -----------------------------------------------------
     def step(self) -> bool:
         """One continuous-batching iteration; returns True when any request
-        made progress (admission, a prefill chunk, or a decode token)."""
+        made progress (admission, a prefill chunk, a decode token, or a
+        deadline expiry reclaiming its resources)."""
         with self._lock:
-            progress = bool(self.sched.admit())
+            # before admit: an already-expired queued request must never
+            # take a decode row first
+            progress = self._expire_deadlines()
+            progress |= bool(self.sched.admit())
             progress |= self._step_prefill()
-            progress |= (self._step_verify() if self._drafter is not None
+            progress |= (self._step_verify()
+                         if self._drafter is not None
+                         and not self.spec_suspended
                          else self._step_decode())
             self._publish_iteration()
             self._iterations += 1
             return progress
+
+    def _expire_deadlines(self) -> bool:
+        """Deadline enforcement at decode time: a request whose absolute
+        deadline passed finishes as ``deadline_exceeded`` NOW — rows and
+        blocks free at this iteration boundary instead of decoding to its
+        token budget — and its un-forked siblings (who could never fork
+        anymore) expire with it. The ledger stays balanced:
+        submitted == completed + cancelled + deadline_exceeded."""
+        now = self.clock()
+        expired = self.sched.expire_deadlines(now)
+        if not expired:
+            return False
+        from .scheduler import DEADLINE_EXCEEDED
+
+        for req in list(expired):
+            for sib in self._pending_forks.pop(req.rid, []):
+                sib.state = DEADLINE_EXCEEDED
+                sib.finish_s = now
+                self.sched.deadline_exceeded_count += 1
+                expired.append(sib)
+        obs = get_session()
+        for req in expired:
+            if obs.enabled:
+                obs.registry.counter(
+                    "serving/requests_deadline_exceeded",
+                    help="requests terminated at an iteration boundary "
+                         "after their deadline passed").inc(
+                             tenant=req.tenant)
+            handle = self._handles.pop(req.rid, None)
+            if handle is not None:
+                handle._wake()
+        return True
 
     def _table_for(self, reqs: List[Request]) -> np.ndarray:
         """(len(reqs), MAXB) block table; unfilled entries → scratch 0."""

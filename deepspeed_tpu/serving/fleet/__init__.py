@@ -5,28 +5,37 @@ deployment layer over N of them (the DeepSpeed-MII/FastGen analog taken
 past one engine, ROADMAP item 2):
 
   replica.py   Replica + the cheap ReplicaHealth snapshot the router
-               polls between scheduler iterations
+               polls between scheduler iterations, plus the replica
+               lifecycle state machine (quarantine → probation →
+               graduation, death → revival, circuit-breaker retirement)
   router.py    FleetRouter: same submit()/stream()/result()/cancel()
                surface as ServingEngine, pluggable routing policies
                (queue-depth / KV-occupancy / prefix-affinity with
                cross-replica admission hints), replica-death drain +
-               bit-exact resubmission
+               bit-exact resubmission, health verdicts (slow/TTFT-SLO
+               quarantine), replica revival with probation, overload
+               admission control (Overloaded/retry_after_s) and the
+               degraded-mode ladder
   disagg.py    prefill/decode disaggregation: the KVHandoff seam and the
                in-HBM ArenaHandoff (jitted block gather/scatter —
-               serving/kv_export + serving/kv_import)
+               serving/kv_export + serving/kv_import), with a
+               deterministic transfer-failure seam for the chaos gate
 
-See docs/serving.md ("Fleet serving & disaggregation").
+See docs/serving.md ("Fleet serving & disaggregation", "Fleet
+self-healing & overload").
 """
 
 from .disagg import (ArenaHandoff, HandoffGeometryError,  # noqa: F401
-                     KVHandoff)
+                     HandoffTransferError, KVHandoff)
 from .replica import (ROLE_DECODE, ROLE_MIXED, ROLE_PREFILL,  # noqa: F401
                       Replica, ReplicaDead, ReplicaHealth, build_replicas)
-from .router import FleetHandle, FleetRouter, FleetUnavailable  # noqa: F401
+from .router import (FleetHandle, FleetRouter,  # noqa: F401
+                     FleetUnavailable, Overloaded)
 
 __all__ = [
-    "FleetRouter", "FleetHandle", "FleetUnavailable",
+    "FleetRouter", "FleetHandle", "FleetUnavailable", "Overloaded",
     "Replica", "ReplicaHealth", "ReplicaDead", "build_replicas",
     "ROLE_MIXED", "ROLE_PREFILL", "ROLE_DECODE",
     "KVHandoff", "ArenaHandoff", "HandoffGeometryError",
+    "HandoffTransferError",
 ]

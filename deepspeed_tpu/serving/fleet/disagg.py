@@ -35,12 +35,20 @@ from ...utils.logging import logger
 from .. import paged_kv
 
 __all__ = ["KVHandoff", "ArenaHandoff", "HandoffGeometryError",
-           "register_handoff_audit_entries"]
+           "HandoffTransferError", "register_handoff_audit_entries"]
 
 
 class HandoffGeometryError(ValueError):
     """Source and destination engines disagree on arena geometry — their
     blocks are not interchangeable."""
+
+
+class HandoffTransferError(RuntimeError):
+    """The KV transfer itself failed mid-flight (a cross-host link drop, a
+    device error out of kv_import — or the chaos harness's ``handoff_fail``
+    fault standing in for either). Destination blocks are already freed
+    when this propagates; the router retries on another decode replica,
+    then falls back to decoding in place."""
 
 
 def _check_geometry(src, dst) -> None:
@@ -60,11 +68,27 @@ class KVHandoff:
     """Transport interface: move ``blocks`` (source-engine block ids) into
     the destination engine's arena. Returns the destination block ids —
     same count, request-order preserved — or None when the destination
-    pool cannot take them right now (the router's fallback signal).
-    Implementations own their device programs; the router owns policy."""
+    pool cannot take them right now (the router's fallback signal). A
+    transfer that starts and then FAILS raises ``HandoffTransferError``
+    with the destination blocks already freed.
+    Implementations own their device programs; the router owns policy.
+
+    ``inject_fail_next`` is the chaos seam: each unit makes the next
+    ``transfer`` fail AFTER destination allocation (and, for
+    ``ArenaHandoff``, after the export) — exercising the exact
+    free-on-failure path a real mid-flight loss takes. The router arms it
+    from the ``handoff_fail`` fault plan."""
+
+    inject_fail_next: int = 0
 
     def transfer(self, src, dst, blocks: List[int]) -> Optional[List[int]]:
         raise NotImplementedError
+
+    def _maybe_inject_failure(self) -> None:
+        if self.inject_fail_next > 0:
+            self.inject_fail_next -= 1
+            raise HandoffTransferError(
+                "injected handoff_fail fault (chaos harness)")
 
 
 class ArenaHandoff(KVHandoff):
@@ -75,6 +99,7 @@ class ArenaHandoff(KVHandoff):
         self._export = paged_kv.build_kv_export_program()
         self._import = paged_kv.build_kv_import_program()
         self.transfers = 0
+        self.inject_fail_next = 0
 
     def transfer(self, src, dst, blocks: List[int]) -> Optional[List[int]]:
         """``src``/``dst`` are ServingEngines (callers hold whatever locks
@@ -97,6 +122,10 @@ class ArenaHandoff(KVHandoff):
             with obs.span("fleet/kv_handoff", blocks=len(blocks)):
                 with mesh_mod.ambient(src.engine.mesh):
                     buf_k, buf_v = self._export(src._arena, src_pad)
+                # mid-flight: after the export left the source, before the
+                # import commits to the destination — the window a real
+                # cross-host transfer dies in
+                self._maybe_inject_failure()
                 with mesh_mod.ambient(dst.engine.mesh):
                     dst._arena = self._import(dst._arena, buf_k, buf_v,
                                               dst_pad)
@@ -104,7 +133,8 @@ class ArenaHandoff(KVHandoff):
 
                 jax.block_until_ready(dst._arena["k"])   # honest latency
         except Exception:
-            # a failed transfer must not leak destination blocks
+            # a failed transfer must not leak destination blocks; a partial
+            # import is harmless garbage once its blocks return to the pool
             dst.alloc.free(dst_ids)
             raise
         self.transfers += 1

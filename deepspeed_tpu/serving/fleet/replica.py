@@ -1,19 +1,41 @@
 """Replica — one ``ServingEngine`` behind the fleet router.
 
-A ``Replica`` wraps a serving engine with the three things the router
-needs that the engine itself does not expose: an identity + role (mixed /
-prefill / decode for disaggregation), a liveness flag the chaos harness
-can flip (``replica_kill``) and real death detection hooks onto, and a
-cheap host-side :class:`ReplicaHealth` snapshot the router polls between
-scheduler iterations — every field is a host counter read, no device sync.
+A ``Replica`` wraps a serving engine with what the router needs that the
+engine itself does not expose:
+
+* identity + role (mixed / prefill / decode for disaggregation);
+* the **lifecycle state machine** the self-healing loop drives::
+
+      serving ──slow/TTFT-breach──▶ quarantined ──backoff──▶ probation
+         ▲                                                      │
+         │◀──────────────── N clean completions ────────────────┘
+         │
+         ├──kill/step-exception──▶ dead ──revive()──▶ probation
+         │
+         └──incidents > breaker──▶ retired (terminal)
+
+  Quarantined replicas are alive — they keep stepping their in-flight
+  work but take no new traffic until the backoff expires. Dead replicas
+  are drained (requests resubmitted elsewhere) and may be **rebuilt**
+  reusing the fleet's shared weights and already-compiled programs.
+  Probation bounds a re-admitted replica's traffic share until it proves
+  itself with clean completions. The circuit breaker retires a replica
+  that keeps flapping — retirement is terminal, never revived.
+* a cheap host-side :class:`ReplicaHealth` snapshot the router polls
+  between scheduler iterations — every field is a host counter read, no
+  device sync — now including a rolling step-time window the router
+  feeds from its own wall-clock measurements (the slow-replica verdict
+  input).
 """
 
 from __future__ import annotations
 
+import collections
 import dataclasses
+import statistics
 from typing import List, Optional
 
-__all__ = ["Replica", "ReplicaHealth", "ReplicaDead",
+__all__ = ["Replica", "ReplicaHealth", "ReplicaDead", "ReplicaRetired",
            "ROLE_MIXED", "ROLE_PREFILL", "ROLE_DECODE", "build_replicas"]
 
 ROLE_MIXED = "mixed"
@@ -24,6 +46,24 @@ ROLE_DECODE = "decode"
 class ReplicaDead(RuntimeError):
     """The replica is not serving (killed by fault injection, a crashed
     driver thread, or an explicit drain)."""
+
+
+def graft_programs(dst, src) -> None:
+    """Share ``src``'s compiled serving programs into ``dst``: identical
+    (config, shapes) by fleet construction make the jitted callables
+    interchangeable, collapsing N compiles into 1 — the fact both
+    fleet construction and replica revival are built on (ONE copy of the
+    contract; a program added to ServingEngine joins the fleet here)."""
+    dst._prefill = src._prefill
+    dst._decode = src._decode
+    dst._cow = src._cow
+    if dst._verify is not None and src._verify is not None:
+        dst._verify = src._verify
+
+
+class ReplicaRetired(RuntimeError):
+    """The replica tripped its circuit breaker (too many incidents) and is
+    permanently out of the fleet — revival is refused."""
 
 
 @dataclasses.dataclass
@@ -40,6 +80,11 @@ class ReplicaHealth:
     kv_blocks_free: int = 0
     arena_occupancy: float = 0.0    # allocated fraction of the block pool
     decode_batch_occupancy: float = 0.0   # decoding rows / max_seqs
+    quarantined: bool = False       # alive but taking no new traffic
+    probation_left: int = 0         # clean completions still owed (> 0 =
+    #   on probation: traffic share bounded)
+    step_time_median_s: Optional[float] = None  # rolling median of
+    #   router-measured iteration wall times (None until window warm)
 
     @property
     def load_key(self):
@@ -52,7 +97,8 @@ class Replica:
     """One fleet member. ``role`` partitions the fleet for prefill/decode
     disaggregation (``ROLE_MIXED`` replicas serve both phases)."""
 
-    def __init__(self, engine, index: int, role: str = ROLE_MIXED):
+    def __init__(self, engine, index: int, role: str = ROLE_MIXED,
+                 health_window: int = 8):
         if role not in (ROLE_MIXED, ROLE_PREFILL, ROLE_DECODE):
             raise ValueError(f"unknown replica role '{role}'")
         self.engine = engine
@@ -61,6 +107,41 @@ class Replica:
         self.alive = True
         self.drained = False        # router bookkeeping: dead AND resubmitted
         self.death_reason: Optional[str] = None
+        # -- lifecycle state (router-driven; see module docstring) --
+        self.quarantined = False
+        self.quarantine_reason: Optional[str] = None
+        self.quarantine_until = 0   # router iteration the backoff expires at
+        self.revive_at = 0          # router iteration revival may be tried
+        self.death_iteration = 0    # router iteration of the last kill
+        #   (the bench's time-to-revival input)
+        self.probation_left = 0     # clean completions owed before full
+        #   routing weight (0 = full member)
+        self.deaths = 0
+        self.quarantines = 0
+        self.revivals = 0
+        self.retired = False        # circuit breaker tripped — terminal
+        # rebuild inputs, captured NOW: once the replica is declared dead
+        # its engine object is never consulted again, so revival needs the
+        # construction recipe up front (the InferenceEngine — weights and
+        # mesh — is fleet-shared and survives any replica's death)
+        self._infer_engine = getattr(engine, "engine", None)
+        self._draft_engine = getattr(engine, "_draft_engine", None)
+        self._clock = getattr(engine, "clock", None)
+        import copy
+
+        self._cfg_template = (copy.deepcopy(engine.config)
+                              if engine is not None else None)
+        # router-measured iteration wall times (the slow-verdict input);
+        # warmup_left steps are discarded first — the router sets it from
+        # fleet.health_warmup_steps so compile jitter never convicts
+        self.warmup_left = 0
+        self.step_times: "collections.deque" = collections.deque(
+            maxlen=max(int(health_window), 2))
+
+    @property
+    def incidents(self) -> int:
+        """Circuit-breaker ledger: every death and every quarantine counts."""
+        return self.deaths + self.quarantines
 
     def kill(self, reason: str = "killed") -> None:
         """Mark the replica dead. The router stops stepping it and its
@@ -70,6 +151,82 @@ class Replica:
         if self.alive:
             self.alive = False
             self.death_reason = reason
+            self.deaths += 1
+            self.quarantined = False
+            self.quarantine_reason = None
+            self.probation_left = 0
+            self.step_times.clear()
+
+    def quarantine(self, reason: str, until_iteration: int) -> None:
+        """Alive but suspect: no new traffic until ``until_iteration``."""
+        if not self.alive or self.quarantined:
+            return
+        self.quarantined = True
+        self.quarantine_reason = reason
+        self.quarantine_until = int(until_iteration)
+        self.quarantines += 1
+        self.step_times.clear()     # the window that convicted it is stale
+
+    def retire(self) -> None:
+        """Circuit breaker: permanently out — ``revive`` refuses."""
+        self.retired = True
+        self.kill("breaker")
+
+    def routable(self) -> bool:
+        """May receive NEW traffic (probation share is the router's call)."""
+        return self.alive and not self.quarantined
+
+    def note_step_time(self, dt_s: float) -> None:
+        if self.warmup_left > 0:
+            self.warmup_left -= 1
+            return
+        self.step_times.append(float(dt_s))
+
+    def step_time_median(self) -> Optional[float]:
+        """Rolling median once the window is warm (None before — a verdict
+        off two samples would quarantine on compile jitter)."""
+        if len(self.step_times) < self.step_times.maxlen:
+            return None
+        return statistics.median(self.step_times)
+
+    def rebuild(self, donor: Optional["Replica"] = None):
+        """Build a replacement ``ServingEngine`` from the captured recipe:
+        the fleet-shared InferenceEngine (weights, mesh) plus a fresh copy
+        of this replica's serving config — and graft the fleet's
+        already-compiled program set from ``donor`` (any alive replica), so
+        revival costs one arena allocation, not a compile set. Returns the
+        new engine; the caller (router) swaps it in via :meth:`revive`."""
+        if self.retired:
+            raise ReplicaRetired(
+                f"replica {self.index} is retired (circuit breaker) — "
+                "refusing to rebuild")
+        import copy
+
+        from ..api import ServingEngine
+
+        kw = {"clock": self._clock} if self._clock is not None else {}
+        srv = ServingEngine(self._infer_engine,
+                            copy.deepcopy(self._cfg_template),
+                            draft_engine=self._draft_engine, **kw)
+        if donor is not None and donor.alive:
+            graft_programs(srv, donor.engine)
+        return srv
+
+    def revive(self, new_engine, probation_requests: int) -> None:
+        """Swap in the rebuilt engine and re-enter the fleet ON PROBATION:
+        the router bounds this replica's traffic share until
+        ``probation_requests`` requests complete cleanly on it."""
+        if self.retired:
+            raise ReplicaRetired(
+                f"replica {self.index} is retired — refusing to revive")
+        self.engine = new_engine
+        self.alive = True
+        self.drained = False
+        self.quarantined = False
+        self.quarantine_reason = None
+        self.probation_left = int(probation_requests)
+        self.revivals += 1
+        self.step_times.clear()
 
     def step(self) -> bool:
         if not self.alive:
@@ -92,7 +249,10 @@ class Replica:
             kv_blocks_free=alloc.blocks_free,
             arena_occupancy=alloc.blocks_in_use / max(alloc.capacity, 1),
             decode_batch_occupancy=(len(sched.decode_requests())
-                                    / eng.config.max_seqs))
+                                    / eng.config.max_seqs),
+            quarantined=self.quarantined,
+            probation_left=self.probation_left,
+            step_time_median_s=self.step_time_median())
 
 
 def build_replicas(engine, serving_config, n: int,
@@ -103,7 +263,9 @@ def build_replicas(engine, serving_config, n: int,
     host and wraps each the same way). The replicas share the underlying
     ``InferenceEngine``'s params and — since their arena/program shapes are
     identical — the first replica's compiled serving programs, so a fleet
-    costs one compile set plus N arenas, not N compile sets."""
+    costs one compile set plus N arenas, not N compile sets. (Replica
+    revival leans on the same fact: a rebuilt engine grafts a surviving
+    replica's program set.)"""
     import copy
 
     from ..api import ServingEngine
@@ -122,13 +284,7 @@ def build_replicas(engine, serving_config, n: int,
         if first is None:
             first = srv
         else:
-            # identical (cfg, shapes) → the jitted callables are
-            # interchangeable; sharing them collapses N compiles into 1
-            srv._prefill = first._prefill
-            srv._decode = first._decode
-            srv._cow = first._cow
-            if srv._verify is not None:
-                srv._verify = first._verify
+            graft_programs(srv, first)
         replicas.append(Replica(srv, index=i,
                                 role=roles[i] if roles else ROLE_MIXED))
     return replicas

@@ -207,6 +207,14 @@ class PrefixCache:
     def cached_blocks(self) -> int:
         return len(self._entries)
 
+    @property
+    def reclaimable_blocks(self) -> int:
+        """Cached blocks held ONLY by the cache pin (allocator refcount 1):
+        evictable on demand, so load/occupancy signals must not count them
+        as pressure — a warm cache deliberately fills the pool."""
+        return sum(1 for b in self._entries.values()
+                   if self.alloc.refcount(b) == 1)
+
     @staticmethod
     def _chain(prev: bytes, tokens: np.ndarray) -> bytes:
         h = hashlib.blake2b(prev, digest_size=16)

@@ -44,13 +44,15 @@ from .paged_kv import (BlockAllocator, PrefixCache, blocks_for_tokens,
                        extend_block_list, truncate_block_list)
 
 __all__ = ["Request", "SamplingParams", "Scheduler", "QueueFull",
-           "QUEUED", "PREFILL", "DECODE", "FINISHED", "CANCELLED"]
+           "QUEUED", "PREFILL", "DECODE", "FINISHED", "CANCELLED",
+           "DEADLINE_EXCEEDED"]
 
 QUEUED = "queued"
 PREFILL = "prefill"
 DECODE = "decode"
 FINISHED = "finished"
 CANCELLED = "cancelled"
+DEADLINE_EXCEEDED = "deadline_exceeded"
 
 
 class QueueFull(RuntimeError):
@@ -115,7 +117,7 @@ class Request:
 
     @property
     def done(self) -> bool:
-        return self.state in (FINISHED, CANCELLED)
+        return self.state in (FINISHED, CANCELLED, DEADLINE_EXCEEDED)
 
     @property
     def ttft_s(self) -> Optional[float]:
@@ -162,6 +164,11 @@ class Scheduler:
         self.preemption_count = 0
         self.finished_count = 0
         self.cancelled_count = 0
+        self.deadline_exceeded_count = 0
+        # deadline-bearing requests currently queued/running: the O(1)
+        # fast path for expire_deadlines — a no-deadline workload must not
+        # pay a per-iteration scan for a feature it never uses
+        self._deadline_reqs = 0
         self.handoffs_out = 0          # requests handed to another engine
         self.prefix_hits = 0           # admissions that reused ≥1 block
         self.prefix_hit_tokens = 0     # prompt tokens whose prefill was skipped
@@ -187,6 +194,8 @@ class Scheduler:
             raise ValueError(f"request {req.rid}: empty prompt")
         req.arrival_s = self.clock()
         req.state = QUEUED
+        if req.deadline_s is not None:
+            self._deadline_reqs += 1
         self.queued.append(req)
 
     def submit_forked(self, req: Request) -> None:
@@ -201,6 +210,8 @@ class Scheduler:
             req.arrival_s = self.clock()
         req.state = QUEUED
         req.prefilled = True
+        if req.deadline_s is not None:
+            self._deadline_reqs += 1
         self.queued.append(req)
 
     def cancel(self, req: Request) -> bool:
@@ -213,6 +224,7 @@ class Scheduler:
         # can transiently carry blocks) — skipping it here leaked them for
         # the server's lifetime
         self._release(req)
+        self._note_terminal(req)
         req.state = CANCELLED
         req.finish_s = self.clock()
         self.cancelled_count += 1
@@ -241,11 +253,41 @@ class Scheduler:
         if self.on_release is not None:
             self.on_release(req)
 
+    def _note_terminal(self, req: Request) -> None:
+        """Terminal-state bookkeeping shared by finish/cancel/handoff/
+        expire (NOT preemption — a preempted request is still in flight)."""
+        if req.deadline_s is not None:
+            self._deadline_reqs = max(self._deadline_reqs - 1, 0)
+
     def finish(self, req: Request) -> None:
         self._release(req)
+        self._note_terminal(req)
         req.state = FINISHED
         req.finish_s = self.clock()
         self.finished_count += 1
+
+    def expire_deadlines(self, now: float) -> List[Request]:
+        """Terminal-state the requests whose absolute deadline has passed —
+        queued OR running: a request that can no longer meet its deadline
+        must stop consuming decode rows and blocks to completion. Frees
+        rows/blocks immediately (the bugfix: an expired request used to
+        decode to its token budget while live requests waited on the pool)
+        and returns the expired requests so the engine can count them and
+        wake their handles. O(1) when no in-flight request carries a
+        deadline — the common workload never pays for the scan."""
+        if self._deadline_reqs == 0:
+            return []
+        expired = [r for r in list(self.queued) + list(self.running.values())
+                   if r.deadline_s is not None and now > r.deadline_s]
+        for req in expired:
+            if req.state == QUEUED:
+                self.queued.remove(req)
+            self._release(req)
+            self._note_terminal(req)
+            req.state = DEADLINE_EXCEEDED
+            req.finish_s = now
+            self.deadline_exceeded_count += 1
+        return expired
 
     def release_handoff(self, req: Request) -> None:
         """Terminal release for a request whose KV was handed to ANOTHER
@@ -254,6 +296,7 @@ class Scheduler:
         completion — the destination engine finishes the request and owns
         its completion ledger entry."""
         self._release(req)
+        self._note_terminal(req)
         req.state = FINISHED
         req.finish_s = self.clock()
         self.handoffs_out += 1

@@ -21,13 +21,19 @@ from typing import Iterator, List, Optional
 
 import numpy as np
 
-from .scheduler import CANCELLED, FINISHED, Request
+from .scheduler import CANCELLED, DEADLINE_EXCEEDED, FINISHED, Request
 
-__all__ = ["RequestHandle", "RequestCancelled"]
+__all__ = ["RequestHandle", "RequestCancelled", "DeadlineExceeded"]
 
 
 class RequestCancelled(RuntimeError):
     """Raised by ``result()`` when the request was cancelled."""
+
+
+class DeadlineExceeded(RuntimeError):
+    """Raised by ``result()`` when the request's deadline expired before it
+    finished — its rows/blocks were reclaimed at the iteration boundary and
+    the tokens streamed so far are all there will be."""
 
 
 def drive_stream(cond: threading.Condition, tokens: List[int], is_done,
@@ -166,10 +172,16 @@ class RequestHandle:
 
     def result(self, timeout_s: Optional[float] = None) -> np.ndarray:
         """Block (or drive) until the request finishes; returns the full
-        generated token array. Raises ``RequestCancelled`` on cancellation."""
+        generated token array. Raises ``RequestCancelled`` on cancellation
+        and ``DeadlineExceeded`` when the deadline expired mid-stream."""
         for _ in self.stream(timeout_s=timeout_s):
             pass
         if self._req.state == CANCELLED:
             raise RequestCancelled(f"request {self._req.rid} was cancelled")
+        if self._req.state == DEADLINE_EXCEEDED:
+            raise DeadlineExceeded(
+                f"request {self._req.rid} missed its deadline "
+                f"({len(self.tokens)} of {self._req.max_new_tokens} tokens "
+                "generated)")
         assert self._req.state == FINISHED
         return np.asarray(self.tokens, np.int32)

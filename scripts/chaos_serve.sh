@@ -1,0 +1,36 @@
+#!/usr/bin/env bash
+# Serving chaos gate: the fleet's detect → remediate → verify loop
+# (docs/serving.md "Fleet self-healing & overload").
+#
+# Drives 12+ staggered temperature-0.7 requests through a 3-replica fleet
+# under a deterministic kill → slow → revive fault plan and asserts:
+#   * every client stream is bit-identical to the single-engine oracle —
+#     replica death, drain + recompute resubmission, quarantine, revival
+#     and probation are invisible to clients;
+#   * at least one quarantine fired (step-time verdict on the
+#     replica_slow straggler) and at least one revival graduated
+#     probation;
+#   * a deadline-infeasible submit was shed with a structured
+#     Overloaded(retry_after_s=...);
+#   * zero leaked KV blocks (pools drain to prefix-cache pins) and a
+#     balanced fleet request ledger;
+# plus the disaggregated variant (handoff_fail mid-transfer → retry on
+# another decode replica / decode-in-place fallback, blocks freed exactly
+# once) and the full fleet lifecycle/overload suites.
+#
+# CPU-only and sleep-free: injected slowness rides the health data-plane,
+# faults are pinned to router iterations — a chaos run is exactly
+# reproducible.
+#
+# Usage: scripts/chaos_serve.sh [extra pytest args...]
+set -euo pipefail
+
+cd "$(dirname "$0")/.."
+
+JAX_PLATFORMS=cpu python -m pytest \
+    "tests/unit/test_fleet_chaos.py" \
+    "tests/unit/test_fleet.py::TestReplicaLifecycle" \
+    "tests/unit/test_fleet.py::TestOverloadControl" \
+    "tests/unit/test_fleet.py::TestHandoffFaultTolerance" \
+    "tests/unit/test_fleet.py::TestParkedResubmission" \
+    -q -p no:cacheprovider "$@"

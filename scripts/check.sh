@@ -1,8 +1,9 @@
 #!/usr/bin/env bash
 # The unified static-analysis driver: lint (source) + audit (program
 # semantics) + cost (program cost) + parity (serving kernel-path tests,
-# tier-1 marker set) + chaos (fault-injection recovery smoke) in one run,
-# one exit code for CI.
+# tier-1 marker set) + chaos (training fault-injection recovery smoke) +
+# chaos_serve (serving-fleet self-healing smoke) in one run, one exit
+# code for CI.
 #
 # The three analyzers share the same gate semantics (committed baseline,
 # stale-entry rot detection, the render_report tail in
@@ -19,7 +20,7 @@ cd "$(dirname "$0")/.."
 
 selected=("$@")
 fail=0
-for gate in lint audit cost parity chaos; do
+for gate in lint audit cost parity chaos chaos_serve; do
     if [ "${#selected[@]}" -gt 0 ]; then
         case " ${selected[*]} " in
             *" $gate "*) ;;

@@ -50,12 +50,28 @@ def tiny_engine():
 
 
 def mk_fleet(engine, n=3, roles=None, policy="kv_occupancy", fault_plan=None,
-             fleet_cfg=None, **cfg):
+             fleet_cfg=None, clock=None, **cfg):
     kwargs = dict(SCFG)
     kwargs.update(cfg)
-    replicas = build_replicas(engine, ServingConfig(**kwargs), n, roles=roles)
+    replicas = build_replicas(engine, ServingConfig(**kwargs), n,
+                              roles=roles, clock=clock)
     fc = fleet_cfg or FleetConfig(policy=policy)
-    return FleetRouter(replicas, fc, fault_plan=fault_plan), replicas
+    rkw = {"clock": clock} if clock is not None else {}
+    return (FleetRouter(replicas, fc, fault_plan=fault_plan, **rkw),
+            replicas)
+
+
+class FakeClock:
+    """Injectable router/engine clock (sleep-free lifecycle tests)."""
+
+    def __init__(self, t: float = 0.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
 
 
 def mk_prompts(n, lo=4, hi=40, seed=0):
@@ -311,7 +327,10 @@ class TestReplicaDeath:
             hs = [router.submit(p, max_new_tokens=12, seed=i)
                   for i, p in enumerate(prompts)]
             outs = [h.result() for h in hs]
-            assert not replicas[1].alive
+            # the replica really died mid-run (auto-revive may have since
+            # rebuilt it — deaths are the durable evidence)
+            assert replicas[1].deaths == 1
+            assert replicas[1].death_reason == "fault"
             assert sum(h.resubmits for h in hs) > 0
             for got, exp in zip(outs, want):
                 np.testing.assert_array_equal(got, exp)
@@ -340,7 +359,7 @@ class TestReplicaDeath:
 
             replicas[0].engine.step = exploding_step
             outs = [h.result() for h in hs]
-            assert not replicas[0].alive
+            assert replicas[0].deaths == 1
             assert replicas[0].death_reason == "step-exception"
             for got, exp in zip(outs, want):
                 np.testing.assert_array_equal(got, exp)
@@ -634,7 +653,7 @@ class TestFleetAcceptanceSmoke:
         try:
             hs = run_staggered(router, prompts, n_new=self.N_NEW,
                                temperature=self.TEMP)
-            assert not replicas[1].alive          # the fault actually fired
+            assert replicas[1].deaths == 1        # the fault actually fired
             resubmitted = sum(h.resubmits for h in hs)
             assert resubmitted > 0                # ... mid-stream
             for i, (h, exp) in enumerate(zip(hs, want)):
@@ -730,6 +749,28 @@ class TestFleetServingReport:
              "labels": {"reason": "fault"}, "value": 1},
             {"type": "counter", "name": "fleet_serving/resubmits",
              "labels": {}, "value": 3},
+            # the self-healing / overload block (ISSUE-12)
+            {"type": "gauge", "name": "fleet_serving/health_state",
+             "labels": lbl, "value": 1},
+            {"type": "gauge", "name": "fleet_serving/health_state",
+             "labels": lbl2, "value": 3},
+            {"type": "counter", "name": "fleet_serving/health_verdicts",
+             "labels": {"verdict": "slow"}, "value": 2},
+            {"type": "counter", "name": "fleet_serving/quarantines",
+             "labels": {"reason": "slow"}, "value": 2},
+            {"type": "counter", "name": "fleet_serving/revivals",
+             "labels": {}, "value": 1},
+            {"type": "counter",
+             "name": "fleet_serving/probation_graduations",
+             "labels": {}, "value": 1},
+            {"type": "counter", "name": "fleet_serving/handoff_failures",
+             "labels": {}, "value": 1},
+            {"type": "counter", "name": "fleet_serving/shed",
+             "labels": {"reason": "deadline_infeasible"}, "value": 4},
+            {"type": "counter", "name": "fleet_serving/shed",
+             "labels": {"reason": "degraded"}, "value": 2},
+            {"type": "gauge", "name": "fleet_serving/degraded_mode",
+             "labels": {}, "value": 2},
         ]
 
     def test_section_renders_everything(self):
@@ -745,6 +786,16 @@ class TestFleetServingReport:
         assert "p50=2.00ms" in text and "p99=8.80ms" in text
         assert "1 replica death(s)" in text and "fault=1" in text
         assert "3 in-flight request(s) resubmitted" in text
+        # the self-healing / overload block
+        assert "serving" in text and "probation" in text  # state column
+        assert "verdicts: slow=2" in text
+        assert "quarantines=2" in text and "revivals=1" in text
+        assert "graduations=1" in text
+        assert "handoff_failures=1" in text
+        assert "6 request(s) shed under overload" in text
+        assert "deadline_infeasible=4" in text and "degraded=2" in text
+        assert "degraded_mode=2" in text
+        assert "affinity hints off" in text
 
     def test_absent_without_fleet_metrics(self):
         from deepspeed_tpu.observability.report import summarize_fleet_serving
@@ -824,3 +875,629 @@ class TestFleetCloseGauges:
                 or len(set(pooled)) == 1
         finally:
             reset_session()
+
+
+# ---------------------------------------------------------------------------
+# ISSUE-12: replica lifecycle — quarantine → probation → graduation,
+# revival, circuit breaker (sleep-free: fault-injected step-time penalties
+# ride the health data-plane, never the wall clock)
+# ---------------------------------------------------------------------------
+
+
+# warmup 3 swallows every compile-heavy first dispatch (prefill, decode —
+# an SLO of 2s with ms-scale real steps then only ever convicts the
+# injected 10s penalty); lifecycle tests also run prefix_cache=False so a
+# late COW-program compile can never land in a sampled step
+HEAL_CFG = dict(policy="round_robin", health_window=2, step_time_slo_s=2.0,
+                health_warmup_steps=3, quarantine_iterations=4,
+                revive_after_iterations=2, probation_requests=2,
+                probation_share=0.25, breaker_incidents=4)
+
+
+class TestReplicaLifecycle:
+    def test_slow_replica_quarantined_then_graduates(self, tiny_engine):
+        """The full state machine on one replica: a step-time SLO breach
+        quarantines it (alive, no new traffic), the backoff expires into
+        probation, and clean completions graduate it back to full
+        weight."""
+        router, replicas = mk_fleet(
+            tiny_engine, n=2, fleet_cfg=FleetConfig(**HEAL_CFG),
+            prefix_cache=False,
+            fault_plan=[{"kind": "replica_slow", "step": 0, "steps": 7,
+                         "replica": 1, "sleep_s": 10.0}])
+        try:
+            hs = [router.submit(np.arange(1, 20, dtype=np.int32),
+                                max_new_tokens=6, seed=i) for i in range(4)]
+            it = 0
+            while not replicas[1].quarantined:
+                router.step()
+                it += 1
+                assert it < 50, "slow replica never quarantined"
+            assert replicas[1].alive                  # quarantined ≠ dead
+            assert replicas[1].quarantine_reason == "step_slo"
+            assert router._quarantine_count == 1
+            # no NEW traffic routes to it while quarantined...
+            h_new = router.submit(np.arange(1, 20, dtype=np.int32),
+                                  max_new_tokens=4)
+            assert h_new._fr.replica.index == 0
+            # ...but its own in-flight work keeps stepping to completion
+            for h in hs:
+                h.result()
+            while replicas[1].quarantined:
+                router.step()
+                it += 1
+                assert it < 200, "quarantine never expired"
+            # on probation now — its own work completing during probation
+            # may already have earned clean-completion credit
+            assert 0 <= replicas[1].probation_left <= 2
+            # clean completions graduate it (bounded: the fault window is
+            # over, so probation must resolve — never re-convict)
+            h_new.result()
+            for _ in range(20):
+                if replicas[1].probation_left == 0:
+                    break
+                router.submit(np.arange(1, 20, dtype=np.int32),
+                              max_new_tokens=4).result()
+            assert router._graduation_count == 1
+            assert replicas[1].routable()
+        finally:
+            router.close()
+
+    def test_probation_traffic_share_bounded(self, tiny_engine):
+        """A probation replica's concurrent share stays under
+        probation_share × fleet in-flight (floor one): with share 0.25 and
+        ~6 in flight, at most one lands on it at a time."""
+        router, replicas = mk_fleet(
+            tiny_engine, n=2, policy="least_queue",
+            fleet_cfg=FleetConfig(**{**HEAL_CFG, "policy": "least_queue"}))
+        try:
+            replicas[1].probation_left = 3           # force probation
+            hs = [router.submit(np.arange(1, 20, dtype=np.int32),
+                                max_new_tokens=8, seed=i)
+                  for i in range(6)]
+            on_probation = [h for h in hs if h._fr.replica.index == 1]
+            # least_queue would have split 3/3; the probation cap allows
+            # at most max(1, int(0.25 × in_flight)) concurrent
+            assert len(on_probation) <= 1
+            for h in hs:
+                h.result()
+        finally:
+            router.close()
+
+    def test_flapping_replica_respects_breaker_budget(self, tiny_engine):
+        """replica_flap kills every revived incarnation; the per-replica
+        circuit breaker must retire it after breaker_incidents incidents —
+        revivals never exceed the budget and the fleet finishes all work
+        on the survivor."""
+        cfg = dict(HEAL_CFG)
+        cfg.update(breaker_incidents=2, revive_after_iterations=1)
+        router, replicas = mk_fleet(
+            tiny_engine, n=2, fleet_cfg=FleetConfig(**cfg),
+            fault_plan=[{"kind": "replica_flap", "step": 1, "steps": 60,
+                         "replica": 1}])
+        try:
+            prompts = mk_prompts(6, seed=21)
+            want = oracle_outputs(tiny_engine, prompts, n_new=10)
+            hs = [router.submit(p, max_new_tokens=10, seed=i)
+                  for i, p in enumerate(prompts)]
+            outs = [h.result() for h in hs]
+            # drive past the flap window so the breaker resolves
+            for _ in range(70):
+                router.step()
+            assert replicas[1].retired
+            assert replicas[1].death_reason.startswith("breaker")
+            assert replicas[1].revivals <= cfg["breaker_incidents"]
+            # retired means retired: no more revivals, ever
+            revivals_at_retirement = replicas[1].revivals
+            for _ in range(30):
+                router.step()
+            assert replicas[1].revivals == revivals_at_retirement
+            for got, exp in zip(outs, want):
+                np.testing.assert_array_equal(got, exp)
+        finally:
+            router.close()
+
+    def test_revived_replica_streams_bit_exact(self, tiny_engine):
+        """Requests served by a revived replica (post-kill rebuild sharing
+        the survivor's compiled programs) are bit-identical to the
+        single-engine oracle — revival is invisible to clients."""
+        prompts = mk_prompts(8, seed=31)
+        want = oracle_outputs(tiny_engine, prompts, n_new=8,
+                              temperature=0.7)
+        cfg = dict(HEAL_CFG)
+        cfg.update(revive_after_iterations=1, probation_requests=1,
+                   probation_share=1.0)
+        router, replicas = mk_fleet(
+            tiny_engine, n=2, fleet_cfg=FleetConfig(**cfg),
+            fault_plan=[{"kind": "replica_kill", "step": 2, "replica": 1}])
+        try:
+            # first half rides through the kill + revival
+            hs = [router.submit(p, max_new_tokens=8, seed=i,
+                                temperature=0.7)
+                  for i, p in enumerate(prompts[:4])]
+            outs = [h.result() for h in hs]
+            assert replicas[1].revivals == 1
+            # second half: round_robin lands half on the REVIVED replica
+            hs2 = [router.submit(p, max_new_tokens=8, seed=4 + i,
+                                 temperature=0.7)
+                   for i, p in enumerate(prompts[4:])]
+            outs += [h.result() for h in hs2]
+            assert any(h._fr.replica.index == 1 for h in hs2)
+            assert router._graduation_count >= 1
+            for i, (got, exp) in enumerate(zip(outs, want)):
+                np.testing.assert_array_equal(
+                    got, exp, err_msg=f"request {i} diverged after revival")
+            # revival reuses the compile set: the rebuilt engine's jitted
+            # callables ARE the survivor's
+            assert replicas[1].engine._decode is replicas[0].engine._decode
+        finally:
+            router.close()
+
+    def test_prefill_replica_graduates_via_handoffs(self, tiny_engine):
+        """In a disaggregated fleet every request rebinds to a decode
+        replica at handoff, so a probation PREFILL replica's service is
+        its completed handoffs — it must still be able to graduate."""
+        router, replicas = mk_fleet(
+            tiny_engine, n=2, roles=[ROLE_PREFILL, ROLE_DECODE],
+            fleet_cfg=FleetConfig(**HEAL_CFG))
+        try:
+            replicas[0].probation_left = 2        # prefill on probation
+            for i in range(3):
+                h = router.submit(np.arange(1, 40, dtype=np.int32),
+                                  max_new_tokens=4, seed=i)
+                h.result()
+                assert h.handoffs == 1            # served via handoff
+            assert replicas[0].probation_left == 0
+            assert router._graduation_count == 1
+        finally:
+            router.close()
+
+    def test_revival_keeps_dead_incarnations_latency_samples(
+            self, tiny_engine, tmp_path):
+        """Close-time fleet-wide latency gauges must pool the REPLACED
+        engine's reservoirs too — a revival must not erase the requests
+        its dead incarnation served."""
+        from deepspeed_tpu.config.config import ObservabilityConfig
+        from deepspeed_tpu.observability import (configure_observability,
+                                                 get_registry,
+                                                 reset_session)
+
+        reset_session()
+        configure_observability(ObservabilityConfig(
+            enabled=True, output_dir=str(tmp_path / "obs"),
+            flight_recorder=False))
+        try:
+            cfg = dict(HEAL_CFG)
+            cfg.update(revive_after_iterations=1, probation_requests=1)
+            router, replicas = mk_fleet(tiny_engine, n=2,
+                                        fleet_cfg=FleetConfig(**cfg))
+            hs = [router.submit(p, max_new_tokens=6, seed=i)
+                  for i, p in enumerate(mk_prompts(4, seed=71))]
+            [h.result() for h in hs]
+            served_before = list(replicas[1].engine._ttft_samples)
+            assert served_before          # round_robin spread the load
+            router.kill_replica(1)
+            router.step()                 # drain + revive (backoff 1)
+            while not replicas[1].alive:
+                router.step()
+            assert replicas[1].revivals == 1
+            router.close()
+            # the dead incarnation's samples survived into the pool
+            assert get_registry().gauge("serving/ttft_p50_ms").value() \
+                is not None
+            pooled_n = len(router._replaced_engines[0]._ttft_samples)
+            assert pooled_n == len(served_before)
+        finally:
+            reset_session()
+
+    def test_manual_revive_refused_for_retired(self, tiny_engine):
+        from deepspeed_tpu.serving.fleet.replica import ReplicaRetired
+
+        router, replicas = mk_fleet(
+            tiny_engine, n=2,
+            fleet_cfg=FleetConfig(**{**HEAL_CFG, "auto_revive": False}))
+        try:
+            replicas[1].retire()
+            with pytest.raises(ReplicaRetired):
+                router.revive_replica(1)
+        finally:
+            router.close()
+
+    def test_auto_revive_off_keeps_dead_replica_dead(self, tiny_engine):
+        router, replicas = mk_fleet(
+            tiny_engine, n=2,
+            fleet_cfg=FleetConfig(**{**HEAL_CFG, "auto_revive": False}))
+        try:
+            router.kill_replica(1)
+            h = router.submit(np.arange(1, 20, dtype=np.int32),
+                              max_new_tokens=4)
+            h.result()
+            for _ in range(20):
+                router.step()
+            assert not replicas[1].alive and replicas[1].revivals == 0
+        finally:
+            router.close()
+
+
+# ---------------------------------------------------------------------------
+# ISSUE-12: overload control — deadline-infeasibility admission shedding +
+# the degraded-mode ladder
+# ---------------------------------------------------------------------------
+
+
+class TestOverloadControl:
+    def test_infeasible_deadline_shed_at_admission(self, tiny_engine):
+        from deepspeed_tpu.serving.fleet import Overloaded
+
+        router, replicas = mk_fleet(tiny_engine, n=2)
+        try:
+            # one finished request seeds the TPOT estimator
+            router.submit(np.arange(1, 20, dtype=np.int32),
+                          max_new_tokens=8).result()
+            assert router._tpot_estimate() is not None
+            with pytest.raises(Overloaded) as exc:
+                router.submit(np.arange(1, 20, dtype=np.int32),
+                              max_new_tokens=64, deadline_s=1e-9)
+            assert exc.value.retry_after_s > 0
+            assert router._shed_count == 1
+            # the shed request never reached an engine
+            assert all(r.engine.in_flight() == 0 for r in replicas)
+            # a feasible deadline still admits
+            h = router.submit(np.arange(1, 20, dtype=np.int32),
+                              max_new_tokens=4, deadline_s=3600.0)
+            h.result()
+        finally:
+            router.close()
+
+    def test_parallel_sampling_scales_feasibility_estimate(self,
+                                                           tiny_engine):
+        """submit(n=8) decodes 8× the budget — a deadline feasible for one
+        sample but not eight must shed."""
+        from deepspeed_tpu.serving.fleet import Overloaded
+
+        router, _ = mk_fleet(tiny_engine, n=2)
+        try:
+            router.submit(np.arange(1, 20, dtype=np.int32),
+                          max_new_tokens=8).result()
+            tpot = router._tpot_estimate()
+            assert tpot is not None
+            # feasible for one sample (queue empty): est = tpot × 8
+            deadline = tpot * 8 * 4
+            h = router.submit(np.arange(1, 20, dtype=np.int32),
+                              max_new_tokens=8, deadline_s=deadline)
+            h.result()
+            with pytest.raises(Overloaded):
+                router.submit(np.arange(1, 20, dtype=np.int32),
+                              max_new_tokens=8, deadline_s=deadline, n=8)
+        finally:
+            router.close()
+
+    def test_shed_submission_does_not_pollute_affinity(self, tiny_engine):
+        """An admission-shed request must not leave an affinity hint —
+        later prefix-sharers would follow it to a cold replica."""
+        from deepspeed_tpu.serving.fleet import Overloaded
+
+        router, _ = mk_fleet(tiny_engine, n=2, policy="affinity")
+        try:
+            router.submit(np.arange(50, 90, dtype=np.int32),
+                          max_new_tokens=8).result()   # seeds TPOT
+            sys_prompt = np.arange(1, 40, dtype=np.int32)
+            with pytest.raises(Overloaded):
+                router.submit(sys_prompt, max_new_tokens=64,
+                              deadline_s=1e-9)
+            key = router._affinity_key(sys_prompt)
+            assert key not in router._affinity    # no hint committed
+            # the next (admitted) submission is a genuine cold start
+            router.submit(sys_prompt, max_new_tokens=2).result()
+            assert router._decisions[("affinity", "affinity_cold")] >= 1
+            assert router._decisions[("affinity", "affinity_warm")] == 0
+        finally:
+            router.close()
+
+    def test_revive_before_drain_resubmits_stranded_requests(self,
+                                                            tiny_engine):
+        """A manual revive racing the step loop (kill not yet drained)
+        must drain the dead incarnation's requests first — they would
+        otherwise stay bound to the discarded engine forever."""
+        fc = FleetConfig(policy="round_robin", auto_revive=False)
+        router, replicas = mk_fleet(tiny_engine, n=2, fleet_cfg=fc,
+                                    policy="round_robin")
+        try:
+            prompts = mk_prompts(2, lo=18, hi=20, seed=61)
+            want = oracle_outputs(tiny_engine, prompts, n_new=6)
+            hs = [router.submit(p, max_new_tokens=6, seed=i)
+                  for i, p in enumerate(prompts)]
+            router.step()
+            router.kill_replica(1)
+            # revive BEFORE any step could drain the dead incarnation
+            assert not replicas[1].drained
+            assert router.revive_replica(1) is True
+            outs = [h.result() for h in hs]
+            assert all(h.state == "finished" for h in hs)
+            assert hs[1].resubmits == 1
+            for got, exp in zip(outs, want):
+                np.testing.assert_array_equal(got, exp)
+        finally:
+            router.close()
+
+    def test_no_tpot_data_admits(self, tiny_engine):
+        """The estimator sheds only on MEASURED evidence — a cold fleet
+        admits every deadline."""
+        router, _ = mk_fleet(tiny_engine, n=1)
+        try:
+            h = router.submit(np.arange(1, 20, dtype=np.int32),
+                              max_new_tokens=4, deadline_s=1e-9)
+            assert h is not None     # admitted (it will expire, not shed)
+        finally:
+            router.close()
+
+    def test_degraded_ladder_climbs_sheds_and_recovers(self, tiny_engine):
+        from deepspeed_tpu.serving.fleet import Overloaded
+
+        fc = FleetConfig(policy="round_robin", overload_queue_depth=1,
+                         overload_up_iterations=1,
+                         overload_down_iterations=2)
+        # 2 rows per replica: a 10-request burst queues deep
+        router, replicas = mk_fleet(tiny_engine, n=2, fleet_cfg=fc,
+                                    max_seqs=2)
+        try:
+            hs = [router.submit(np.arange(1, 30, dtype=np.int32),
+                                max_new_tokens=8, seed=i,
+                                deadline_s=(None if i % 2 else 3600.0))
+                  for i in range(10)]
+            seen_rungs = set()
+            it = 0
+            while router.in_flight():
+                router.step()
+                seen_rungs.add(router.degraded_mode)
+                if router.degraded_mode >= 1:
+                    # rung 1+: speculation suspended fleet-wide
+                    assert all(r.engine.spec_suspended
+                               for r in replicas if r.alive)
+                it += 1
+                assert it < 500
+            assert 3 in seen_rungs                # the ladder reached shed
+            shed = [h for h in hs if h.state == "shed"]
+            assert shed                           # rung 3 shed queued work
+            assert router.shed_count_total == len(shed)
+            # no-deadline work was shed first (lowest priority)
+            assert all(h._fr.deadline_abs is None for h in shed) \
+                or len(shed) > sum(1 for h in hs
+                                   if h._fr.deadline_abs is None)
+            for h in shed:
+                with pytest.raises(Overloaded) as exc:
+                    h.result()
+                assert exc.value.retry_after_s > 0
+            # calm iterations walk the ladder back down, spec resumes
+            for _ in range(3 * fc.overload_down_iterations + 3):
+                router.step()
+            assert router.degraded_mode == 0
+            assert all(not r.engine.spec_suspended
+                       for r in replicas if r.alive)
+            # ledger: submitted == finished + cancelled + shed + deadline
+            assert router.submitted_count == (
+                router.finished_count + router.cancelled_count
+                + router.shed_count_total
+                + router.deadline_exceeded_count)
+        finally:
+            router.close()
+
+    def test_rung2_spills_affinity(self, tiny_engine):
+        """Degraded rung 2 stops following warm prefix-affinity hints —
+        the request routes by load with reason degraded_spill."""
+        router, replicas = mk_fleet(tiny_engine, n=2, policy="affinity")
+        try:
+            sys_prompt = np.arange(1, 40, dtype=np.int32)
+            router.submit(sys_prompt, max_new_tokens=2).result()
+            router._degraded = 2
+            router.submit(sys_prompt, max_new_tokens=2).result()
+            assert router._decisions[("affinity", "degraded_spill")] == 1
+            assert router._decisions[("affinity", "affinity_warm")] == 0
+        finally:
+            router.close()
+
+
+# ---------------------------------------------------------------------------
+# ISSUE-12: handoff fault tolerance — a transfer that dies mid-flight
+# retries once on another decode replica, then falls back to decoding in
+# place; both sides' blocks freed exactly once
+# ---------------------------------------------------------------------------
+
+
+class TestHandoffFaultTolerance:
+    def test_transfer_failure_frees_destination_blocks(self, tiny_engine):
+        from deepspeed_tpu.serving.fleet import HandoffTransferError
+
+        src = ServingEngine(tiny_engine, ServingConfig(**SCFG))
+        dst = ServingEngine(tiny_engine, ServingConfig(**SCFG))
+        try:
+            handoff = ArenaHandoff()
+            handoff.inject_fail_next = 1
+            before = dst.alloc.blocks_in_use
+            with pytest.raises(HandoffTransferError):
+                handoff.transfer(src, dst, [1, 2, 3])
+            assert dst.alloc.blocks_in_use == before   # freed exactly once
+            # the seam is one-shot: the next transfer succeeds
+            assert handoff.transfer(src, dst, [1, 2, 3]) is not None
+        finally:
+            src.close()
+            dst.close()
+
+    def test_failed_handoff_retries_on_other_decode_replica(self,
+                                                           tiny_engine):
+        prompts = mk_prompts(3, seed=41)
+        want = oracle_outputs(tiny_engine, prompts, n_new=8)
+        router, replicas = mk_fleet(
+            tiny_engine, n=3,
+            roles=[ROLE_PREFILL, ROLE_DECODE, ROLE_DECODE],
+            fault_plan=[{"kind": "handoff_fail", "step": 0}])
+        try:
+            hs = [router.submit(p, max_new_tokens=8, seed=i)
+                  for i, p in enumerate(prompts)]
+            outs = [h.result() for h in hs]
+            assert router._handoff_failures == 1     # the fault fired
+            # the retry landed every request on SOME decode replica
+            assert sum(h.handoffs for h in hs) == len(prompts)
+            assert router._handoff_fallbacks == 0
+            for got, exp in zip(outs, want):
+                np.testing.assert_array_equal(got, exp)
+            for r in replicas:
+                held = (r.engine.sched.prefix.cached_blocks
+                        if r.engine.sched.prefix else 0)
+                assert r.engine.alloc.blocks_in_use == held
+        finally:
+            router.close()
+
+    def test_failed_handoff_falls_back_in_place(self, tiny_engine):
+        """Single decode replica: the failed transfer has nowhere to
+        retry — the request decodes on its prefill replica, bit-exact,
+        with zero leaked blocks on either side."""
+        prompt = np.arange(1, 40, dtype=np.int32)
+        want = oracle_outputs(tiny_engine, [prompt], n_new=8)
+        router, replicas = mk_fleet(
+            tiny_engine, n=2, roles=[ROLE_PREFILL, ROLE_DECODE],
+            prefix_cache=False,
+            fault_plan=[{"kind": "handoff_fail", "step": 0}])
+        try:
+            h = router.submit(prompt, max_new_tokens=8, seed=0)
+            np.testing.assert_array_equal(h.result(), want[0])
+            assert h.handoffs == 0
+            assert router._handoff_failures == 1
+            assert router._handoff_fallbacks == 1
+            router.step()
+            assert replicas[0].engine.alloc.blocks_in_use == 0
+            assert replicas[1].engine.alloc.blocks_in_use == 0
+        finally:
+            router.close()
+
+    def test_import_exception_falls_back_no_leak(self, tiny_engine):
+        """Not just the injected fault: ANY exception out of the transfer
+        (kv_import raising) takes the same retry/fallback path."""
+        prompt = np.arange(1, 40, dtype=np.int32)
+        want = oracle_outputs(tiny_engine, [prompt], n_new=6)
+        router, replicas = mk_fleet(tiny_engine, n=2,
+                                    roles=[ROLE_PREFILL, ROLE_DECODE],
+                                    prefix_cache=False)
+        try:
+            orig = router.handoff.transfer
+
+            def exploding_transfer(src, dst, blocks):
+                raise RuntimeError("synthetic kv_import device loss")
+
+            router.handoff.transfer = exploding_transfer
+            h = router.submit(prompt, max_new_tokens=6, seed=0)
+            np.testing.assert_array_equal(h.result(), want[0])
+            assert router._handoff_failures >= 1
+            assert h.handoffs == 0
+            router.handoff.transfer = orig
+            router.step()
+            assert replicas[1].engine.alloc.blocks_in_use == 0
+        finally:
+            router.close()
+
+
+# ---------------------------------------------------------------------------
+# ISSUE-12 satellite: a resubmission that finds every survivor full PARKS
+# and retries on later iterations — it must not burn max_resubmits in one
+# iteration
+# ---------------------------------------------------------------------------
+
+
+class TestParkedResubmission:
+    def test_queuefull_parks_instead_of_cancelling(self, tiny_engine):
+        prompts = mk_prompts(4, lo=18, hi=20, seed=51)
+        want = oracle_outputs(tiny_engine, prompts, n_new=6, max_queue=2)
+        fc = FleetConfig(policy="round_robin", max_resubmits=1,
+                         auto_revive=False)
+        router, replicas = mk_fleet(tiny_engine, n=2, fleet_cfg=fc,
+                                    policy="round_robin", max_queue=2)
+        try:
+            hs = [router.submit(p, max_new_tokens=6, seed=i)
+                  for i, p in enumerate(prompts)]
+            # round_robin: replica 0 holds #0/#2, replica 1 holds #1/#3 —
+            # the survivor is FULL (max_queue=2) when replica 1 dies
+            assert [h._fr.replica.index for h in hs] == [0, 1, 0, 1]
+            router.kill_replica(1)
+            router.step()
+            # both victims parked (not cancelled), one death each on the
+            # budget ledger
+            assert len(router._parked) == 2
+            assert all(h._fr.resubmits == 1 for h in hs[1::2])
+            outs = [h.result() for h in hs]
+            # the parked pair resubmitted once survivor capacity freed,
+            # without spending further budget
+            assert all(h.state == "finished" for h in hs)
+            assert all(h._fr.resubmits == 1 for h in hs[1::2])
+            assert router.cancelled_count == 0
+            for got, exp in zip(outs, want):
+                np.testing.assert_array_equal(got, exp)
+        finally:
+            router.close()
+
+    def test_parked_request_expires_if_deadline_passes(self, tiny_engine):
+        clk = FakeClock()
+        from deepspeed_tpu.serving import DeadlineExceeded
+
+        fc = FleetConfig(policy="round_robin", auto_revive=False)
+        router, replicas = mk_fleet(tiny_engine, n=2, fleet_cfg=fc,
+                                    policy="round_robin", max_queue=1,
+                                    clock=clk)
+        try:
+            h0 = router.submit(np.arange(1, 20, dtype=np.int32),
+                               max_new_tokens=32)
+            h1 = router.submit(np.arange(1, 20, dtype=np.int32),
+                               max_new_tokens=8, deadline_s=5.0)
+            assert h1._fr.replica.index == 1
+            router.kill_replica(1)
+            router.step()
+            assert len(router._parked) == 1       # survivor full
+            clk.advance(10.0)                     # deadline passes, parked
+            router.step()
+            assert h1.state == "deadline_exceeded"
+            with pytest.raises(DeadlineExceeded):
+                h1.result()
+            h0.result()
+            assert router.deadline_exceeded_count == 1
+        finally:
+            router.close()
+
+
+# ---------------------------------------------------------------------------
+# ISSUE-12: new fault kinds (device-free injector unit tests)
+# ---------------------------------------------------------------------------
+
+
+class TestNewFleetFaults:
+    def test_replica_slow_penalty_window(self):
+        from deepspeed_tpu.observability.faultinject import FaultInjector
+
+        inj = FaultInjector(plan=[{"kind": "replica_slow", "step": 3,
+                                   "steps": 2, "replica": 1,
+                                   "sleep_s": 5.0}], rank=0, restart=0)
+        assert inj.slow_penalty(2, 1) == 0.0
+        assert inj.slow_penalty(3, 1) == 5.0
+        assert inj.slow_penalty(4, 1) == 5.0
+        assert inj.slow_penalty(5, 1) == 0.0      # window over
+        assert inj.slow_penalty(3, 0) == 0.0      # other replica untouched
+        assert len(inj.applied) == 1              # noted once
+
+    def test_replica_flap_fires_across_window(self):
+        from deepspeed_tpu.observability.faultinject import FaultInjector
+
+        inj = FaultInjector(plan=[{"kind": "replica_flap", "step": 2,
+                                   "steps": 3, "replica": 0}],
+                            rank=0, restart=0)
+        killed = []
+        for it in range(8):
+            inj.before_router_step(it, killed.append)
+        assert killed == [0, 0, 0]                # every window iteration
+        assert len(inj.applied) == 1              # noted once
+
+    def test_handoff_fail_consumed_once(self):
+        from deepspeed_tpu.observability.faultinject import FaultInjector
+
+        inj = FaultInjector(plan=[{"kind": "handoff_fail", "step": 4}],
+                            rank=0, restart=0)
+        assert not inj.take_handoff_fail(3)       # not due yet
+        assert inj.take_handoff_fail(6)           # due (at/after step)
+        assert not inj.take_handoff_fail(7)       # consumed

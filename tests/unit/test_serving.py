@@ -850,3 +850,117 @@ class TestPagedKernelAB:
                                           err_msg=f"gather {i} diverged")
             np.testing.assert_array_equal(outs["auto"][i], want,
                                           err_msg=f"paged {i} diverged")
+
+
+# ---------------------------------------------------------------------------
+# deadline enforcement at decode time (ISSUE-12 satellite): an expired
+# request must stop consuming rows/blocks, finish as deadline_exceeded, and
+# keep the request ledger balanced
+# ---------------------------------------------------------------------------
+
+
+class TestDeadlineEnforcement:
+    def test_running_request_expires_and_frees_blocks(self, tiny_engine):
+        from deepspeed_tpu.serving import DeadlineExceeded
+
+        clk = FakeClock()
+        srv = serving(tiny_engine, clock=clk, prefix_cache=False)
+        try:
+            h = srv.submit(np.arange(1, 40, dtype=np.int32),
+                           max_new_tokens=64, deadline_s=5.0)
+            for _ in range(4):
+                srv.step()
+            assert len(h.tokens) > 0 and not h.done   # mid-stream
+            assert srv.alloc.blocks_in_use > 0
+            clk.advance(10.0)                         # past the deadline
+            progress = srv.step()
+            assert progress                            # expiry IS progress
+            assert h.state == "deadline_exceeded" and h.done
+            # the bugfix: rows and blocks free NOW, not at token budget
+            assert srv.alloc.blocks_in_use == 0
+            assert srv.sched.queue_depth() == 0
+            assert len(srv.sched.running) == 0
+            assert srv.sched.deadline_exceeded_count == 1
+            with pytest.raises(DeadlineExceeded):
+                h.result()
+        finally:
+            srv.close()
+
+    def test_queued_request_expires_before_admission(self, tiny_engine):
+        clk = FakeClock()
+        srv = serving(tiny_engine, clock=clk, max_seqs=1,
+                      prefix_cache=False)
+        try:
+            # one request holds the only row; the second queues (admit h0
+            # FIRST — EDF would otherwise prefer the deadline-bearing h1)
+            h0 = srv.submit(np.arange(1, 20, dtype=np.int32),
+                            max_new_tokens=32)
+            srv.step()
+            h1 = srv.submit(np.arange(1, 20, dtype=np.int32),
+                            max_new_tokens=4, deadline_s=2.0)
+            srv.step()
+            assert h1.state == "queued"
+            clk.advance(5.0)
+            srv.step()
+            assert h1.state == "deadline_exceeded"
+            assert len(h1.tokens) == 0      # never decoded a token
+            h0.result()                     # the survivor is unaffected
+        finally:
+            srv.close()
+
+    def test_pending_fork_siblings_expire_with_parent(self, tiny_engine):
+        clk = FakeClock()
+        srv = serving(tiny_engine, clock=clk, prefix_cache=False,
+                      prefill_chunk=16)
+        try:
+            # long prompt: parent still prefilling when the deadline hits,
+            # so the n=3 siblings are still waiting for their fork point
+            hs = srv.submit(np.arange(1, 100, dtype=np.int32),
+                            max_new_tokens=8, deadline_s=3.0, n=3)
+            srv.step()
+            assert srv._pending_fork_count() == 2
+            clk.advance(5.0)
+            srv.step()
+            assert all(h.state == "deadline_exceeded" for h in hs)
+            assert srv._pending_fork_count() == 0
+            assert srv.sched.deadline_exceeded_count == 3
+            assert srv.alloc.blocks_in_use == 0
+        finally:
+            srv.close()
+
+    def test_ledger_balances_across_terminal_states(self, tiny_engine):
+        clk = FakeClock()
+        srv = serving(tiny_engine, clock=clk, prefix_cache=False)
+        try:
+            done = srv.submit(np.arange(1, 20, dtype=np.int32),
+                              max_new_tokens=2)
+            gone = srv.submit(np.arange(1, 20, dtype=np.int32),
+                              max_new_tokens=8)
+            late = srv.submit(np.arange(1, 20, dtype=np.int32),
+                              max_new_tokens=8, deadline_s=1.0)
+            srv.step()
+            gone.cancel()
+            clk.advance(2.0)
+            srv.run()
+            done.result()
+            s = srv.sched
+            assert (s.finished_count, s.cancelled_count,
+                    s.deadline_exceeded_count) == (1, 1, 1)
+            # submitted == completed + cancelled + deadline_exceeded
+            assert (s.finished_count + s.cancelled_count
+                    + s.deadline_exceeded_count) == 3
+            assert srv.in_flight() == 0
+        finally:
+            srv.close()
+
+    def test_no_deadline_never_expires(self, tiny_engine):
+        clk = FakeClock()
+        srv = serving(tiny_engine, clock=clk, prefix_cache=False)
+        try:
+            h = srv.submit(np.arange(1, 20, dtype=np.int32),
+                           max_new_tokens=4)
+            clk.advance(1e6)
+            out = h.result()
+            assert out.size == 4 and h.state == "finished"
+        finally:
+            srv.close()
