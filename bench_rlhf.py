@@ -1,17 +1,40 @@
 #!/usr/bin/env python
-"""RLHF workload benchmark — the DS-Chat step-3 shape on the hybrid engine.
+"""RLHF workload benchmark — the DS-Chat step-3 shape over the hybrid
+engine v2, with the rollout phase running through the serving stack.
 
-Reference workload (blogs/deepspeed-chat/README.md:57 benchmark setting):
-each RLHF iteration GENERATES a rollout (prompt 256 → 256 new tokens with
-the inference engine's KV arena + decode kernel, LoRA adapters applied)
-and then TRAINS on the concatenated (prompt+response) sequence — the
-hybrid engine flips ONE weight set between the two layouts. The reference's
-headline claim is end-to-end RLHF throughput (its e2e figure mixes both
-phases); this bench reports each phase plus the flip overhead so
-regressions in either layout or in the reshard path are visible.
+Each RLHF iteration is generate → score → train → flip
+(``deepspeed_tpu/rlhf``): candidate groups of ``--group-n`` samples per
+prompt ride ONE prefill + COW forks, prompts share a system prefix
+through the prefix cache, the policy's own n-gram drafter speculates over
+its rollouts (``--spec ngram``), scoring is two more serving passes over
+the same arena, and the weight flip reuses the arena with zero
+reallocation and zero recompiles.
 
-Prints ONE JSON line: e2e tokens/s (generated+trained tokens per wall
-second, the DS-Chat e2e metric shape) plus per-phase rates and flip cost.
+Prints ONE JSON line: e2e tokens/s (generated + trained tokens per wall
+second, the DS-Chat e2e metric shape) plus the per-phase breakdown, the
+flip cost, and a rollout A/B over the SAME prompt set:
+
+  * ``stub``              — the seed-era hybrid path: plain batched
+                            ``generate()``, every sample prefills its full
+                            prompt, no sharing, no speculation;
+  * ``serving_spec_off``  — serving-stack rollouts, speculation suspended
+                            (fork + prefix sharing only);
+  * ``serving_spec_ngram``— the full path (``--spec off|ngram`` pins one
+                            arm instead);
+plus a ``--group-n`` A/B (group 1 vs the configured group) showing what
+fork reuse buys. The rlhf/* + serving/* metrics are dumped to
+``BENCH_metrics_rlhf.jsonl`` (``BENCH_OBS=0`` opts out).
+
+Knobs (env): BENCH_RLHF_MODEL, BENCH_RLHF_PROMPTS (prompts/iteration),
+BENCH_RLHF_PROMPT (prompt len), BENCH_RLHF_SYS (shared system-prefix
+len), BENCH_RLHF_GEN (response len), BENCH_RLHF_GROUP, BENCH_RLHF_ITERS,
+BENCH_RLHF_ROWS (decode rows), BENCH_RLHF_SPEC, BENCH_RLHF_LR.
+
+Like bench.py / bench_infer.py, the measurement runs in a watchdogged
+child (``bench_common.py``): a hang gets SIGUSR1 (flight-record dump)
+then SIGKILL, and the skip record carries ``failure_kind`` + the bundle
+path + the static ``predicted_mfu`` half of the measured-vs-predicted
+pairing. The parent imports neither jax nor deepspeed_tpu.
 """
 
 import json
@@ -21,90 +44,255 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-import jax
-import jax.numpy as jnp
-import numpy as np
+from bench_common import run_watchdogged  # noqa: E402
 
 
-def main() -> None:
-    from deepspeed_tpu.config.config import load_config
+def _env_int(name: str, default: int) -> int:
+    return int(os.environ.get(name, default))
+
+
+def predict_main() -> None:
+    """BENCH_PREDICT=1 child mode: the analytic train-phase MFU ceiling
+    for this bench's config, host-side (the rollout phase is latency- and
+    reuse-bound, not flops-bound — the static pairing covers the train
+    half, like bench.py)."""
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.autotuning.cost_model import (TpuCostModel,
+                                                     peak_flops_for)
     from deepspeed_tpu.models import create_model
-    from deepspeed_tpu.runtime.hybrid_engine import HybridEngine
+    from deepspeed_tpu.profiling import transformer_breakdown
+
+    batch = _env_int("BENCH_RLHF_PROMPTS", 8) * _env_int("BENCH_RLHF_GROUP",
+                                                         4)
+    seq = _env_int("BENCH_RLHF_PROMPT", 128) + _env_int("BENCH_RLHF_GEN",
+                                                        128)
+    preset = os.environ.get("BENCH_RLHF_MODEL", "gpt2-125m")
+    model = create_model(preset, dtype=jnp.bfloat16, max_seq_len=seq)
+    cfg = model.config
+    n = transformer_breakdown(cfg, batch, seq).total_params
+    flops_per_token = 6 * n + 12 * cfg.num_layers * cfg.hidden_size * seq
+    cm = TpuCostModel(model_info={
+        "num_params": n, "hidden_size": cfg.hidden_size,
+        "num_layers": cfg.num_layers, "seq_length": seq,
+        "vocab_size": cfg.vocab_size}, mfu=1.0)
+    tps = cm.predict_throughput({"train_micro_batch_size_per_gpu": batch})
+    print(json.dumps({
+        "predicted_mfu": round(tps * flops_per_token / peak_flops_for(None),
+                               4),
+        "predicted_tokens_per_sec": round(tps, 1),
+        "source": "analytic-roofline",
+    }))
+
+
+def _rollout_arm(collector, prompts, base_iter) -> dict:
+    """Time one rollout pass over ``prompts`` (fresh iteration index so
+    seeds never collide with the e2e loop's) and return tokens/s + the
+    collection stats."""
+    # collect() host-materializes every sampled token (np.asarray per
+    # iteration + handle.result()), so the window is fenced
+    t0 = time.perf_counter()
+    batch, _ = collector.collect(prompts, base_iter)
+    wall = time.perf_counter() - t0  # tpulint: disable=wallclock-timing-without-sync
+    gen = batch.stats["generated_tokens"]
+    return {
+        "tokens_per_sec": round(gen / max(wall, 1e-9), 1),
+        "generated_tokens": gen,
+        "wall_s": round(wall, 3),
+        "fork_reuse_ratio": round(batch.stats["fork_reuse_ratio"], 4),
+        "spec_acceptance_rate": (
+            round(batch.stats["spec_acceptance_rate"], 4)
+            if batch.stats["spec_acceptance_rate"] is not None else None),
+    }
+
+
+def rlhf_main() -> None:
+    import jax
+    import numpy as np
+
+    import deepspeed_tpu
+    from deepspeed_tpu.rlhf import RLHFTrainer, RolloutCollector
 
     preset = os.environ.get("BENCH_RLHF_MODEL", "gpt2-125m")
-    batch = int(os.environ.get("BENCH_RLHF_BATCH", 8))
-    prompt_len = int(os.environ.get("BENCH_RLHF_PROMPT", 256))
-    gen_len = int(os.environ.get("BENCH_RLHF_GEN", 256))
-    iters = int(os.environ.get("BENCH_RLHF_ITERS", 4))
-    lora_rank = int(os.environ.get("BENCH_RLHF_LORA_RANK", 8))
-
+    n_prompts = _env_int("BENCH_RLHF_PROMPTS", 8)
+    prompt_len = _env_int("BENCH_RLHF_PROMPT", 128)
+    sys_len = _env_int("BENCH_RLHF_SYS", prompt_len // 2)
+    gen_len = _env_int("BENCH_RLHF_GEN", 128)
+    group = _env_int("BENCH_RLHF_GROUP", 4)
+    iters = _env_int("BENCH_RLHF_ITERS", 2)
+    rows = _env_int("BENCH_RLHF_ROWS", max(8, n_prompts * group))
+    spec_arg = os.environ.get("BENCH_RLHF_SPEC", "both")
+    block = 16
     seq = prompt_len + gen_len
-    model = create_model(preset, dtype=jnp.bfloat16, remat=True,
-                         remat_policy="dots", max_seq_len=seq)
-    cfg = load_config({
-        "train_micro_batch_size_per_gpu": batch,
-        "steps_per_print": 1000,
-        "optimizer": {"type": "adamw", "params": {"lr": 1e-5}},
-        "bf16": {"enabled": True},
-        "zero_optimization": {"stage": 0},
-    })
-    engine = HybridEngine(model=model, config=cfg, max_out_tokens=seq)
+    seq += (-seq) % block
 
-    # LoRA adapters on the attention out-projections (the DS-Chat actor
-    # trains LoRA deltas; generation serves W + scaling*right@left)
-    mcfg = model.config
-    L, H = mcfg.num_layers, mcfg.hidden_size
-    k1, k2 = jax.random.split(jax.random.PRNGKey(0))
-    engine.set_lora({"attn/wo": (
-        (jax.random.normal(k1, (L, H, lora_rank), jnp.float32)
-         * 0.01).astype(jnp.bfloat16),
-        jnp.zeros((L, lora_rank, H), jnp.bfloat16))}, scaling=1.0)
+    obs_wanted = os.environ.get("BENCH_OBS", "1") != "0"
+    if obs_wanted:
+        from deepspeed_tpu.config.config import ObservabilityConfig
+        from deepspeed_tpu.observability import configure_observability
+
+        configure_observability(ObservabilityConfig(
+            enabled=True,
+            output_dir=os.environ.get("BENCH_OBS_DIR",
+                                      "bench_results/obs_rlhf")))
+
+    engine = deepspeed_tpu.init_rlhf(
+        preset,
+        config={
+            "train_micro_batch_size_per_gpu": n_prompts * group,
+            "steps_per_print": 10 ** 9,
+            "optimizer": {"type": "adamw", "params": {
+                "lr": float(os.environ.get("BENCH_RLHF_LR", 1e-5))}},
+            "bf16": {"enabled": True},
+            "rlhf": {"algo": "grpo" if group > 1 else "ppo",
+                     "group_n": group, "temperature": 0.7,
+                     "max_new_tokens": gen_len},
+        },
+        serving_config={
+            "block_size": block, "max_seqs": rows, "max_model_len": seq,
+            "prefill_chunk": 64 if prompt_len >= 64 else block,
+            "max_queue": 4 * n_prompts * group,
+            "speculative": {"mode": "ngram"},
+        })
 
     rng = np.random.RandomState(0)
-    prompts = rng.randint(0, mcfg.vocab_size, (batch, prompt_len))
+    vocab = engine.model.config.vocab_size
+    system = rng.randint(0, vocab, (sys_len,))
+    tails = rng.randint(0, vocab, (n_prompts, prompt_len - sys_len))
+    prompts = [np.concatenate([system, t]).astype(np.int32) for t in tails]
 
-    def one_iter(i):
-        t0 = time.perf_counter()
-        rollout = np.asarray(engine.generate(
-            jnp.asarray(prompts), max_new_tokens=gen_len))
-        jax.block_until_ready(rollout)
-        t1 = time.perf_counter()
-        full = np.concatenate([prompts, rollout[:, :gen_len]], axis=1)
-        loss = engine.train_batch(batch={
-            "input_ids": jnp.asarray(full[None])})
-        float(loss)
-        t2 = time.perf_counter()
-        return t1 - t0, t2 - t1
+    def prompt_fn(_it):
+        return prompts
 
-    one_iter(0)                      # compile both phases + first flip
-    # measure the steady-state flip (train step happened => params stale)
-    engine.train_batch(batch={"input_ids": jnp.asarray(
-        np.concatenate([prompts, prompts[:, :gen_len]], axis=1)[None])})
+    def reward_fn(_prompt, tokens):
+        return float(len(set(tokens)))
+
+    trainer = RLHFTrainer(engine, prompt_fn, reward_fn)
+    serving = engine.serving_engine()
+
+    trainer.step()                      # warmup: compiles + first flip
+    for k in trainer._phase_s:
+        trainer._phase_s[k] = 0.0
+    # the warmup's train bracket must not leak into the timed loop's
+    # first data_fn (it would book the train-step compile as train time)
+    trainer._last_prepare_end = None
+    gen0, trained0 = serving._tokens_out, trainer._tokens_trained
+    # trainer.step() ends in float(loss) — every iteration is fenced
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        trainer.step()
+    wall = time.perf_counter() - t0  # tpulint: disable=wallclock-timing-without-sync
+    # close the trailing train bracket (train() normally does this) with
+    # the TRAINER's clock — _last_prepare_end is a trainer.clock()
+    # timestamp, and perf_counter shares its epoch only on Linux
+    trainer._phase_s["train"] += trainer.clock() \
+        - trainer._last_prepare_end
+    trainer._last_prepare_end = None
+    gen_tok = serving._tokens_out - gen0
+    train_tok = trainer._tokens_trained - trained0
+    phases = {k: round(v, 3) for k, v in trainer._phase_s.items()}
+
+    # steady-state flip cost, isolated (params stale after the last step)
     tf = time.perf_counter()
-    engine.refresh_inference_params()
+    engine.refresh_params()
     jax.block_until_ready(jax.tree.leaves(engine._infer.params)[0])
     flip_s = time.perf_counter() - tf
 
-    gen_s = train_s = 0.0
-    for i in range(iters):
-        g, t = one_iter(i + 1)
-        gen_s += g
-        train_s += t
+    # -- rollout A/B over the same prompt set ------------------------------
+    arm_iter = 10_000   # seed-space far from the e2e loop's iterations
+    ab = {}
+    def mk(g):
+        return RolloutCollector(serving, group_n=g, temperature=0.7,
+                                max_new_tokens=gen_len)
 
-    gen_tok = batch * gen_len * iters
-    train_tok = batch * seq * iters
-    e2e = (gen_tok + train_tok) / (gen_s + train_s)
+    # warm the plain R×1 decode program (the e2e loop only dispatched the
+    # verify path) so no A/B arm pays a first compile in its timed window
+    serving.spec_suspended = True
+    mk(1).collect([prompts[0]], arm_iter + 9)
+    serving.spec_suspended = False
+
+    if spec_arg in ("both", "ngram"):
+        serving.spec_suspended = False
+        ab["serving_spec_ngram"] = _rollout_arm(mk(group), prompts,
+                                                arm_iter)
+    if spec_arg in ("both", "off"):
+        serving.spec_suspended = True
+        ab["serving_spec_off"] = _rollout_arm(mk(group), prompts,
+                                              arm_iter + 1)
+        serving.spec_suspended = False
+    # group-n A/B: what fork reuse buys (group 1 = no sharing besides the
+    # prefix cache)
+    group_ab = {}
+    if group > 1:
+        serving.spec_suspended = True
+        group_ab["n1"] = _rollout_arm(mk(1), prompts, arm_iter + 2)
+        group_ab[f"n{group}"] = _rollout_arm(mk(group), prompts,
+                                             arm_iter + 3)
+        serving.spec_suspended = False
+    # stub arm: the seed-era path — batched plain generate, every sample
+    # prefilling its full prompt (no fork, no prefix cache, no spec)
+    tiled = np.repeat(np.stack(prompts), group, axis=0)
+    t0 = time.perf_counter()
+    out = np.asarray(engine.generate(tiled, max_new_tokens=gen_len,
+                                     temperature=0.7))
+    jax.block_until_ready(out)
+    stub_wall = time.perf_counter() - t0
+    stub_tok = int(out.shape[0]) * gen_len
+    ab["stub"] = {"tokens_per_sec": round(stub_tok / stub_wall, 1),
+                  "generated_tokens": stub_tok,
+                  "wall_s": round(stub_wall, 3)}
+
+    from deepspeed_tpu.observability import get_session
+
+    obs = get_session()
+    metric = f"{preset}_rlhf_e2e_tokens_per_sec_per_chip"
+    if obs.enabled:
+        obs.dump_metrics(path=os.environ.get("BENCH_METRICS_JSONL",
+                                             "BENCH_metrics_rlhf.jsonl"),
+                         metric=metric)
+        obs.close(export=False)
     print(json.dumps({
-        "metric": f"{preset}_rlhf_e2e_tokens_per_sec_per_chip",
-        "value": round(e2e, 1),
+        "metric": metric,
+        "value": round((gen_tok + train_tok) / wall, 1),
         "unit": "tokens/s",
-        "generate_tokens_per_sec": round(gen_tok / gen_s, 1),
-        "train_tokens_per_sec": round(train_tok / train_s, 1),
+        "vs_baseline": None,
+        "generated_tokens_per_sec": round(
+            gen_tok / max(phases["rollout"], 1e-9), 1),
+        "phase_seconds": phases,
         "flip_seconds": round(flip_s, 4),
-        "prompt_len": prompt_len, "gen_len": gen_len, "batch": batch,
-        "lora_rank": lora_rank, "iters": iters,
+        "rollout_ab": ab,
+        "group_ab": group_ab,
+        "prompt_len": prompt_len, "system_len": sys_len,
+        "gen_len": gen_len, "prompts": n_prompts, "group_n": group,
+        "iters": iters,
     }))
 
 
 if __name__ == "__main__":
-    main()
+    argv = sys.argv[1:]
+    for i, a in enumerate(argv):
+        if a == "--spec" and i + 1 < len(argv):
+            os.environ["BENCH_RLHF_SPEC"] = argv[i + 1]
+        elif a.startswith("--spec="):
+            os.environ["BENCH_RLHF_SPEC"] = a.split("=", 1)[1]
+        elif a == "--group-n" and i + 1 < len(argv):
+            os.environ["BENCH_RLHF_GROUP"] = argv[i + 1]
+        elif a.startswith("--group-n="):
+            os.environ["BENCH_RLHF_GROUP"] = a.split("=", 1)[1]
+    if os.environ.get("BENCH_RLHF_SPEC", "both") not in ("both", "off",
+                                                         "ngram"):
+        raise SystemExit("--spec must be 'off', 'ngram' or 'both'")
+    if os.environ.get("BENCH_PREDICT") == "1":
+        predict_main()
+    elif os.environ.get("BENCH_CHILD") == "1":
+        rlhf_main()
+    else:
+        preset = os.environ.get("BENCH_RLHF_MODEL", "gpt2-125m")
+        # same metric name as the child's success record, so skip and
+        # success records pair under one key
+        run_watchdogged(
+            f"{preset}_rlhf_e2e_tokens_per_sec_per_chip", "tokens/s",
+            os.path.abspath(__file__),
+            crash_dir=os.path.join(
+                os.environ.get("BENCH_OBS_DIR", "bench_results/obs_rlhf"),
+                "crash"))

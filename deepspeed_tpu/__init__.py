@@ -8,6 +8,9 @@ of CUDA. Public entry points mirror the reference (``deepspeed/__init__.py``):
   initialize()           -> (engine, optimizer, dataloader, lr_scheduler)
   init_inference()       -> InferenceEngine
   init_serving()         -> ServingEngine (continuous batching, the MII analog)
+  init_rlhf()            -> HybridEngine with the RLHF objective + serving
+                            rollout side (the DeepSpeed-Chat substrate —
+                            docs/rlhf.md)
   run_training_session() -> self-healing supervised training (rollback on
                             numerics trips, hang escalation, straggler
                             eviction via the elastic agent — docs/resilience.md)
@@ -86,6 +89,18 @@ def init_serving(model=None, serving_config=None, **kwargs):
     from .serving import init_serving as _init_serving
 
     return _init_serving(model=model, serving_config=serving_config, **kwargs)
+
+
+def init_rlhf(model=None, config=None, serving_config=None, **kwargs):
+    """RLHF post-training entry point (the DeepSpeed-Chat hybrid-engine
+    analog): a ``HybridEngine`` whose model carries the PPO-clip/GRPO
+    objective and whose rollouts run through the serving stack — one
+    weight set, one paged arena, bit-exactly replayable rollouts. Pair
+    with ``rlhf.RLHFTrainer``. See docs/rlhf.md."""
+    from .rlhf import init_rlhf as _init_rlhf
+
+    return _init_rlhf(model=model, config=config,
+                      serving_config=serving_config, **kwargs)
 
 
 def add_config_arguments(parser):

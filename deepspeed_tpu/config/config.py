@@ -690,6 +690,54 @@ class FleetConfig(ConfigModel):
 
 
 @dataclass
+class RLHFConfig(ConfigModel):
+    """RLHF post-training (``deepspeed_tpu/rlhf`` — the DeepSpeed-Chat
+    step-3 analog over the hybrid engine v2): per-iteration
+    generate → score → train → flip, with rollouts running through the
+    serving stack (continuous batching, prefix sharing, ``fork(n)``
+    candidate groups, optional speculative decoding) and every rollout
+    bit-exactly replayable from its manifest (docs/rlhf.md)."""
+
+    algo: str = "grpo"             # 'grpo' (group-normalized advantages,
+    #   no critic) | 'ppo' (PPO-clip with batch-whitened reward advantages)
+    group_n: int = 4               # candidate samples per prompt — ONE
+    #   prefill + n-1 COW forks through the refcounted block tables
+    temperature: float = 0.7       # rollout sampling
+    top_k: int = 0
+    top_p: float = 1.0
+    max_new_tokens: int = 32       # rollout response budget
+    eos_token_id: Optional[int] = None
+    clip_ratio: float = 0.2        # PPO clip epsilon on the policy ratio
+    kl_coef: float = 0.05          # k3-estimator KL penalty vs the frozen
+    #   reference (0 disables; the reference pass is skipped entirely)
+    whiten_advantages: bool = True  # 'ppo' only: normalize rewards across
+    #   the batch before broadcasting them as advantages
+    replay_verify: bool = False    # after every rollout phase, replay the
+    #   manifest with speculation toggled OPPOSITE and assert bit-exact
+    #   token streams (the determinism contract, continuously enforced —
+    #   one extra serving pass per iteration)
+
+    def validate(self) -> None:
+        if self.algo not in ("grpo", "ppo"):
+            raise ConfigError(
+                f"rlhf.algo must be 'grpo' or 'ppo', got '{self.algo}'")
+        if self.group_n < 1:
+            raise ConfigError("rlhf.group_n must be >= 1")
+        if self.algo == "grpo" and self.group_n < 2:
+            raise ConfigError(
+                "rlhf.algo='grpo' needs group_n >= 2 — the advantage is "
+                "normalized within each prompt's candidate group")
+        if self.temperature < 0:
+            raise ConfigError("rlhf.temperature must be >= 0")
+        if self.max_new_tokens < 1:
+            raise ConfigError("rlhf.max_new_tokens must be >= 1")
+        if self.clip_ratio <= 0:
+            raise ConfigError("rlhf.clip_ratio must be > 0")
+        if self.kl_coef < 0:
+            raise ConfigError("rlhf.kl_coef must be >= 0")
+
+
+@dataclass
 class ElasticityConfig(ConfigModel):
     """Reference: elasticity/config.py — pure batch/world-size math."""
 
@@ -840,6 +888,7 @@ class Config(ConfigModel):
     observability: ObservabilityConfig = field(
         default_factory=ObservabilityConfig)
     resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
+    rlhf: RLHFConfig = field(default_factory=RLHFConfig)
     elasticity: ElasticityConfig = field(default_factory=ElasticityConfig)
     curriculum_learning: CurriculumConfig = field(default_factory=CurriculumConfig)
     progressive_layer_drop: ProgressiveLayerDropConfig = field(

@@ -891,6 +891,13 @@ def _layer_forward(cfg: TransformerConfig, x: jax.Array, layer: Dict[str, Any],
             kk = ck[block_table].reshape(B, T_view, K, D)
             vv = cv[block_table].reshape(B, T_view, K, D)
             col = jnp.arange(T_view, dtype=jnp.int32)
+            # zero v beyond each row's max resident position — masked
+            # columns carry softmax weight 0, and 0 × NaN = NaN: scratch/
+            # recycled pages may hold nonfinite residue that must not
+            # leak into live rows (same rule as reference_paged_attention
+            # and the Pallas kernels' edge-padded v zeroing)
+            resident = col[None, :] <= jnp.max(pos, axis=1)[:, None]
+            vv = jnp.where(resident[:, :, None, None], vv, 0)
             full = (col[None, None, :] <= pos[:, :, None]).astype(jnp.int32)
             dense_fn = cfg.attention_impl or dot_product_attention
             if cfg.attention_scale is not None and cfg.attention_impl is None:
@@ -1316,6 +1323,23 @@ def head_logits(params: Dict[str, Any], x: jax.Array,
         if "lm_head_b" in params:
             logits = logits + params["lm_head_b"]
     return logits
+
+
+def gather_target_logprobs(logits: jax.Array,
+                           targets: jax.Array) -> jax.Array:
+    """Per-position log softmax mass on ``targets`` (``logits[..., V]`` →
+    ``(...)`` fp32), via the TP-safe one-hot masked-sum contraction — the
+    shared implementation behind the RLHF score program and policy loss.
+    ``take_along_axis`` over a vocab dim TP shards over 'model'
+    miscompiles in the XLA CPU SPMD partitioner (see the rationale in
+    :func:`cross_entropy_loss`, which interleaves the same contraction
+    with its -100 label masking)."""
+    logits = logits.astype(jnp.float32)
+    lse = jax.nn.logsumexp(logits, axis=-1)
+    one_hot = targets[..., None] == jnp.arange(logits.shape[-1],
+                                               dtype=targets.dtype)
+    picked = jnp.sum(jnp.where(one_hot, logits, 0.0), axis=-1)
+    return picked - lse
 
 
 def cross_entropy_loss(logits: jax.Array, labels: jax.Array,

@@ -542,6 +542,74 @@ def summarize_resilience(records: List[Dict[str, Any]]) -> str:
     return "\n".join(lines)
 
 
+def summarize_rlhf(records: List[Dict[str, Any]]) -> str:
+    """``== rlhf ==`` — the post-training loop's shape: per-phase wall
+    share (rollout/score/train/flip), tokens generated vs trained,
+    rollout speculation acceptance, fork/prefix reuse, replay
+    verifications and the flip ledger (weight refreshes absorbed without
+    arena realloc), from the rlhf/* metrics the trainer and collector
+    publish."""
+    recs = [r for r in records
+            if str(r.get("name", "")).startswith(("rlhf/", "serving/weight_",
+                                                  "serving/prefix_inval"))]
+    if not recs:
+        return ""
+    latest: Dict[Tuple[str, str], Dict[str, Any]] = {}
+    for r in recs:
+        latest[(r["name"], _label_str(r.get("labels", {})))] = r
+
+    def gauge(name: str, label: str = "-") -> Any:
+        r = latest.get((name, label))
+        return r["value"] if r is not None else None
+
+    def counter_total(name: str) -> float:
+        return sum(r["value"] for (n, _), r in latest.items()
+                   if n == name and r.get("type") == "counter")
+
+    lines = ["== rlhf =="]
+    iters = counter_total("rlhf/iterations")
+    if iters:
+        lines.append(f"  iterations: {iters:.0f}")
+    phases = {lbl.split("=", 1)[1]: r["value"]
+              for (n, lbl), r in latest.items()
+              if n == "rlhf/phase_seconds" and lbl.startswith("phase=")}
+    wall = sum(phases.values())
+    if phases and wall > 0:
+        for phase in ("rollout", "score", "train", "flip"):
+            secs = phases.get(phase)
+            if secs is None:
+                continue
+            lines.append(f"  {phase:<8}{secs:>10.3f}s  {secs / wall:>6.1%}")
+    gen = counter_total("rlhf/rollout_tokens")
+    trained = counter_total("rlhf/tokens_trained")
+    if gen or trained:
+        line = f"  tokens: generated={gen:.0f} trained={trained:.0f}"
+        if trained:
+            line += f" (gen/train = {gen / trained:.2f})"
+        lines.append(line)
+    accept = gauge("rlhf/spec_acceptance_rate")
+    if accept is not None:
+        lines.append(f"  rollout speculation acceptance: {accept:.1%}")
+    reuse = gauge("rlhf/fork_reuse_ratio")
+    if reuse is not None:
+        lines.append(f"  fork/prefix prefill reuse: {reuse:.1%}")
+    reward = gauge("rlhf/reward_mean")
+    if reward is not None:
+        lines.append(f"  reward mean: {reward:.4f}")
+    loss = gauge("rlhf/loss")
+    if loss is not None:
+        lines.append(f"  objective: {loss:.6f}")
+    replays = counter_total("rlhf/replay_verifications")
+    if replays:
+        lines.append(f"  replay verifications: {replays:.0f} (bit-exact)")
+    flips = counter_total("serving/weight_refreshes")
+    if flips:
+        inval = counter_total("serving/prefix_invalidations")
+        lines.append(f"  weight flips: {flips:.0f} (zero arena realloc; "
+                     f"{inval:.0f} prefix entries invalidated)")
+    return "\n".join(lines)
+
+
 def summarize_cost(records: List[Dict[str, Any]]) -> str:
     """``== cost ==`` — the static cost vectors tpucost publishes as
     ``tpucost/<entry>/<metric>`` gauges: per-entry flops / bytes / peak HBM /
@@ -648,6 +716,7 @@ def report(paths: List[str]) -> str:
                             summarize_metrics(records),
                             summarize_goodput(records),
                             summarize_resilience(records),
+                            summarize_rlhf(records),
                             summarize_cost(records),
                             summarize_serving(records),
                             summarize_fleet_serving(records),

@@ -327,6 +327,14 @@ def reference_paged_attention(q: jax.Array, k_pool: jax.Array,
     scale = scale if scale is not None else D ** -0.5
     kk = k_pool[block_table].reshape(B, T, K, D)
     vv = v_pool[block_table].reshape(B, T, K, D)
+    # zero v beyond each row's max resident position: masked columns get
+    # softmax weight 0, but 0 × NaN = NaN — scratch/recycled pages may
+    # carry nonfinite residue (e.g. KV written under briefly-poisoned
+    # params in an RLHF run), and it must never leak into live rows (the
+    # Pallas kernels zero their edge-padded v rows for the same reason)
+    colmask = (jnp.arange(T, dtype=jnp.int32)[None]
+               <= jnp.max(positions, axis=1)[:, None])      # (B, T)
+    vv = jnp.where(colmask[:, :, None, None], vv, 0)
     q5 = q.reshape(B, S, K, G, D)
     s = jnp.einsum("bskgd,btkd->bkgst", q5, kk).astype(jnp.float32) * scale
     col = jnp.arange(T, dtype=jnp.int32)

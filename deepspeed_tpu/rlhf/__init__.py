@@ -1,0 +1,72 @@
+"""RLHF post-training over the hybrid engine v2 — the DeepSpeed-Chat
+substrate (PAPER.md layer 9) rebuilt on this repo's serving stack.
+
+One weight set, one paged arena: the training engine and the serving
+engine share parameters through a single resharding flip
+(``runtime/hybrid_engine.py``), rollouts run as serving traffic
+(continuous batching, prefix sharing over shared system prompts,
+``fork(n)`` candidate groups, the policy's own n-gram drafter), scoring
+is two more serving passes over the same arena, and the bit-stable
+sampling contract makes every rollout replayable from its manifest —
+including across a NaN→rollback recovery. See docs/rlhf.md.
+
+    rollout.py   RolloutCollector + RolloutManifest + replay()
+    loss.py      PPO-clip / GRPO objective as a drop-in Model.loss_fn
+    trainer.py   the generate → score → train → flip loop, with
+                 TrainingSession resilience
+
+Entry point::
+
+    engine = deepspeed_tpu.rlhf.init_rlhf(
+        "tiny", config={"train_micro_batch_size_per_gpu": 8,
+                        "rlhf": {"algo": "grpo", "group_n": 4}},
+        serving_config={"max_seqs": 8, "max_model_len": 256})
+    trainer = RLHFTrainer(engine, prompt_fn, reward_fn)
+    trainer.run(iterations=100, save_dir="ckpt/")
+"""
+
+from .loss import group_advantages, rlhf_model, whitened_advantages
+from .rollout import (ReplayMismatch, RolloutBatch, RolloutCollector,
+                      RolloutManifest, RolloutSample, replay, rollout_seed)
+from .trainer import RLHFTrainer
+
+__all__ = ["init_rlhf", "RLHFTrainer", "RolloutCollector",
+           "RolloutManifest", "RolloutBatch", "RolloutSample", "replay",
+           "rollout_seed", "ReplayMismatch", "rlhf_model",
+           "group_advantages", "whitened_advantages"]
+
+
+def init_rlhf(model=None, config=None, serving_config=None, mesh=None,
+              inference_mesh: str = "auto", max_out_tokens: int = 0,
+              **hybrid_kwargs):
+    """Build a :class:`HybridEngine` whose model carries the RLHF
+    objective (:func:`rlhf_model` wraps its ``loss_fn``) and whose rollout
+    side is sized by ``serving_config``. ``model`` is a preset name or a
+    ``Model``; ``config`` the usual config tree (the ``rlhf`` block
+    selects the algorithm). ``max_out_tokens`` defaults to the serving
+    ``max_model_len`` so the offline generate() arena matches the rollout
+    budget."""
+    from ..config.config import ServingConfig, load_config
+    from ..runtime.hybrid_engine import HybridEngine
+
+    cfg = load_config(config)
+    cfg.rlhf.validate()
+    if isinstance(serving_config, dict):
+        serving_config = ServingConfig.from_dict(serving_config)
+    scfg = serving_config or ServingConfig()
+    if isinstance(model, str):
+        import jax.numpy as jnp
+
+        from ..models import create_model
+
+        # build the preset in the config's precision so model-internal
+        # dtypes (KV writes, arena) agree with the engine's compute dtype
+        dtype = {"bfloat16": jnp.bfloat16, "float16": jnp.float16,
+                 "float32": jnp.float32}[cfg.precision_dtype]
+        model = create_model(model, dtype=dtype)
+    wrapped = rlhf_model(model, cfg.rlhf)
+    return HybridEngine(
+        model=wrapped, config=cfg, mesh=mesh,
+        serving_config=scfg, inference_mesh=inference_mesh,
+        max_out_tokens=max_out_tokens or scfg.max_model_len,
+        **hybrid_kwargs)

@@ -1,0 +1,112 @@
+"""RLHF policy losses — PPO-clip / GRPO as a drop-in ``Model.loss_fn``.
+
+The training engine differentiates ``model.loss_fn(params, microbatch)``;
+RLHF needs a different objective over an enriched microbatch, so
+:func:`rlhf_model` wraps a base model with a loss that reads the extra
+keys the trainer packs:
+
+    input_ids   (B, T) int32    prompt + response, zero-padded
+    targets     (B, T) int32    input_ids shifted left one (pad 0)
+    loss_mask   (B, T) float32  1.0 on positions whose TARGET is a
+                                response token, 0 elsewhere (prompt + pad)
+    advantages  (B, T) float32  per-token advantage (group-normalized for
+                                GRPO, whitened rewards for PPO), already
+                                broadcast over response positions
+    old_logp    (B, T) float32  behaviour-policy logprobs (the serving
+                                score pass under the rollout weights)
+    ref_logp    (B, T) float32  frozen-reference logprobs (second score
+                                pass); all-zero when kl_coef == 0
+
+The objective is the standard clipped surrogate plus a k3 KL penalty:
+
+    ratio  = exp(logp - old_logp)
+    pg     = -min(ratio * A, clip(ratio, 1±eps) * A)
+    kl     = exp(ref - logp) - (ref - logp) - 1        # k3: >= 0, unbiased
+    loss   = mean_masked(pg + kl_coef * kl)
+
+GRPO vs PPO differ only in how the trainer computes ``advantages`` (the
+host-side :func:`group_advantages` / :func:`whitened_advantages`), so ONE
+compiled train step serves both.
+
+Target logprobs are gathered with the one-hot masked-sum contraction, not
+``take_along_axis`` — the vocab dim may be TP-sharded and the XLA CPU SPMD
+partitioner miscompiles the gather (the PR-5 root cause in
+``models/transformer.cross_entropy_loss``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, List, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["rlhf_model", "group_advantages", "whitened_advantages"]
+
+
+def rlhf_model(model: Any, rlhf_cfg: Any) -> Any:
+    """A copy of ``model`` whose ``loss_fn`` is the PPO-clip/GRPO
+    objective (``eval_loss_fn`` dropped — eval of a policy objective on
+    held-out rollouts has no meaning without rollouts). The wrapped model
+    drives a stock ``TrainEngine``/``HybridEngine`` unchanged — gas
+    scanning, ZeRO sharding, fp16/bf16, the numerics sentinel and the
+    NaN-rollback machinery all apply to the RLHF step for free."""
+    clip = float(rlhf_cfg.clip_ratio)
+    kl_coef = float(rlhf_cfg.kl_coef)
+    base_apply = model.apply
+
+    def loss_fn(params, mb):
+        from ..models.transformer import gather_target_logprobs
+
+        logits, _ = base_apply(params, {"input_ids": mb["input_ids"]})
+        logp = gather_target_logprobs(logits, mb["targets"])
+        mask = mb["loss_mask"].astype(jnp.float32)
+        adv = mb["advantages"].astype(jnp.float32)
+        ratio = jnp.exp(logp - mb["old_logp"])
+        pg = -jnp.minimum(ratio * adv,
+                          jnp.clip(ratio, 1.0 - clip, 1.0 + clip) * adv)
+        obj = pg
+        if kl_coef > 0.0:
+            # masked positions carry fake ref_logp (0.0 == prob 1), and a
+            # padded target the model finds unlikely would drive
+            # exp(ref - logp) to inf — and inf * mask(0) is NaN, poisoning
+            # the whole masked sum (the same 0×nonfinite class the paged
+            # read paths guard against). Zero d under the mask so pads
+            # contribute exactly exp(0) - 0 - 1 = 0.
+            d = jnp.where(mask > 0, mb["ref_logp"] - logp, 0.0)
+            obj = obj + kl_coef * (jnp.exp(d) - d - 1.0)
+        return jnp.sum(obj * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+    return dataclasses.replace(model, loss_fn=loss_fn, eval_loss_fn=None,
+                               name=model.name + "-rlhf")
+
+
+def group_advantages(rewards: Sequence[Sequence[float]]
+                     ) -> List[List[float]]:
+    """GRPO: within each prompt's candidate group, advantage =
+    (r - mean) / (std + eps). A zero-variance group (every candidate
+    scored the same) yields zeros — no gradient signal, by design."""
+    out: List[List[float]] = []
+    for group in rewards:
+        r = np.asarray(group, np.float64)
+        centred = r - r.mean()
+        std = r.std()
+        out.append(list((centred / (std + 1e-6)).astype(np.float64)))
+    return out
+
+
+def whitened_advantages(rewards: Sequence[Sequence[float]],
+                        whiten: bool = True) -> List[List[float]]:
+    """PPO (critic-free): the advantage is the reward, whitened across the
+    WHOLE batch when ``whiten`` — the RLOO-style baseline that keeps the
+    clipped surrogate scale-stable without a value model."""
+    flat = np.asarray([x for g in rewards for x in g], np.float64)
+    if whiten and flat.size:
+        flat = (flat - flat.mean()) / (flat.std() + 1e-6)
+    out: List[List[float]] = []
+    i = 0
+    for group in rewards:
+        out.append(list(flat[i:i + len(group)]))
+        i += len(group)
+    return out

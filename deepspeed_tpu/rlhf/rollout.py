@@ -1,0 +1,302 @@
+"""Rollout collection through the serving stack + deterministic replay.
+
+The RLHF rollout phase is just serving traffic with a derived seed
+discipline:
+
+* a batch of prompts sharing a system prompt rides **prefix sharing** —
+  the shared head prefills once and every later prompt maps the cached
+  blocks into its table (``serving/paged_kv.PrefixCache``);
+* each prompt's candidate group of ``n`` samples is ONE prefill plus
+  ``fork(n)`` COW siblings (``submit(n=...)``) — GRPO/best-of-n sampling
+  is literally ``n-1`` block-table increfs;
+* the policy's own **n-gram drafter** (``speculative.mode='ngram'``)
+  speculates over its rollouts with zero extra weights;
+* per-request seeds derive from ``(iteration, prompt_index,
+  sample_index)`` (:func:`rollout_seed`), and the serving layer's sampling
+  contract — draws depend only on (engine seed, request seed,
+  output-token index) — makes every rollout **bit-exactly replayable**
+  from the manifest alone: :func:`replay` reproduces identical token
+  streams across preemption/recompute and with speculation toggled either
+  way.
+
+The :class:`RolloutManifest` is the replay unit: prompts, per-sample
+seeds, sampling knobs and the recorded streams, JSON-serializable. It is
+also the resilience contract — a NaN→rollback recovery re-runs
+``data_fn(step)``, which re-collects the same iteration's rollouts from
+the restored (bit-identical) weights and seeds, reproducing the manifest
+exactly (tests/unit/test_rlhf.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..observability import get_session
+
+__all__ = ["rollout_seed", "RolloutSample", "RolloutBatch",
+           "RolloutManifest", "RolloutCollector", "ReplayMismatch",
+           "replay", "SEED_STRIDE"]
+
+# seeds within one prompt's candidate group are consecutive (submit(n=...)
+# gives sibling i seed base+i), so groups are strided apart; group_n is
+# validated against this bound
+SEED_STRIDE = 4096
+
+
+def rollout_seed(iteration: int, prompt_index: int,
+                 sample_index: int = 0) -> int:
+    """The documented, replay-stable seed derivation: sample ``s`` of
+    prompt ``p`` in iteration ``i`` samples with
+    ``((i * 1_000_003 + p) * SEED_STRIDE + s) mod 2^30``. Consecutive
+    sample indices are consecutive seeds, which is exactly the sibling
+    seed rule of ``ServingEngine.submit(n=...)`` — a forked group and
+    ``n`` solo submissions draw from identical streams."""
+    if not 0 <= sample_index < SEED_STRIDE:
+        raise ValueError(f"sample_index must be in [0, {SEED_STRIDE}), "
+                         f"got {sample_index}")
+    return ((iteration * 1_000_003 + prompt_index) * SEED_STRIDE
+            + sample_index) & 0x3FFFFFFF
+
+
+class ReplayMismatch(AssertionError):
+    """A replayed rollout diverged from its manifest — the determinism
+    contract is broken (weight drift, engine-seed mismatch, or a sampling
+    bug)."""
+
+
+@dataclasses.dataclass
+class RolloutSample:
+    """One generated candidate: ``tokens`` is the response stream only
+    (the prompt is shared group-wide)."""
+
+    prompt_index: int
+    sample_index: int
+    seed: int
+    prompt: np.ndarray
+    tokens: List[int]
+
+    @property
+    def sequence(self) -> np.ndarray:
+        """prompt + response, the scoring/training token sequence."""
+        return np.concatenate([np.asarray(self.prompt, np.int32),
+                               np.asarray(self.tokens, np.int32)])
+
+
+@dataclasses.dataclass
+class RolloutBatch:
+    """One iteration's rollouts: ``groups[p][s]`` is sample ``s`` of
+    prompt ``p``, plus the collection-side stats the metrics/report layer
+    surfaces."""
+
+    iteration: int
+    groups: List[List[RolloutSample]]
+    stats: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    @property
+    def samples(self) -> List[RolloutSample]:
+        return [s for g in self.groups for s in g]
+
+
+@dataclasses.dataclass
+class RolloutManifest:
+    """Everything needed to re-produce an iteration's rollouts bit-exactly
+    — and the recorded streams to verify against. ``engine_seed`` is the
+    serving engine's sampling-stream seed (``ServingConfig.seed``);
+    ``spec_mode`` records how the streams were produced (informational:
+    the streams are identical either way — that IS the contract)."""
+
+    iteration: int
+    group_n: int
+    engine_seed: int
+    temperature: float
+    top_k: int
+    top_p: float
+    max_new_tokens: int
+    eos_token_id: Optional[int]
+    prompts: List[List[int]]
+    seeds: List[List[int]]            # [prompt][sample]
+    streams: List[List[List[int]]]    # [prompt][sample][token]
+    spec_mode: str = "off"
+    version: int = 1
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self))
+
+    @classmethod
+    def from_json(cls, text: str) -> "RolloutManifest":
+        return cls(**json.loads(text))
+
+    def save(self, path: str) -> None:
+        with open(path, "w", encoding="utf-8") as fh:
+            fh.write(self.to_json())
+
+    @classmethod
+    def load(cls, path: str) -> "RolloutManifest":
+        with open(path, "r", encoding="utf-8") as fh:
+            return cls.from_json(fh.read())
+
+
+class RolloutCollector:
+    """Drives one iteration's generation through a ``ServingEngine``.
+
+    ``engine`` must hold the CURRENT policy weights (the hybrid engine's
+    ``flip_to_serving()`` contract). Publishes ``rlhf/*`` rollout metrics
+    and returns ``(RolloutBatch, RolloutManifest)``."""
+
+    def __init__(self, engine, group_n: int = 4, temperature: float = 0.7,
+                 top_k: int = 0, top_p: float = 1.0,
+                 max_new_tokens: int = 32,
+                 eos_token_id: Optional[int] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        if not 1 <= group_n < SEED_STRIDE:
+            raise ValueError(f"group_n must be in [1, {SEED_STRIDE}), "
+                             f"got {group_n}")
+        self.engine = engine
+        self.group_n = int(group_n)
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.top_p = float(top_p)
+        self.max_new_tokens = int(max_new_tokens)
+        self.eos_token_id = eos_token_id
+        self.clock = clock
+
+    def collect(self, prompts: Sequence[np.ndarray], iteration: int
+                ) -> Tuple[RolloutBatch, RolloutManifest]:
+        eng = self.engine
+        if eng.in_flight():
+            raise RuntimeError(
+                f"rollout collect with {eng.in_flight()} foreign requests "
+                "in flight — the collector owns the engine for the phase")
+        n = self.group_n
+        pre_chunks = eng.prefill_chunks_run
+        pre_tokens = eng.prefill_tokens_run
+        pre_prop, pre_acc = eng._spec_proposed, eng._spec_accepted
+        t0 = self.clock()
+        handle_groups = []
+        for p_idx, prompt in enumerate(prompts):
+            hs = eng.submit(np.asarray(prompt, np.int32),
+                            max_new_tokens=self.max_new_tokens,
+                            temperature=self.temperature, top_k=self.top_k,
+                            top_p=self.top_p,
+                            eos_token_id=self.eos_token_id,
+                            seed=rollout_seed(iteration, p_idx), n=n)
+            handle_groups.append([hs] if n == 1 else hs)
+        eng.run()
+        wall = self.clock() - t0
+        groups: List[List[RolloutSample]] = []
+        for p_idx, (prompt, hs) in enumerate(zip(prompts, handle_groups)):
+            groups.append([
+                RolloutSample(prompt_index=p_idx, sample_index=s_idx,
+                              seed=rollout_seed(iteration, p_idx, s_idx),
+                              prompt=np.asarray(prompt, np.int32),
+                              tokens=[int(t) for t in h.result()])
+                for s_idx, h in enumerate(hs)])
+        gen_tokens = sum(len(s.tokens) for g in groups for s in g)
+        prefill_tokens = eng.prefill_tokens_run - pre_tokens
+        # every sample's prompt would prefill in full without fork/prefix
+        # reuse; the ratio is the fraction of that work the sharing paths
+        # absorbed (n-1 forked siblings + prefix-cache hits)
+        submitted = sum(int(np.asarray(p).size) for p in prompts) * n
+        reuse = 1.0 - prefill_tokens / max(submitted, 1)
+        proposed = eng._spec_proposed - pre_prop
+        accepted = eng._spec_accepted - pre_acc
+        stats = {
+            "wall_s": wall,
+            "generated_tokens": gen_tokens,
+            "prefill_chunks": eng.prefill_chunks_run - pre_chunks,
+            "prefill_tokens": prefill_tokens,
+            "submitted_prompt_tokens": submitted,
+            "fork_reuse_ratio": reuse,
+            "spec_proposed": proposed,
+            "spec_accepted": accepted,
+            "spec_acceptance_rate": (accepted / proposed if proposed
+                                     else None),
+        }
+        self._publish(stats, len(list(prompts)))
+        manifest = RolloutManifest(
+            iteration=int(iteration), group_n=n,
+            engine_seed=int(eng.config.seed),
+            temperature=self.temperature, top_k=self.top_k,
+            top_p=self.top_p, max_new_tokens=self.max_new_tokens,
+            eos_token_id=self.eos_token_id,
+            prompts=[[int(t) for t in np.asarray(p).reshape(-1)]
+                     for p in prompts],
+            seeds=[[s.seed for s in g] for g in groups],
+            streams=[[list(s.tokens) for s in g] for g in groups],
+            spec_mode=("off" if eng._drafter is None or eng.spec_suspended
+                       else eng.config.speculative.mode))
+        return RolloutBatch(iteration=int(iteration), groups=groups,
+                            stats=stats), manifest
+
+    @staticmethod
+    def _publish(stats: Dict[str, Any], n_prompts: int) -> None:
+        obs = get_session()
+        if not obs.enabled:
+            return
+        reg = obs.registry
+        reg.counter("rlhf/rollout_tokens",
+                    help="tokens generated by rollout phases").inc(
+                        stats["generated_tokens"])
+        reg.counter("rlhf/rollout_prompts",
+                    help="prompts rolled out").inc(n_prompts)
+        reg.gauge("rlhf/fork_reuse_ratio",
+                  help="fraction of per-sample prompt prefill absorbed by "
+                       "fork(n) + prefix sharing").set(
+                      stats["fork_reuse_ratio"])
+        if stats["spec_acceptance_rate"] is not None:
+            reg.gauge("rlhf/spec_acceptance_rate",
+                      help="rollout draft-token acceptance rate").set(
+                          stats["spec_acceptance_rate"])
+
+
+def replay(manifest: RolloutManifest, engine, verify: bool = True,
+           ) -> List[List[List[int]]]:
+    """Re-produce a manifest's token streams from the manifest alone.
+
+    ``engine`` must hold the same weights and engine seed the recording
+    run used (the iteration's policy — after a rollback, the restored
+    checkpoint). Each sample resubmits INDIVIDUALLY with its recorded
+    seed — deliberately not through ``submit(n=...)`` — so a successful
+    verify also witnesses the fork-vs-solo bit-identity. Speculation may
+    be on or off, toggled, or differently configured: the serving layer's
+    sampling contract makes the streams identical, and ``verify=True``
+    asserts exactly that (raising :class:`ReplayMismatch` on the first
+    divergence, publishing ``rlhf/replay_verifications`` on success)."""
+    if int(engine.config.seed) != manifest.engine_seed:
+        raise ReplayMismatch(
+            f"engine seed {engine.config.seed} != manifest engine seed "
+            f"{manifest.engine_seed} — the sampling streams cannot match")
+    handles = []
+    for p_idx, prompt in enumerate(manifest.prompts):
+        row = []
+        for s_idx in range(manifest.group_n):
+            row.append(engine.submit(
+                np.asarray(prompt, np.int32),
+                max_new_tokens=manifest.max_new_tokens,
+                temperature=manifest.temperature, top_k=manifest.top_k,
+                top_p=manifest.top_p, eos_token_id=manifest.eos_token_id,
+                seed=manifest.seeds[p_idx][s_idx]))
+        handles.append(row)
+    engine.run()
+    streams = [[[int(t) for t in h.result()] for h in row]
+               for row in handles]
+    if verify:
+        for p_idx, (got_row, want_row) in enumerate(
+                zip(streams, manifest.streams)):
+            for s_idx, (got, want) in enumerate(zip(got_row, want_row)):
+                if got != want:
+                    raise ReplayMismatch(
+                        f"iteration {manifest.iteration} prompt {p_idx} "
+                        f"sample {s_idx}: replayed stream diverged "
+                        f"(got {got[:8]}..., recorded {want[:8]}...)")
+        obs = get_session()
+        if obs.enabled:
+            obs.registry.counter(
+                "rlhf/replay_verifications",
+                help="manifests replayed and verified bit-exact").inc()
+    return streams

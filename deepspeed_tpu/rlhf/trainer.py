@@ -1,0 +1,273 @@
+"""The RLHF iteration loop: generate → score → train → flip.
+
+One :class:`RLHFTrainer` owns a :class:`~deepspeed_tpu.runtime
+.hybrid_engine.HybridEngine` (whose model carries the
+:func:`~deepspeed_tpu.rlhf.loss.rlhf_model` objective) and drives the
+DeepSpeed-Chat step-3 shape over the serving stack:
+
+1. **flip** — ``engine.flip_to_serving()``: one resharding program moves
+   the current training weights into the serving layout; the arena, block
+   pool, compiled programs and scheduler survive (zero realloc, zero
+   recompiles); the prefix cache invalidates (stale content hashes).
+2. **rollout** — :class:`~.rollout.RolloutCollector`: each prompt's
+   candidate group is ONE prefill + ``fork(n)`` COW siblings; shared
+   system prompts ride prefix sharing; the policy's own n-gram drafter
+   speculates; seeds derive from (iteration, prompt, sample) so the whole
+   phase is bit-exactly replayable from its manifest.
+3. **score** — the pluggable ``reward_fn`` scores each candidate;
+   behaviour-policy (π_old) and frozen-reference logprobs come from
+   **two more serving passes over the same arena**
+   (``ServingEngine.score_logprobs`` — one compiled program, params as an
+   argument).
+4. **train** — PPO-clip / GRPO step on the TrainEngine (the wrapped
+   ``loss_fn``), then back to 1.
+
+Resilience rides :class:`~deepspeed_tpu.runtime.session.TrainingSession`
+(:meth:`RLHFTrainer.run`): the whole iteration is ``data_fn(step)`` — a
+NaN→rollback recovery restores the checkpoint and re-calls it, and
+because the restored weights and the derived seeds are bit-identical, the
+re-collected rollouts reproduce the failed iteration's manifest exactly.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..observability import get_session
+from ..utils.logging import log_dist
+from .loss import group_advantages, whitened_advantages
+from .rollout import (RolloutBatch, RolloutCollector, RolloutManifest,
+                      replay)
+
+__all__ = ["RLHFTrainer"]
+
+
+class RLHFTrainer:
+    """Drives RLHF iterations over a hybrid engine.
+
+    ``prompt_fn(iteration) -> [token arrays]`` MUST be a pure function of
+    the iteration (the replay/rollback contract — the same purity rule as
+    ``TrainingSession.data_fn``); return a fixed prompt count so the train
+    step never respecializes. ``reward_fn(prompt, response_tokens) ->
+    float`` is the pluggable scorer (a reward model, a verifier, a
+    heuristic). The sample count per iteration
+    (``len(prompts) * group_n``) must divide by the engine's
+    ``gradient_accumulation_steps``."""
+
+    def __init__(self, engine, prompt_fn: Callable[[int], Sequence[Any]],
+                 reward_fn: Callable[[np.ndarray, List[int]], float],
+                 rlhf: Optional[Any] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.engine = engine
+        self.cfg = rlhf if rlhf is not None else engine.config.rlhf
+        self.cfg.validate()
+        self.prompt_fn = prompt_fn
+        self.reward_fn = reward_fn
+        self.clock = clock
+        self.serving = engine.serving_engine()
+        self.seq_budget = self.serving.config.max_model_len
+        self.collector = RolloutCollector(
+            self.serving, group_n=self.cfg.group_n,
+            temperature=self.cfg.temperature, top_k=self.cfg.top_k,
+            top_p=self.cfg.top_p, max_new_tokens=self.cfg.max_new_tokens,
+            eos_token_id=self.cfg.eos_token_id, clock=clock)
+        # frozen reference = the policy at trainer construction: flip once
+        # and HOLD the resharded tree — the next flip REPLACES
+        # infer.params with fresh arrays, so this reference costs zero
+        # copies and stays on the serving shardings (the score program
+        # accepts it without a recompile)
+        engine.refresh_params()
+        self._ref_params = (engine._inference_engine().params
+                            if self.cfg.kl_coef > 0 else None)
+        import collections
+
+        # bounded (step, manifest) history: manifests hold every generated
+        # stream, so keeping all of a long run's would leak host memory —
+        # the recent window covers replay/debugging (a rollback's re-run
+        # appends a second entry for the same step, deliberately); persist
+        # manifests yourself (RolloutManifest.save) for full retention
+        self.manifests: "collections.deque[Tuple[int, RolloutManifest]]" \
+            = collections.deque(maxlen=16)
+        self.losses: List[float] = []
+        self._phase_s: Dict[str, float] = {
+            "flip": 0.0, "rollout": 0.0, "score": 0.0, "train": 0.0}
+        self._tokens_trained = 0
+        self._last_prepare_end: Optional[float] = None
+        self._reward_sum = 0.0
+        self._reward_n = 0
+
+    # -- one iteration's batch (the TrainingSession data_fn) ---------------
+    def data_fn(self, step: int) -> Dict[str, np.ndarray]:
+        """Everything before the train step: flip, rollout (+ optional
+        replay verification), score, advantage, batch packing. Pure given
+        the engine's weights at ``step`` — a rollback that restores them
+        re-produces this batch bit-exactly."""
+        eng = self.engine
+        obs = get_session()
+        now = self.clock()
+        if self._last_prepare_end is not None:
+            # the wall between data_fn calls is the train phase (the
+            # session owns the train_batch call, so the trainer brackets
+            # it from the outside)
+            self._phase_s["train"] += now - self._last_prepare_end
+        t0 = now
+        serving = eng.flip_to_serving()
+        self._phase_s["flip"] += self.clock() - t0
+
+        t0 = self.clock()
+        prompts = [np.asarray(p, np.int32).reshape(-1)
+                   for p in self.prompt_fn(step)]
+        rollouts, manifest = self.collector.collect(prompts, step)
+        self.manifests.append((step, manifest))
+        if self.cfg.replay_verify:
+            # continuously enforce the determinism contract: replay with
+            # speculation toggled OPPOSITE to the recording run
+            was = serving.spec_suspended
+            serving.spec_suspended = not was
+            try:
+                replay(manifest, serving, verify=True)
+            finally:
+                serving.spec_suspended = was
+        self._phase_s["rollout"] += self.clock() - t0
+
+        t0 = self.clock()
+        batch = self._score_and_pack(rollouts)
+        self._phase_s["score"] += self.clock() - t0
+        self._publish(obs, iteration=True)
+        self._last_prepare_end = self.clock()
+        return batch
+
+    # -- scoring + packing -------------------------------------------------
+    def _score_and_pack(self, rollouts: RolloutBatch
+                        ) -> Dict[str, np.ndarray]:
+        cfg = self.cfg
+        serving = self.serving
+        rewards = [[self.reward_fn(s.prompt, list(s.tokens)) for s in g]
+                   for g in rollouts.groups]
+        self._reward_sum += float(sum(x for g in rewards for x in g))
+        self._reward_n += sum(len(g) for g in rewards)
+        if cfg.algo == "grpo":
+            advantages = group_advantages(rewards)
+        else:
+            advantages = whitened_advantages(rewards,
+                                             whiten=cfg.whiten_advantages)
+        samples = rollouts.samples
+        flat_adv = [a for g in advantages for a in g]
+        B, T = len(samples), self.seq_budget
+        gas = self.engine.gradient_accumulation_steps()
+        if B % gas:
+            raise ValueError(
+                f"rlhf: samples per iteration ({B}) must divide by "
+                f"gradient_accumulation_steps ({gas}) — adjust the prompt "
+                "count or group_n")
+        ids = np.zeros((B, T), np.int32)
+        targets = np.zeros((B, T), np.int32)
+        mask = np.zeros((B, T), np.float32)
+        adv = np.zeros((B, T), np.float32)
+        old_logp = np.zeros((B, T), np.float32)
+        ref_logp = np.zeros((B, T), np.float32)
+        for k, (s, a) in enumerate(zip(samples, flat_adv)):
+            seq = s.sequence
+            L, n_prompt = int(seq.size), int(s.prompt.size)
+            ids[k, :L] = seq
+            targets[k, :L - 1] = seq[1:]
+            # position p's target is seq[p+1]: response targets are
+            # p in [n_prompt - 1, L - 1)
+            mask[k, n_prompt - 1:L - 1] = 1.0
+            adv[k, n_prompt - 1:L - 1] = a
+            # π_old under the freshly flipped (pre-update) weights — the
+            # behaviour policy that generated the rollout
+            old_logp[k, :L - 1] = serving.score_logprobs(seq)
+            if self._ref_params is not None:
+                ref_logp[k, :L - 1] = serving.score_logprobs(
+                    seq, params=self._ref_params)
+            self._tokens_trained += L
+        mb = B // gas
+        return {
+            "input_ids": ids.reshape(gas, mb, T),
+            "targets": targets.reshape(gas, mb, T),
+            "loss_mask": mask.reshape(gas, mb, T),
+            "advantages": adv.reshape(gas, mb, T),
+            "old_logp": old_logp.reshape(gas, mb, T),
+            "ref_logp": ref_logp.reshape(gas, mb, T),
+        }
+
+    # -- plain loop (tests / no-checkpoint runs) ---------------------------
+    def step(self) -> float:
+        """One unsupervised RLHF iteration (see :meth:`run` for the
+        self-healing path): data_fn + train_batch."""
+        batch = self.data_fn(self.engine.global_steps)
+        loss = float(self.engine.train_batch(batch=batch))
+        self.losses.append(loss)
+        obs = get_session()
+        if obs.enabled:
+            obs.registry.gauge("rlhf/loss",
+                               help="last RLHF objective value").set(loss)
+        return loss
+
+    def train(self, iterations: int) -> List[float]:
+        for _ in range(int(iterations)):
+            self.step()
+        # close the final train-phase bracket so phase shares add up
+        if self._last_prepare_end is not None:
+            self._phase_s["train"] += self.clock() - self._last_prepare_end
+            self._last_prepare_end = None
+            self._publish(get_session())
+        return list(self.losses)
+
+    # -- the supervised path -----------------------------------------------
+    def run(self, iterations: int, save_dir: str,
+            engine_factory: Optional[Callable[[], Any]] = None,
+            injector: Optional[Any] = None) -> Dict[str, Any]:
+        """Run ``iterations`` RLHF steps under the PR-9
+        :class:`TrainingSession` policy (``config.resilience``): a
+        ``NumericsTrip`` rolls back to the last verified checkpoint and
+        re-calls :meth:`data_fn` — the restored weights plus the derived
+        seeds re-produce the failed iteration's rollouts deterministically
+        before the step replays. ``engine_factory`` (for hang
+        soft-restarts) defaults to reusing this trainer's engine."""
+        from ..runtime.session import TrainingSession
+
+        session = TrainingSession(
+            engine_factory or (lambda: self.engine), self.data_fn,
+            total_steps=int(iterations), save_dir=save_dir,
+            resilience=self.engine.config.resilience, injector=injector,
+            clock=self.clock,
+            on_step=lambda step, loss: self.losses.append(loss))
+        summary = session.run()
+        if self._last_prepare_end is not None:
+            self._phase_s["train"] += self.clock() - self._last_prepare_end
+            self._last_prepare_end = None
+            self._publish(get_session())
+        summary["manifests"] = len(self.manifests)
+        summary["phase_seconds"] = dict(self._phase_s)
+        return summary
+
+    # -- telemetry ---------------------------------------------------------
+    def _publish(self, obs, iteration: bool = False) -> None:
+        if not obs.enabled:
+            return
+        reg = obs.registry
+        if iteration:
+            reg.counter(
+                "rlhf/iterations",
+                help="RLHF generate→score→train→flip iterations").inc()
+        for phase, secs in self._phase_s.items():
+            g = reg.gauge("rlhf/phase_seconds",
+                          help="cumulative wall seconds per RLHF phase")
+            g.set(secs, phase=phase)
+        reg.counter("rlhf/tokens_trained",
+                    help="prompt+response tokens fed to the RLHF train "
+                         "step").inc(self._tokens_trained
+                                     - getattr(self, "_pub_trained", 0))
+        self._pub_trained = self._tokens_trained
+        if self._reward_n:
+            reg.gauge("rlhf/reward_mean",
+                      help="mean reward over all scored candidates").set(
+                          self._reward_sum / self._reward_n)
+        log_dist(
+            "rlhf: iter done — phases "
+            + " ".join(f"{k}={v:.2f}s" for k, v in self._phase_s.items()))

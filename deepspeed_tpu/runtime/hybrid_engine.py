@@ -1,15 +1,30 @@
-"""Hybrid engine — RLHF training + generation sharing one weight set.
+"""Hybrid engine v2 — RLHF training + serving sharing one weight set and
+one paged arena.
 
 Reference: ``runtime/hybrid_engine.py:32`` (DeepSpeedHybridEngine): trains
 like DeepSpeedEngine and serves ``generate()`` with the inference kernels,
 flipping the SAME weights between the two layouts (ZeRO-3 gathers per layer
 at generation, inference-sharded containers at :353-396).
 
-TPU rendering: the training params are global jax Arrays, so the "flip" is a
-``device_put`` onto the inference shardings (XLA emits the gather from the
-fsdp layout) — no per-layer hook machinery. The inference side is the
-standard InferenceEngine (KV arena, decode kernel, buckets); its params are
-refreshed from the training state on every generate after a train step.
+TPU rendering: the training params are global jax Arrays, so the "flip" is
+ONE resharding program — ``jax.jit(identity, out_shardings=<serving>)``
+when the train and serve meshes share a device set (XLA emits the
+fsdp→replicated gather; registered with tpuaudit as ``rlhf/flip``), a
+plain ``device_put`` across disjoint device sets — no per-layer hook
+machinery.
+
+v2 (the RLHF substrate, ``docs/rlhf.md``): the flip targets a
+``ServingEngine``, not a bare ``generate()``. ``refresh_params()``
+reshards the current training weights (LoRA deltas fused as a pure
+function) into the serving layout and *invalidates the prefix cache's
+content hashes* — cached KV bytes are a function of the params — while
+**preserving the arena allocation**: the block pool, the compiled
+prefill/decode/verify/cow/score programs and the scheduler all survive
+the flip (they are keyed on shapes, which a weight refresh never
+changes), so an RLHF iteration costs zero HBM realloc and zero serving
+recompiles. Flipping back to the train step is free: the arena simply
+parks, fully allocated, until the next rollout phase. The offline
+``generate()`` surface remains for A/B baselines and API parity.
 """
 
 from __future__ import annotations
@@ -19,27 +34,51 @@ from typing import Any, Optional
 import jax
 import jax.numpy as jnp
 
-from ..utils.logging import log_dist
+from ..utils.logging import log_dist, logger
 from .engine import TrainEngine
 
 
 class HybridEngine(TrainEngine):
-    """TrainEngine + generate(). Construct via ``initialize(...,
-    hybrid_engine=True)`` or directly."""
+    """TrainEngine + a serving-stack rollout side. Construct via
+    ``initialize(..., hybrid_engine=True)``, ``deepspeed_tpu.rlhf
+    .init_rlhf(...)``, or directly.
+
+    ``serving_config`` (a ``ServingConfig`` or dict) sizes the rollout
+    arena; ``serving_engine()`` builds the continuous-batching engine
+    lazily and keeps it alive across every flip. ``inference_mesh='train'``
+    places the inference/serving side on the TRAINING mesh (tp = the train
+    mesh's model-axis degree) so the flip is one jitted all-gather instead
+    of a cross-mesh ``device_put`` — the default ``'auto'`` builds the
+    PR-era standalone mesh from ``inference_tp_size``/``inference_ep_size``
+    (on a single device the two coincide and the flip is jitted anyway)."""
 
     def __init__(self, *args, inference_tp_size: int = 1,
                  inference_ep_size: Optional[int] = None,
-                 max_out_tokens: int = 1024, **kwargs):
+                 max_out_tokens: int = 1024,
+                 serving_config: Optional[Any] = None,
+                 inference_mesh: str = "auto", **kwargs):
         super().__init__(*args, **kwargs)
+        if inference_mesh not in ("auto", "train"):
+            raise ValueError("inference_mesh must be 'auto' or 'train', "
+                             f"got '{inference_mesh}'")
         self._inference_tp = inference_tp_size
         # MoE policies: default the generation-side expert parallelism to
         # the TRAINING mesh's expert degree, so an ep-trained actor serves
         # with the same expert placement (reference _create_ep_parallel_group,
         # inference/engine.py:274)
         self._inference_ep = inference_ep_size
+        self._inference_mesh = inference_mesh
         self._max_out_tokens = max_out_tokens
+        from ..config.config import ServingConfig
+
+        if isinstance(serving_config, dict):
+            serving_config = ServingConfig.from_dict(serving_config)
+        self._serving_config = serving_config
+        self._serving = None
         self._infer = None
         self._infer_params_step = -1
+        self._flip_program = None     # jitted reshard (shared device set)
+        self._flip_registered = False
         self._lora = None            # (adapters, scaling)
         self._lora_fused = False
 
@@ -118,16 +157,36 @@ class HybridEngine(TrainEngine):
             if ep is None:
                 ep = (int(self.mesh.shape.get(mesh_mod.EXPERT_AXIS, 1))
                       if cfg is not None and cfg.moe_num_experts > 0 else 1)
+            share = self._inference_mesh == "train"
+            if share:
+                # serve on the TRAINING mesh: tp/ep degrees come from its
+                # axes and the flip becomes one jitted resharding program
+                # (the fsdp→serving gather) on the shared device set
+                tp = int(self.mesh.shape[mesh_mod.MODEL_AXIS])
+                ep = int(self.mesh.shape.get(mesh_mod.EXPERT_AXIS, 1))
+            else:
+                tp = self._inference_tp
             icfg = InferenceConfig(dtype=self.compute_dtype,
-                                   tensor_parallel=self._inference_tp,
+                                   tensor_parallel=tp,
                                    expert_parallel=ep,
                                    max_out_tokens=self._max_out_tokens)
-            self._infer = InferenceEngine(base, icfg,
-                                          params=self._export_params())
+            self._infer = InferenceEngine(
+                base, icfg, params=self._export_params(),
+                mesh=self.mesh if share else None)
+            # CPU backends: device_put of live train params may alias
+            # their buffers zero-copy, and the DONATING train step then
+            # mutates the inference tree in place (the PR-9 resume-
+            # corruption class, at the hybrid seam) — route every leaf
+            # through an owned copy; TPU/GPU device_put always copies
+            from .checkpoint import _owned_copy
+
+            self._infer.params = jax.tree.map(_owned_copy,
+                                              self._infer.params)
             self._infer_params_step = self.global_steps
             log_dist("hybrid engine: inference side ready "
-                     f"(tp={self._inference_tp}, ep={ep}, "
-                     f"arena={self._max_out_tokens})")
+                     f"(tp={tp}, ep={ep}, "
+                     f"arena={self._max_out_tokens}, "
+                     f"mesh={'train' if share else 'own'})")
         return self._infer
 
     def _export_params(self) -> Any:
@@ -143,14 +202,140 @@ class HybridEngine(TrainEngine):
             params = self._lora_delta_params(params, +1.0)
         return params
 
-    def refresh_inference_params(self) -> None:
-        """Reshard the CURRENT training weights into the inference layout
-        (the reference's train->eval flip, hybrid_engine.py:353)."""
+    # -- the flip ----------------------------------------------------------
+    def _flip_jittable(self, infer) -> bool:
+        """The reshard is ONE jitted program when the train and serve
+        meshes cover the same device set (out_shardings may then name a
+        different mesh over the same assignment); across disjoint sets the
+        transfer is ``device_put``'s job."""
+        return (set(d.id for d in self.mesh.devices.flat)
+                == set(d.id for d in infer.mesh.devices.flat))
+
+    def refresh_params(self) -> None:
+        """Flip train→serve: reshard the CURRENT training weights (LoRA
+        deltas fused as a pure function — the training tree is never
+        touched) onto the serving shardings in one program/``device_put``,
+        and invalidate the serving stack's prefix-cache content hashes.
+        Everything else on the serving side SURVIVES: arena allocation,
+        block pool, compiled programs, scheduler (zero HBM realloc, zero
+        recompiles — recompile-watchdog-asserted in tests/unit/
+        test_rlhf.py). The reference's train→eval flip, hybrid_engine
+        .py:353, minus the per-layer gather hooks."""
         infer = self._inference_engine()
+        if self._serving is not None:
+            # the idle guard + prefix invalidation run FIRST: a refused
+            # flip must leave the serving weights, the staleness cache and
+            # the prefix cache all untouched — resharding before the guard
+            # would hand in-flight requests new weights over old KV, and
+            # the already-bumped step marker would make the retried flip
+            # skip the cache invalidation entirely
+            self._serving.note_weights_updated()
         params = self._export_params()
-        infer.params = jax.tree.map(
-            lambda x, s: jax.device_put(x, s), params, infer.param_shardings)
+        obs = self._obs
+        with obs.span("rlhf/flip", step=self.global_steps):
+            if self._flip_program is None and self._flip_jittable(infer):
+                self._flip_program = jax.jit(
+                    lambda p: p, out_shardings=infer.param_shardings)
+                self._register_flip_audit()
+            if self._flip_program is not None:
+                # the program's output buffers are runtime-owned — no
+                # aliasing with the (donated) training tree by construction
+                infer.params = self._flip_program(params)
+            else:
+                from .checkpoint import _owned_copy
+
+                infer.params = jax.tree.map(
+                    lambda x, s: _owned_copy(jax.device_put(x, s)), params,
+                    infer.param_shardings)
         self._infer_params_step = self.global_steps
+
+    def refresh_inference_params(self) -> None:
+        """Back-compat alias for :meth:`refresh_params`."""
+        self.refresh_params()
+
+    # -- the serving rollout side ------------------------------------------
+    def serving_engine(self):
+        """The continuous-batching rollout engine over THIS engine's
+        weights — built once, surviving every flip (the arena parks
+        between rollout phases). Fresh weights are the caller's contract:
+        ``flip_to_serving()`` refreshes then returns it."""
+        if self._serving is None:
+            from ..config.config import ServingConfig
+            from ..serving.api import ServingEngine
+
+            scfg = self._serving_config or ServingConfig()
+            self._serving = ServingEngine(self._inference_engine(), scfg)
+            log_dist("hybrid engine: serving rollout side ready "
+                     f"(rows={scfg.max_seqs}, "
+                     f"blocks={scfg.pool_blocks()}x{scfg.block_size}, "
+                     f"spec={scfg.speculative.mode})")
+        return self._serving
+
+    def flip_to_serving(self):
+        """Enter the rollout phase: refresh the serving weights from the
+        current training state (a no-op when no train step happened since
+        the last flip) and return the ``ServingEngine``."""
+        serving = self.serving_engine()
+        if self._infer_params_step != self.global_steps:
+            self.refresh_params()
+        self.mark_step_boundary()
+        return serving
+
+    def flip_to_train(self) -> None:
+        """Leave the rollout phase: the serving engine must be drained
+        (its in-flight KV would go stale under the next update) and the
+        arena parks — fully allocated, programs warm — until the next
+        ``flip_to_serving()``. Nothing de-materialises; training state was
+        live all along."""
+        if self._serving is not None and self._serving.in_flight():
+            raise RuntimeError(
+                "flip_to_train with rollout requests in flight "
+                f"({self._serving.in_flight()}) — drain or cancel first")
+        self.mark_step_boundary()
+
+    def _register_flip_audit(self) -> None:
+        """Register the jitted reshard with tpuaudit as ``rlhf/flip``:
+        under ZeRO-3 the program IS the fsdp→serving all-gather, so its
+        collective census (and tpucost's bytes budget) is exactly the
+        flip's HBM/ICI cost."""
+        if self._flip_registered:
+            return
+        self._flip_registered = True
+        try:
+            from tools.tpuaudit.registry import (StaleEntryError,
+                                                 register_entry_point)
+        except ImportError:
+            return
+        try:
+            import weakref
+
+            wself = weakref.ref(self)
+            # the gather exists iff the source is param-sharded: ZeRO-3
+            # shards params over 'data'; stages <= 2 keep them replicated
+            # and tp/ep placements match the serving rules bit-for-bit
+            expected = (frozenset({"all-gather"})
+                        if self.zero_optimization_stage() >= 3
+                        else frozenset())
+
+            def build():
+                eng = wself()
+                if eng is None or eng._flip_program is None:
+                    raise StaleEntryError("rlhf/flip: engine gone")
+                params = eng._export_params()
+                sds = jax.tree.map(
+                    lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                                   sharding=x.sharding),
+                    params)
+                return eng._flip_program, (sds,), {}
+
+            register_entry_point(
+                "rlhf/flip", build=build, expected_collectives=expected,
+                mesh=self.mesh,
+                tags={"engine": "HybridEngine",
+                      "zero_stage": self.zero_optimization_stage()})
+        except Exception:   # registration must never take training down
+            logger.warning("tpuaudit rlhf/flip registration failed",
+                           exc_info=True)
 
     def train_batch(self, *args, **kwargs):
         if self._lora_fused:
@@ -174,6 +359,16 @@ class HybridEngine(TrainEngine):
     def save_checkpoint(self, *args, **kwargs):
         self._guard_fused_save("save_checkpoint")
         return super().save_checkpoint(*args, **kwargs)
+
+    def load_checkpoint(self, *args, **kwargs):
+        out = super().load_checkpoint(*args, **kwargs)
+        # a restore invalidates the flip's staleness cache UNCONDITIONALLY:
+        # after a rollback the restored global_steps can EQUAL the step the
+        # last (possibly poisoned) flip ran at, and the step-equality check
+        # would then skip the refresh and keep serving the pre-rollback
+        # weights (found by the NaN→rollback replay test)
+        self._infer_params_step = -1
+        return out
 
     def save_16bit_model(self, *args, **kwargs):
         self._guard_fused_save("save_16bit_model")

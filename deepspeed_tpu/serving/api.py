@@ -102,8 +102,18 @@ class ServingEngine:
         self._prefill = paged_kv.build_prefill_program(cfg, self._paged_impl)
         self._decode = paged_kv.build_decode_program(cfg, self._paged_impl)
         self._cow = paged_kv.build_cow_program()
+        # teacher-forced scoring over the same arena (the RLHF second
+        # serving pass — docs/rlhf.md); jit is lazy, so an engine that
+        # never scores pays nothing
+        self._score = paged_kv.build_score_program(cfg, self._paged_impl)
         self._cow_copies = 0
         self._published_cow = 0
+        # rollout accounting: prefill dispatches + real tokens they
+        # ingested — the fork/prefix reuse ratio's denominator-side
+        # evidence (a candidate group of n samples must cost ONE prefill)
+        self.prefill_chunks_run = 0
+        self.prefill_tokens_run = 0
+        self.weight_refreshes = 0
         # -- speculative decoding (off → the plain R×1 decode path) --
         from .speculative import make_drafter
 
@@ -428,6 +438,105 @@ class ServingEngine:
             self.sched.release_handoff(req)
             self._handles.pop(req.rid, None)
 
+    # -- weight flip (RLHF hybrid engine) ----------------------------------
+    def note_weights_updated(self) -> int:
+        """The wrapped engine's params were just refreshed in place (the
+        hybrid-engine train→serve flip). The arena ALLOCATION survives —
+        block pool, compiled prefill/decode/verify/cow/score programs and
+        scheduler state are all keyed on shapes, which a weight refresh
+        never changes — but cached KV CONTENT is a function of the params,
+        so every prefix-cache entry is invalidated (its content hash
+        describes bytes that no longer exist). Requires an idle engine:
+        in-flight requests hold KV computed under the OLD weights and
+        cannot be continued coherently. Returns the number of prefix-cache
+        entries dropped."""
+        with self._lock:
+            if self.sched.in_flight() or self._pending_fork_count():
+                raise RuntimeError(
+                    "weight flip with requests in flight "
+                    f"({self.sched.in_flight()} scheduled, "
+                    f"{self._pending_fork_count()} pending forks) — drain "
+                    "the engine before refresh (their KV was computed "
+                    "under the old weights)")
+            self.weight_refreshes += 1
+            dropped = 0
+            if self.prefix is not None:
+                dropped = self.prefix.clear()
+            obs = get_session()
+            if obs.enabled:
+                obs.registry.counter(
+                    "serving/weight_refreshes",
+                    help="hybrid-engine weight flips absorbed without "
+                         "arena realloc").inc()
+                if dropped:
+                    obs.registry.counter(
+                        "serving/prefix_invalidations",
+                        help="prefix-cache entries dropped by weight "
+                             "flips (stale content hashes)").inc(dropped)
+            return dropped
+
+    # -- teacher-forced scoring (the RLHF second serving pass) -------------
+    def score_logprobs(self, tokens, params: Optional[Any] = None
+                       ) -> np.ndarray:
+        """Per-position log-probabilities of a full sequence under
+        ``params`` (default: the engine's current weights): returns
+        ``logp`` of shape ``(len(tokens) - 1,)`` where ``logp[p]`` is the
+        model's log-probability of ``tokens[p + 1]`` given
+        ``tokens[:p + 1]``. Runs through the SAME paged arena in
+        prefill-chunk-sized pieces over scratch blocks allocated from the
+        pool (evicting unpinned prefix-cache entries under pressure, never
+        preempting) and freed before returning. Passing a resharded
+        frozen-reference tree as ``params`` reuses the one compiled score
+        program — the RLHF reference-logprob pass costs zero extra
+        compiles."""
+        tokens = np.asarray(tokens, np.int32).reshape(-1)
+        T = int(tokens.size)
+        if T < 2:
+            raise ValueError(f"score_logprobs needs >= 2 tokens, got {T}")
+        if T > self.config.max_model_len:
+            raise ValueError(
+                f"score_logprobs: sequence of {T} tokens exceeds "
+                f"serving.max_model_len={self.config.max_model_len}")
+        C = self.config.prefill_chunk
+        with self._lock:
+            need = paged_kv.blocks_for_tokens(T, self.config.block_size)
+            ids = self.sched._alloc_evicting_cache(need)
+            if ids is None:
+                raise RuntimeError(
+                    f"score_logprobs: cannot allocate {need} scratch "
+                    f"blocks ({self.alloc.blocks_free} free) — score after "
+                    "rollouts drain, or grow serving.num_blocks")
+            try:
+                bt = np.zeros((1, self.blocks_per_seq), np.int32)
+                bt[0, :need] = ids
+                if params is None:
+                    params = self.engine.params
+                out = np.zeros((T - 1,), np.float32)
+                obs = get_session()
+                with mesh_mod.ambient(self.engine.mesh):
+                    for start in range(0, T, C):
+                        n_valid = min(C, T - start)
+                        chunk = np.zeros((1, C), np.int32)
+                        chunk[0, :n_valid] = tokens[start:start + n_valid]
+                        # the target for position p is tokens[p + 1]; the
+                        # final sequence position has none
+                        nt = min(n_valid, T - 1 - start)
+                        tgt = np.zeros((1, C), np.int32)
+                        if nt > 0:
+                            tgt[0, :nt] = tokens[start + 1:start + 1 + nt]
+                        with obs.span("serving/score_chunk",
+                                      tokens=int(n_valid)):
+                            lp, self._arena = self._score(
+                                params, self._arena, bt, chunk, tgt,
+                                np.asarray(start, np.int32),
+                                np.asarray(n_valid, np.int32))
+                            lp = np.asarray(lp)   # fence: chunk really ran
+                        if nt > 0:
+                            out[start:start + nt] = lp[0, :nt]
+            finally:
+                self.alloc.free(ids)
+        return out
+
     # -- the iteration -----------------------------------------------------
     def step(self) -> bool:
         """One continuous-batching iteration; returns True when any request
@@ -554,6 +663,8 @@ class ServingEngine:
                     np.asarray(n_valid, np.int32),
                     temps, topks, topps, seeds, self._base_rng)
                 tok = np.asarray(tok)   # the fence: chunk really ran
+        self.prefill_chunks_run += 1
+        self.prefill_tokens_run += int(n_valid)
         req.prefill_pos += n_valid
         req.length = req.prefill_pos
         # newly completed full prompt blocks become shareable prefix cache
@@ -1204,8 +1315,32 @@ class ServingEngine:
                 expected_collectives=(), mesh=self.engine.mesh,
                 tags={"engine": "ServingEngine",
                       "block_size": self.config.block_size})
+            def build_score():
+                eng = wself()
+                if eng is None:
+                    raise StaleEntryError("serving/score_chunk: engine gone")
+                i32 = jnp.int32
+                args = (eng.engine._params_sds(), eng._arena_sds(),
+                        jax.ShapeDtypeStruct((1, MAXB), i32),
+                        jax.ShapeDtypeStruct((1, C), i32),
+                        jax.ShapeDtypeStruct((1, C), i32),
+                        jax.ShapeDtypeStruct((), i32),
+                        jax.ShapeDtypeStruct((), i32))
+                return eng._score, args, {}
+
+            # the RLHF teacher-forced scoring pass: prefill-shaped forward
+            # returning target logprobs instead of samples — same engine
+            # collectives, same arena donation
+            register_entry_point(
+                "serving/score_chunk", build=build_score,
+                donate_argnums=(1,), expected_collectives=expected,
+                mesh=self.engine.mesh,
+                tags={"engine": "ServingEngine", "chunk": C,
+                      "max_blocks": MAXB, "paged_impl": self._paged_impl,
+                      # one scoring chunk ingests C sequence tokens
+                      "tokens_per_step": C})
             names = ["serving/prefill_chunk", "serving/decode",
-                     "serving/cow_copy"]
+                     "serving/cow_copy", "serving/score_chunk"]
             if self._drafter is not None:
                 names += self._register_spec_audit_entries(
                     register_entry_point, StaleEntryError, wself, expected)

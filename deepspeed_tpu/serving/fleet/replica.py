@@ -57,6 +57,7 @@ def graft_programs(dst, src) -> None:
     dst._prefill = src._prefill
     dst._decode = src._decode
     dst._cow = src._cow
+    dst._score = src._score
     if dst._verify is not None and src._verify is not None:
         dst._verify = src._verify
 

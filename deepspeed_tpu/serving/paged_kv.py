@@ -51,9 +51,9 @@ __all__ = ["BlockAllocator", "BlockAllocatorError", "PrefixCache",
            "blocks_for_tokens", "assert_block_divisible", "init_paged_cache",
            "paged_cache_memory_bytes", "build_prefill_program",
            "build_decode_program", "build_verify_program",
-           "build_cow_program", "build_kv_export_program",
-           "build_kv_import_program", "sample_rows", "extend_block_list",
-           "truncate_block_list"]
+           "build_score_program", "build_cow_program",
+           "build_kv_export_program", "build_kv_import_program",
+           "sample_rows", "extend_block_list", "truncate_block_list"]
 
 
 class BlockAllocatorError(RuntimeError):
@@ -202,6 +202,7 @@ class PrefixCache:
         self._entries: "OrderedDict[bytes, int]" = OrderedDict()
         self.inserts = 0
         self.evictions = 0
+        self.invalidations = 0   # entries dropped by weight-flip clear()
 
     @property
     def cached_blocks(self) -> int:
@@ -280,6 +281,22 @@ class PrefixCache:
         for i in range(block_index + 1):
             key = self.chain_key(prompt, key, i)
         return self.insert_key(key, block_id)
+
+    def clear(self) -> int:
+        """Drop EVERY entry, pinned or not, releasing the cache's one pin
+        reference per block — the weight-flip invalidation rule
+        (``docs/rlhf.md``): cached KV bytes are a pure function of
+        (tokens, positions, params), so a parameter refresh makes every
+        content hash stale at once. Blocks shared with a live request stay
+        resident for that request (``free`` drops one reference); callers
+        flip with the engine idle, so normally the whole cache returns to
+        the free list. Returns the number of entries dropped."""
+        n = len(self._entries)
+        for bid in self._entries.values():
+            self.alloc.free([bid])
+        self._entries.clear()
+        self.invalidations += n
+        return n
 
     def evict(self, need: int) -> int:
         """Drop up to ``need`` UNPINNED entries (blocks only the cache
@@ -370,8 +387,13 @@ def build_prefill_program(cfg, paged_impl: str = "auto"):
     def prefill_chunk(params, cache, block_table, chunk, start, n_valid,
                       temperature, top_k, top_p, seeds, base_key):
         C = chunk.shape[1]
-        pos = (start + jnp.arange(C, dtype=jnp.int32))[None]
-        write_mask = (jnp.arange(C, dtype=jnp.int32) < n_valid)[None]
+        offs = jnp.arange(C, dtype=jnp.int32)
+        write_mask = (offs < n_valid)[None]
+        # pad queries ride position -1 (the inactive convention): a pad
+        # position past the written range would otherwise widen the read
+        # path's residency window onto scratch/recycled pages, whose
+        # nonfinite residue must never touch live rows
+        pos = jnp.where(write_mask, (start + offs)[None], -1)
         logits, cache, _ = model_forward(params, chunk, cfg, cache=cache,
                                          positions=pos,
                                          block_table=block_table,
@@ -454,8 +476,12 @@ def build_verify_program(cfg, num_tokens: int, paged_impl: str = "auto"):
                temperature, top_k, top_p, seeds, steps, base_key):
         R, S = tokens.shape
         offs = jnp.arange(S, dtype=jnp.int32)
-        pos = lengths[:, None] + offs[None]
         write_mask = offs[None] < n_valid[:, None]
+        # invalid slots (beyond the row's proposal count, and every slot
+        # of an inactive row) ride position -1 — see prefill_chunk: pad
+        # positions past the written range would widen the residency
+        # window onto scratch/recycled pages
+        pos = jnp.where(write_mask, lengths[:, None] + offs[None], -1)
         logits, cache, _ = model_forward(params, tokens, cfg, cache=cache,
                                          positions=pos,
                                          block_table=block_table,
@@ -474,6 +500,54 @@ def build_verify_program(cfg, num_tokens: int, paged_impl: str = "auto"):
         raise ValueError(f"build_verify_program(num_tokens={num_tokens}): "
                          "need the pending token plus >= 1 draft slot")
     return jax.jit(verify, donate_argnums=(1,))
+
+
+def build_score_program(cfg, paged_impl: str = "auto"):
+    """Jitted teacher-forced scoring chunk over the paged arena — the RLHF
+    second serving pass (``docs/rlhf.md``): instead of sampling, it returns
+    the log-probability the model assigns to given TARGET tokens. Same
+    chunked discipline and block-table shapes as the prefill program, so it
+    rides the SAME arena and pool (scratch blocks allocated per scored
+    sequence, freed after) with zero extra HBM and one compiled program per
+    chunk width.
+
+    Args (shapes static per (C, max_blocks) pair):
+      params, cache          — scoring params / paged arena (arena DONATED).
+                               ``params`` is an argument, not a capture, so
+                               the policy pass (π_old logprobs) and the
+                               frozen-reference pass share ONE compiled
+                               program
+      block_table (1, MAXB)  — the scoring scratch blocks
+      chunk (1, C) int32     — sequence tokens, zero-padded past ``n_valid``
+      targets (1, C) int32   — targets[0, j] is the token whose logprob
+                               position ``start + j`` should yield (the
+                               next sequence token); pad entries score
+                               garbage the host never reads
+      start/n_valid () int32 — chunk position / real token count
+
+    Returns (logp (1, C) f32, cache): per-position log softmax mass on the
+    target token (``transformer.gather_target_logprobs`` — the TP-safe
+    one-hot contraction).
+    """
+    from ..models.transformer import forward as model_forward
+    from ..models.transformer import gather_target_logprobs
+
+    def score_chunk(params, cache, block_table, chunk, targets, start,
+                    n_valid):
+        C = chunk.shape[1]
+        offs = jnp.arange(C, dtype=jnp.int32)
+        write_mask = (offs < n_valid)[None]
+        # pad queries at position -1 — see prefill_chunk
+        pos = jnp.where(write_mask, (start + offs)[None], -1)
+        logits, cache, _ = model_forward(params, chunk, cfg, cache=cache,
+                                         positions=pos,
+                                         block_table=block_table,
+                                         paged_write_mask=write_mask,
+                                         paged_impl=paged_impl,
+                                         paged_chunk=True)
+        return gather_target_logprobs(logits, targets), cache
+
+    return jax.jit(score_chunk, donate_argnums=(1,))
 
 
 def build_kv_export_program():

@@ -2,8 +2,9 @@
 # The unified static-analysis driver: lint (source) + audit (program
 # semantics) + cost (program cost) + parity (serving kernel-path tests,
 # tier-1 marker set) + chaos (training fault-injection recovery smoke) +
-# chaos_serve (serving-fleet self-healing smoke) in one run, one exit
-# code for CI.
+# chaos_serve (serving-fleet self-healing smoke) + rlhf (hybrid-engine-v2
+# post-training smoke: flip-no-recompile + replay-bit-exact) in one run,
+# one exit code for CI.
 #
 # The three analyzers share the same gate semantics (committed baseline,
 # stale-entry rot detection, the render_report tail in
@@ -20,7 +21,7 @@ cd "$(dirname "$0")/.."
 
 selected=("$@")
 fail=0
-for gate in lint audit cost parity chaos chaos_serve; do
+for gate in lint audit cost parity chaos chaos_serve rlhf; do
     if [ "${#selected[@]}" -gt 0 ]; then
         case " ${selected[*]} " in
             *" $gate "*) ;;

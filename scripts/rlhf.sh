@@ -1,0 +1,31 @@
+#!/usr/bin/env bash
+# RLHF smoke gate: the hybrid-engine-v2 post-training loop cannot rot
+# silently (docs/rlhf.md).
+#
+# Drives a 2-iteration GRPO run on a tiny model through the full
+# generate → score → train → flip loop and asserts the ISSUE-13
+# acceptance bar:
+#   * the weight flip triggers ZERO serving-program recompiles and ZERO
+#     arena reallocation (recompile-watchdog counter + block-pool
+#     identity);
+#   * a candidate group of n=4 costs ONE prefill (prefill-chunk dispatch
+#     count) and every forked sibling is bit-identical to a solo submit
+#     of the same seed;
+#   * replay(manifest) reproduces every rollout token stream bit-exactly
+#     with speculation toggled OPPOSITE to the recording run — including
+#     under forced preemption (pool too small) and after a NaN→rollback
+#     recovery mid-iteration.
+#
+# CPU-only and deterministic; part of scripts/check.sh (7th gate).
+#
+# Usage: scripts/rlhf.sh [extra pytest args...]
+set -euo pipefail
+
+cd "$(dirname "$0")/.."
+
+# the WHOLE file, slow-marked replay suites included (tier-1 runs only
+# the not-slow subset to protect its time budget; this gate is the
+# comprehensive pass)
+JAX_PLATFORMS=cpu python -m pytest \
+    tests/unit/test_rlhf.py \
+    -q -p no:cacheprovider "$@"

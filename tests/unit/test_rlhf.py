@@ -1,0 +1,558 @@
+"""RLHF subsystem tests — hybrid engine v2 + rollouts through the serving
+stack (the ISSUE-13 acceptance bar).
+
+Coverage map:
+  * the tier-1 smoke: a 2-iteration GRPO run on a tiny model where
+    (a) the weight flip triggers ZERO serving-program recompiles and ZERO
+    arena reallocation (recompile-watchdog counter + block-pool identity),
+    (b) a candidate group of n=4 costs ONE prefill (prefill-chunk dispatch
+    count) with siblings bit-identical to solo submits, and
+    (c) ``replay(manifest)`` reproduces every rollout stream bit-exactly
+    with speculation toggled OPPOSITE to the recording run;
+  * deterministic replay under forced preemption (pool too small) and
+    after a NaN→rollback recovery mid-iteration (slow-marked; the
+    ``scripts/rlhf.sh`` gate runs them every CI pass);
+  * the scoring pass (``serving/score_chunk``) bit-matches a dense
+    forward oracle;
+  * seed derivation, advantage math, manifest JSON roundtrip, the flip's
+    prefix-cache invalidation rule, and the ``== rlhf ==`` report section.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.config.base import ConfigError
+from deepspeed_tpu.config.config import ObservabilityConfig, RLHFConfig
+from deepspeed_tpu.observability import (configure_observability,
+                                         get_registry, reset_session)
+from deepspeed_tpu.rlhf import (ReplayMismatch, RLHFTrainer,
+                                RolloutCollector, RolloutManifest,
+                                group_advantages, replay, rollout_seed,
+                                whitened_advantages)
+
+SERVING = dict(block_size=8, max_seqs=8, max_model_len=48,
+               prefill_chunk=8, max_queue=64,
+               speculative={"mode": "ngram", "num_draft_tokens": 3})
+
+
+def build_engine(serving=None, seed=1234, **cfg_extra):
+    return deepspeed_tpu.init_rlhf(
+        "tiny",
+        config={"train_micro_batch_size_per_gpu": 8,
+                "steps_per_print": 10 ** 9, "seed": seed,
+                "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+                "rlhf": {"algo": "grpo", "group_n": 4, "temperature": 0.7,
+                         "max_new_tokens": 8},
+                **cfg_extra},
+        serving_config=dict(serving if serving is not None else SERVING))
+
+
+def mk_prompts(n=2, length=16, seed=0):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(0, 250, (length,)).astype(np.int32)
+            for _ in range(n)]
+
+
+def reward_fn(_prompt, tokens):
+    return float(len(set(tokens)))
+
+
+@pytest.fixture
+def obs_session(tmp_path):
+    reset_session()
+    sess = configure_observability(ObservabilityConfig(
+        enabled=True, output_dir=str(tmp_path / "obs"),
+        flight_recorder=False))
+    yield sess
+    reset_session()
+
+
+# ---------------------------------------------------------------------------
+# host-side units (milliseconds)
+# ---------------------------------------------------------------------------
+
+
+class TestSeedDerivation:
+    def test_group_seeds_are_consecutive(self):
+        # submit(n=...) gives sibling i seed base+i — the derivation must
+        # agree, or forked groups and solo submits would diverge
+        base = rollout_seed(3, 7)
+        for i in range(8):
+            assert rollout_seed(3, 7, i) == base + i
+
+    def test_unique_across_prompts_and_iterations(self):
+        seen = set()
+        for it in range(4):
+            for p in range(16):
+                for s in range(4):
+                    seen.add(rollout_seed(it, p, s))
+        assert len(seen) == 4 * 16 * 4
+
+    def test_sample_index_bound(self):
+        with pytest.raises(ValueError):
+            rollout_seed(0, 0, 4096)
+
+
+class TestAdvantages:
+    def test_grpo_group_normalized(self):
+        adv = group_advantages([[1.0, 2.0, 3.0, 6.0], [5.0, 5.0]])
+        a = np.asarray(adv[0])
+        assert abs(a.mean()) < 1e-9
+        assert a.std() == pytest.approx(1.0, rel=1e-4)
+        assert adv[1] == [0.0, 0.0]          # zero-variance group → zeros
+
+    def test_grpo_ranks_preserved(self):
+        adv = group_advantages([[0.0, 10.0, 5.0]])[0]
+        assert adv[1] > adv[2] > adv[0]
+
+    def test_ppo_whitened_across_batch(self):
+        adv = whitened_advantages([[1.0, 2.0], [3.0, 6.0]])
+        flat = np.asarray([x for g in adv for x in g])
+        assert abs(flat.mean()) < 1e-9
+        assert flat.std() == pytest.approx(1.0, rel=1e-4)
+
+    def test_ppo_unwhitened_passthrough(self):
+        adv = whitened_advantages([[1.0, 2.0]], whiten=False)
+        assert adv == [[1.0, 2.0]]
+
+
+class TestLoss:
+    def test_kl_pad_positions_cannot_poison_loss(self):
+        """Masked positions carry fake ref_logp; an absurd value there
+        must neither change nor NaN the objective — exp(ref − logp) at a
+        pad would otherwise overflow and inf × mask(0) = NaN (the same
+        0×nonfinite class the paged read paths guard against)."""
+        import jax
+
+        from deepspeed_tpu.models import create_model
+        from deepspeed_tpu.rlhf import rlhf_model
+
+        model = rlhf_model(create_model("tiny", dtype=jnp.float32),
+                           RLHFConfig(kl_coef=0.1))
+        params = model.init(jax.random.PRNGKey(0))
+        B, T = 2, 16
+        rng = np.random.RandomState(0)
+        ids = rng.randint(0, 250, (B, T)).astype(np.int32)
+        tgt = np.concatenate([ids[:, 1:], np.zeros((B, 1), np.int32)], 1)
+        mask = np.zeros((B, T), np.float32)
+        mask[:, 4:10] = 1.0
+        base = {"input_ids": ids, "targets": tgt, "loss_mask": mask,
+                "advantages": mask * 0.5,
+                "old_logp": np.full((B, T), -5.0, np.float32)}
+        a = float(model.loss_fn(
+            params, {**base, "ref_logp": np.zeros((B, T), np.float32)}))
+        ref_absurd = np.where(mask > 0, 0.0, 1000.0).astype(np.float32)
+        b = float(model.loss_fn(params, {**base, "ref_logp": ref_absurd}))
+        assert np.isfinite(a)
+        assert a == b
+
+
+class TestConfig:
+    def test_validates(self):
+        RLHFConfig().validate()
+        with pytest.raises(ConfigError):
+            RLHFConfig(algo="dpo").validate()
+        with pytest.raises(ConfigError):
+            RLHFConfig(algo="grpo", group_n=1).validate()
+        with pytest.raises(ConfigError):
+            RLHFConfig(clip_ratio=0.0).validate()
+        RLHFConfig(algo="ppo", group_n=1).validate()
+
+    def test_nested_in_root_config(self):
+        cfg = deepspeed_tpu.load_config(
+            {"train_micro_batch_size_per_gpu": 1,
+             "rlhf": {"algo": "ppo", "group_n": 2, "kl_coef": 0.0}})
+        assert cfg.rlhf.algo == "ppo" and cfg.rlhf.kl_coef == 0.0
+
+
+class TestManifest:
+    def _manifest(self):
+        return RolloutManifest(
+            iteration=2, group_n=2, engine_seed=0, temperature=0.7,
+            top_k=0, top_p=1.0, max_new_tokens=4, eos_token_id=None,
+            prompts=[[1, 2, 3]], seeds=[[10, 11]],
+            streams=[[[4, 5, 6, 7], [8, 9, 1, 2]]], spec_mode="ngram")
+
+    def test_json_roundtrip(self, tmp_path):
+        m = self._manifest()
+        path = str(tmp_path / "m.json")
+        m.save(path)
+        m2 = RolloutManifest.load(path)
+        assert m2 == m
+
+    def test_engine_seed_mismatch_raises(self):
+        class FakeCfg:
+            seed = 99
+
+        class FakeEngine:
+            config = FakeCfg()
+
+        with pytest.raises(ReplayMismatch, match="engine seed"):
+            replay(self._manifest(), FakeEngine())
+
+
+# ---------------------------------------------------------------------------
+# the tier-1 acceptance smoke
+# ---------------------------------------------------------------------------
+
+
+class TestSmoke:
+    def test_two_iteration_grpo_smoke(self, obs_session, tmp_path):
+        """The ISSUE-13 bar, one run: flip-no-recompile + no-realloc,
+        group-of-4 = one prefill with fork==solo bit-identity, and
+        manifest replay bit-exact with speculation toggled opposite."""
+        engine = build_engine()
+        trainer = RLHFTrainer(engine, lambda it: mk_prompts(2, 16, it),
+                              reward_fn)
+        serving = engine.serving_engine()
+        alloc_id = id(serving.alloc)
+        arena_shape = {k: v.shape for k, v in serving._arena.items()}
+
+        losses = trainer.train(2)
+        assert len(losses) == 2 and all(np.isfinite(losses))
+        assert len(trainer.manifests) == 2
+
+        # (a) steady-state flip: zero serving recompiles, zero realloc.
+        # Train once more so the flip is real (stale params), then flip +
+        # roll out again: every compile counter must hold still.
+        batch = trainer.data_fn(engine.global_steps)
+        engine.train_batch(batch=batch)
+        compiles = get_registry().counter("xla/compiles")
+        before = {w: compiles.value(where=w)
+                  for w in ("serving/prefill_chunk", "serving/decode",
+                            "serving/verify", "serving/score_chunk",
+                            "rlhf/flip")}
+        extra = trainer.data_fn(engine.global_steps + 1)  # flip + rollout
+        for where, val in before.items():
+            assert compiles.value(where=where) == val, where
+        assert id(serving.alloc) == alloc_id
+        assert {k: v.shape for k, v in serving._arena.items()} \
+            == arena_shape
+        assert serving.alloc.capacity == \
+            serving.config.pool_blocks()   # pool never re-provisioned
+        assert extra["input_ids"].shape == batch["input_ids"].shape
+
+        # (b) one prefill per candidate group + fork == solo bit-identity
+        prompt = mk_prompts(1, 16, 99)[0]
+        pre = serving.prefill_chunks_run
+        hs = serving.submit(prompt, max_new_tokens=8, temperature=0.7,
+                            seed=rollout_seed(50, 0), n=4)
+        group_streams = [list(h.result()) for h in hs]
+        chunks_for_group = serving.prefill_chunks_run - pre
+        assert chunks_for_group == 2   # 16 tokens / 8-chunk — ONCE, not ×4
+        solo_streams = []
+        for i in range(4):
+            h = serving.submit(prompt, max_new_tokens=8, temperature=0.7,
+                               seed=rollout_seed(50, 0, i))
+            solo_streams.append(list(h.result()))
+        assert group_streams == solo_streams
+
+        # (c) replay with speculation toggled OPPOSITE (recorded with the
+        # ngram drafter → replay plain-decode) — bit-exact. The weights
+        # moved since iteration 0/1, so replay the LAST manifest, whose
+        # weights are still current.
+        step, manifest = trainer.manifests[-1]
+        assert manifest.spec_mode == "ngram"
+        serving.spec_suspended = True
+        try:
+            streams = replay(manifest, serving, verify=True)
+        finally:
+            serving.spec_suspended = False
+        assert streams == manifest.streams
+        assert get_registry().counter(
+            "rlhf/replay_verifications").value() >= 1
+
+    def test_report_section(self, obs_session, tmp_path):
+        engine = build_engine()
+        trainer = RLHFTrainer(engine, lambda it: mk_prompts(2, 16, it),
+                              reward_fn)
+        trainer.train(1)
+        mpath = obs_session.dump_metrics(str(tmp_path / "metrics.jsonl"))
+        from deepspeed_tpu.observability.report import report
+
+        text = report([mpath])
+        assert "== rlhf ==" in text
+        # the registry is a process singleton — counts are cumulative
+        # across the test session, so assert presence, not magnitude
+        assert "iterations:" in text
+        assert "rollout" in text and "flip" in text
+        assert "weight flips" in text
+
+
+# ---------------------------------------------------------------------------
+# flip semantics
+# ---------------------------------------------------------------------------
+
+
+class TestFlip:
+    def test_flip_invalidates_prefix_cache(self, obs_session):
+        engine = build_engine()
+        serving = engine.flip_to_serving()
+        prompt = mk_prompts(1, 16, 3)[0]
+        serving.submit(prompt, max_new_tokens=2).result()
+        assert serving.prefix.cached_blocks > 0
+        pre_free = serving.alloc.blocks_free
+        engine.train_batch(batch=engine_batch(engine))
+        engine.refresh_params()
+        # stale content hashes dropped, pinned blocks back in the pool
+        assert serving.prefix.cached_blocks == 0
+        assert serving.alloc.blocks_free > pre_free
+        assert serving.alloc.blocks_in_use == 0
+
+    def test_flip_with_inflight_requests_raises(self, obs_session):
+        engine = build_engine()
+        serving = engine.flip_to_serving()
+        h = serving.submit(mk_prompts(1, 16, 4)[0], max_new_tokens=4)
+        serving.step()   # admitted, mid-prefill
+        engine.train_batch(batch=engine_batch(engine))
+        with pytest.raises(RuntimeError, match="in flight"):
+            engine.refresh_params()
+        h.result()       # drain; now the flip goes through
+        engine.refresh_params()
+
+    def test_rollouts_immune_to_nonfinite_arena_residue(self):
+        """Serving output must be a pure function of (weights, seeds,
+        requests) — NEVER of leftover arena bytes. KV written under
+        briefly-poisoned params (the NaN→rollback scenario) leaves
+        nonfinite residue in recycled/scratch blocks; a 0 × NaN leak in
+        any read path would let it corrupt later, healthy requests (found
+        by the rollback replay test: masked softmax columns multiplied
+        NaN v values, and pad queries widened the residency window)."""
+        import jax.numpy as jnp
+
+        from deepspeed_tpu.rlhf import RolloutCollector
+
+        engine = build_engine()
+        serving = engine.flip_to_serving()
+        collector = RolloutCollector(serving, group_n=2, temperature=0.7,
+                                     max_new_tokens=8)
+        prompts = mk_prompts(2, 16, 7)
+        _, before = collector.collect(prompts, 0)
+        # worst-case residue: every arena byte nonfinite
+        serving._arena = {k: jnp.full_like(v, jnp.nan)
+                          for k, v in serving._arena.items()}
+        serving.note_weights_updated()
+        _, after = collector.collect(prompts, 0)   # verify path
+        assert after.streams == before.streams
+        serving.spec_suspended = True              # plain decode path
+        _, plain = collector.collect(prompts, 0)
+        serving.spec_suspended = False
+        assert plain.streams == before.streams
+        seq = np.concatenate([prompts[0], np.asarray(before.streams[0][0])])
+        assert np.isfinite(serving.score_logprobs(seq)).all()
+
+    def test_initial_inference_params_survive_donating_train_step(self):
+        """CPU device_put of live train params may alias their buffers
+        zero-copy; the donating train step then mutates the inference tree
+        in place (the PR-9 resume-corruption class at the hybrid seam) —
+        the engine must hand the inference side OWNED buffers."""
+        import jax
+
+        engine = build_engine()
+        infer = engine._inference_engine()
+        before = jax.tree.map(lambda x: np.asarray(x).copy(), infer.params)
+        engine.train_batch(batch=engine_batch(engine))   # donates buffers
+        for b, a in zip(jax.tree.leaves(before),
+                        jax.tree.leaves(infer.params)):
+            np.testing.assert_array_equal(b, np.asarray(a))
+
+    def test_flip_to_train_requires_drained_engine(self, obs_session):
+        engine = build_engine()
+        serving = engine.flip_to_serving()
+        h = serving.submit(mk_prompts(1, 16, 5)[0], max_new_tokens=4)
+        with pytest.raises(RuntimeError, match="in flight"):
+            engine.flip_to_train()
+        h.result()
+        engine.flip_to_train()
+
+
+def engine_batch(engine, seed=0):
+    import jax
+
+    gas = engine.gradient_accumulation_steps()
+    gb = engine.train_batch_size() // gas
+    T = 48
+    rng = np.random.RandomState(seed)
+    ids = rng.randint(0, 250, (gas, gb, T)).astype(np.int32)
+    mask = np.ones((gas, gb, T), np.float32)
+    tgt = np.concatenate([ids[:, :, 1:], np.zeros((gas, gb, 1), np.int32)],
+                         axis=2)
+    return {"input_ids": ids, "targets": tgt, "loss_mask": mask,
+            "advantages": rng.randn(gas, gb, T).astype(np.float32) * 0.1,
+            "old_logp": np.full((gas, gb, T), -5.0, np.float32),
+            "ref_logp": np.full((gas, gb, T), -5.0, np.float32)}
+
+
+# ---------------------------------------------------------------------------
+# scoring parity
+# ---------------------------------------------------------------------------
+
+
+class TestScoring:
+    def test_score_logprobs_matches_dense_oracle(self):
+        import jax
+
+        engine = build_engine()
+        serving = engine.flip_to_serving()
+        infer = engine._inference_engine()
+        toks = np.asarray(mk_prompts(1, 33, 8)[0])
+        lp = serving.score_logprobs(toks)
+        logits, _ = infer.model.apply(
+            infer.params, {"input_ids": jnp.asarray(toks[None], jnp.int32)})
+        ref = np.asarray(
+            jax.nn.log_softmax(np.asarray(logits, np.float32), axis=-1))[0]
+        want = np.array([ref[p, toks[p + 1]] for p in range(toks.size - 1)])
+        np.testing.assert_allclose(lp, want, atol=2e-4)
+        assert serving.alloc.blocks_in_use == 0   # scratch blocks freed
+
+    def test_reference_params_share_the_program(self, obs_session):
+        """Scoring with a different params tree (the frozen reference)
+        must reuse the one compiled score program — params are an
+        argument, not a capture."""
+        engine = build_engine()
+        serving = engine.flip_to_serving()
+        ref_params = engine._inference_engine().params   # hold pre-update
+        toks = np.asarray(mk_prompts(1, 17, 9)[0])
+        serving.score_logprobs(toks)                     # compiles
+        engine.train_batch(batch=engine_batch(engine))
+        engine.refresh_params()
+        compiles = get_registry().counter("xla/compiles")
+        before = compiles.value(where="serving/score_chunk")
+        a = serving.score_logprobs(toks)                    # new policy
+        b = serving.score_logprobs(toks, params=ref_params)  # frozen ref
+        assert compiles.value(where="serving/score_chunk") == before
+        assert not np.allclose(a, b)   # the reference really is frozen
+
+
+# ---------------------------------------------------------------------------
+# deterministic replay, the hard cases (scripts/rlhf.sh runs these every
+# CI pass; slow-marked to protect the tier-1 wall budget)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+class TestReplayUnderPressure:
+    def test_replay_bit_exact_under_forced_preemption(self):
+        """A pool far too small for the load forces preemption/recompute
+        mid-rollout; the recorded streams must STILL replay bit-exactly —
+        on a comfortable pool AND on the starved one."""
+        starved = dict(SERVING, num_blocks=8, max_seqs=4)  # 8 blocks vs
+        #   4 rows × 3 blocks each (24-token sequences) — guaranteed
+        #   eviction churn
+        engine = build_engine(serving=starved)
+        serving = engine.flip_to_serving()
+        collector = RolloutCollector(serving, group_n=2, temperature=0.7,
+                                     max_new_tokens=8)
+        prompts = mk_prompts(3, 16, 11)
+        batch, manifest = collector.collect(prompts, 0)
+        assert serving.sched.preemption_count > 0   # pressure was real
+        # replay on the SAME starved engine, speculation toggled off
+        serving.spec_suspended = True
+        streams = replay(manifest, serving, verify=True)
+        assert streams == manifest.streams
+        serving.spec_suspended = False
+        # and on a fresh, comfortable engine with the same weights+seed:
+        # preemption scheduling must leave zero fingerprint on tokens
+        roomy = build_engine()   # same config seed → same init weights
+        s2 = roomy.flip_to_serving()
+        streams2 = replay(manifest, s2, verify=True)
+        assert streams2 == manifest.streams
+
+    def test_spec_recorded_replayed_plain_and_back(self):
+        """Record WITHOUT speculation, replay WITH the drafter — the
+        opposite toggle direction from the smoke."""
+        engine = build_engine()
+        serving = engine.flip_to_serving()
+        serving.spec_suspended = True
+        collector = RolloutCollector(serving, group_n=2, temperature=0.7,
+                                     max_new_tokens=8)
+        batch, manifest = collector.collect(mk_prompts(2, 16, 12), 0)
+        assert manifest.spec_mode == "off"
+        serving.spec_suspended = False
+        streams = replay(manifest, serving, verify=True)
+        assert streams == manifest.streams
+
+
+@pytest.mark.slow
+class TestRollbackReplay:
+    def test_nan_rollback_replays_iteration_rollouts(self, tmp_path):
+        """The resilience bar: a nan_params fault poisons iteration 1; the
+        numerics sentinel trips, the TrainingSession rolls back to the
+        last verified checkpoint, and data_fn(1) re-runs — rollouts,
+        scoring and the step replay deterministically. The recovered
+        iteration's manifest must then replay BIT-EXACTLY from a fresh
+        engine restored from the same checkpoint the rollback used, with
+        speculation toggled opposite — the manifest outlives the
+        process."""
+        from deepspeed_tpu.observability.faultinject import (Fault,
+                                                             FaultInjector)
+
+        reset_session()
+        try:
+            engine = build_engine(
+                observability={"enabled": True,
+                               "output_dir": str(tmp_path / "obs"),
+                               "flight_recorder": False,
+                               "numerics_sentinel": True,
+                               "numerics_action": "abort",
+                               "numerics_check_steps": 1},
+                resilience={"checkpoint_every_steps": 1,
+                            "on_numerics": "rollback", "max_rollbacks": 2})
+            trainer = RLHFTrainer(engine,
+                                  lambda it: mk_prompts(2, 16, 1000 + it),
+                                  reward_fn)
+            inj = FaultInjector(
+                plan=[Fault(kind="nan_params", step=1, rank=0)],
+                rank=0, restart=0)
+            out = trainer.run(2, save_dir=str(tmp_path / "ck"),
+                              injector=inj)
+            assert out["completed"] and out["rollbacks"] == 1
+            assert out["recoveries"][0]["kind"] == "numerics"
+            assert all(np.isfinite(trainer.losses))
+            # iteration 1 collected twice: poisoned attempt + clean replay
+            steps = [s for s, _ in trainer.manifests]
+            assert steps == [0, 1, 1]
+            clean = trainer.manifests[-1][1]
+            poisoned = trainer.manifests[-2][1]
+            # the rollback really re-generated (poisoned streams differ)
+            assert clean.streams != poisoned.streams
+        finally:
+            reset_session()
+        # the replay contract across process/engine boundaries: a FRESH
+        # engine restored from the rollback's checkpoint (the weights the
+        # recovered iteration rolled out from) reproduces its streams
+        # bit-exactly, speculation toggled OPPOSITE to the recording run
+        engine2 = build_engine()
+        engine2.load_checkpoint(str(tmp_path / "ck"), tag="global_step1",
+                                verify=True)
+        serving2 = engine2.flip_to_serving()
+        assert clean.spec_mode == "ngram"
+        serving2.spec_suspended = True
+        streams = replay(clean, serving2, verify=True)
+        assert streams == clean.streams
+
+
+@pytest.mark.slow
+class TestTrainerAlgos:
+    def test_ppo_arm_trains(self):
+        engine = build_engine(
+            rlhf={"algo": "ppo", "group_n": 2, "temperature": 0.7,
+                  "max_new_tokens": 8, "kl_coef": 0.0})
+        trainer = RLHFTrainer(engine, lambda it: mk_prompts(4, 16, it),
+                              reward_fn)
+        losses = trainer.train(2)
+        assert all(np.isfinite(losses))
+        # kl_coef=0 skips the reference pass entirely
+        assert trainer._ref_params is None
+
+    def test_gas_divisibility_guard(self):
+        engine = build_engine(gradient_accumulation_steps=3,
+                              train_micro_batch_size_per_gpu=0,
+                              train_batch_size=24)
+        trainer = RLHFTrainer(engine, lambda it: mk_prompts(2, 16, it),
+                              reward_fn)   # 2 prompts × 4 = 8 samples, gas=3
+        with pytest.raises(ValueError, match="divide"):
+            trainer.data_fn(0)

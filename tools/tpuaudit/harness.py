@@ -168,6 +168,40 @@ def run_section_serving(section: Dict[str, Any]) -> List[str]:
     return names
 
 
+def run_section_rlhf(section: Dict[str, Any]) -> List[str]:
+    """Build a ``HybridEngine`` on the training mesh and trigger one flip,
+    registering the ``rlhf/flip`` resharding program (under ZeRO-3 the
+    program IS the fsdp→serving all-gather, so the audit's collective
+    census and tpucost's bytes budget are exactly the flip's cost). The
+    rollout-side device programs (``serving/score_chunk`` etc.) register
+    through the ``serving`` section — one shape set, no duplicates."""
+    from deepspeed_tpu.config.config import load_config
+    from deepspeed_tpu.parallel import mesh as mesh_mod
+    from deepspeed_tpu.runtime.hybrid_engine import HybridEngine
+
+    model = _build_model(section.get("model", {"type": "preset",
+                                               "name": "tiny"}))
+    cfg = dict(section.get("config") or {})
+    cfg.setdefault("train_micro_batch_size_per_gpu", 2)
+    cfg.setdefault("optimizer", {"type": "adamw", "params": {"lr": 1e-3}})
+    cfg.setdefault("steps_per_print", 10 ** 9)
+    # engine construction replaces the PROCESS-global ambient mesh, which
+    # the pipeline section's lazily-synthesized entries still need at
+    # trace time (their shard_map axes come from it) — restore it after
+    prev_mesh = mesh_mod.get_mesh()
+    try:
+        engine = HybridEngine(
+            model=model, config=load_config(cfg),
+            max_out_tokens=int(section.get("max_out_tokens", 64)),
+            inference_mesh="train")
+        _KEEPALIVE.append(engine)
+        engine.refresh_params()   # builds + registers the jitted flip
+    finally:
+        if prev_mesh is not None:
+            mesh_mod.set_mesh(prev_mesh)
+    return ["rlhf/flip"] if engine._flip_program is not None else []
+
+
 def build_from_config(config: Dict[str, Any]) -> List[str]:
     """Build every engine the config names; returns the registered entry
     names (the registry keeps the entries for the CLI to audit)."""
@@ -179,6 +213,8 @@ def build_from_config(config: Dict[str, Any]) -> List[str]:
         registered += run_section_inference(config["inference"])
     if "serving" in config:
         registered += run_section_serving(config["serving"])
+    if "rlhf" in config:
+        registered += run_section_rlhf(config["rlhf"])
     return registered
 
 
