@@ -267,7 +267,34 @@ def _configure_bench_obs():
     configure_observability(ObservabilityConfig(
         enabled=True,
         output_dir=os.environ.get("BENCH_OBS_DIR",
-                                  "bench_results/obs_serve")))
+                                  "bench_results/obs_serve"),
+        # request traces (BENCH_TRACE=0 opts out): head-sample everything —
+        # the arm dumps Chrome timelines for its top-3 TTFT outliers
+        request_tracing=os.environ.get("BENCH_TRACE", "1") == "1",
+        # per-iteration serving wall-time buckets; the arm records carry
+        # the bucket shares and the gauges land in the metrics JSONL
+        serve_goodput=True))
+
+
+def _arm_observability_stats(stats, tag, accts):
+    """Fold the observability arm outputs into one arm's stats dict: the
+    serve_goodput bucket shares (per accountant) and a Chrome trace of the
+    top-3 TTFT-outlier request timelines (BENCH_TRACE=0 opt-out)."""
+    from deepspeed_tpu.observability import get_session
+
+    obs = get_session()
+    if not obs.enabled:
+        return
+    shares = {rep: a.bucket_shares() for rep, a in accts if a is not None}
+    if shares:
+        stats["serve_goodput"] = (next(iter(shares.values()))
+                                  if len(shares) == 1 else shares)
+    if obs.reqtrace is not None:
+        path = os.path.join(obs.output_dir, f"trace_top_{tag}.json")
+        top = obs.reqtrace.export_chrome_top(path, k=3, key="ttft_ms")
+        if top:
+            stats["trace_outliers"] = {"chrome_trace": path,
+                                       "trace_ids": top}
 
 
 def _load_stats(handles, wall):
@@ -388,6 +415,10 @@ def _serve_one_mode(engine, scfg_kwargs, paged_kernel, prompts, arrivals,
             vcost = cost_vector_record("serving/verify")
             if vcost is not None:
                 stats["tpucost_verify"] = vcost
+    if enable_obs:
+        _arm_observability_stats(
+            stats, f"{paged_kernel}_{spec_mode}",
+            [("0", srv._serve_acct)])
     srv.close()
     return stats
 
@@ -493,6 +524,10 @@ def _serve_fleet_arm(engine, scfg_kwargs, paged_kernel, n, policy, disagg,
             "p50_ms": round(p(xs, 0.50), 3) if xs else None,
             "p99_ms": round(p(xs, 0.99), 3) if xs else None,
         }
+    if enable_obs:
+        _arm_observability_stats(
+            stats, f"fleet{n}_{policy}",
+            [(str(r.index), r.engine._serve_acct) for r in replicas])
     router.close()
     return stats
 

@@ -321,6 +321,32 @@ class ObservabilityConfig(ConfigModel):
     numerics_check_steps: int = 10     # host-side flag check cadence
     numerics_spike_factor: float = 0.0  # loss > k * EMA trips; 0 disables
     numerics_spike_warmup_steps: int = 20  # steps before spike check arms
+    # request-scoped serving traces (observability/reqtrace.py): a trace_id
+    # minted at submit follows the request through routing, queue wait,
+    # prefill chunks, KV handoffs, decode participation, preemption,
+    # resubmission and fork lineage. Head sampling decides at mint
+    # (trace_sample_rate); tail retention ALWAYS keeps outliers
+    # (deadline_exceeded, shed, preempted, resubmitted, TTFT > SLO).
+    request_tracing: bool = False
+    trace_sample_rate: float = 1.0     # head-sampled fraction of traces
+    trace_keep: int = 1024             # retained traces in memory (Chrome
+    #   export / bench top-k); the JSONL keeps everything retained
+    trace_max_events: int = 256        # events kept per trace (aggregates
+    #   stay exact past the cap; dropped_events counts the overflow)
+    trace_decode_sample: int = 16      # record every Nth decode/verify
+    #   participation event per request (never per-token)
+    trace_ttft_slo_ms: float = 0.0     # TTFT outlier threshold (0 = off)
+    reqtrace_file: str = "reqtrace.jsonl"          # retained-trace records
+    reqtrace_chrome_file: str = "reqtrace_chrome.json"  # chrome export
+    # serving goodput accountant (observability/servegoodput.py):
+    # per-iteration wall-time buckets on ServingEngine.step (prefill/
+    # decode/verify/draft/sample-host/scheduling-host/handoff/compile/idle
+    # — buckets sum to wall), per replica, plus TTFT/TPOT SLO burn rates
+    serve_goodput: bool = False
+    serve_ttft_slo_ms: float = 0.0     # burn-rate SLOs (0 = gauge off)
+    serve_tpot_slo_ms: float = 0.0
+    serve_slo_budget: float = 0.01     # allowed breach fraction: burn rate
+    #   = observed breach fraction / this (1.0 = spending on budget)
 
     def validate(self) -> None:
         if self.max_spans < 1:
@@ -364,6 +390,29 @@ class ObservabilityConfig(ConfigModel):
         if self.numerics_spike_warmup_steps < 0:
             raise ConfigError(
                 "observability.numerics_spike_warmup_steps must be >= 0")
+        if not 0.0 <= self.trace_sample_rate <= 1.0:
+            raise ConfigError(
+                "observability.trace_sample_rate must be in [0, 1], got "
+                f"{self.trace_sample_rate}")
+        if self.trace_keep < 1:
+            raise ConfigError("observability.trace_keep must be >= 1")
+        if self.trace_max_events < 8:
+            raise ConfigError(
+                "observability.trace_max_events must be >= 8 (a trace needs "
+                "room for its causal chain)")
+        if self.trace_decode_sample < 1:
+            raise ConfigError(
+                "observability.trace_decode_sample must be >= 1")
+        if self.trace_ttft_slo_ms < 0:
+            raise ConfigError(
+                "observability.trace_ttft_slo_ms must be >= 0")
+        if self.serve_ttft_slo_ms < 0 or self.serve_tpot_slo_ms < 0:
+            raise ConfigError(
+                "observability.serve_{ttft,tpot}_slo_ms must be >= 0")
+        if not 0.0 < self.serve_slo_budget <= 1.0:
+            raise ConfigError(
+                "observability.serve_slo_budget must be in (0, 1], got "
+                f"{self.serve_slo_budget}")
 
 
 @dataclass

@@ -64,7 +64,10 @@ from .numerics import NumericsSentinel, NumericsState, NumericsTrip
 from .recompile import RecompileWatchdog, get_watchdog
 from .recompile import install as install_watchdog
 from .recompile import uninstall as uninstall_watchdog
-from .spans import Span, SpanTracer, noop_tracer
+from .reqtrace import ReqTrace, RequestTracer
+from .servegoodput import ServeGoodput
+from .servegoodput import note_compile_current as _sg_note_compile
+from .spans import Span, SpanTracer, noop_tracer, write_chrome_trace
 
 __all__ = [
     "Observability", "configure_observability", "get_session", "reset_session",
@@ -77,6 +80,7 @@ __all__ = [
     "FleetHealthMonitor", "build_replica_checksum_probe",
     "NumericsSentinel", "NumericsState", "NumericsTrip",
     "Fault", "FaultInjector",
+    "ReqTrace", "RequestTracer", "ServeGoodput", "write_chrome_trace",
 ]
 
 
@@ -150,6 +154,24 @@ class Observability:
                 spike_factor=config.numerics_spike_factor,
                 spike_warmup=config.numerics_spike_warmup_steps,
                 registry=self.registry, recorder=self.recorder)
+        # request tracing (observability/reqtrace.py): off unless its gate
+        # is on — the serving layer consults ``session.reqtrace`` at submit
+        # time, so the disabled path wires nothing request-side
+        self.reqtrace: Optional[RequestTracer] = None
+        if self.enabled and getattr(config, "request_tracing", False):
+            self.reqtrace = RequestTracer(
+                sample_rate=config.trace_sample_rate,
+                jsonl_path=os.path.join(self.output_dir,
+                                        config.reqtrace_file),
+                keep=config.trace_keep,
+                max_events=config.trace_max_events,
+                decode_sample=config.trace_decode_sample,
+                ttft_slo_ms=config.trace_ttft_slo_ms)
+            if self.recorder is not None:
+                # a serving hang's crash bundle names what every stuck
+                # request was doing (the in-flight trace tail)
+                self.recorder.context_providers["request_traces"] = \
+                    self.reqtrace.inflight_summary
         if self.recorder is not None or self.hang is not None \
                 or self.goodput is not None or self.fleet is not None:
             self.tracer.on_event = self._span_event
@@ -214,6 +236,13 @@ class Observability:
                                  where=where, steady=steady)
         if self.goodput is not None:
             self.goodput.on_compile(secs, where=where)
+        if self.reqtrace is not None:
+            # attribute the compile to the trace whose dispatch is open on
+            # this thread (serving compiles name their victim request)
+            self.reqtrace.note_compile(secs, where)
+        # serving goodput: routed to whichever replica accountant is
+        # mid-iteration on this thread (a threadlocal read when none is)
+        _sg_note_compile(secs)
 
     def _on_hang_fire(self, stalled_span: str, waited: float,
                       deadline: float, bundle: str) -> None:
@@ -231,6 +260,15 @@ class Observability:
         hang watchdog."""
         if self.hang is not None:
             self.hang.heartbeat(name)
+
+    def flight_event(self, kind: str, **fields: Any) -> None:
+        """Drop one event into the flight-recorder ring (no-op without a
+        recorder). The serving layer records request-terminal incidents
+        (shed, deadline_exceeded, resubmit, handoff_fail) through this so
+        crash bundles from fleet incidents carry the victim requests' ids
+        even with request tracing disabled."""
+        if self.recorder is not None:
+            self.recorder.record(kind, **fields)
 
     def crash_dump(self, reason: str, exc: Optional[BaseException] = None,
                    **extra: Any) -> Optional[str]:
@@ -307,6 +345,9 @@ class Observability:
                     self.goodput.publish()   # final bucket snapshot
                 self.dump_metrics()
                 self.export_chrome_trace()
+                if self.reqtrace is not None and self.reqtrace.retained:
+                    self.reqtrace.export_chrome_trace(os.path.join(
+                        self.output_dir, self.config.reqtrace_chrome_file))
             except Exception:  # telemetry must never take the job down
                 from ..utils.logging import logger
 
@@ -314,6 +355,8 @@ class Observability:
                                exc_info=True)
         self.tracer.on_event = None
         self.tracer.close()
+        if self.reqtrace is not None:
+            self.reqtrace.close()
         if self.recorder is not None:
             self.recorder.detach_logging()
             # the registry is a process singleton: only clear the publish

@@ -173,6 +173,10 @@ class FlightRecorder:
         self._open_spans: Dict[int, List[tuple]] = {}
         self._log_handler: Optional[_RingLogHandler] = None
         self.dumps: List[str] = []
+        # name -> zero-arg callable consulted at dump time; its JSON-able
+        # return value lands in the MANIFEST under that name (the request
+        # tracer staples the in-flight trace tail through this seam)
+        self.context_providers: Dict[str, Any] = {}
 
     # -- recording --------------------------------------------------------
     def record(self, kind: str, **fields: Any) -> None:
@@ -293,6 +297,11 @@ class FlightRecorder:
             "environment": _environment_summary(),
             "files": [EVENTS_NAME, STACKS_NAME, MEMORY_NAME],
         }
+        for key, provider in list(self.context_providers.items()):
+            try:
+                manifest[key] = provider()
+            except Exception:   # a provider must never block the dump
+                pass
         if exc is not None:
             manifest["exception"] = {
                 "type": type(exc).__name__,

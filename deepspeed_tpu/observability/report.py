@@ -305,6 +305,125 @@ def summarize_serving(records: List[Dict[str, Any]]) -> str:
     return "\n".join(lines)
 
 
+def summarize_reqtrace(records: List[Dict[str, Any]]) -> str:
+    """``== request traces ==`` — the slowest / outlier requests from the
+    retained reqtrace records (``observability/reqtrace.py`` JSONL): per-
+    phase wall breakdown, attempts, replicas visited and fork parent —
+    the per-request answer to an aggregate p99."""
+    recs = [r for r in records if r.get("type") == "reqtrace"]
+    if not recs:
+        return ""
+    n_out = sum(1 for r in recs if r.get("outlier"))
+    lines = [f"== request traces ==  retained={len(recs)}"
+             + (f"  outliers={n_out}" if n_out else "")]
+    # outliers first, then slowest wall — the table a p99 investigation
+    # starts from
+    ranked = sorted(recs, key=lambda r: (not r.get("outlier"),
+                                         -(r.get("wall_s") or 0.0)))
+
+    def ms(r, phase):
+        v = r.get("phases", {}).get(phase)
+        return f"{v * 1e3:.1f}" if v is not None else "-"
+
+    rows = []
+    for r in ranked[:12]:
+        flags = ",".join(r.get("outlier", [])) or "-"
+        rows.append([
+            r.get("trace_id", "?"), r.get("state", "?"),
+            str(r.get("attempt", 1)),
+            ">".join(r.get("replicas", [])) or "-",
+            ms(r, "queue_wait"), ms(r, "prefill"),
+            ms(r, "decode"), ms(r, "handoff"),
+            (f"{r['ttft_ms']:.1f}" if r.get("ttft_ms") is not None
+             else "-"),
+            str(r.get("tokens", 0)),
+            r.get("fork_of", "-"), flags,
+        ])
+    lines.append(_fmt_table(
+        ["trace", "state", "att", "replicas", "queue_ms", "prefill_ms",
+         "decode_ms", "handoff_ms", "ttft_ms", "toks", "fork_of", "flags"],
+        rows))
+    resub = sum(r.get("resubmits", 0) for r in recs)
+    preempt = sum(r.get("preemptions", 0) for r in recs)
+    hand = sum(r.get("handoffs", 0) for r in recs)
+    extras = []
+    if resub:
+        extras.append(f"resubmits={resub}")
+    if preempt:
+        extras.append(f"preemptions={preempt}")
+    if hand:
+        extras.append(f"handoffs={hand}")
+    if extras:
+        lines.append("  incidents: " + "  ".join(extras))
+    return "\n".join(lines)
+
+
+def summarize_serve_goodput(records: List[Dict[str, Any]]) -> str:
+    """``== serving goodput ==`` — per-replica wall-time buckets (prefill/
+    decode/verify/draft/sample-host/scheduling-host/handoff/compile/idle;
+    they sum to wall), the device-productive fraction, tokens/s and the
+    TTFT/TPOT SLO burn rates, from the serve_goodput/* gauges
+    (``observability/servegoodput.py``)."""
+    recs = [r for r in records if r.get("type") == "gauge"
+            and str(r.get("name", "")).startswith("serve_goodput/")]
+    if not recs:
+        return ""
+    latest: Dict[Tuple[str, str], Dict[str, Any]] = {}
+    for r in recs:
+        latest[(r["name"], _label_str(r.get("labels", {})))] = r
+    lines = ["== serving goodput =="]
+    # per-replica bucket table
+    per: Dict[str, Dict[str, float]] = {}
+    walls: Dict[str, float] = {}
+    scalars: Dict[str, Dict[str, float]] = {}
+    for (name, _), r in latest.items():
+        labels = r.get("labels", {})
+        rep = str(labels.get("replica", "?"))
+        if name == "serve_goodput/seconds":
+            per.setdefault(rep, {})[labels.get("bucket", "?")] = r["value"]
+        elif name == "serve_goodput/wall_seconds":
+            walls[rep] = r["value"]
+        elif name.startswith("serve_goodput/"):
+            scalars.setdefault(rep, {})[name.split("/", 1)[1]] = r["value"]
+    bucket_order = ["prefill", "decode", "verify", "draft", "sample_host",
+                    "scheduling_host", "handoff", "compile", "idle"]
+    if per:
+        rows = []
+        for rep in sorted(per):
+            buckets = per[rep]
+            wall = walls.get(rep, sum(buckets.values()))
+            row = [rep, f"{wall:.3f}"]
+            for b in bucket_order:
+                v = buckets.get(b, 0.0)
+                row.append(f"{v / wall:.1%}" if wall > 0 else "-")
+            rows.append(row)
+        lines.append(_fmt_table(["replica", "wall_s"] + bucket_order, rows))
+    for rep in sorted(scalars):
+        s = scalars[rep]
+        parts = []
+        if "goodput_fraction" in s:
+            parts.append(f"goodput={s['goodput_fraction']:.3f}")
+        if "tokens_per_sec" in s:
+            parts.append(f"tokens/s={s['tokens_per_sec']:.6g}")
+        if "ttft_slo_burn_rate" in s:
+            parts.append(f"ttft_burn={s['ttft_slo_burn_rate']:.2f}")
+        if "tpot_slo_burn_rate" in s:
+            parts.append(f"tpot_burn={s['tpot_slo_burn_rate']:.2f}")
+        if parts:
+            lines.append(f"  replica {rep}: " + "  ".join(parts))
+    fleet = latest.get(("serve_goodput/fleet_tokens_per_device_sec", "-"))
+    if fleet is not None:
+        lines.append("  fleet emitted tokens per device-second = "
+                     f"{fleet['value']:.6g}")
+    burn = [s for s in scalars.values()
+            if s.get("ttft_slo_burn_rate", 0) > 1
+            or s.get("tpot_slo_burn_rate", 0) > 1]
+    if burn:
+        lines.append("  !! SLO error budget burning faster than allowed "
+                     "(burn rate > 1) — see per-replica lines above")
+    return "\n".join(lines)
+
+
 def summarize_fleet_serving(records: List[Dict[str, Any]]) -> str:
     """``== fleet serving ==`` — the serving-fleet router's view: per-replica
     occupancy/queue table, routing decisions by policy reason, prefill→decode
@@ -719,6 +838,8 @@ def report(paths: List[str]) -> str:
                             summarize_rlhf(records),
                             summarize_cost(records),
                             summarize_serving(records),
+                            summarize_serve_goodput(records),
+                            summarize_reqtrace(records),
                             summarize_fleet_serving(records),
                             summarize_fleet(records),
                             summarize_recompiles(records)) if s]
@@ -818,6 +939,21 @@ def crash_report(bundle_dir: str, last_steps: int = 5,
     if entries:
         lines.append("  registered programs: "
                      + ", ".join(e["name"] for e in entries))
+    traces = man.get("request_traces") or []
+    if traces:
+        lines.append(f"\n== in-flight requests ==  ({len(traces)} traced)")
+        for tr in traces[:16]:
+            last = tr.get("last_event") or {}
+            doing = last.get("kind", "?")
+            phases = tr.get("phases") or {}
+            breakdown = " ".join(f"{k}={v:.3f}s"
+                                 for k, v in sorted(phases.items()))
+            lines.append(
+                f"  {tr.get('trace_id', '?')} [{tr.get('tenant', '?')}] "
+                f"attempt {tr.get('attempt', 1)} "
+                f"replicas {'>'.join(tr.get('replicas', [])) or '-'} "
+                f"age {tr.get('age_s', 0):.1f}s — last: {doing}"
+                + (f" ({breakdown})" if breakdown else ""))
 
     steps = [e for e in events
              if e.get("kind") == "span_end" and e.get("name") == "train_batch"]

@@ -1,0 +1,259 @@
+"""Serving goodput — where each replica's wall-clock seconds actually went.
+
+The serving analog of :mod:`.goodput` (PR 4): a per-iteration accountant on
+``ServingEngine.step`` / ``FleetRouter.step`` bucketing wall time into
+
+* ``prefill`` / ``decode`` / ``verify`` — the device dispatch spans (their
+  host-materialize fence makes the measured interval device-inclusive:
+  sync-honest by construction, no extra drain);
+* ``draft``            — host-side drafter proposal time (speculation);
+* ``sample_host``      — host materialization + token emission after a
+  decode/verify dispatch;
+* ``handoff``          — KV export→transfer→import seconds (attributed to
+  the SOURCE replica, whose iteration ran the transfer);
+* ``compile``          — XLA compile seconds that fired inside an
+  iteration (recompile-watchdog feed), **deducted** from the phase span
+  they ran under — the same dedup discipline as PR 4's goodput, so the
+  same wall second is never counted twice;
+* ``scheduling_host``  — the iteration remainder: admission, block
+  bookkeeping, queue policy, python;
+* ``idle``             — gaps between iterations (the engine had nothing
+  to do, or the router was stepping someone else).
+
+**Buckets sum to wall** by construction: the accounted window opens at the
+first ``iteration_begin`` and closes at the last ``iteration_end``; inside
+an iteration every second lands in exactly one bucket (remainder →
+``scheduling_host``), and between iterations it is ``idle`` — the property
+the tests assert exactly under a fake clock.
+
+Derived gauges, per replica (``replica=`` label) through the
+MetricsRegistry:
+
+* ``serve_goodput/seconds{bucket=,replica=}`` and
+  ``serve_goodput/wall_seconds``;
+* ``serve_goodput/goodput_fraction`` = (prefill + decode + verify) / wall
+  — the device-productive share;
+* ``serve_goodput/tokens_per_sec`` — emitted tokens per accounted wall
+  second (the fleet router additionally publishes the fleet-wide
+  ``serve_goodput/fleet_tokens_per_device_sec``);
+* ``serve_goodput/ttft_slo_burn_rate`` / ``serve_goodput/tpot_slo_burn_rate``
+  — (breach fraction over the recent request window) / ``slo_budget``:
+  burn rate 1.0 means the error budget is being spent exactly at the
+  allowed rate, >1 means the SLO is burning down faster (the SRE
+  convention, so alerting thresholds transfer).
+
+All off by default (``ObservabilityConfig.serve_goodput``); the disabled
+path wires nothing — the engine carries a None and every hook site is one
+attribute check.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, Optional
+
+__all__ = ["ServeGoodput", "BUCKETS", "note_compile_current"]
+
+BUCKETS = ("prefill", "decode", "verify", "draft", "sample_host",
+           "scheduling_host", "handoff", "compile", "idle")
+DEVICE_BUCKETS = ("prefill", "decode", "verify")
+
+_CURRENT = threading.local()   # .acct — the accountant whose iteration is
+#   open on this thread (compiles run synchronously on the dispatching
+#   thread, so this IS the attribution)
+
+
+def note_compile_current(secs: float) -> None:
+    """Route compile seconds (from the recompile watchdog, via the
+    observability session) to whichever accountant is mid-iteration on the
+    calling thread — a no-op when none is (one threadlocal read)."""
+    acct = getattr(_CURRENT, "acct", None)
+    if acct is not None:
+        acct.note_compile(secs)
+
+
+class ServeGoodput:
+    """Per-replica serving wall-time accountant (see module docstring).
+    One per ``ServingEngine`` with the gate on; ``clock`` is the engine's
+    own (injectable) clock so tests are sleep-free and exact."""
+
+    def __init__(self, registry: Optional[Any] = None, replica: str = "0",
+                 clock: Callable[[], float] = time.monotonic,
+                 ttft_slo_ms: float = 0.0, tpot_slo_ms: float = 0.0,
+                 slo_budget: float = 0.01, window: int = 1024):
+        if registry is None:
+            from .metrics import get_registry
+
+            registry = get_registry()
+        self.registry = registry
+        self.replica = str(replica)
+        self.clock = clock
+        self.ttft_slo_ms = float(ttft_slo_ms)
+        self.tpot_slo_ms = float(tpot_slo_ms)
+        self.slo_budget = float(slo_budget)
+        self._lock = threading.RLock()
+        self._b: Dict[str, float] = {b: 0.0 for b in BUCKETS}
+        self._t0: Optional[float] = None
+        self._last: Optional[float] = None
+        self._iter_start: Optional[float] = None
+        self._iter_accounted = 0.0
+        # compile seconds awaiting dedup against the phase span that
+        # contained them (same discipline as goodput._compute_unattributed)
+        self._compile_pending = 0.0
+        self.iterations = 0
+        self.tokens = 0
+        import collections
+
+        self._ttft_breach = collections.deque(maxlen=max(int(window), 1))
+        self._tpot_breach = collections.deque(maxlen=max(int(window), 1))
+
+    # -- the iteration window ---------------------------------------------
+    def iteration_begin(self, t: float) -> None:
+        with self._lock:
+            if self._t0 is None:
+                self._t0 = t
+            elif self._last is not None and t > self._last:
+                self._b["idle"] += t - self._last
+            self._iter_start = t
+            self._iter_accounted = 0.0
+            self._compile_pending = 0.0
+        _CURRENT.acct = self
+
+    def iteration_end(self, t: float) -> None:
+        with self._lock:
+            if self._iter_start is not None:
+                rest = (t - self._iter_start) - self._iter_accounted
+                # the remainder is host scheduling work (admission, block
+                # bookkeeping, queue policy); phases were measured with
+                # the SAME clock inside this window, so rest >= 0 up to
+                # float noise — added as-is to keep buckets == wall exact
+                self._b["scheduling_host"] += rest
+            self._iter_start = None
+            self._last = t
+            self.iterations += 1
+        _CURRENT.acct = None
+
+    def note_phase(self, name: str, dur_s: float) -> None:
+        """A measured phase inside the current iteration. Compile seconds
+        noted since the iteration began are deducted (they ran inside this
+        span and already landed in the ``compile`` bucket)."""
+        dur_s = max(dur_s, 0.0)
+        with self._lock:
+            take = min(dur_s, self._compile_pending)
+            self._compile_pending -= take
+            self._b[name] += dur_s - take
+            self._iter_accounted += dur_s - take
+
+    def note_compile(self, secs: float) -> None:
+        with self._lock:
+            self._b["compile"] += secs
+            self._compile_pending += secs
+            self._iter_accounted += secs
+
+    def reset(self) -> None:
+        """Drop every accumulator and restart the wall window — benches
+        call this after warmup so the published buckets describe the
+        measured load, not program compilation."""
+        with self._lock:
+            self._b = {b: 0.0 for b in BUCKETS}
+            self._t0 = None
+            self._last = None
+            self._iter_start = None
+            self._iter_accounted = 0.0
+            self._compile_pending = 0.0
+            self.iterations = 0
+            self.tokens = 0
+            self._ttft_breach.clear()
+            self._tpot_breach.clear()
+
+    # -- workload feed -----------------------------------------------------
+    def note_tokens(self, n: int = 1) -> None:
+        with self._lock:
+            self.tokens += n
+
+    def note_request(self, ttft_ms: Optional[float] = None,
+                     tpot_ms: Optional[float] = None) -> None:
+        """One finished request's latencies — the SLO burn-rate inputs."""
+        with self._lock:
+            if ttft_ms is not None and self.ttft_slo_ms > 0:
+                self._ttft_breach.append(ttft_ms > self.ttft_slo_ms)
+            if tpot_ms is not None and self.tpot_slo_ms > 0:
+                self._tpot_breach.append(tpot_ms > self.tpot_slo_ms)
+
+    # -- derived -----------------------------------------------------------
+    def totals(self) -> Dict[str, Any]:
+        with self._lock:
+            buckets = dict(self._b)
+            t0, last = self._t0, self._last
+            tokens, iters = self.tokens, self.iterations
+            ttft = list(self._ttft_breach)
+            tpot = list(self._tpot_breach)
+            open_accounted = (self._iter_accounted
+                              if self._iter_start is not None else None)
+        wall = max((last - t0) if t0 is not None and last is not None
+                   else 0.0, 0.0)
+        if open_accounted is not None:
+            # mid-iteration read (a concurrent dump_metrics): the open
+            # iteration's phases are already in the buckets but its
+            # remainder is not — extend the wall by exactly the accounted
+            # seconds so buckets still sum to wall and the fraction never
+            # exceeds 1
+            wall += open_accounted
+        device = sum(buckets[b] for b in DEVICE_BUCKETS)
+        out: Dict[str, Any] = {
+            "wall_s": wall, "buckets": buckets, "iterations": iters,
+            "tokens": tokens,
+            "goodput_fraction": (device / wall) if wall > 0 else 0.0,
+        }
+        if wall > 0:
+            out["tokens_per_sec"] = tokens / wall
+        if ttft:
+            out["ttft_slo_burn_rate"] = \
+                (sum(ttft) / len(ttft)) / self.slo_budget
+        if tpot:
+            out["tpot_slo_burn_rate"] = \
+                (sum(tpot) / len(tpot)) / self.slo_budget
+        return out
+
+    def bucket_shares(self) -> Dict[str, float]:
+        """Bucket → fraction-of-wall (the bench record's compact form)."""
+        tot = self.totals()
+        wall = tot["wall_s"]
+        if wall <= 0:
+            return {}
+        return {b: round(s / wall, 4) for b, s in tot["buckets"].items()}
+
+    def publish(self) -> Dict[str, Any]:
+        tot = self.totals()
+        reg = self.registry
+        lbl = {"replica": self.replica}
+        g = reg.gauge("serve_goodput/seconds",
+                      help="serving wall seconds by bucket, per replica")
+        for bucket, secs in tot["buckets"].items():
+            g.set(secs, bucket=bucket, **lbl)
+        reg.gauge("serve_goodput/wall_seconds",
+                  help="accounted serving wall seconds").set(
+                      tot["wall_s"], **lbl)
+        reg.gauge("serve_goodput/iterations",
+                  help="accounted scheduler iterations").set(
+                      tot["iterations"], **lbl)
+        reg.gauge("serve_goodput/goodput_fraction",
+                  help="(prefill + decode + verify) / wall — the "
+                       "device-productive share").set(
+                      tot["goodput_fraction"], **lbl)
+        if "tokens_per_sec" in tot:
+            reg.gauge("serve_goodput/tokens_per_sec",
+                      help="emitted tokens per accounted wall second").set(
+                          tot["tokens_per_sec"], **lbl)
+        if "ttft_slo_burn_rate" in tot:
+            reg.gauge("serve_goodput/ttft_slo_burn_rate",
+                      help="TTFT SLO breach fraction / error budget "
+                           "(>1 = burning too fast)").set(
+                          tot["ttft_slo_burn_rate"], **lbl)
+        if "tpot_slo_burn_rate" in tot:
+            reg.gauge("serve_goodput/tpot_slo_burn_rate",
+                      help="TPOT SLO breach fraction / error budget "
+                           "(>1 = burning too fast)").set(
+                          tot["tpot_slo_burn_rate"], **lbl)
+        return tot

@@ -37,6 +37,17 @@ from typing import Any, Dict, List, Optional
 from ..utils.logging import logger
 
 
+def write_chrome_trace(events: List[Dict[str, Any]], path: str) -> str:
+    """Write pre-built Chrome trace events as a loadable trace file — the
+    one exporter behind both the span tracer and the request tracer
+    (``reqtrace.py``), so every timeline this package produces opens in
+    chrome://tracing / Perfetto the same way."""
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    with open(path, "w") as fh:
+        json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, fh)
+    return path
+
+
 def _drain_dispatch_queue() -> None:
     """Block until previously dispatched device work completes. Enqueues a
     trivial computation and drains it — XLA executes per-device programs in
@@ -238,9 +249,7 @@ class SpanTracer:
                 "tid": rec.get("tid", 0),
                 "args": {**rec.get("attrs", {}), "synced": rec.get("synced")},
             } for rec in self._spans]
-        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
-        with open(path, "w") as fh:
-            json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, fh)
+        write_chrome_trace(events, path)
         if self.dropped:
             logger.warning(f"span tracer dropped {self.dropped} spans past "
                            f"max_spans={self.max_spans}")

@@ -123,6 +123,12 @@ class RolloutManifest:
     streams: List[List[List[int]]]    # [prompt][sample][token]
     spec_mode: str = "off"
     version: int = 1
+    # [prompt][sample] request-trace ids (observability/reqtrace.py), when
+    # the recording run had request_tracing on — a replayed/diverged sample
+    # is cross-referencable against its original causal timeline. Empty
+    # (the default) when tracing was off; old manifests load unchanged.
+    trace_ids: List[List[Optional[str]]] = dataclasses.field(
+        default_factory=list)
 
     def to_json(self) -> str:
         return json.dumps(dataclasses.asdict(self))
@@ -229,7 +235,12 @@ class RolloutCollector:
             seeds=[[s.seed for s in g] for g in groups],
             streams=[[list(s.tokens) for s in g] for g in groups],
             spec_mode=("off" if eng._drafter is None or eng.spec_suspended
-                       else eng.config.speculative.mode))
+                       else eng.config.speculative.mode),
+            trace_ids=[[(h._req.trace.trace_id
+                         if getattr(h._req, "trace", None) is not None
+                         else None) for h in hs]
+                       for hs in handle_groups]
+            if get_session().reqtrace is not None else [])
         return RolloutBatch(iteration=int(iteration), groups=groups,
                             stats=stats), manifest
 

@@ -22,6 +22,7 @@ site), and tpuaudit entries of the same names.
 
 from __future__ import annotations
 
+import contextlib
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional
@@ -90,6 +91,14 @@ class ServingEngine:
                        if self.config.prefix_cache else None)
         self.sched = Scheduler(self.config, allocator=self.alloc,
                                clock=clock, prefix_cache=self.prefix)
+        # fleet identity on traces / serving-goodput labels (the router
+        # overwrites it with the replica index before stepping)
+        self.trace_tag = "0"
+        # lazy ServeGoodput accountant (see _accountant: the bench builds
+        # engines BEFORE enabling observability, so the gate is consulted
+        # at step time, not construction)
+        self._serve_acct = None
+        self.sched.on_preempt = self._trace_preempt
         self._dtype = engine.config.dtype
         with mesh_mod.ambient(engine.mesh):
             self._arena = paged_kv.init_paged_cache(
@@ -252,6 +261,7 @@ class ServingEngine:
             req = make(self._rid, seed)
             self.sched.submit(req)   # raises before rid is consumed
             self._rid += 1
+            self._trace_start(req)
             handle = RequestHandle(self, req)
             self._handles[req.rid] = handle
             obs = get_session()
@@ -268,6 +278,7 @@ class ServingEngine:
                 sib.arrival_s = req.arrival_s   # TTFT from the client's
                 #   submit — the wait through the parent's prefill counts
                 self._rid += 1
+                self._trace_start(sib, parent_trace=req.trace)
                 sibs.append(sib)
                 h = RequestHandle(self, sib)
                 self._handles[sib.rid] = h
@@ -292,10 +303,13 @@ class ServingEngine:
                     self.sched.cancelled_count += 1
                     self._handles.pop(req.rid, None)
                     self._count_cancelled(1)
+                    self._trace_finish(req, "cancelled")
                     handle._wake()
                     return True
             ok = self.sched.cancel(req)
             cancelled += int(ok)
+            if ok:
+                self._trace_finish(req, "cancelled")
             # a cancelled parent takes its un-forked siblings with it
             for sib in self._pending_forks.pop(req.rid, []):
                 sh = self._handles.pop(sib.rid, None)
@@ -303,6 +317,7 @@ class ServingEngine:
                 sib.finish_s = self.clock()
                 self.sched.cancelled_count += 1
                 cancelled += 1
+                self._trace_finish(sib, "cancelled")
                 if sh is not None:
                     sh._wake()
             self._handles.pop(req.rid, None)
@@ -321,6 +336,70 @@ class ServingEngine:
 
     def _pending_fork_count(self) -> int:
         return sum(len(v) for v in self._pending_forks.values())
+
+    # -- request tracing + serving goodput (observability) -----------------
+    def _accountant(self):
+        """Lazy ServeGoodput lookup: None until an enabled session with the
+        ``serve_goodput`` gate exists (the disabled path wires nothing)."""
+        acct = self._serve_acct
+        if acct is None:
+            obs = get_session()
+            if obs.enabled and getattr(obs.config, "serve_goodput", False):
+                from ..observability.servegoodput import ServeGoodput
+
+                acct = self._serve_acct = ServeGoodput(
+                    registry=obs.registry, replica=self.trace_tag,
+                    clock=self.clock,
+                    ttft_slo_ms=obs.config.serve_ttft_slo_ms,
+                    tpot_slo_ms=obs.config.serve_tpot_slo_ms,
+                    slo_budget=obs.config.serve_slo_budget)
+        return acct
+
+    def _trace_start(self, req: Request, parent_trace=None) -> None:
+        rt = get_session().reqtrace
+        if rt is None:
+            return
+        req.trace = rt.start(
+            tenant=req.tenant, t=self.clock(),
+            fork_of=(parent_trace.trace_id if parent_trace is not None
+                     else None),
+            attrs={"rid": req.rid, "seed": req.seed,
+                   "n_prompt": req.n_prompt,
+                   "max_new_tokens": req.max_new_tokens})
+        if parent_trace is not None:
+            rt.link_fork(parent_trace, req.trace)
+
+    def _trace_admitted(self, admitted: List[Request]) -> None:
+        rt = get_session().reqtrace
+        if rt is None:
+            return
+        now = self.clock()
+        for req in admitted:
+            if req.trace is not None:
+                rt.admitted(req.trace, now, self.trace_tag, row=req.row)
+
+    def _trace_preempt(self, req: Request) -> None:
+        if req.trace is not None:
+            rt = get_session().reqtrace
+            if rt is not None:
+                rt.preempted(req.trace, self.clock(), self.trace_tag)
+
+    def _trace_finish(self, req: Request, state: str, **attrs: Any) -> None:
+        if req.trace is None:
+            return
+        rt = get_session().reqtrace
+        if rt is not None:
+            rt.finish(req.trace, state, t=self.clock(), ttft_s=req.ttft_s,
+                      tokens=len(req.generated), replica=self.trace_tag,
+                      **attrs)
+
+    def _trace_dispatch(self, rt, trace):
+        """Context manager marking ``trace`` as the compile-attribution
+        target while a device dispatch is open (nullcontext when tracing
+        is off)."""
+        if rt is None:
+            return contextlib.nullcontext()
+        return rt.active(trace)
 
     def in_flight(self) -> int:
         """Requests holding queue capacity: queued + running + parallel-
@@ -437,6 +516,11 @@ class ServingEngine:
         with self._lock:
             self.sched.release_handoff(req)
             self._handles.pop(req.rid, None)
+            if req.trace is not None:
+                rt = get_session().reqtrace
+                if rt is not None:
+                    rt.event(req.trace, "handoff_release", t=self.clock(),
+                             replica=self.trace_tag)
 
     # -- weight flip (RLHF hybrid engine) ----------------------------------
     def note_weights_updated(self) -> int:
@@ -543,18 +627,35 @@ class ServingEngine:
         made progress (admission, a prefill chunk, a decode token, or a
         deadline expiry reclaiming its resources)."""
         with self._lock:
-            # before admit: an already-expired queued request must never
-            # take a decode row first
-            progress = self._expire_deadlines()
-            progress |= bool(self.sched.admit())
-            progress |= self._step_prefill()
-            progress |= (self._step_verify()
-                         if self._drafter is not None
-                         and not self.spec_suspended
-                         else self._step_decode())
-            self._publish_iteration()
-            self._iterations += 1
-            return progress
+            acct = self._accountant()
+            if acct is not None:
+                acct.iteration_begin(self.clock())
+            try:
+                # before admit: an already-expired queued request must
+                # never take a decode row first
+                progress = self._expire_deadlines()
+                admitted = self.sched.admit()
+                progress |= bool(admitted)
+                if admitted:
+                    self._trace_admitted(admitted)
+                progress |= self._step_prefill()
+                progress |= (self._step_verify()
+                             if self._drafter is not None
+                             and not self.spec_suspended
+                             else self._step_decode())
+                self._publish_iteration()
+                self._iterations += 1
+                return progress
+            finally:
+                if acct is not None:
+                    acct.iteration_end(self.clock())
+                    # gauge refresh at a cadence, always AFTER the window
+                    # closed (wall and buckets stay consistent): per-
+                    # iteration publishing would put O(window) breach-deque
+                    # scans on the decode loop's critical path. close()
+                    # publishes the final snapshot.
+                    if acct.iterations % 16 == 1:
+                        acct.publish()
 
     def _expire_deadlines(self) -> bool:
         """Deadline enforcement at decode time: a request whose absolute
@@ -583,6 +684,14 @@ class ServingEngine:
                     help="requests terminated at an iteration boundary "
                          "after their deadline passed").inc(
                              tenant=req.tenant)
+            # the ring carries the victim's id even with tracing disabled:
+            # a crash bundle from a fleet incident names its requests
+            obs.flight_event(
+                "req_terminal", event="deadline_exceeded", rid=req.rid,
+                tenant=req.tenant,
+                trace_id=(req.trace.trace_id if req.trace is not None
+                          else None))
+            self._trace_finish(req, "deadline_exceeded")
             handle = self._handles.pop(req.rid, None)
             if handle is not None:
                 handle._wake()
@@ -653,16 +762,30 @@ class ServingEngine:
         chunk[0, :n_valid] = src[start:start + n_valid]
         temps, topks, topps, seeds = self._sampling_arrays([req])
         obs = get_session()
-        with mesh_mod.ambient(self.engine.mesh):
-            with obs.span("serving/prefill_chunk", batch=1,
-                          tokens=int(n_valid)):
-                tok, _last, self._arena = self._prefill(
-                    self.engine.params, self._arena,
-                    self._table_for([req]), chunk,
-                    np.asarray(start, np.int32),
-                    np.asarray(n_valid, np.int32),
-                    temps, topks, topps, seeds, self._base_rng)
-                tok = np.asarray(tok)   # the fence: chunk really ran
+        rt = obs.reqtrace
+        acct = self._serve_acct
+        timed = acct is not None or (rt is not None
+                                     and req.trace is not None)
+        t0 = self.clock() if timed else 0.0
+        with self._trace_dispatch(rt, req.trace):
+            with mesh_mod.ambient(self.engine.mesh):
+                with obs.span("serving/prefill_chunk", batch=1,
+                              tokens=int(n_valid)):
+                    tok, _last, self._arena = self._prefill(
+                        self.engine.params, self._arena,
+                        self._table_for([req]), chunk,
+                        np.asarray(start, np.int32),
+                        np.asarray(n_valid, np.int32),
+                        temps, topks, topps, seeds, self._base_rng)
+                    tok = np.asarray(tok)   # the fence: chunk really ran
+        if timed:
+            t1 = self.clock()
+            if acct is not None:
+                acct.note_phase("prefill", t1 - t0)
+            if rt is not None and req.trace is not None:
+                rt.interval(req.trace, "prefill", t0, t1,
+                            kind="prefill_chunk", tokens=int(n_valid),
+                            chunk_start=int(start), replica=self.trace_tag)
         self.prefill_chunks_run += 1
         self.prefill_tokens_run += int(n_valid)
         req.prefill_pos += n_valid
@@ -752,6 +875,7 @@ class ServingEngine:
                           else req.seed + i + 1),
                     fork_of=req.rid, n_prompt=req.n_prompt)
                 self._rid += 1
+                self._trace_start(sib, parent_trace=req.trace)
                 sib.generated = list(req.generated)
                 sib.pending_token = req.pending_token
                 sib.length = req.length
@@ -826,16 +950,35 @@ class ServingEngine:
             #   sampling stream is (engine seed, request seed, index) —
             #   schedule-independent and preemption-stable
         obs = get_session()
-        with mesh_mod.ambient(self.engine.mesh):
-            with obs.span("serving/decode", batch=len(ready)):
-                nxt, self._arena = self._decode(
-                    self.engine.params, self._arena, bt, lengths, tokens,
-                    temps, topks, topps, seeds, steps, self._base_rng)
-                nxt = np.asarray(nxt)   # the iteration's one host sync
+        rt = obs.reqtrace
+        acct = self._serve_acct
+        timed = acct is not None or rt is not None
+        t0 = self.clock() if timed else 0.0
+        first_trace = (next((r.trace for r in ready
+                             if r.trace is not None), None)
+                       if rt is not None else None)
+        with self._trace_dispatch(rt, first_trace):
+            with mesh_mod.ambient(self.engine.mesh):
+                with obs.span("serving/decode", batch=len(ready)):
+                    nxt, self._arena = self._decode(
+                        self.engine.params, self._arena, bt, lengths,
+                        tokens, temps, topks, topps, seeds, steps,
+                        self._base_rng)
+                    nxt = np.asarray(nxt)  # the iteration's one host sync
+        t1 = self.clock() if timed else 0.0
+        if acct is not None:
+            acct.note_phase("decode", t1 - t0)
+        if rt is not None:
+            for r in ready:
+                if r.trace is not None:
+                    rt.note_decode(r.trace, t0, t1, batch=len(ready),
+                                   replica=self.trace_tag)
         for r in ready:
             r.length += 1
             self.sched.note_service(r, 1)
             self._emit(r, int(nxt[r.row]))
+        if acct is not None:
+            acct.note_phase("sample_host", self.clock() - t1)
         return True
 
     def _step_verify(self) -> bool:
@@ -866,7 +1009,10 @@ class ServingEngine:
             caps.append(0 if low_pool else max(cap, 0))
         t0 = self.clock()
         proposals = self._drafter.propose(ready, caps)
-        self._spec_draft_s += self.clock() - t0
+        draft_s = self.clock() - t0
+        self._spec_draft_s += draft_s
+        if self._serve_acct is not None:
+            self._serve_acct.note_phase("draft", draft_s)
         # speculating may preempt nothing, but the drafter's catch-up runs
         # under the engine lock with live state — re-check anyway
         plan = []
@@ -916,16 +1062,30 @@ class ServingEngine:
             #   this dispatch — position j samples index steps+j, the
             #   exact key the non-speculative path uses
         obs = get_session()
+        rt = obs.reqtrace
+        acct = self._serve_acct
+        first_trace = (next((r.trace for r, _ in plan
+                             if r.trace is not None), None)
+                       if rt is not None else None)
         t0 = self.clock()
-        with mesh_mod.ambient(self.engine.mesh):
-            with obs.span("serving/verify", batch=len(plan),
-                          tokens=int(n_valid.sum())):
-                sampled, self._arena = self._verify(
-                    self.engine.params, self._arena, bt, lengths, tokens,
-                    n_valid, temps, topks, topps, seeds, steps,
-                    self._base_rng)
-                sampled = np.asarray(sampled)  # the iteration's one sync
-        self._spec_verify_s += self.clock() - t0
+        with self._trace_dispatch(rt, first_trace):
+            with mesh_mod.ambient(self.engine.mesh):
+                with obs.span("serving/verify", batch=len(plan),
+                              tokens=int(n_valid.sum())):
+                    sampled, self._arena = self._verify(
+                        self.engine.params, self._arena, bt, lengths,
+                        tokens, n_valid, temps, topks, topps, seeds, steps,
+                        self._base_rng)
+                    sampled = np.asarray(sampled)  # the iteration's 1 sync
+        t1 = self.clock()
+        self._spec_verify_s += t1 - t0
+        if acct is not None:
+            acct.note_phase("verify", t1 - t0)
+        if rt is not None:
+            for r, _ in plan:
+                if r.trace is not None:
+                    rt.note_decode(r.trace, t0, t1, kind="verify",
+                                   batch=len(plan), replica=self.trace_tag)
         self._spec_dispatches += 1
         for r, prop in plan:
             x = sampled[r.row]
@@ -952,6 +1112,8 @@ class ServingEngine:
                 # back the same way
                 self.sched.truncate_blocks(r, r.length)
                 self._drafter.commit(r)
+        if acct is not None:
+            acct.note_phase("sample_host", self.clock() - t1)
         return True
 
     def _emit(self, req: Request, token: int, first: bool = False) -> None:
@@ -974,6 +1136,13 @@ class ServingEngine:
         req.generated.append(token)
         req.pending_token = token
         self._tokens_out += 1
+        if req.trace is not None:
+            # live progress marker: a crash dump's in-flight tail must say
+            # how far each stuck request got (finish() re-stamps the
+            # authoritative count from len(generated))
+            req.trace.tokens += 1
+        if self._serve_acct is not None:
+            self._serve_acct.note_tokens(1)
         handle = self._handles.get(req.rid)
         if handle is not None:
             handle._push(token)
@@ -997,6 +1166,12 @@ class ServingEngine:
                         "serving/tpot_ms",
                         help="mean per-token wall ms after the first "
                              "token").observe(tpot * 1e3, tenant=req.tenant)
+            if self._serve_acct is not None:
+                ttft, tpot = req.ttft_s, req.tpot_s
+                self._serve_acct.note_request(
+                    ttft_ms=ttft * 1e3 if ttft is not None else None,
+                    tpot_ms=tpot * 1e3 if tpot is not None else None)
+            self._trace_finish(req, "finished")
             self._handles.pop(req.rid, None)   # the client holds its own
             #   reference; keeping ours would leak one handle per request
             #   over a server's lifetime
@@ -1159,6 +1334,8 @@ class ServingEngine:
         self.stop()
         if self._drafter is not None:
             self._drafter.close()
+        if self._serve_acct is not None:
+            self._serve_acct.publish()   # final bucket snapshot
         self.publish_latency_gauges()
 
     def publish_latency_gauges(self) -> None:
@@ -1213,6 +1390,10 @@ class ServingEngine:
             # next _publish_iteration would compute negative counter deltas
             self._published_spec = (0, 0, 0, 0)
             self._published_forks = 0
+            if self._serve_acct is not None:
+                # warmup iterations carry compile-scale phases — the
+                # published buckets must describe the measured load
+                self._serve_acct.reset()
 
     # -- tpuaudit ----------------------------------------------------------
     def _audit_args_prefill(self):

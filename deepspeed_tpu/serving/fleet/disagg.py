@@ -81,7 +81,12 @@ class KVHandoff:
 
     inject_fail_next: int = 0
 
-    def transfer(self, src, dst, blocks: List[int]) -> Optional[List[int]]:
+    def transfer(self, src, dst, blocks: List[int],
+                 trace=None) -> Optional[List[int]]:
+        """``trace`` (an ``observability.reqtrace.ReqTrace``, or None) is
+        the request-trace context riding the seam: implementations record
+        the export → transfer → import stages onto it so a handoff's
+        timeline carries BOTH replicas."""
         raise NotImplementedError
 
     def _maybe_inject_failure(self) -> None:
@@ -101,11 +106,15 @@ class ArenaHandoff(KVHandoff):
         self.transfers = 0
         self.inject_fail_next = 0
 
-    def transfer(self, src, dst, blocks: List[int]) -> Optional[List[int]]:
+    def transfer(self, src, dst, blocks: List[int],
+                 trace=None) -> Optional[List[int]]:
         """``src``/``dst`` are ServingEngines (callers hold whatever locks
         protect them — the router runs this inside its iteration). The
         destination blocks come from PLAIN allocation: a handoff never
-        evicts or preempts the decode pool's residents."""
+        evicts or preempts the decode pool's residents. When ``trace`` is
+        set, the export and import stages land on the request's trace with
+        their replica identities — the handoff timeline spans both ends of
+        the seam."""
         _check_geometry(_EngineView(src), _EngineView(dst))
         dst_ids = dst.alloc.alloc(len(blocks))
         if dst_ids is None:
@@ -118,20 +127,37 @@ class ArenaHandoff(KVHandoff):
         from ...observability import get_session
 
         obs = get_session()
+        rt = obs.reqtrace if trace is not None else None
+        clock = src.clock
         try:
             with obs.span("fleet/kv_handoff", blocks=len(blocks)):
+                t0 = clock() if rt is not None else 0.0
                 with mesh_mod.ambient(src.engine.mesh):
                     buf_k, buf_v = self._export(src._arena, src_pad)
+                    if rt is not None:
+                        import jax
+
+                        jax.block_until_ready(buf_k)   # stage-honest split
+                if rt is not None:
+                    t1 = clock()
+                    rt.interval(trace, "handoff", t0, t1,
+                                kind="handoff_export",
+                                replica=src.trace_tag, blocks=len(blocks))
                 # mid-flight: after the export left the source, before the
                 # import commits to the destination — the window a real
                 # cross-host transfer dies in
                 self._maybe_inject_failure()
+                t2 = clock() if rt is not None else 0.0
                 with mesh_mod.ambient(dst.engine.mesh):
                     dst._arena = self._import(dst._arena, buf_k, buf_v,
                                               dst_pad)
                 import jax
 
                 jax.block_until_ready(dst._arena["k"])   # honest latency
+                if rt is not None:
+                    rt.interval(trace, "handoff", t2, clock(),
+                                kind="handoff_import",
+                                replica=dst.trace_tag, blocks=len(dst_ids))
         except Exception:
             # a failed transfer must not leak destination blocks; a partial
             # import is harmless garbage once its blocks return to the pool

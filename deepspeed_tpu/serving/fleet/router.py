@@ -274,6 +274,8 @@ class FleetRouter:
             r.step_times = collections.deque(
                 r.step_times, maxlen=self.config.health_window)
             r.warmup_left = self.config.health_warmup_steps
+            # request traces + serve_goodput gauges carry the replica index
+            r.engine.trace_tag = str(r.index)
         roles = {r.role for r in self.replicas}
         self.disagg = roles != {ROLE_MIXED}
         self.prefill_pool = [r for r in self.replicas
@@ -423,6 +425,19 @@ class FleetRouter:
                 est = self._estimate_completion_s(replica, mnt * n)
                 if est is not None and est > deadline_s:
                     self._count_shed("deadline_infeasible")
+                    obs = get_session()
+                    obs.flight_event("req_terminal", event="shed",
+                                     reason="deadline_infeasible",
+                                     tenant=tenant)
+                    rt = obs.reqtrace
+                    if rt is not None:
+                        # a shed submission still leaves a (retained)
+                        # trace: shed is a tail-retention outlier
+                        t = rt.start(tenant=tenant, t=self.clock(),
+                                     attrs={"deadline_s": deadline_s})
+                        rt.finish(t, "shed", t=self.clock(),
+                                  reason="deadline_infeasible",
+                                  estimated_s=round(est, 4))
                     raise Overloaded(
                         f"deadline {deadline_s:.3f}s is infeasible: "
                         f"estimated completion {est:.3f}s at current "
@@ -442,6 +457,15 @@ class FleetRouter:
             self._count_decision(reason, replica)
             if n == 1:
                 handles = [handles]
+            rt = get_session().reqtrace
+            if rt is not None:
+                # the routing decision joins each request's causal chain
+                # (the trace itself was minted by engine.submit)
+                for h in handles:
+                    if h._req.trace is not None:
+                        rt.event(h._req.trace, "routed",
+                                 t=self.clock(), policy=self.config.policy,
+                                 reason=reason, replica=str(replica.index))
             # every admitted request weighs into the estimator's average
             self._mnt_obs.extend([mnt] * n)
             now = self.clock()
@@ -477,6 +501,10 @@ class FleetRouter:
                 return False
             if fr.replica.alive:
                 fr.replica.engine.cancel(fr.u_handle)
+            else:
+                # the engine-side finish cannot run on a dead replica —
+                # the router closes the trace itself
+                self._trace_finish_fr(fr, "cancelled")
             self._finish_fr(fr, F_CANCELLED)
             return True
 
@@ -638,6 +666,8 @@ class FleetRouter:
             engine = r.rebuild(donor)
             self._replaced_engines.append(r.engine)
             r.revive(engine, self.config.probation_requests)
+            engine.trace_tag = str(r.index)   # the incarnation keeps the
+            #   replica's identity on traces and serve_goodput gauges
             # conservative: even with grafted programs, the incarnation's
             # first measured steps are not representative
             r.warmup_left = self.config.health_warmup_steps
@@ -758,6 +788,22 @@ class FleetRouter:
                     verdict=verdict)
 
     # -- internals ---------------------------------------------------------
+    def _trace_finish_fr(self, fr: _FleetRequest, state: str,
+                         **attrs: Any) -> None:
+        """Router-level terminal for a trace whose engine binding cannot
+        record it (dead replica, shed-from-queue). Idempotent with the
+        engine's own finish — the first terminal state wins."""
+        trace = (getattr(fr.u_req, "trace", None)
+                 if fr.u_req is not None else None)
+        if trace is None:
+            return
+        rt = get_session().reqtrace
+        if rt is not None:
+            rt.finish(trace, state, t=self.clock(),
+                      ttft_s=(fr.first_token_s - fr.arrival_s
+                              if fr.first_token_s is not None else None),
+                      **attrs)
+
     def _count_decision(self, reason: str, replica: Replica) -> None:
         self._decisions[(self.config.policy, reason)] += 1
         obs = get_session()
@@ -1015,6 +1061,8 @@ class FleetRouter:
                     f"fleet request {fr.fid}: resubmission budget "
                     f"({self.config.max_resubmits}) exhausted — "
                     "cancelling")
+                self._trace_finish_fr(fr, "cancelled",
+                                      reason="resubmit_budget")
                 self._finish_fr(fr, F_CANCELLED)
                 continue
             self._try_resubmit(fr)
@@ -1034,8 +1082,12 @@ class FleetRouter:
             if fr.deadline_abs is not None and now > fr.deadline_abs:
                 # nobody engine-side can expire a parked request (its
                 # binding is the dead replica) — the router must
+                self._trace_finish_fr(fr, "deadline_exceeded",
+                                      reason="parked_past_deadline")
                 self._finish_fr(fr, F_DEADLINE)
                 obs = get_session()
+                obs.flight_event("req_terminal", event="deadline_exceeded",
+                                 fid=fr.fid, reason="parked_past_deadline")
                 if obs.enabled:
                     obs.registry.counter(
                         "serving/requests_deadline_exceeded",
@@ -1063,8 +1115,13 @@ class FleetRouter:
         if not cands:
             logger.error(f"fleet request {fr.fid}: no alive replica for "
                          "the resubmission — cancelling")
+            self._trace_finish_fr(fr, "cancelled", reason="fleet_dead")
             self._finish_fr(fr, F_CANCELLED)
             return
+        # the trace survives the dead binding: the SAME trace_id continues
+        # on the survivor at attempt + 1 (the resubmission causal link)
+        trace = (getattr(fr.u_req, "trace", None)
+                 if fr.u_req is not None else None)
         for target in sorted(cands, key=lambda r: r.health().load_key):
             try:
                 h2 = target.engine.submit_recovered(
@@ -1073,7 +1130,19 @@ class FleetRouter:
             except QueueFull:
                 continue
             self._by_engine.pop((fr.replica.index, fr.u_req.rid), None)
+            dead_index = fr.replica.index
             fr.bind(target, h2)
+            if trace is not None:
+                h2._req.trace = trace
+                rt = obs.reqtrace
+                if rt is not None:
+                    rt.resubmitted(trace, self.clock(),
+                                   replica=target.index)
+            obs.flight_event("req_terminal", event="resubmit", fid=fr.fid,
+                             from_replica=dead_index,
+                             to_replica=target.index,
+                             trace_id=(trace.trace_id
+                                       if trace is not None else None))
             if fr.fid in self._parked:
                 self._parked.remove(fr.fid)
             # streamed tokens live engine-side in req.generated but were
@@ -1168,6 +1237,12 @@ class FleetRouter:
             fr.deadline_abs is not None,
             -(fr.deadline_abs or 0.0), -fr.fid))
         self._drain_tokens(victim)
+        # the shed terminal must land BEFORE the engine cancel (the first
+        # terminal state wins — this one is the truthful one)
+        self._trace_finish_fr(victim, "shed", reason="degraded")
+        get_session().flight_event(
+            "req_terminal", event="shed", reason="degraded",
+            fid=victim.fid, rung=self._degraded)
         if victim.replica.alive:
             victim.replica.engine.cancel(victim.u_handle)
         tpot = self._tpot_estimate() or 0.0
@@ -1217,10 +1292,11 @@ class FleetRouter:
     def _handoff_attempts(self, src: Replica, req, fr: _FleetRequest,
                           cands: List[Replica], t0: float, obs) -> None:
         failures = 0
+        rt = obs.reqtrace
         for dst in cands:
             try:
                 dst_ids = self.handoff.transfer(src.engine, dst.engine,
-                                                req.blocks)
+                                                req.blocks, trace=req.trace)
             except Exception:
                 # mid-flight transfer loss: the transport already freed
                 # the destination blocks; the source request is untouched
@@ -1232,6 +1308,14 @@ class FleetRouter:
                         "fleet_serving/handoff_failures",
                         help="KV handoff transfers that failed mid-flight "
                              "(retried once, then decoded in place)").inc()
+                obs.flight_event(
+                    "req_terminal", event="handoff_fail", fid=fr.fid,
+                    src=src.index, dst=dst.index,
+                    trace_id=(req.trace.trace_id
+                              if req.trace is not None else None))
+                if rt is not None and req.trace is not None:
+                    rt.event(req.trace, "handoff_fail", t=self.clock(),
+                             src=str(src.index), dst=str(dst.index))
                 logger.warning(
                     f"fleet request {fr.fid}: KV handoff to replica "
                     f"{dst.index} failed mid-transfer "
@@ -1265,6 +1349,13 @@ class FleetRouter:
             fr.bind(dst, h2)
             fr.handoffs += 1
             self._by_engine[(dst.index, h2._req.rid)] = fr.fid
+            if req.trace is not None:
+                # the trace context rides the handoff seam: the SAME
+                # trace_id continues on the destination replica
+                h2._req.trace = req.trace
+                if rt is not None:
+                    rt.handoff_adopted(req.trace, self.clock(),
+                                       src=src.index, dst=dst.index)
             src.engine.release_for_handoff(req)
             # a completed prefill handed off cleanly IS the prefill
             # replica's unit of service — its probation credit cannot
@@ -1272,6 +1363,11 @@ class FleetRouter:
             self._credit_probation(src)
             ms = (self.clock() - t0) * 1e3
             self._handoff_ms.append(ms)
+            if src.engine._serve_acct is not None:
+                # the transfer ran inside the SOURCE replica's iteration
+                # (the on_prefill_complete hook) — bucket it as handoff
+                # there so its scheduling_host remainder stays honest
+                src.engine._serve_acct.note_phase("handoff", ms / 1e3)
             self._count_decision("disagg_decode", dst)
             if obs.enabled:
                 obs.registry.counter(
@@ -1336,6 +1432,19 @@ class FleetRouter:
         reg.gauge("fleet_serving/degraded_mode",
                   help="overload ladder rung: 0=normal 1=no-speculation "
                        "2=no-affinity 3=shedding").set(self._degraded)
+        # fleet-wide serving goodput: emitted tokens per device-second
+        # (each replica's accounted wall is one device-second stream)
+        accts = [r.engine._serve_acct for r in self.replicas
+                 if r.alive and r.engine._serve_acct is not None]
+        if accts:
+            tots = [a.totals() for a in accts]
+            wall = sum(t["wall_s"] for t in tots)
+            if wall > 0:
+                reg.gauge(
+                    "serve_goodput/fleet_tokens_per_device_sec",
+                    help="fleet emitted tokens / summed per-replica "
+                         "accounted wall seconds").set(
+                        sum(t["tokens"] for t in tots) / wall)
 
     def publish_latency_gauges(self) -> None:
         """Close-time percentile gauges over the handoff reservoir — the

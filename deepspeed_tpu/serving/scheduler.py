@@ -109,6 +109,9 @@ class Request:
     # -- speculative decoding accounting (engine-owned) --
     spec_proposed: int = 0             # draft tokens this request verified
     spec_accepted: int = 0             # ... and accepted
+    # -- request tracing (engine-owned; None unless the session's
+    #    request_tracing gate is on — the disabled path carries a None) --
+    trace: Optional[object] = None     # observability.reqtrace.ReqTrace
 
     def __post_init__(self):
         self.prompt = np.asarray(self.prompt, np.int32).reshape(-1)
@@ -151,6 +154,9 @@ class Scheduler:
         # called with the request on EVERY release (finish/cancel/preempt)
         # — the speculative drafter's device-state teardown hook
         self.on_release: Optional[Callable[[Request], None]] = None
+        # called with the victim AFTER a preemption re-queued it — the
+        # request tracer's eviction event (None costs one attribute check)
+        self.on_preempt: Optional[Callable[[Request], None]] = None
         self._free_rows: List[int] = list(range(config.max_seqs))[::-1]
         self.service: Dict[str, float] = {}        # tenant -> tokens served
         self._admit_seq = 0
@@ -504,6 +510,8 @@ class Scheduler:
         req.prefilled = False   # a forked sibling recomputes like anyone
         req.state = QUEUED
         self.queued.append(req)
+        if self.on_preempt is not None:
+            self.on_preempt(req)
 
     # -- iteration planning ------------------------------------------------
     def next_prefill(self) -> Optional[Request]:

@@ -10,3 +10,8 @@ python -m tools.tpulint \
     deepspeed_tpu/ tools/ scripts/ tests/ \
     bench.py bench_infer.py bench_moe.py bench_rlhf.py bench_zero.py \
     --baseline .tpulint-baseline.json "$@"
+
+# metric-name <-> docs drift gate: every literal registry.counter/gauge/
+# histogram name in the tree must appear in docs/observability.md's metric
+# table (tools/tpulint/metricsdoc.py)
+python -m tools.tpulint.metricsdoc

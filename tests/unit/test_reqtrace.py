@@ -1,0 +1,672 @@
+"""Request tracing + serving goodput (ISSUE-15).
+
+Coverage map:
+  * ``ServeGoodput`` bucket math under a fake clock — buckets sum to wall
+    EXACTLY, compile seconds dedup out of the phase that contained them,
+    idle accumulates between iterations, SLO burn rates;
+  * ``RequestTracer`` unit semantics — deterministic head sampling, tail
+    retention of outliers at sample rate 0, per-trace event cap with the
+    terminal event never dropped;
+  * single-engine trace assembly — causal chain (submitted → admitted →
+    prefill → decode → finished), fork lineage (``submit(n=)``), deadline
+    and preemption outliers, flight-ring terminal events WITHOUT tracing;
+  * the chaos-gate scenario — 16 staggered requests through a 3-replica
+    disaggregated fleet with a mid-stream replica kill: every request's
+    chain is complete, the killed replica's requests resubmit under the
+    SAME trace_id (attempt + 1), handoff export/import stitch across two
+    replicas, the Chrome trace loads, and serving goodput buckets sum to
+    wall per replica;
+  * the disabled path — enabling tracing adds ZERO dispatches and ZERO
+    compiles (recompile-watchdog-counted) and leaves streams bit-identical;
+  * report CLI sections, crash-dump in-flight tail, metricsdoc gate, and
+    the rollout-manifest trace_ids column.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from deepspeed_tpu.config.base import ConfigError
+from deepspeed_tpu.config.config import (FleetConfig, ObservabilityConfig,
+                                         ServingConfig)
+from deepspeed_tpu.inference import init_inference
+from deepspeed_tpu.observability import (configure_observability,
+                                         get_registry, get_session,
+                                         reset_session)
+from deepspeed_tpu.observability.reqtrace import RequestTracer
+from deepspeed_tpu.observability.servegoodput import BUCKETS, ServeGoodput
+from deepspeed_tpu.serving import ServingEngine
+from deepspeed_tpu.serving.fleet import (ROLE_DECODE, ROLE_PREFILL,
+                                         FleetRouter, build_replicas)
+
+SCFG = dict(block_size=16, num_blocks=32, max_seqs=4, max_model_len=128,
+            prefill_chunk=16, max_queue=64)
+
+
+class FakeClock:
+    def __init__(self, t: float = 0.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _registry_hygiene():
+    """The MetricsRegistry is a process singleton: serving counters this
+    module increments (forks, requests_*) would leak into later test
+    files that assert ABSOLUTE counter values (test_speculative's report
+    renders). Restore the pristine registry after the module."""
+    yield
+    get_registry().reset()
+
+
+@pytest.fixture(scope="module")
+def tiny_engine():
+    return init_inference("tiny", dtype=jnp.float32, max_out_tokens=128)
+
+
+@pytest.fixture
+def traced_session(tmp_path):
+    reset_session()
+    sess = configure_observability(ObservabilityConfig(
+        enabled=True, output_dir=str(tmp_path / "obs"),
+        request_tracing=True, serve_goodput=True, flight_recorder=False))
+    yield sess
+    reset_session()
+
+
+def serving(tiny_engine, clock=None, **cfg):
+    defaults = dict(SCFG)
+    defaults.update(cfg)
+    return ServingEngine(tiny_engine, ServingConfig(**defaults),
+                         **({"clock": clock} if clock else {}))
+
+
+def mk_prompts(n, lo=4, hi=50, seed=7):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(1, 50, size=rng.randint(lo, hi)).astype(np.int32)
+            for _ in range(n)]
+
+
+# ---------------------------------------------------------------------------
+# ServeGoodput bucket math (fake clock, device-free)
+# ---------------------------------------------------------------------------
+
+
+class TestServeGoodputMath:
+    def test_buckets_sum_to_wall_exactly(self):
+        clk = FakeClock()
+        a = ServeGoodput(registry=get_registry(), replica="7", clock=clk)
+        # iteration 1: prefill 0.4s + 0.1s host remainder
+        a.iteration_begin(clk.t)
+        a.note_phase("prefill", 0.4)
+        clk.advance(0.5)
+        a.iteration_end(clk.t)
+        # 0.3s idle gap
+        clk.advance(0.3)
+        # iteration 2: decode 0.2 + sample_host 0.05 + 0.05 remainder
+        a.iteration_begin(clk.t)
+        a.note_phase("decode", 0.2)
+        a.note_phase("sample_host", 0.05)
+        clk.advance(0.3)
+        a.iteration_end(clk.t)
+        tot = a.totals()
+        assert tot["wall_s"] == pytest.approx(1.1, abs=1e-12)
+        assert sum(tot["buckets"].values()) == pytest.approx(
+            tot["wall_s"], abs=1e-12)
+        b = tot["buckets"]
+        assert b["prefill"] == pytest.approx(0.4)
+        assert b["idle"] == pytest.approx(0.3)
+        assert b["decode"] == pytest.approx(0.2)
+        assert b["sample_host"] == pytest.approx(0.05)
+        assert b["scheduling_host"] == pytest.approx(0.15)
+        assert set(b) == set(BUCKETS)
+
+    def test_compile_dedup_inside_phase(self):
+        """Compile seconds noted mid-iteration land in the compile bucket
+        and are DEDUCTED from the phase span that contained them — the
+        same wall second is never counted twice."""
+        clk = FakeClock()
+        a = ServeGoodput(registry=get_registry(), clock=clk)
+        a.iteration_begin(clk.t)
+        a.note_compile(1.0)          # fired inside the prefill dispatch
+        a.note_phase("prefill", 1.2)  # span duration INCLUDES the compile
+        clk.advance(1.3)
+        a.iteration_end(clk.t)
+        tot = a.totals()
+        b = tot["buckets"]
+        assert b["compile"] == pytest.approx(1.0)
+        assert b["prefill"] == pytest.approx(0.2)
+        assert b["scheduling_host"] == pytest.approx(0.1)
+        assert sum(b.values()) == pytest.approx(tot["wall_s"], abs=1e-12)
+
+    def test_mid_iteration_read_stays_consistent(self):
+        """A concurrent dump_metrics can read totals() while an iteration
+        is open: the open iteration's accounted phases extend the wall so
+        buckets still sum to wall and the fraction never exceeds 1."""
+        clk = FakeClock()
+        a = ServeGoodput(registry=get_registry(), clock=clk)
+        a.iteration_begin(clk.t)
+        a.note_phase("prefill", 0.4)
+        tot = a.totals()                       # mid-iteration read
+        assert tot["wall_s"] == pytest.approx(0.4, abs=1e-12)
+        assert sum(tot["buckets"].values()) == pytest.approx(
+            tot["wall_s"], abs=1e-12)
+        assert tot["goodput_fraction"] <= 1.0
+        clk.advance(0.5)
+        a.iteration_end(clk.t)
+        tot = a.totals()
+        assert sum(tot["buckets"].values()) == pytest.approx(
+            tot["wall_s"], abs=1e-12)
+
+    def test_goodput_fraction_and_tokens(self):
+        clk = FakeClock()
+        a = ServeGoodput(registry=get_registry(), clock=clk)
+        a.iteration_begin(clk.t)
+        a.note_phase("decode", 0.5)
+        a.note_tokens(10)
+        clk.advance(1.0)
+        a.iteration_end(clk.t)
+        tot = a.totals()
+        assert tot["goodput_fraction"] == pytest.approx(0.5)
+        assert tot["tokens_per_sec"] == pytest.approx(10.0)
+
+    def test_slo_burn_rates(self):
+        a = ServeGoodput(registry=get_registry(), ttft_slo_ms=100.0,
+                         tpot_slo_ms=10.0, slo_budget=0.1)
+        for ttft in (50, 150, 80, 90):       # 1/4 breach
+            a.note_request(ttft_ms=ttft, tpot_ms=5.0)
+        tot = a.totals()
+        assert tot["ttft_slo_burn_rate"] == pytest.approx(2.5)  # 0.25/0.1
+        assert tot["tpot_slo_burn_rate"] == pytest.approx(0.0)
+
+    def test_reset_restarts_window(self):
+        clk = FakeClock()
+        a = ServeGoodput(registry=get_registry(), clock=clk)
+        a.iteration_begin(clk.t)
+        a.note_phase("prefill", 1.0)
+        clk.advance(1.0)
+        a.iteration_end(clk.t)
+        a.reset()
+        assert a.totals()["wall_s"] == 0.0
+        clk.advance(5.0)
+        a.iteration_begin(clk.t)
+        clk.advance(0.25)
+        a.iteration_end(clk.t)
+        tot = a.totals()
+        # the 5s pre-reset gap is NOT idle — the window restarted
+        assert tot["wall_s"] == pytest.approx(0.25)
+        assert tot["buckets"]["idle"] == 0.0
+
+
+# ---------------------------------------------------------------------------
+# RequestTracer unit semantics (device-free)
+# ---------------------------------------------------------------------------
+
+
+class TestRequestTracerUnit:
+    def test_head_sampling_deterministic(self):
+        clk = FakeClock()
+        rt_all = RequestTracer(sample_rate=1.0, clock=clk)
+        rt_none = RequestTracer(sample_rate=0.0, clock=clk)
+        assert all(rt_all.start().sampled for _ in range(8))
+        assert not any(rt_none.start().sampled for _ in range(8))
+
+    def test_tail_retention_keeps_outliers_at_rate_zero(self):
+        clk = FakeClock()
+        rt = RequestTracer(sample_rate=0.0, clock=clk)
+        plain = rt.start()
+        assert rt.finish(plain, "finished") is False   # unsampled, normal
+        late = rt.start()
+        assert rt.finish(late, "deadline_exceeded") is True
+        pre = rt.start()
+        rt.preempted(pre, clk.t, replica=0)
+        assert rt.finish(pre, "finished") is True
+        res = rt.start()
+        rt.resubmitted(res, clk.t, replica=1)
+        assert res.attempt == 2
+        assert rt.finish(res, "finished") is True
+        recs = rt.snapshot()
+        assert {tuple(r["outlier"]) for r in recs} == {
+            ("deadline_exceeded",), ("preempted",), ("resubmitted",)}
+        assert rt.dropped == 1 and rt.retained == 3
+
+    def test_ttft_slo_outlier(self):
+        rt = RequestTracer(sample_rate=0.0, ttft_slo_ms=10.0,
+                           clock=FakeClock())
+        fast = rt.start()
+        assert rt.finish(fast, "finished", ttft_s=0.005) is False
+        slow = rt.start()
+        assert rt.finish(slow, "finished", ttft_s=0.5) is True
+        assert rt.snapshot()[0]["outlier"] == ["ttft_slo"]
+
+    def test_event_cap_never_drops_terminal(self):
+        rt = RequestTracer(sample_rate=1.0, max_events=8, clock=FakeClock())
+        tr = rt.start()
+        for i in range(20):
+            rt.event(tr, "decode", iter=i)
+        rt.finish(tr, "finished")
+        rec = rt.snapshot()[0]
+        assert rec["dropped_events"] == 13   # 20 - (8 - 1 submitted)
+        assert rec["events"][-1]["kind"] == "finished"
+
+    def test_finish_idempotent_first_state_wins(self):
+        rt = RequestTracer(sample_rate=1.0, clock=FakeClock())
+        tr = rt.start()
+        assert rt.finish(tr, "shed") is True
+        assert rt.finish(tr, "cancelled") is False
+        assert rt.snapshot()[0]["state"] == "shed"
+
+    def test_chrome_export_loads(self, tmp_path):
+        clk = FakeClock()
+        rt = RequestTracer(sample_rate=1.0, clock=clk)
+        tr = rt.start()
+        rt.interval(tr, "prefill", 0.0, 0.5, replica="2")
+        rt.finish(tr, "finished")
+        path = str(tmp_path / "chrome.json")
+        rt.export_chrome_trace(path)
+        d = json.load(open(path))
+        names = {e["name"] for e in d["traceEvents"]}
+        assert {"thread_name", "prefill", "submitted", "finished"} <= names
+        x = [e for e in d["traceEvents"] if e["name"] == "prefill"][0]
+        assert x["ph"] == "X" and x["dur"] == pytest.approx(0.5e6)
+        assert x["pid"] == 2    # replica of first service
+
+
+# ---------------------------------------------------------------------------
+# single-engine trace assembly
+# ---------------------------------------------------------------------------
+
+
+class TestSingleEngineTraces:
+    def test_lifecycle_causal_chain(self, tiny_engine, traced_session):
+        srv = serving(tiny_engine)
+        hs = [srv.submit(p, max_new_tokens=5) for p in mk_prompts(3)]
+        srv.run()
+        [h.result() for h in hs]
+        rt = traced_session.reqtrace
+        recs = rt.snapshot()
+        assert len(recs) == 3 and rt.started == 3
+        for r in recs:
+            kinds = [e["kind"] for e in r["events"]]
+            assert kinds[0] == "submitted"
+            assert "admitted" in kinds and "prefill_chunk" in kinds
+            assert kinds[-1] == "finished"
+            assert r["phases"]["prefill"] > 0
+            assert r["phases"]["decode"] > 0
+            assert r["tokens"] == 5 and r["ttft_ms"] is not None
+            assert r["replicas"] == ["0"]
+        # the retained records stream to the session's reqtrace JSONL
+        jsonl = os.path.join(traced_session.output_dir, "reqtrace.jsonl")
+        lines = [json.loads(x) for x in open(jsonl)]
+        assert {x["trace_id"] for x in lines} == \
+            {r["trace_id"] for r in recs}
+        srv.close()
+
+    def test_fork_lineage(self, tiny_engine, traced_session):
+        srv = serving(tiny_engine)
+        hs = srv.submit(np.arange(1, 24), max_new_tokens=4, n=3)
+        srv.run()
+        [h.result() for h in hs]
+        recs = traced_session.reqtrace.snapshot()
+        parents = [r for r in recs if r.get("forks")]
+        children = [r for r in recs if r.get("fork_of")]
+        assert len(parents) == 1 and len(children) == 2
+        assert set(parents[0]["forks"]) == \
+            {c["trace_id"] for c in children}
+        assert all(c["fork_of"] == parents[0]["trace_id"]
+                   for c in children)
+        srv.close()
+
+    def test_deadline_outlier_and_flight_ring(self, tiny_engine, tmp_path):
+        """Deadline expiry: the trace retains as an outlier even at sample
+        rate 0, and the flight ring carries a req_terminal event even
+        WITHOUT tracing (the satellite-2 contract)."""
+        # arm 1: tracing OFF, flight recorder ON — ring still names victims
+        reset_session()
+        sess = configure_observability(ObservabilityConfig(
+            enabled=True, output_dir=str(tmp_path / "o1"),
+            flight_recorder=True, flight_sigusr1=False))
+        clk = FakeClock()
+        srv = serving(tiny_engine, clock=clk)
+        h = srv.submit(np.arange(1, 20), max_new_tokens=50, deadline_s=5.0)
+        srv.step()
+        clk.advance(10.0)
+        srv.step()
+        assert h.state == "deadline_exceeded"
+        ring = sess.recorder.snapshot()
+        term = [e for e in ring if e.get("kind") == "req_terminal"]
+        assert term and term[0]["event"] == "deadline_exceeded"
+        assert term[0]["trace_id"] is None       # tracing was off
+        srv.close()
+        # arm 2: tracing ON at sample rate 0 — outlier retained anyway
+        reset_session()
+        sess = configure_observability(ObservabilityConfig(
+            enabled=True, output_dir=str(tmp_path / "o2"),
+            request_tracing=True, trace_sample_rate=0.0,
+            flight_recorder=False))
+        clk = FakeClock()
+        srv = serving(tiny_engine, clock=clk)
+        h = srv.submit(np.arange(1, 20), max_new_tokens=50, deadline_s=5.0)
+        srv.step()
+        clk.advance(10.0)
+        srv.step()
+        assert h.state == "deadline_exceeded"
+        recs = sess.reqtrace.snapshot()
+        assert len(recs) == 1
+        assert recs[0]["state"] == "deadline_exceeded"
+        assert "deadline_exceeded" in recs[0]["outlier"]
+        srv.close()
+        reset_session()
+
+    def test_preemption_outlier_retained(self, tiny_engine, tmp_path):
+        reset_session()
+        sess = configure_observability(ObservabilityConfig(
+            enabled=True, output_dir=str(tmp_path / "obs"),
+            request_tracing=True, trace_sample_rate=0.0,
+            flight_recorder=False))
+        # a pool far too small for the load — evictions guaranteed (two
+        # concurrent ~100-token sequences need ~14 of the 8 blocks)
+        srv = serving(tiny_engine, num_blocks=8, max_seqs=2,
+                      prefix_cache=False)
+        hs = [srv.submit(p, max_new_tokens=40)
+              for p in mk_prompts(4, lo=40, hi=60, seed=3)]
+        srv.run()
+        [h.result() for h in hs]
+        assert srv.sched.preemption_count > 0
+        recs = sess.reqtrace.snapshot()
+        preempted = [r for r in recs if "preempted" in r.get("outlier", [])]
+        assert preempted
+        r = preempted[0]
+        kinds = [e["kind"] for e in r["events"]]
+        assert "preempted" in kinds
+        # recompute re-admits: at least two admitted events on the chain
+        assert kinds.count("admitted") >= 2
+        assert r["preemptions"] >= 1
+        srv.close()
+        reset_session()
+
+
+# ---------------------------------------------------------------------------
+# zero-overhead disabled path (watchdog-asserted)
+# ---------------------------------------------------------------------------
+
+
+class TestDisabledPathZeroOverhead:
+    def test_tracing_adds_zero_dispatch_zero_compile(self, tiny_engine,
+                                                     tmp_path):
+        """The acceptance bar: the SAME engine, the SAME workload, run
+        first with tracing/goodput disabled and then enabled — identical
+        iteration and prefill-dispatch counts, identical streams, and the
+        recompile watchdog counts ZERO new compiles (tracing never touches
+        a program)."""
+        def run_load(srv):
+            it0 = srv._iterations
+            pc0 = srv.prefill_chunks_run
+            hs = [srv.submit(p, max_new_tokens=6, seed=i)
+                  for i, p in enumerate(mk_prompts(5, seed=9))]
+            srv.run()
+            outs = [np.asarray(h.result()) for h in hs]
+            return srv._iterations - it0, srv.prefill_chunks_run - pc0, outs
+
+        reset_session()
+        configure_observability(ObservabilityConfig(
+            enabled=True, output_dir=str(tmp_path / "off"),
+            flight_recorder=False))
+        # prefix_cache off: the second pass over identical prompts would
+        # otherwise hit the now-warm cache and take the COW path — a
+        # workload difference, not a tracing one
+        srv = serving(tiny_engine, prefix_cache=False)
+        iters_off, chunks_off, outs_off = run_load(srv)
+        assert srv._serve_acct is None       # gate off → wired nothing
+        compiles = get_registry().counter("xla/compiles")
+        before = sum(compiles.series().values())
+        # flip tracing + goodput ON for the same engine, same workload
+        reset_session()
+        sess = configure_observability(ObservabilityConfig(
+            enabled=True, output_dir=str(tmp_path / "on"),
+            request_tracing=True, serve_goodput=True,
+            flight_recorder=False))
+        iters_on, chunks_on, outs_on = run_load(srv)
+        after = sum(compiles.series().values())
+        assert after - before == 0           # zero recompiles
+        assert (iters_on, chunks_on) == (iters_off, chunks_off)
+        for a, b in zip(outs_on, outs_off):
+            np.testing.assert_array_equal(a, b)
+        assert len(sess.reqtrace.snapshot()) == 5   # tracing DID run
+        assert srv._serve_acct is not None
+        srv.close()
+        reset_session()
+
+
+# ---------------------------------------------------------------------------
+# the chaos-gate scenario: fleet kill + disagg handoffs, traced
+# ---------------------------------------------------------------------------
+
+
+def run_staggered(router, prompts, n_new=8, temperature=0.7, stagger=2):
+    handles = []
+    i, it = 0, 0
+    while i < len(prompts) or router.in_flight():
+        if i < len(prompts) and it % stagger == 0:
+            handles.append(router.submit(prompts[i], max_new_tokens=n_new,
+                                         seed=i, temperature=temperature))
+            i += 1
+        router.step()
+        it += 1
+        assert it < 10_000, "fleet made no progress"
+    return handles
+
+
+class TestFleetChaosTraces:
+    def test_sixteen_request_chaos_acceptance(self, tiny_engine,
+                                              traced_session, tmp_path):
+        """ISSUE-15 acceptance: 16 requests through a 3-replica
+        disaggregated fleet with a mid-stream decode-replica kill. Every
+        request has a complete causal chain; the killed replica's
+        requests resubmit under the SAME trace_id; handoff spans stitch
+        across two replicas; the Chrome trace loads; serving goodput
+        buckets sum to wall per replica."""
+        prompts = mk_prompts(16, seed=3, lo=4, hi=60)
+        replicas = build_replicas(
+            tiny_engine, ServingConfig(**SCFG), 3,
+            roles=[ROLE_PREFILL, ROLE_DECODE, ROLE_DECODE])
+        router = FleetRouter(
+            replicas, FleetConfig(policy="kv_occupancy", auto_revive=True,
+                                  revive_after_iterations=8),
+            fault_plan=[{"kind": "replica_kill", "step": 12, "replica": 1}])
+        try:
+            hs = run_staggered(router, prompts)
+            assert all(h.state == "finished" for h in hs)
+            assert replicas[1].deaths == 1
+            assert sum(h.resubmits for h in hs) >= 1
+            rt = traced_session.reqtrace
+            recs = rt.snapshot()
+            assert len(recs) == 16
+            assert len({r["trace_id"] for r in recs}) == 16
+            # every request: a complete causal chain
+            for r in recs:
+                kinds = [e["kind"] for e in r["events"]]
+                assert kinds[0] == "submitted"
+                assert "routed" in kinds and "admitted" in kinds
+                assert "prefill_chunk" in kinds
+                assert kinds[-1] == "finished"
+            # the killed replica's requests: SAME trace_id, attempt + 1
+            resub = [r for r in recs if r["resubmits"]]
+            assert resub
+            for r in resub:
+                assert r["attempt"] == 1 + r["resubmits"]
+                assert any(e["kind"] == "resubmitted"
+                           for e in r["events"])
+            # handoff spans stitch across replicas: export on the prefill
+            # replica, import on the decode replica
+            handed = [r for r in recs if r["handoffs"]]
+            assert handed
+            for r in handed[:4]:
+                ev = {e["kind"]: e for e in r["events"]}
+                assert "handoff_export" in ev and "handoff_import" in ev
+                assert ev["handoff_export"]["replica"] == "0"
+                assert ev["handoff_import"]["replica"] != "0"
+                assert r["phases"]["handoff"] > 0
+                assert len(set(r["replicas"])) >= 2
+            # Chrome trace loads with per-trace rows
+            path = str(tmp_path / "chaos_chrome.json")
+            rt.export_chrome_trace(path)
+            d = json.load(open(path))
+            assert len(d["traceEvents"]) > 16
+            assert {e["name"] for e in d["traceEvents"]} >= {
+                "thread_name", "submitted", "prefill_chunk", "finished"}
+            # serving goodput: buckets sum to wall per replica
+            seen = 0
+            for r in router.replicas:
+                acct = r.engine._serve_acct
+                if acct is None:
+                    continue
+                tot = acct.totals()
+                assert sum(tot["buckets"].values()) == pytest.approx(
+                    tot["wall_s"], abs=1e-6)
+                seen += 1
+            assert seen >= 3
+        finally:
+            router.close()
+
+    def test_shed_trace_retained(self, tiny_engine, traced_session):
+        """An admission-shed request leaves a retained 'shed' trace (tail
+        retention) and a flight-style terminal state."""
+        from deepspeed_tpu.serving.fleet import Overloaded
+
+        replicas = build_replicas(tiny_engine, ServingConfig(**SCFG), 2)
+        router = FleetRouter(replicas, FleetConfig(policy="kv_occupancy"))
+        try:
+            hs = [router.submit(p, max_new_tokens=8)
+                  for p in mk_prompts(4, seed=5)]
+            router.run()
+            [h.result() for h in hs]
+            assert router._tpot_estimate() is not None
+            with pytest.raises(Overloaded):
+                router.submit(np.arange(1, 30), max_new_tokens=64,
+                              deadline_s=1e-9)
+            shed = [r for r in traced_session.reqtrace.snapshot()
+                    if r["state"] == "shed"]
+            assert len(shed) == 1
+            assert shed[0]["outlier"] == ["shed"]
+            assert shed[0]["events"][-1]["reason"] == "deadline_infeasible"
+        finally:
+            router.close()
+
+
+# ---------------------------------------------------------------------------
+# report CLI + crash dump + manifest + metricsdoc
+# ---------------------------------------------------------------------------
+
+
+class TestReporting:
+    def test_report_sections_render(self, tiny_engine, traced_session,
+                                    tmp_path):
+        from deepspeed_tpu.observability.report import report
+
+        srv = serving(tiny_engine)
+        hs = [srv.submit(p, max_new_tokens=4) for p in mk_prompts(2)]
+        srv.run()
+        [h.result() for h in hs]
+        srv.close()
+        traced_session.dump_metrics()
+        out = report([
+            os.path.join(traced_session.output_dir, "reqtrace.jsonl"),
+            traced_session.metrics_path()])
+        assert "== request traces ==" in out
+        assert "== serving goodput ==" in out
+        assert "req-" in out
+        # bucket columns render
+        for col in ("prefill", "decode", "scheduling_host", "idle"):
+            assert col in out
+
+    def test_crash_dump_inflight_trace_tail(self, tiny_engine, tmp_path):
+        from deepspeed_tpu.observability.report import crash_report
+
+        reset_session()
+        sess = configure_observability(ObservabilityConfig(
+            enabled=True, output_dir=str(tmp_path / "obs"),
+            request_tracing=True, flight_recorder=True,
+            flight_sigusr1=False))
+        srv = serving(tiny_engine)
+        srv.submit(np.arange(1, 40), max_new_tokens=32)
+        for _ in range(3):
+            srv.step()      # mid-flight: prefill done, decoding
+        bundle = sess.crash_dump("test-serving-hang")
+        assert bundle
+        man = json.load(open(os.path.join(bundle, "MANIFEST.json")))
+        traces = man["request_traces"]
+        assert len(traces) == 1
+        assert traces[0]["trace_id"].startswith("req-")
+        assert traces[0]["last_event"] is not None
+        out = crash_report(bundle)
+        assert "== in-flight requests ==" in out
+        assert traces[0]["trace_id"] in out
+        srv.close()
+        reset_session()
+
+    def test_rollout_manifest_trace_ids(self, tiny_engine, traced_session):
+        from deepspeed_tpu.rlhf.rollout import (RolloutCollector,
+                                                RolloutManifest)
+
+        srv = serving(tiny_engine)
+        coll = RolloutCollector(srv, group_n=2, temperature=0.7,
+                                max_new_tokens=4)
+        prompts = mk_prompts(2, seed=11)
+        _, manifest = coll.collect(prompts, iteration=0)
+        assert len(manifest.trace_ids) == 2
+        assert all(len(row) == 2 for row in manifest.trace_ids)
+        ids = {t for row in manifest.trace_ids for t in row}
+        retained = {r["trace_id"]
+                    for r in traced_session.reqtrace.snapshot()}
+        assert ids <= retained                # cross-referencable
+        # JSON round-trip keeps the column; old manifests (no column)
+        # still load
+        m2 = RolloutManifest.from_json(manifest.to_json())
+        assert m2.trace_ids == manifest.trace_ids
+        legacy = json.loads(manifest.to_json())
+        legacy.pop("trace_ids")
+        m3 = RolloutManifest(**legacy)
+        assert m3.trace_ids == []
+        srv.close()
+
+    def test_metricsdoc_gate_clean_and_detects(self, tmp_path):
+        from tools.tpulint.metricsdoc import (DEFAULT_DOC, DEFAULT_PATHS,
+                                              find_undocumented, main)
+
+        # the repo gate: every literal metric name is documented
+        paths = [p for p in DEFAULT_PATHS if os.path.exists(p)]
+        assert find_undocumented(paths, DEFAULT_DOC) == []
+        assert main([]) == 0
+        # the negative: an undocumented metric is flagged
+        bad = tmp_path / "bad.py"
+        bad.write_text("reg.counter('nope/unknown_metric').inc()\n")
+        missing = find_undocumented([str(bad)], DEFAULT_DOC)
+        assert [m[0] for m in missing] == ["nope/unknown_metric"]
+        # doc-pattern semantics: brace alternation + wildcard + labels
+        doc = tmp_path / "doc.md"
+        doc.write_text(
+            "| x | — | `a/{b,c}_d`, `e/<stat>{agg=min,max}`, `f/g/*` |\n")
+        ok = tmp_path / "ok.py"
+        ok.write_text("reg.gauge('a/b_d')\nreg.gauge('a/c_d')\n"
+                      "reg.gauge('e/anything')\nreg.gauge('f/g/deep/x')\n")
+        assert find_undocumented([str(ok)], str(doc)) == []
+
+    def test_config_validation(self):
+        with pytest.raises(ConfigError):
+            ObservabilityConfig(trace_sample_rate=1.5).validate()
+        with pytest.raises(ConfigError):
+            ObservabilityConfig(trace_max_events=2).validate()
+        with pytest.raises(ConfigError):
+            ObservabilityConfig(serve_slo_budget=0.0).validate()
+        ObservabilityConfig(request_tracing=True, serve_goodput=True,
+                            trace_sample_rate=0.25).validate()

@@ -421,6 +421,11 @@ class TestForkCOW:
         belong on the sharing line, not a phantom speculation line."""
         from deepspeed_tpu.observability.report import report
 
+        # the registry is a process singleton and this test renders a
+        # report from its ABSOLUTE contents — spec/fork counters left by
+        # earlier test modules (rlhf rollouts speculate) would paint a
+        # phantom speculation line. Render from a pristine registry.
+        get_registry().reset()
         srv = serving(tiny_engine)   # spec off
         handles = srv.submit(mixed_prompts(1, seed=17)[0],
                              max_new_tokens=4, n=2)
