@@ -28,7 +28,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..models.core import Model, cast_floating, resolve_param_specs
+from ..models.core import Model, cast_floating
 from ..models.presets import create_model
 from ..observability import get_session
 from ..parallel import mesh as mesh_mod
@@ -224,16 +224,16 @@ class InferenceEngine:
                     f") — those sites serve the weight-only {wo} path")
             cfg.a8_decode = True
 
-        # TP sharding plan (no fsdp axis — reference inference shards
-        # qkv/mlp across the mp group only, replicating the rest); MoE
-        # expert banks additionally shard their leading E dim over 'expert'
+        # the 'serving' policy from the rule registry: TP only, no fsdp axis
+        # (reference inference shards qkv/mlp across the mp group,
+        # replicating the rest); MoE expert banks additionally shard their
+        # leading E dim over 'expert'
         self._param_shapes = jax.eval_shape(model.init,
                                             jax.random.PRNGKey(0))
-        from ..models.core import DEFAULT_TP_RULES, EXPERT
+        from ..parallel.rules import get_policy
 
-        specs = resolve_param_specs(
-            self._param_shapes, model.axes,
-            rules={**DEFAULT_TP_RULES, EXPERT: mesh_mod.EXPERT_AXIS})
+        specs = get_policy("serving").param_specs(
+            self._param_shapes, model.axes, expert_parallel=True)
         self.param_shardings = jax.tree.map(
             lambda s: NamedSharding(self.mesh, s), specs,
             is_leaf=lambda x: isinstance(x, P))
@@ -431,7 +431,8 @@ class InferenceEngine:
                 tags={"engine": "InferenceEngine", "batch": B,
                       "prompt_bucket": S_pad,
                       # prefill ingests the whole padded prompt per run
-                      "tokens_per_step": B * S_pad})
+                      "tokens_per_step": B * S_pad,
+                      "shard": self._shard_tag()})
             return "inference/prefill"
         except Exception:   # registration must never take serving down
             logger.warning("tpuaudit prefill registration failed",
@@ -470,12 +471,24 @@ class InferenceEngine:
                 tags={"engine": "InferenceEngine", "batch": B,
                       "new_tokens": n_rest,
                       # one decode program emits n_rest tokens per row
-                      "tokens_per_step": B * n_rest})
+                      "tokens_per_step": B * n_rest,
+                      "shard": self._shard_tag()})
             return "inference/decode"
         except Exception:
             logger.warning("tpuaudit decode registration failed",
                            exc_info=True)
             return None
+
+    def _shard_tag(self) -> dict:
+        """tools/tpushard placement contract: the params argument follows
+        the registry's 'serving' policy; every program consuming these
+        weights (prefill↔decode, the ServingEngine programs over this
+        engine) shares the 'serving' exchange group, so the analyzer
+        cross-checks the chain's layouts."""
+        from ..parallel.rules import shard_tag
+
+        return shard_tag("serving", axes=self.model.axes, params_arg=0,
+                         expert_parallel=True, group="serving")
 
     # -- plain forward (reference InferenceEngine.forward / module call) -----
     def forward(self, input_ids, attention_mask=None):

@@ -19,27 +19,19 @@ the TPU answer to the reference's per-architecture injection policies.
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+from typing import Any, Callable, Optional
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import PartitionSpec as P
 
-from ..parallel.mesh import DATA_AXIS, MODEL_AXIS
-
-# logical axis vocabulary
-BATCH = "batch"
-SEQ = "seq"
-LAYERS = "layers"    # scanned layer stack dim — never sharded (scan carries it)
-VOCAB = "vocab"
-EMBED = "embed"
-HEADS = "heads"      # attention heads (TP-sharded)
-KV_HEADS = "kv_heads"
-HEAD_DIM = "head_dim"
-MLP = "mlp"          # ffn hidden (TP-sharded)
-EXPERT = "expert"    # MoE expert dim
-
-AxesTree = Any       # pytree of tuples of logical axis names, or None leaves
+# the logical-axis vocabulary, rule sets and spec resolution live in the
+# sharding rule registry (parallel/rules.py — the single source of truth
+# tools/tpushard audits against); re-exported here because models declare
+# their axes in these terms
+from ..parallel.rules import (AxesTree, BATCH, DEFAULT_TP_RULES, EMBED,  # noqa: F401
+                              EXPERT, HEAD_DIM, HEADS, KV_HEADS, LAYERS,
+                              MLP, SEQ, VOCAB, logical_to_spec,
+                              resolve_param_specs)
 
 
 @dataclasses.dataclass
@@ -71,78 +63,6 @@ class Model:
     # param-offload tier initialise one block at a time without ever
     # materialising the full stack
     init_layer_block: Optional[Callable[..., Any]] = None
-
-
-# ---------------------------------------------------------------------------
-# logical-axis → PartitionSpec resolution
-# ---------------------------------------------------------------------------
-
-# default TP rules (Megatron pattern): column-parallel on heads/mlp/vocab,
-# row-parallel contractions produce partial sums that XLA psums over "model".
-DEFAULT_TP_RULES: Dict[str, Optional[str]] = {
-    VOCAB: MODEL_AXIS,
-    HEADS: MODEL_AXIS,
-    KV_HEADS: MODEL_AXIS,
-    MLP: MODEL_AXIS,
-    EXPERT: None,          # expert dim handled by the MoE layer itself
-    "pipe_stage": "pipe",  # pipelined models: stage dim over the pipe axis
-}
-
-
-def logical_to_spec(axes: Optional[Tuple[str, ...]],
-                    shape: Tuple[int, ...],
-                    rules: Dict[str, Optional[str]],
-                    fsdp_axis: Optional[str] = None,
-                    fsdp_min_size: int = 2 ** 14) -> P:
-    """Resolve one param's logical axes to a PartitionSpec.
-
-    1. map each logical axis through ``rules`` (TP placement);
-    2. if ``fsdp_axis`` is set (ZeRO-3), additionally shard the largest
-       still-unmapped dimension over it — unless the param is tiny
-       (< fsdp_min_size elements, the reference's
-       stage3_param_persistence_threshold concept: small params stay
-       replicated to avoid gather latency for no memory win).
-    """
-    if axes is None:
-        return P()
-    mesh_axes: list = [rules.get(a) for a in axes]
-    # never shard the scan-carried layer dim
-    mesh_axes = [None if a == LAYERS else m for a, m in zip(axes, mesh_axes)]
-    if fsdp_axis is not None:
-        # a mesh axis may appear once per PartitionSpec: drop components of
-        # the (possibly composite) fsdp axis already consumed by TP/EP rules
-        used = set()
-        for m in mesh_axes:
-            if m is None:
-                continue
-            used.update(m if isinstance(m, tuple) else (m,))
-        want = fsdp_axis if isinstance(fsdp_axis, tuple) else (fsdp_axis,)
-        free = tuple(a for a in want if a not in used)
-        size = 1
-        for s in shape:
-            size *= s
-        if free and size >= fsdp_min_size:
-            candidates = [i for i, (a, m) in enumerate(zip(axes, mesh_axes))
-                          if m is None and a != LAYERS]
-            if candidates:
-                best = max(candidates, key=lambda i: shape[i])
-                mesh_axes[best] = free if len(free) > 1 else free[0]
-    return P(*mesh_axes)
-
-
-def resolve_param_specs(params: Any, axes: AxesTree,
-                        rules: Optional[Dict[str, Optional[str]]] = None,
-                        fsdp_axis: Optional[str] = None,
-                        fsdp_min_size: int = 2 ** 14) -> Any:
-    """Params tree + axes tree → PartitionSpec tree."""
-    rules = dict(DEFAULT_TP_RULES if rules is None else rules)
-
-    def one(p, ax):
-        return logical_to_spec(ax, jnp.shape(p), rules, fsdp_axis, fsdp_min_size)
-
-    return jax.tree.map(one, params, axes,
-                        is_leaf=lambda x: x is None or (isinstance(x, tuple)
-                                                        and all(isinstance(e, str) for e in x)))
 
 
 def param_count(params: Any) -> int:

@@ -784,6 +784,48 @@ def summarize_cost(records: List[Dict[str, Any]]) -> str:
     return "\n".join(lines)
 
 
+def summarize_sharding(records: List[Dict[str, Any]]) -> str:
+    """``== sharding ==`` — the layout audit tpushard publishes as
+    ``tpushard/<entry>/<metric>`` gauges plus the ``tpushard/findings``
+    counter: per-entry rule coverage (params checked vs covered by the
+    contract), GSPMD reshard collectives attributed to rule violations, and
+    wasted replicated bytes."""
+    recs = [r for r in records if r.get("type") == "gauge"
+            and str(r.get("name", "")).startswith("tpushard/")]
+    if not recs:
+        return ""
+    entries: Dict[str, Dict[str, Any]] = {}
+    for r in recs:
+        entry, _, metric = r["name"][len("tpushard/"):].rpartition("/")
+        entries.setdefault(entry, {})[metric] = r["value"]   # latest wins
+    rows = []
+    for entry in sorted(entries):
+        m = entries[entry]
+
+        def val(name: str, fmt: str = ",.0f") -> str:
+            return format(m[name], fmt) if name in m else "-"
+
+        rows.append([
+            entry,
+            f"{val('params_checked')}/{val('params_total')}",
+            val("rule_violations"),
+            val("reshard_collectives"),
+            val("replicated_bytes"),
+        ])
+    lines = ["== sharding ==",
+             _fmt_table(["entry", "checked", "violations", "reshards",
+                         "repl_bytes"], rows)]
+    findings: Dict[str, float] = {}
+    for r in records:
+        if r.get("type") == "counter" and r.get("name") == "tpushard/findings":
+            findings[_label_str(r.get("labels", {}))] = r["value"]
+    total = sum(findings.values())
+    if total:
+        lines.append(f"  !! {total:.0f} layout finding(s) — run "
+                     "python -m tools.tpushard for the details")
+    return "\n".join(lines)
+
+
 def summarize_recompiles(records: List[Dict[str, Any]]) -> str:
     compiles = [r for r in records
                 if r.get("type") == "counter" and r.get("name") == "xla/compiles"]
@@ -837,6 +879,7 @@ def report(paths: List[str]) -> str:
                             summarize_resilience(records),
                             summarize_rlhf(records),
                             summarize_cost(records),
+                            summarize_sharding(records),
                             summarize_serving(records),
                             summarize_serve_goodput(records),
                             summarize_reqtrace(records),

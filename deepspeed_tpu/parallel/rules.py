@@ -1,0 +1,273 @@
+"""Logical-axis rule registry — the single source of truth for sharding.
+
+Models declare *logical* axes once (``('embed', 'vocab')``,
+``('layers', 'kv_heads', 'head_dim')``, ...) in ``Model.axes``; this module
+owns the **rule sets** that map logical axes to mesh axes, bundled into named
+:class:`Policy` objects (the t5x/flax ``logical_axis_rules`` pattern, one
+registry instead of per-engine dicts):
+
+* ``tp``      — Megatron tensor parallelism only: heads/mlp/vocab over the
+  ``model`` axis, everything else replicated. The placement of ZeRO 0-2
+  params, ZeRO 0-1 grads and ZeRO-0 optimizer state.
+* ``fsdp``    — ``tp`` plus the largest still-unmapped dimension of each
+  (large-enough) param sharded over the composite data axes
+  (``DATA_SHARD = (expert, data)``). The placement of ZeRO-3 params, ZeRO-2+
+  grads and ZeRO-1+ optimizer state.
+* ``serving`` — ``tp`` resolved on the serving mesh with MoE expert banks
+  over the ``expert`` axis and NO fsdp axis (the reference's inference
+  engine shards qkv/mlp across the mp group only). Also the TARGET of the
+  RLHF train→serve weight flip, which makes the flip "two policies over one
+  rule set": its source is the train policy, its ``out_shardings`` derive
+  from this one.
+
+Everything that used to hand-build PartitionSpec trees (``models/core.py``
+annotations, ``parallel/zero.py`` spec trees, engine ``out_shardings``, the
+RLHF flip's target specs) derives from this registry; ``tools/tpushard``
+statically audits every registered program against it, and the tpulint rule
+``hardcoded-partition-spec`` flags new hand-built specs outside this module.
+
+Entry points advertise their placement contract to the analyzer via
+:func:`shard_tag` stored under ``tags["shard"]`` at tpuaudit registration.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from .mesh import DATA_SHARD, EXPERT_AXIS, MODEL_AXIS, PIPE_AXIS
+
+# ---------------------------------------------------------------------------
+# logical axis vocabulary (models declare these once, in Model.axes)
+# ---------------------------------------------------------------------------
+
+BATCH = "batch"
+SEQ = "seq"
+LAYERS = "layers"    # scanned layer stack dim — never sharded (scan carries it)
+VOCAB = "vocab"
+EMBED = "embed"
+HEADS = "heads"      # attention heads (TP-sharded)
+KV_HEADS = "kv_heads"
+HEAD_DIM = "head_dim"
+MLP = "mlp"          # ffn hidden (TP-sharded)
+EXPERT = "expert"    # MoE expert dim
+PIPE_STAGE = "pipe_stage"   # pipelined models: stacked per-stage params
+
+AxesTree = Any       # pytree of tuples of logical axis names, or None leaves
+
+# default TP rules (Megatron pattern): column-parallel on heads/mlp/vocab,
+# row-parallel contractions produce partial sums that XLA psums over "model".
+DEFAULT_TP_RULES: Dict[str, Optional[str]] = {
+    VOCAB: MODEL_AXIS,
+    HEADS: MODEL_AXIS,
+    KV_HEADS: MODEL_AXIS,
+    MLP: MODEL_AXIS,
+    EXPERT: None,           # expert dim handled by the MoE layer itself
+    PIPE_STAGE: PIPE_AXIS,  # pipelined models: stage dim over the pipe axis
+}
+
+
+# ---------------------------------------------------------------------------
+# logical-axis → PartitionSpec resolution
+# ---------------------------------------------------------------------------
+
+def logical_to_spec(axes: Optional[Tuple[str, ...]],
+                    shape: Tuple[int, ...],
+                    rules: Dict[str, Optional[str]],
+                    fsdp_axis: Optional[str] = None,
+                    fsdp_min_size: int = 2 ** 14) -> P:
+    """Resolve one param's logical axes to a PartitionSpec.
+
+    1. map each logical axis through ``rules`` (TP placement);
+    2. if ``fsdp_axis`` is set (ZeRO-3), additionally shard the largest
+       still-unmapped dimension over it — unless the param is tiny
+       (< fsdp_min_size elements, the reference's
+       stage3_param_persistence_threshold concept: small params stay
+       replicated to avoid gather latency for no memory win).
+    """
+    if axes is None:
+        return P()
+    mesh_axes: list = [rules.get(a) for a in axes]
+    # never shard the scan-carried layer dim
+    mesh_axes = [None if a == LAYERS else m for a, m in zip(axes, mesh_axes)]
+    if fsdp_axis is not None:
+        # a mesh axis may appear once per PartitionSpec: drop components of
+        # the (possibly composite) fsdp axis already consumed by TP/EP rules
+        used = set()
+        for m in mesh_axes:
+            if m is None:
+                continue
+            used.update(m if isinstance(m, tuple) else (m,))
+        want = fsdp_axis if isinstance(fsdp_axis, tuple) else (fsdp_axis,)
+        free = tuple(a for a in want if a not in used)
+        size = 1
+        for s in shape:
+            size *= s
+        if free and size >= fsdp_min_size:
+            candidates = [i for i, (a, m) in enumerate(zip(axes, mesh_axes))
+                          if m is None and a != LAYERS]
+            if candidates:
+                best = max(candidates, key=lambda i: shape[i])
+                mesh_axes[best] = free if len(free) > 1 else free[0]
+    return P(*mesh_axes)
+
+
+def resolve_param_specs(params: Any, axes: AxesTree,
+                        rules: Optional[Dict[str, Optional[str]]] = None,
+                        fsdp_axis: Optional[str] = None,
+                        fsdp_min_size: int = 2 ** 14) -> Any:
+    """Params tree + axes tree → PartitionSpec tree."""
+    rules = dict(DEFAULT_TP_RULES if rules is None else rules)
+
+    def one(p, ax):
+        return logical_to_spec(ax, jnp.shape(p), rules, fsdp_axis, fsdp_min_size)
+
+    return jax.tree.map(one, params, axes,
+                        is_leaf=lambda x: x is None or (isinstance(x, tuple)
+                                                        and all(isinstance(e, str) for e in x)))
+
+
+# ---------------------------------------------------------------------------
+# named policies
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class Policy:
+    """One named rule set: logical-axis → mesh-axis mapping plus the fsdp
+    derivation parameters. ``rules`` is stored as a sorted item tuple so the
+    policy is hashable/frozen; read it through :meth:`rules_dict`."""
+
+    name: str
+    description: str
+    rules: Tuple[Tuple[str, Any], ...]
+    fsdp_axis: Optional[Any] = None         # mesh axis name or tuple, or None
+    fsdp_min_size: int = 2 ** 11
+
+    def rules_dict(self, *, expert_parallel: bool = False,
+                   overrides: Optional[Dict[str, Any]] = None
+                   ) -> Dict[str, Optional[str]]:
+        """The mapping this policy applies. ``expert_parallel`` adds the MoE
+        expert-bank rule (expert dim over the 'expert' mesh axis — the
+        reference's ep<=dp group structure). ``overrides`` is tpushard's
+        fault-injection seam: remap axes on the EXPECTATION side only."""
+        d = dict(self.rules)
+        if expert_parallel:
+            d[EXPERT] = EXPERT_AXIS
+        if overrides:
+            d.update(overrides)
+        return d
+
+    def param_specs(self, params_or_shapes: Any, axes: AxesTree, *,
+                    expert_parallel: bool = False,
+                    fsdp_min_size: Optional[int] = None,
+                    rule_overrides: Optional[Dict[str, Any]] = None) -> Any:
+        """Registry-derived PartitionSpec tree for a params tree under this
+        policy — THE resolution path every engine and the tpushard analyzer
+        share."""
+        return resolve_param_specs(
+            params_or_shapes, axes,
+            self.rules_dict(expert_parallel=expert_parallel,
+                            overrides=rule_overrides),
+            fsdp_axis=self.fsdp_axis,
+            fsdp_min_size=(self.fsdp_min_size if fsdp_min_size is None
+                           else fsdp_min_size))
+
+
+_POLICIES: Dict[str, Policy] = {}
+
+
+def register_policy(policy: Policy) -> Policy:
+    _POLICIES[policy.name] = policy
+    return policy
+
+
+def get_policy(name: str) -> Policy:
+    try:
+        return _POLICIES[name]
+    except KeyError:
+        raise KeyError(f"unknown sharding policy {name!r} "
+                       f"(registered: {sorted(_POLICIES)})") from None
+
+
+def policy_names() -> Tuple[str, ...]:
+    return tuple(sorted(_POLICIES))
+
+
+_TP_ITEMS = tuple(sorted(DEFAULT_TP_RULES.items(),
+                         key=lambda kv: kv[0]))
+
+register_policy(Policy(
+    name="tp",
+    description="Megatron TP only: heads/mlp/vocab over 'model'; the "
+                "placement of ZeRO 0-2 params, 0-1 grads, 0 optimizer state",
+    rules=_TP_ITEMS))
+register_policy(Policy(
+    name="fsdp",
+    description="TP + largest free dim of each >=fsdp_min_size param over "
+                "(expert, data): ZeRO-3 params, ZeRO-2+ grads, ZeRO-1+ "
+                "optimizer state",
+    rules=_TP_ITEMS, fsdp_axis=DATA_SHARD))
+register_policy(Policy(
+    name="serving",
+    description="inference/serving placement: TP with MoE expert banks over "
+                "'expert', no fsdp — also the RLHF flip's target",
+    rules=_TP_ITEMS))
+
+
+def zero_policy(stage: int, state: str = "params") -> Policy:
+    """The placement policy ZeRO assigns one state category at one stage —
+    the table from the module docstring of ``parallel/zero.py`` as data."""
+    thresholds = {"params": 3, "grads": 2, "masters": 1}
+    try:
+        need = thresholds[state]
+    except KeyError:
+        raise ValueError(f"state must be one of {sorted(thresholds)}, "
+                         f"got {state!r}") from None
+    return get_policy("fsdp" if stage >= need else "tp")
+
+
+# ---------------------------------------------------------------------------
+# the analyzer contract (tools/tpushard)
+# ---------------------------------------------------------------------------
+
+def shard_tag(policy: str, *, axes: AxesTree, params_arg: int = 0,
+              expert_parallel: bool = False,
+              fsdp_min_size: Optional[int] = None,
+              group: Optional[str] = None,
+              check_output: bool = False,
+              source: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+    """The ``tags["shard"]`` payload a tpuaudit registration site attaches so
+    ``tools/tpushard`` can recompute the entry's expected layout:
+
+    * ``policy``/``expert_parallel``/``fsdp_min_size`` — how to resolve the
+      expected specs for the params tree at ``args[params_arg]``;
+    * ``axes`` — the model's logical-axis tree (held by reference);
+    * ``group`` — entries that exchange live buffers (train↔eval,
+      prefill↔decode↔verify, ...) share a group name; the analyzer
+      cross-checks same-labelled params across a group;
+    * ``check_output=True`` — audit the program's OUTPUT tree against the
+      policy instead of an input (the RLHF flip: its outputs must land on
+      the serving placement — the analyzer resolves the target mesh from
+      the compiled output shardings themselves, since everything in
+      ``ep.tags`` must stay JSON-serializable for the crash-bundle
+      fingerprints and the analyzers' ``--format json``);
+    * ``source`` — a nested tag for the INPUT side when it follows a
+      different policy than the output (the flip's train-side source).
+    """
+    get_policy(policy)   # fail at registration, not analysis
+    tag: Dict[str, Any] = {"policy": policy, "axes": axes,
+                           "params_arg": params_arg,
+                           "expert_parallel": bool(expert_parallel)}
+    if fsdp_min_size is not None:
+        tag["fsdp_min_size"] = int(fsdp_min_size)
+    if group is not None:
+        tag["group"] = group
+    if check_output:
+        tag["check_output"] = True
+    if source is not None:
+        tag["source"] = source
+    return tag

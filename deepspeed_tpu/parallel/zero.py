@@ -29,8 +29,8 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..config.config import ZeroConfig
-from ..models.core import DEFAULT_TP_RULES, resolve_param_specs
 from ..utils.logging import logger
+from .rules import get_policy, resolve_param_specs
 from .mesh import DATA_SHARD, MODEL_AXIS
 
 
@@ -43,8 +43,15 @@ class ZeroShardingPlan(NamedTuple):
 
 def build_sharding_plan(stage: int, params_or_shapes: Any, axes: Any,
                         tp_rules: Optional[Dict[str, Optional[str]]] = None,
-                        fsdp_min_size: int = 2 ** 11) -> ZeroShardingPlan:
+                        fsdp_min_size: int = 2 ** 11,
+                        expert_parallel: bool = False) -> ZeroShardingPlan:
     """Compute the ZeRO sharding plan.
+
+    The two placements come from the rule registry (``parallel/rules.py``):
+    ``tp`` and ``fsdp`` — which state category gets which is the only thing
+    the stage decides. ``expert_parallel`` adds the MoE expert-bank rule;
+    ``tp_rules`` remains as an explicit-override escape hatch (tests,
+    experiments) and bypasses the registry when given.
 
     ``fsdp_min_size`` mirrors the reference's stage3_param_persistence_threshold
     (partition_parameters.py: small params stay dense); tiny tensors are
@@ -52,11 +59,19 @@ def build_sharding_plan(stage: int, params_or_shapes: Any, axes: Any,
     """
     if not 0 <= stage <= 3:
         raise ValueError(f"ZeRO stage must be 0..3, got {stage}")
-    rules = dict(DEFAULT_TP_RULES if tp_rules is None else tp_rules)
-
-    tp_only = resolve_param_specs(params_or_shapes, axes, rules, fsdp_axis=None)
-    fsdp = resolve_param_specs(params_or_shapes, axes, rules, fsdp_axis=DATA_SHARD,
-                               fsdp_min_size=fsdp_min_size)
+    if tp_rules is not None:
+        rules = dict(tp_rules)
+        tp_only = resolve_param_specs(params_or_shapes, axes, rules,
+                                      fsdp_axis=None)
+        fsdp = resolve_param_specs(params_or_shapes, axes, rules,
+                                   fsdp_axis=DATA_SHARD,
+                                   fsdp_min_size=fsdp_min_size)
+    else:
+        tp_only = get_policy("tp").param_specs(
+            params_or_shapes, axes, expert_parallel=expert_parallel)
+        fsdp = get_policy("fsdp").param_specs(
+            params_or_shapes, axes, expert_parallel=expert_parallel,
+            fsdp_min_size=fsdp_min_size)
 
     param_specs = fsdp if stage >= 3 else tp_only
     grad_specs = fsdp if stage >= 2 else tp_only

@@ -260,11 +260,8 @@ class TrainEngine:
         # ---- sharded state construction (zero.Init equivalent) ----------
         rng = rng if rng is not None else jax.random.PRNGKey(self.config.seed)
         param_shapes = jax.eval_shape(model.init, rng)
-        tp_rules = None
         ep = self.config.parallel.expert_parallel_size
         if ep > 1:
-            from ..models.core import DEFAULT_TP_RULES, EXPERT
-
             # experts shard over the dedicated 'expert' mesh axis; each expert
             # is replicated across its 'data'-axis ranks — the reference's
             # expert + expert-data group structure (groups.py:108/156), ep<=dp
@@ -273,11 +270,12 @@ class TrainEngine:
                 raise ValueError(
                     f"moe_num_experts={n_experts} must be divisible by "
                     f"expert_parallel_size={ep}")
-            tp_rules = {**DEFAULT_TP_RULES, EXPERT: mesh_mod.EXPERT_AXIS}
-        self.plan: ZeroShardingPlan = build_sharding_plan(
-            self.config.zero_stage, param_shapes, model.axes, tp_rules=tp_rules,
-            fsdp_min_size=self.config.zero_optimization.stage3_param_persistence_threshold
+        self._fsdp_min_size = (
+            self.config.zero_optimization.stage3_param_persistence_threshold
             if self.config.zero_stage >= 3 else 2 ** 11)
+        self.plan: ZeroShardingPlan = build_sharding_plan(
+            self.config.zero_stage, param_shapes, model.axes,
+            expert_parallel=ep > 1, fsdp_min_size=self._fsdp_min_size)
         self.param_shardings = as_named(self.plan.param_specs, self.mesh)
         logger.info(describe_plan(self.plan, jax.tree.leaves(param_shapes)
                                   and param_shapes or {}))
@@ -1537,7 +1535,8 @@ class TrainEngine:
                       # tokens processed by ONE execution of this program
                       # (all gas microbatches) — tpucost's roofline turns
                       # it into a predicted tokens/sec bound
-                      "tokens_per_step": _batch_tokens(stacked_batch)})
+                      "tokens_per_step": _batch_tokens(stacked_batch),
+                      "shard": self._shard_tag(group=prefix)})
             return name
         except Exception:  # registration must never take training down
             logger.warning("tpuaudit step registration failed", exc_info=True)
@@ -1569,11 +1568,26 @@ class TrainEngine:
                 expected_collectives=self._expected_collectives(train=False),
                 mesh=self.mesh, compile=not self.model.pipelined,
                 tags={"engine": "TrainEngine",
-                      "tokens_per_step": _batch_tokens(batch)})
+                      "tokens_per_step": _batch_tokens(batch),
+                      "shard": self._shard_tag(group=prefix)})
             return name
         except Exception:
             logger.warning("tpuaudit eval registration failed", exc_info=True)
             return None
+
+    def _shard_tag(self, group: str) -> dict:
+        """The tools/tpushard placement contract for this engine's programs:
+        the params argument follows the ZeRO param placement from the rule
+        registry; entries in one ``group`` exchange live buffers (step and
+        eval consume the same params tree), so the analyzer cross-checks
+        their layouts."""
+        from ..parallel.rules import shard_tag
+
+        return shard_tag(
+            "fsdp" if self.config.zero_stage >= 3 else "tp",
+            axes=self.model.axes, params_arg=0,
+            expert_parallel=self.config.parallel.expert_parallel_size > 1,
+            fsdp_min_size=self._fsdp_min_size, group=group)
 
     # -- profiling (reference flops_profiler engine hooks + NVTX ranges) --
     def get_flops_profile(self):

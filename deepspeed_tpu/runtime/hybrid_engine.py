@@ -328,11 +328,25 @@ class HybridEngine(TrainEngine):
                     params)
                 return eng._flip_program, (sds,), {}
 
+            from ..parallel.rules import shard_tag
+            # the flip is the one program whose INPUT follows the training
+            # policy and whose OUTPUT must land on the serving rules: tag it
+            # check_output so tpushard verifies the target placement (the
+            # analyzer reads the inference mesh off the compiled output
+            # shardings) and cross-checks it against the serving group
+            stage = self.zero_optimization_stage()
+            shard = shard_tag(
+                "serving", axes=self.model.axes, params_arg=0,
+                expert_parallel=True, group="serving",
+                check_output=True,
+                source={"policy": "fsdp" if stage >= 3 else "tp",
+                        "fsdp_min_size": self._fsdp_min_size})
             register_entry_point(
                 "rlhf/flip", build=build, expected_collectives=expected,
                 mesh=self.mesh,
                 tags={"engine": "HybridEngine",
-                      "zero_stage": self.zero_optimization_stage()})
+                      "zero_stage": stage,
+                      "shard": shard})
         except Exception:   # registration must never take training down
             logger.warning("tpuaudit rlhf/flip registration failed",
                            exc_info=True)

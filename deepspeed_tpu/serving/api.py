@@ -1438,6 +1438,10 @@ class ServingEngine:
             expected = self.engine._audit_expected_collectives()
             R, MAXB = self.config.max_seqs, self.blocks_per_seq
             C = self.config.prefill_chunk
+            # all params-consuming programs here serve the SAME weight tree
+            # as the underlying InferenceEngine — same policy, same
+            # exchange group (tools/tpushard cross-checks the chain)
+            shard = self.engine._shard_tag()
 
             def build_prefill():
                 eng = wself()
@@ -1470,14 +1474,14 @@ class ServingEngine:
                 tags={"engine": "ServingEngine", "chunk": C,
                       "max_blocks": MAXB, "paged_impl": self._paged_impl,
                       # one chunked-prefill run ingests C prompt tokens
-                      "tokens_per_step": C})
+                      "tokens_per_step": C, "shard": shard})
             register_entry_point(
                 "serving/decode", build=build_decode, donate_argnums=(1,),
                 expected_collectives=expected, mesh=self.engine.mesh,
                 tags={"engine": "ServingEngine", "rows": R,
                       "max_blocks": MAXB, "paged_impl": self._paged_impl,
                       # one decode iteration emits one token per row
-                      "tokens_per_step": R})
+                      "tokens_per_step": R, "shard": shard})
 
             def build_cow():
                 eng = wself()
@@ -1519,7 +1523,7 @@ class ServingEngine:
                 tags={"engine": "ServingEngine", "chunk": C,
                       "max_blocks": MAXB, "paged_impl": self._paged_impl,
                       # one scoring chunk ingests C sequence tokens
-                      "tokens_per_step": C})
+                      "tokens_per_step": C, "shard": shard})
             names = ["serving/prefill_chunk", "serving/decode",
                      "serving/cow_copy", "serving/score_chunk"]
             if self._drafter is not None:
@@ -1565,7 +1569,8 @@ class ServingEngine:
                   "max_blocks": MAXB, "paged_impl": self._paged_impl,
                   # conservative floor: one verify dispatch emits AT LEAST
                   # one token per row (acceptance only adds to this)
-                  "tokens_per_step": R})
+                  "tokens_per_step": R,
+                  "shard": self.engine._shard_tag()})
         names = ["serving/verify"]
         drafter = self._drafter
         if not hasattr(drafter, "_decode"):    # host-side drafter: no
@@ -1575,6 +1580,12 @@ class ServingEngine:
         dcfg = drafter.engine.model.config
         dexp = drafter.engine._audit_expected_collectives()
         C = drafter.draft_chunk
+        # the draft model is a separate weight tree — its own shard group so
+        # tpushard never cross-compares draft params with target params
+        from ..parallel.rules import shard_tag
+        dshard = shard_tag("serving", axes=drafter.engine.model.axes,
+                           params_arg=0, expert_parallel=True,
+                           group="serving-draft")
 
         def draft_arena_sds(eng):
             return paged_cache_shape_struct(
@@ -1618,13 +1629,15 @@ class ServingEngine:
             donate_argnums=(1,), expected_collectives=dexp,
             mesh=drafter.engine.mesh,
             tags={"engine": "ServingEngine", "rows": R,
-                  "draft_model": True, "tokens_per_step": R})
+                  "draft_model": True, "tokens_per_step": R,
+                  "shard": dshard})
         register_entry_point(
             "serving/draft_prefill", build=build_draft_prefill,
             donate_argnums=(1,), expected_collectives=dexp,
             mesh=drafter.engine.mesh,
             tags={"engine": "ServingEngine", "chunk": C,
-                  "draft_model": True, "tokens_per_step": C})
+                  "draft_model": True, "tokens_per_step": C,
+                  "shard": dshard})
         return names + ["serving/draft_decode", "serving/draft_prefill"]
 
 

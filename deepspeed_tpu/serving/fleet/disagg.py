@@ -61,7 +61,9 @@ def _check_geometry(src, dst) -> None:
         raise HandoffGeometryError(
             f"KV handoff needs identical arena geometry "
             f"(L, KV heads, head dim, block size, blocks/seq, dtype): "
-            f"source {s} vs destination {d}")
+            f"source {s} vs destination {d} — a fleet config this "
+            f"mismatched is caught statically by `python -m tools.tpushard` "
+            f"(finding serving/kv_export::cross-program-mismatch)")
 
 
 class KVHandoff:
@@ -223,16 +225,22 @@ def register_handoff_audit_entries(engine, handoff: ArenaHandoff
             arena, buf, ids = _shapes(eng)
             return handoff._import, (arena, buf, buf, ids), {}
 
+        # no params in these programs — the "handoff" tag is tpushard's
+        # geometry seam: export OUTPUT buffers must land exactly like
+        # import's staging-buffer ARGS (args 1, 2), else the fleet would
+        # reshard every migrated request's KV mid-flight
         register_entry_point(
             "serving/kv_export", build=build_export,
             expected_collectives=(), mesh=engine.engine.mesh,
             tags={"engine": "FleetRouter", "max_blocks": maxb,
-                  "block_size": bs})
+                  "block_size": bs,
+                  "handoff": {"role": "export"}})
         register_entry_point(
             "serving/kv_import", build=build_import, donate_argnums=(0,),
             expected_collectives=(), mesh=engine.engine.mesh,
             tags={"engine": "FleetRouter", "max_blocks": maxb,
-                  "block_size": bs})
+                  "block_size": bs,
+                  "handoff": {"role": "import", "buffer_args": (1, 2)}})
         return ["serving/kv_export", "serving/kv_import"]
     except Exception:   # registration must never take serving down
         logger.warning("tpuaudit handoff registration failed", exc_info=True)

@@ -16,7 +16,7 @@
 #     under forced preemption (pool too small) and after a NaN→rollback
 #     recovery mid-iteration.
 #
-# CPU-only and deterministic; part of scripts/check.sh (7th gate).
+# CPU-only and deterministic; part of scripts/check.sh (8th gate).
 #
 # Usage: scripts/rlhf.sh [extra pytest args...]
 set -euo pipefail

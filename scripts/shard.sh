@@ -1,0 +1,12 @@
+#!/usr/bin/env bash
+# Repo static-sharding gate: tpushard over the selftest engines against the
+# committed baseline. Exits non-zero on any new layout finding (rule
+# violation, implicit reshard, cross-program mismatch, replication waste)
+# or stale baseline entry. Usage: scripts/shard.sh [extra tpushard args...]
+set -euo pipefail
+
+cd "$(dirname "$0")/.."
+
+JAX_PLATFORMS=cpu python -m tools.tpushard \
+    --config tools/tpuaudit/selftest_config.json \
+    --baseline .tpushard-baseline.json "$@"

@@ -179,16 +179,58 @@ class TestUnknownMeshAxis:
         assert "unknown-mesh-axis" in rules_of(src)
 
     def test_negative_declared_axes(self):
+        # (hardcoded-partition-spec still fires on the literal axes — this
+        # fixture only cares that the axes are KNOWN)
         src = (self.DECL +
                "from jax.sharding import PartitionSpec as P\n"
                "spec = P(('data',), 'model')\n")
-        assert rules_of(src) == []
+        assert "unknown-mesh-axis" not in rules_of(src)
 
     def test_negative_without_any_declaration(self):
         # no mesh in the analyzed set -> nothing to validate against
         src = ("from jax.sharding import PartitionSpec as P\n"
                "spec = P('anything')\n")
-        assert rules_of(src) == []
+        assert "unknown-mesh-axis" not in rules_of(src)
+
+
+class TestHardcodedPartitionSpec:
+    SRC = ('MODEL_AXIS = "model"\n'
+           "from jax.sharding import PartitionSpec as P\n"
+           "spec = P('model', None)\n")
+
+    def test_positive_literal_axis(self):
+        assert "hardcoded-partition-spec" in rules_of(self.SRC)
+
+    def test_positive_tuple_axes(self):
+        src = ("from jax.sharding import PartitionSpec as P\n"
+               "spec = P(('expert', 'data'))\n")
+        assert "hardcoded-partition-spec" in rules_of(src)
+
+    def test_negative_axis_constant(self):
+        # placement through the named constants stays allowed — only the
+        # string literals bypass the registry
+        src = ('MODEL_AXIS = "model"\n'
+               "from jax.sharding import PartitionSpec as P\n"
+               "spec = P(MODEL_AXIS)\n")
+        assert "hardcoded-partition-spec" not in rules_of(src)
+
+    def test_negative_empty_spec(self):
+        src = ("from jax.sharding import PartitionSpec as P\n"
+               "spec = P()\n")
+        assert "hardcoded-partition-spec" not in rules_of(src)
+
+    def test_negative_in_rule_registry(self):
+        assert "hardcoded-partition-spec" not in rules_of(
+            self.SRC, path="deepspeed_tpu/parallel/rules.py")
+
+    def test_negative_in_tests(self):
+        assert "hardcoded-partition-spec" not in rules_of(
+            self.SRC, path="tests/unit/test_something.py")
+
+    def test_inline_suppression(self):
+        src = ("from jax.sharding import PartitionSpec as P\n"
+               "spec = P('model')  # tpulint: disable=hardcoded-partition-spec\n")
+        assert "hardcoded-partition-spec" not in rules_of(src)
 
 
 class TestDeprecatedJaxApi:

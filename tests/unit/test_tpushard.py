@@ -1,0 +1,423 @@
+"""tpushard unit tests: registry↔legacy golden spec parity (the migration's
+behavior-preservation proof), compiled-HLO canonical-hash parity, the four
+finding classes on in-process entries (rule-violation, implicit-reshard,
+cross-program-mismatch, replication-waste), the fault-injection seam (a
+deliberately wrong rule must fail the gate naming entry, parameter and
+expected-vs-actual spec), the report CLI's ``== sharding ==`` section, and
+the repo-wide gate (selftest engines vs the committed baseline — what makes
+tier-1 enforce program-layout analysis)."""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deepspeed_tpu.parallel.mesh import DATA_SHARD, EXPERT_AXIS
+from deepspeed_tpu.parallel.rules import (DEFAULT_TP_RULES, EXPERT,
+                                          get_policy, policy_names,
+                                          resolve_param_specs, shard_tag,
+                                          zero_policy)
+from deepspeed_tpu.parallel.zero import build_sharding_plan
+from tools.tpuaudit import clear_registry, register_entry_point
+from tools.tpushard.cli import main as tpushard_main
+from tools.tpushard.core import canonical_hash, run_shard
+
+REPO = Path(__file__).resolve().parents[2]
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    clear_registry()
+    yield
+    clear_registry()
+
+
+def sds(shape, dtype=jnp.float32, sharding=None):
+    return jax.ShapeDtypeStruct(shape, dtype, sharding=sharding)
+
+
+def mesh3():
+    devs = np.array(jax.devices()).reshape(1, 4, 2)
+    return Mesh(devs, ("expert", "data", "model"))
+
+
+SHAPES = {"emb": sds((512, 64)), "w": sds((64, 256)), "b": sds((256,)),
+          "experts": sds((4, 64, 64))}
+AXES = {"emb": ("vocab", "embed"), "w": ("embed", "mlp"), "b": ("mlp",),
+        "experts": ("expert", "embed", "embed")}
+
+
+# ---------------------------------------------------------------------------
+# golden parity: the registry derives EXACTLY the legacy hand-built trees
+# (spec equality is the static form of the HLO-parity guarantee: identical
+# specs -> identical out_shardings -> identical compiled programs)
+
+
+class TestPolicyGolden:
+    def test_registered_policies(self):
+        assert policy_names() == ("fsdp", "serving", "tp")
+
+    def test_tp_matches_legacy_resolution(self):
+        assert get_policy("tp").param_specs(SHAPES, AXES) == \
+            resolve_param_specs(SHAPES, AXES, dict(DEFAULT_TP_RULES),
+                                fsdp_axis=None)
+
+    def test_fsdp_matches_legacy_resolution(self):
+        for min_size in (2 ** 11, 2 ** 14, 1):
+            assert get_policy("fsdp").param_specs(
+                SHAPES, AXES, fsdp_min_size=min_size) == \
+                resolve_param_specs(SHAPES, AXES, dict(DEFAULT_TP_RULES),
+                                    fsdp_axis=DATA_SHARD,
+                                    fsdp_min_size=min_size)
+
+    def test_serving_ep_matches_legacy_resolution(self):
+        legacy = resolve_param_specs(
+            SHAPES, AXES, {**DEFAULT_TP_RULES, EXPERT: EXPERT_AXIS},
+            fsdp_axis=None)
+        assert get_policy("serving").param_specs(
+            SHAPES, AXES, expert_parallel=True) == legacy
+        # the expert bank picked up the expert axis
+        assert legacy["experts"][0] == EXPERT_AXIS
+
+    def test_zero_policy_table(self):
+        # params: fsdp iff stage >= 3; grads >= 2; masters >= 1
+        assert [zero_policy(s, "params").name for s in range(4)] == \
+            ["tp", "tp", "tp", "fsdp"]
+        assert [zero_policy(s, "grads").name for s in range(4)] == \
+            ["tp", "tp", "fsdp", "fsdp"]
+        assert [zero_policy(s, "masters").name for s in range(4)] == \
+            ["tp", "fsdp", "fsdp", "fsdp"]
+        with pytest.raises(ValueError):
+            zero_policy(3, "momentum")
+
+    def test_plan_derives_from_registry(self):
+        plan = build_sharding_plan(3, SHAPES, AXES, fsdp_min_size=2 ** 11)
+        assert plan.param_specs == get_policy("fsdp").param_specs(
+            SHAPES, AXES, fsdp_min_size=2 ** 11)
+        plan0 = build_sharding_plan(0, SHAPES, AXES)
+        assert plan0.param_specs == get_policy("tp").param_specs(SHAPES, AXES)
+        assert plan0.grad_specs == plan0.master_specs == plan0.param_specs
+
+    def test_rule_override_seam(self):
+        rules = get_policy("tp").rules_dict(overrides={"vocab": "data"})
+        assert rules["vocab"] == "data"
+        # the policy's own rules are immutable — overrides never leak back
+        assert dict(get_policy("tp").rules)["vocab"] == "model"
+
+    def test_shard_tag_validates_policy(self):
+        tag = shard_tag("serving", axes=AXES, expert_parallel=True,
+                        group="g")
+        assert tag["policy"] == "serving" and tag["group"] == "g"
+        with pytest.raises(KeyError):
+            shard_tag("nope", axes=AXES)
+
+    def test_hlo_parity_registry_vs_legacy(self):
+        """The actual compiled programs are identical whichever path
+        resolves the specs — the load-bearing migration guarantee."""
+        mesh = mesh3()
+        specs_new = get_policy("tp").param_specs(SHAPES, AXES)
+        specs_old = resolve_param_specs(SHAPES, AXES, dict(DEFAULT_TP_RULES),
+                                        fsdp_axis=None)
+
+        def compile_with(specs):
+            shardings = jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
+                                     is_leaf=lambda x: isinstance(x, P))
+            fn = jax.jit(lambda p: jax.tree.map(lambda a: a * 2.0, p),
+                         out_shardings=shardings)
+            args = jax.tree.map(
+                lambda x, s: sds(x.shape, x.dtype,
+                                 sharding=NamedSharding(mesh, s)),
+                SHAPES, specs)
+            return fn.trace(args).lower().compile().as_text()
+
+        assert canonical_hash(compile_with(specs_new)) == \
+            canonical_hash(compile_with(specs_old))
+
+
+class TestCanonicalHash:
+    def test_metadata_and_whitespace_invariant(self):
+        a = ('%add = f32[4] add(%x, %y), metadata={op_name="jit(f)/add" '
+             'source_file="a.py" source_line=3}\n')
+        b = ('%add  =  f32[4]  add(%x, %y), metadata={op_name="jit(g)/add" '
+             'source_file="b.py" source_line=99}')
+        assert canonical_hash(a) == canonical_hash(b)
+
+    def test_distinguishes_programs(self):
+        assert canonical_hash("%add = f32[4] add(%x, %y)") != \
+            canonical_hash("%mul = f32[4] multiply(%x, %y)")
+
+
+# ---------------------------------------------------------------------------
+# the analyzer on in-process entries
+
+
+def _register(name, params, axes, policy="tp", group=None, fn=None,
+              mesh=None, expected_collectives=frozenset(), **tag_kw):
+    mesh = mesh or mesh3()
+    fn = fn or (lambda p: jax.tree.map(lambda a: a * 2.0, p))
+    register_entry_point(
+        name, fn=jax.jit(fn), args=(params,),
+        expected_collectives=expected_collectives, mesh=mesh,
+        tags={"shard": shard_tag(policy, axes=axes, group=group, **tag_kw)})
+
+
+def _placed(specs, mesh):
+    return jax.tree.map(
+        lambda x, s: sds(x.shape, x.dtype, sharding=NamedSharding(mesh, s)),
+        SHAPES, specs)
+
+
+class TestAnalyzer:
+    def test_clean_entry(self):
+        mesh = mesh3()
+        params = _placed(get_policy("tp").param_specs(SHAPES, AXES), mesh)
+        _register("t/clean", params, AXES, mesh=mesh)
+        from tools.tpuaudit.registry import get_entry_points
+
+        findings, reports = run_shard(get_entry_points(),
+                                      publish_metrics=False)
+        assert findings == []
+        (r,) = reports
+        assert r.entry == "t/clean" and r.policy == "tp"
+        assert r.params_checked == r.params_total == 4
+        assert r.rule_violations == 0 and r.program_hash
+
+    def test_rule_violation_names_param_and_specs(self):
+        mesh = mesh3()
+        specs = get_policy("tp").param_specs(SHAPES, AXES)
+        # misplace the embedding: vocab belongs on 'model', put it on dim 1
+        specs = {**specs, "emb": P(None, "model")}
+        _register("t/bad", _placed(specs, mesh), AXES, mesh=mesh)
+        from tools.tpuaudit.registry import get_entry_points
+
+        findings, reports = run_shard(get_entry_points(),
+                                      publish_metrics=False)
+        viol = [f for f in findings if f.check == "rule-violation"]
+        assert len(viol) == 1 and viol[0].entry == "t/bad"
+        assert "['emb']" in viol[0].message
+        assert "PartitionSpec('model', None)" in viol[0].message  # expected
+        assert "PartitionSpec(None, 'model')" in viol[0].message  # actual
+        assert reports[0].rule_violations == 1
+
+    def test_injected_bad_rule_fails_gate(self, capsys):
+        """The acceptance seam: a wrong rule (vocab -> wrong mesh axis) on
+        the EXPECTATION side makes a clean program fail, naming the entry,
+        the parameter and the expected-vs-actual spec — and the CLI gate
+        exits 1."""
+        mesh = mesh3()
+        params = _placed(get_policy("tp").param_specs(SHAPES, AXES), mesh)
+        _register("t/clean", params, AXES, mesh=mesh)
+        from tools.tpuaudit.registry import get_entry_points
+
+        findings, _ = run_shard(get_entry_points(),
+                                rule_overrides={"vocab": "data"},
+                                publish_metrics=False)
+        viol = [f for f in findings if f.check == "rule-violation"]
+        assert viol and viol[0].entry == "t/clean"
+        assert "['emb']" in viol[0].message
+        assert "PartitionSpec('data', None)" in viol[0].message
+        assert "PartitionSpec('model', None)" in viol[0].message
+
+        rc = tpushard_main(["--override-rule", "vocab=data"])
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "t/clean" in out and "rule-violation" in out
+
+    def test_clean_gate_exits_zero(self, capsys):
+        mesh = mesh3()
+        params = _placed(get_policy("tp").param_specs(SHAPES, AXES), mesh)
+        _register("t/clean", params, AXES, mesh=mesh)
+        rc = tpushard_main([])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "== sharding ==" in out and "t/clean" in out
+
+    def test_replication_waste(self):
+        mesh = mesh3()
+        specs = get_policy("tp").param_specs(SHAPES, AXES)
+        specs = {**specs, "w": P()}          # 64x256 is tiny; grow it
+        shapes = {**SHAPES, "w": sds((1024, 1024))}  # 4 MiB replicated
+        params = jax.tree.map(
+            lambda x, s: sds(x.shape, x.dtype,
+                             sharding=NamedSharding(mesh, s)),
+            shapes, specs)
+        _register("t/waste", params, AXES, mesh=mesh)
+        from tools.tpuaudit.registry import get_entry_points
+
+        findings, reports = run_shard(get_entry_points(),
+                                      publish_metrics=False)
+        waste = [f for f in findings if f.check == "replication-waste"]
+        assert len(waste) == 1 and "['w']" in waste[0].message
+        # expected P(None, 'model') over the 2-wide model axis halves it
+        assert reports[0].replicated_bytes == 1024 * 1024 * 4 // 2
+
+    def test_implicit_reshard_attribution(self):
+        mesh = mesh3()
+        specs = get_policy("tp").param_specs(SHAPES, AXES)
+        specs = {**specs, "w": P("data", None)}   # violates tp AND forces
+        params = _placed(specs, mesh)             # an undeclared all-reduce
+        _register("t/reshard", params, AXES, mesh=mesh,
+                  fn=lambda p: sum(jnp.sum(a) for a in jax.tree.leaves(p)))
+        from tools.tpuaudit.registry import get_entry_points
+
+        findings, reports = run_shard(get_entry_points(),
+                                      publish_metrics=False)
+        checks = {f.check for f in findings}
+        assert "rule-violation" in checks
+        assert "implicit-reshard" in checks
+        assert reports[0].reshard_collectives > 0
+
+    def test_cross_program_mismatch(self):
+        mesh = mesh3()
+        good = _placed(get_policy("tp").param_specs(SHAPES, AXES), mesh)
+        bad_specs = {**get_policy("tp").param_specs(SHAPES, AXES),
+                     "emb": P(None, "model")}
+        bad = _placed(bad_specs, mesh)
+        _register("t/a", good, AXES, group="pair", mesh=mesh)
+        _register("t/b", bad, AXES, group="pair", mesh=mesh)
+        from tools.tpuaudit.registry import get_entry_points
+
+        findings, _ = run_shard(get_entry_points(), publish_metrics=False)
+        cross = [f for f in findings if f.check == "cross-program-mismatch"]
+        assert len(cross) == 1
+        assert cross[0].entry == "t/b" and "t/a" in cross[0].message
+        assert "['emb']" in cross[0].message
+
+    def test_handoff_geometry_mismatch(self):
+        mesh = mesh3()
+        export_out = NamedSharding(mesh, P("data", None))
+        import_in = NamedSharding(mesh, P(None, "model"))
+        register_entry_point(
+            "t/kv_export",
+            fn=jax.jit(lambda x: (x * 1.0,), out_shardings=(export_out,)),
+            args=(sds((8, 64)),), expected_collectives=None, mesh=mesh,
+            tags={"handoff": {"role": "export"}})
+        register_entry_point(
+            "t/kv_import", fn=jax.jit(lambda buf: buf.sum()),
+            args=(sds((8, 64), sharding=import_in),),
+            expected_collectives=None, mesh=mesh,
+            tags={"handoff": {"role": "import", "buffer_args": (0,)}})
+        from tools.tpuaudit.registry import get_entry_points
+
+        findings, _ = run_shard(get_entry_points(), publish_metrics=False)
+        cross = [f for f in findings if f.check == "cross-program-mismatch"]
+        assert len(cross) == 1 and cross[0].entry == "t/kv_export"
+        assert "t/kv_import" in cross[0].message
+
+    def test_handoff_clean(self):
+        mesh = mesh3()
+        shared = NamedSharding(mesh, P("data", None))
+        register_entry_point(
+            "t/kv_export",
+            fn=jax.jit(lambda x: (x * 1.0,), out_shardings=(shared,)),
+            args=(sds((8, 64)),), expected_collectives=None, mesh=mesh,
+            tags={"handoff": {"role": "export"}})
+        register_entry_point(
+            "t/kv_import", fn=jax.jit(lambda buf: buf.sum()),
+            args=(sds((8, 64), sharding=shared),),
+            expected_collectives=None, mesh=mesh,
+            tags={"handoff": {"role": "import", "buffer_args": (0,)}})
+        from tools.tpuaudit.registry import get_entry_points
+
+        findings, _ = run_shard(get_entry_points(), publish_metrics=False)
+        assert findings == []
+
+    def test_untagged_entries_skipped(self):
+        register_entry_point("t/plain", fn=jax.jit(lambda x: x + 1),
+                             args=(sds((4,)),), expected_collectives=None)
+        from tools.tpuaudit.registry import get_entry_points
+
+        findings, reports = run_shard(get_entry_points(),
+                                      publish_metrics=False)
+        assert findings == [] and reports == []
+
+
+# ---------------------------------------------------------------------------
+# the report section
+
+
+class TestReportSection:
+    def test_summarize_sharding(self):
+        from deepspeed_tpu.observability.report import summarize_sharding
+
+        records = [
+            {"type": "gauge", "name": "tpushard/train/step/params_total",
+             "value": 6},
+            {"type": "gauge", "name": "tpushard/train/step/params_checked",
+             "value": 6},
+            {"type": "gauge", "name": "tpushard/train/step/rule_violations",
+             "value": 1},
+            {"type": "counter", "name": "tpushard/findings", "value": 1,
+             "labels": {"entry": "train/step", "check": "rule-violation"}},
+        ]
+        out = summarize_sharding(records)
+        assert "== sharding ==" in out and "train/step" in out
+        assert "6/6" in out
+        assert "1 layout finding" in out
+
+    def test_empty_without_records(self):
+        from deepspeed_tpu.observability.report import summarize_sharding
+
+        assert summarize_sharding([{"type": "gauge", "name": "x/y",
+                                    "value": 1}]) == ""
+
+
+# ---------------------------------------------------------------------------
+# repo-wide gate (tier-1 acceptance)
+
+
+class TestRepoGate:
+    def test_selftest_engines_clean_under_committed_baseline(self, tmp_path):
+        """Acceptance gate: every selftest entry carrying a layout contract
+        (train, pipeline, inference, serving incl. draft + kv handoff, the
+        RLHF flip) audits clean against the rule registry and the committed
+        baseline; the dumped metrics render as == sharding ==."""
+        jsonl = tmp_path / "shard_metrics.jsonl"
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.tpushard",
+             "--config", "tools/tpuaudit/selftest_config.json",
+             "--baseline", ".tpushard-baseline.json",
+             "--metrics-jsonl", str(jsonl)],
+            cwd=REPO, capture_output=True, text=True, timeout=540,
+            env={**__import__("os").environ, "JAX_PLATFORMS": "cpu"})
+        assert proc.returncode == 0, \
+            f"tpushard gate failed:\n{proc.stdout}\n{proc.stderr}"
+        assert "== sharding ==" in proc.stdout
+        for name in ("train/step", "train/eval", "pipeline/step",
+                     "inference/prefill", "inference/decode",
+                     "serving/prefill_chunk", "serving/decode",
+                     "serving/verify", "serving/draft_decode",
+                     "serving/kv_export", "serving/kv_import", "rlhf/flip"):
+            assert name in proc.stdout, name
+
+        rep = subprocess.run(
+            [sys.executable, "-m", "deepspeed_tpu.observability", "report",
+             str(jsonl)],
+            cwd=REPO, capture_output=True, text=True, timeout=120,
+            env={**__import__("os").environ, "JAX_PLATFORMS": "cpu"})
+        assert rep.returncode == 0, rep.stderr
+        assert "== sharding ==" in rep.stdout
+        assert "train/step" in rep.stdout
+
+    def test_injected_bad_rule_fails_repo_gate(self):
+        """A wrong rule against the real selftest engines exits 1 and names
+        the entry, the parameter and the expected-vs-actual spec."""
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.tpushard",
+             "--config", "tools/tpuaudit/selftest_config.json",
+             "--baseline", ".tpushard-baseline.json",
+             "--entries", "train/step", "--override-rule", "mlp=data"],
+            cwd=REPO, capture_output=True, text=True, timeout=540,
+            env={**__import__("os").environ, "JAX_PLATFORMS": "cpu"})
+        assert proc.returncode == 1, \
+            f"expected gate failure:\n{proc.stdout}\n{proc.stderr}"
+        assert "train/step" in proc.stdout
+        assert "rule-violation" in proc.stdout
+        assert "expected" in proc.stdout and "actual" in proc.stdout
+        assert "PartitionSpec" in proc.stdout

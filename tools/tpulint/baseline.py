@@ -146,8 +146,8 @@ def render_report(gating: Sequence, stale: Sequence[str], *, tool: str,
                                      "--prune-baseline"),
                   extra_json: Optional[Dict] = None) -> int:
     """The shared report/exit tail — text/JSON rendering of over-budget
-    findings + stale keys and the exit code. All three analyzers (tpulint,
-    tpuaudit, tpucost) end here, so ``scripts/check.sh`` composes three
+    findings + stale keys and the exit code. All four analyzers (tpulint,
+    tpuaudit, tpucost, tpushard) end here, so ``scripts/check.sh`` composes
     identical gate semantics into one CI exit code. ``stale_note`` lets a
     value-gated tool (tpucost) phrase staleness in its own terms."""
     if fmt == "json":

@@ -1,4 +1,4 @@
-"""The six tpulint rules.
+"""The eight tpulint rules.
 
 Each rule is small and heuristic by design: the goal is catching the silent
 TPU performance/correctness failure modes (host syncs, trace-time side
@@ -480,7 +480,61 @@ class WallclockTimingWithoutSync(Rule):
 
 
 # ---------------------------------------------------------------------------
-# 7. key-reuse
+# 7. hardcoded-partition-spec
+
+
+@register
+class HardcodedPartitionSpec(Rule):
+    name = "hardcoded-partition-spec"
+    description = ("PartitionSpec built from literal mesh-axis strings "
+                   "outside the rule registry (parallel/rules.py) — layout "
+                   "decisions the tpushard analyzer cannot see or audit")
+
+    _EXEMPT_SUFFIXES = (
+        # THE place mesh-axis placement is allowed to be spelled out: the
+        # logical-axis rule registry itself, and the mesh module that
+        # defines the axis vocabulary the registry maps onto
+        "parallel/rules.py",
+        "parallel/mesh.py",
+    )
+
+    def _is_test_path(self, path: str) -> bool:
+        norm = path.replace("\\", "/")
+        base = norm.rpartition("/")[2]
+        return ("/tests/" in norm or norm.startswith("tests/")
+                or base.startswith("test_") or base.endswith("_test.py"))
+
+    def _strings_of(self, node: ast.AST) -> Iterator[ast.AST]:
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            yield node
+        elif isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            for elt in node.elts:
+                yield from self._strings_of(elt)
+
+    def check(self, module: ModuleInfo, jit: JitGraph,
+              context: RunContext) -> Iterator[Finding]:
+        norm = module.path.replace("\\", "/")
+        if norm.endswith(self._EXEMPT_SUFFIXES) or self._is_test_path(norm):
+            return
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = module.dotted(node.func) or ""
+            if dotted.rpartition(".")[2] != "PartitionSpec":
+                continue
+            literals = [c.value for arg in node.args
+                        for c in self._strings_of(arg)]
+            if literals:
+                yield _finding(
+                    self, module, node,
+                    f"PartitionSpec({', '.join(repr(s) for s in literals)}) "
+                    "hardcodes mesh axes outside parallel/rules.py — derive "
+                    "the placement from the rule registry (or suppress if "
+                    "this spec is genuinely not a parameter/output layout)")
+
+
+# ---------------------------------------------------------------------------
+# 8. key-reuse
 
 
 @register

@@ -1,0 +1,12 @@
+"""tpushard — whole-program sharding analyzer.
+
+The fourth static analyzer: tpulint reads the source, tpuaudit the program
+semantics, tpucost the program cost — tpushard reads the program LAYOUT.
+For every registered entry point it lowers the program host-side and checks
+the actual per-parameter / per-output shardings against the placement the
+logical-axis rule registry (``deepspeed_tpu/parallel/rules.py``) derives for
+the entry's declared policy.
+"""
+
+from .core import (EntryReport, analyze_entry, canonical_hash,  # noqa: F401
+                   run_shard)
