@@ -58,6 +58,7 @@ import dataclasses
 import json
 import os
 import signal
+import threading
 import time
 from typing import Any, Callable, Dict, List, Optional
 
@@ -156,6 +157,9 @@ class FaultInjector:
         self.recorder = recorder
         self.applied: List[Dict[str, Any]] = []
         self._done: set = set()
+        # hooks run on different threads (session main, fleet router,
+        # engine driver); claim/record must be atomic across them
+        self._lock = threading.Lock()
         # straggle state: (until_step, sleep_s) while active
         self._straggle_until = -1
         self._straggle_sleep = 0.0
@@ -179,10 +183,20 @@ class FaultInjector:
             return False
         return True
 
+    def _claim(self, i: int) -> bool:
+        """Atomically claim plan entry ``i`` — True exactly once, however
+        many hook threads race the same fault."""
+        with self._lock:
+            if i in self._done:
+                return False
+            self._done.add(i)
+            return True
+
     def _note(self, fault: Fault, step: int, **detail: Any) -> None:
         info = {"kind": fault.kind, "step": step, "rank": self.rank,
                 "restart": self.restart, **detail}
-        self.applied.append(info)
+        with self._lock:
+            self.applied.append(info)
         logger.warning(f"FAULT INJECTED: {info}")
         if self.registry is not None:
             self.registry.counter(
@@ -207,7 +221,8 @@ class FaultInjector:
                     or fault.kind in ROUTER_KINDS \
                     or not self._mine(fault) or fault.step != step:
                 continue
-            self._done.add(i)
+            if not self._claim(i):
+                continue
             if fault.kind == "rank_kill":
                 self._note(fault, step)
                 self._kill()            # no return (SIGKILL) outside tests
@@ -237,16 +252,14 @@ class FaultInjector:
         for i, fault in enumerate(self.plan):
             if not self._mine(fault):
                 continue
-            if fault.kind == "replica_kill" and i not in self._done \
-                    and fault.step == iteration:
-                self._done.add(i)
+            if fault.kind == "replica_kill" and fault.step == iteration \
+                    and self._claim(i):
                 self._note(fault, iteration, replica=fault.replica)
                 kill_fn(fault.replica)
             elif fault.kind == "replica_flap" \
                     and fault.step <= iteration \
                     < fault.step + max(fault.steps, 1):
-                if i not in self._done:
-                    self._done.add(i)
+                if self._claim(i):
                     self._note(fault, iteration, replica=fault.replica,
                                until_step=fault.step + max(fault.steps, 1))
                 kill_fn(fault.replica)
@@ -263,8 +276,7 @@ class FaultInjector:
                     or fault.replica != replica:
                 continue
             if fault.step <= iteration < fault.step + max(fault.steps, 1):
-                if i not in self._done:
-                    self._done.add(i)
+                if self._claim(i):
                     self._note(fault, iteration, replica=fault.replica,
                                sleep_s=fault.sleep_s,
                                until_step=fault.step + max(fault.steps, 1))
@@ -279,7 +291,8 @@ class FaultInjector:
             if i in self._done or fault.kind != "handoff_fail" \
                     or not self._mine(fault) or fault.step > iteration:
                 continue
-            self._done.add(i)
+            if not self._claim(i):
+                continue
             self._note(fault, iteration)
             return True
         return False
@@ -297,9 +310,86 @@ class FaultInjector:
                 continue
             truncated = truncate_checkpoint_shard(ckpt_dir,
                                                   fault.shard_index)
-            if truncated:
-                self._done.add(i)
+            if truncated and self._claim(i):
                 self._note(fault, fault.step, file=truncated)
+
+
+class LockPerturber:
+    """Deterministic context-switch pressure at lock boundaries — the
+    chaos suite's ``--stress`` mode (``pytest --stress``, wired through
+    ``scripts/chaos_serve.sh``).
+
+    Every acquire on a wrapped lock first consults a seeded LCG; on a hit
+    the acquiring thread yields the GIL (``sleep(0)`` — a scheduler yield,
+    never a wall-clock wait) BEFORE taking the lock, handing any other
+    runnable thread the critical region first. That widens exactly the
+    windows tpusync reasons about: check-then-act gaps, publication
+    ordering, lock-order interleavings. Same seed → same yield-point
+    sequence → reproducible stress runs.
+    """
+
+    def __init__(self, seed: int = 1234, period: int = 3,
+                 yield_fn: Optional[Callable[[], None]] = None):
+        self._state = (int(seed) or 1) & 0x7FFFFFFF
+        self.period = max(int(period), 1)
+        self._yield = yield_fn or (lambda: time.sleep(0))
+        self.acquires = 0
+        self.yields = 0
+        self._lock = threading.Lock()     # guards the LCG stream itself
+
+    def maybe_yield(self) -> None:
+        with self._lock:
+            self.acquires += 1
+            self._state = (self._state * 1103515245 + 12345) & 0x7FFFFFFF
+            hit = self._state % self.period == 0
+            if hit:
+                self.yields += 1
+        if hit:
+            self._yield()
+
+    def wrap(self, lock: Any) -> "PerturbedLock":
+        return PerturbedLock(lock, self)
+
+    def instrument(self, *objects: Any, attr: str = "_lock") -> None:
+        """Replace each object's ``attr`` lock with a perturbed wrapper
+        (idempotent: an already-wrapped lock is left alone)."""
+        for obj in objects:
+            lock = getattr(obj, attr)
+            if not isinstance(lock, PerturbedLock):
+                setattr(obj, attr, self.wrap(lock))
+
+
+class PerturbedLock:
+    """Delegating lock proxy that routes every acquire through its
+    :class:`LockPerturber` — supports the ``with`` protocol plus the
+    introspection the instrumented code (and tests) rely on."""
+
+    def __init__(self, inner: Any, perturber: LockPerturber):
+        self._inner_lock = inner
+        self._perturber = perturber
+
+    def acquire(self, *args: Any, **kwargs: Any) -> bool:
+        self._perturber.maybe_yield()
+        return self._inner_lock.acquire(*args, **kwargs)
+
+    def release(self) -> None:
+        self._inner_lock.release()
+
+    def __enter__(self) -> "PerturbedLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.release()
+
+    def locked(self) -> bool:
+        return self._inner_lock.locked()
+
+    def _is_owned(self) -> bool:
+        inner = self._inner_lock
+        if hasattr(inner, "_is_owned"):
+            return inner._is_owned()
+        return inner.locked()
 
 
 def poison_params(engine: Any) -> None:

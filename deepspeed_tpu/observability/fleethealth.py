@@ -298,6 +298,9 @@ class FleetHealthMonitor:
                 f"median {med:.4f}s (step {step})")
         if self.on_straggler is not None:
             try:
+                # tpusync: disable=callback-under-lock — internal seam the
+                # elastic agent binds, not user code; the verdict must be
+                # atomic with the step-time window it indicts
                 self.on_straggler(culprit, {
                     "step": step,
                     "step_time_s": float(times[culprit]),

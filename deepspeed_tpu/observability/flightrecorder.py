@@ -313,7 +313,11 @@ class FlightRecorder:
             manifest["extra"] = extra
         with open(os.path.join(bundle, MANIFEST_NAME), "w") as fh:
             json.dump(manifest, fh, indent=1)
-        self.dumps.append(bundle)
+        with self._lock:
+            # dump() is reachable from the watchdog thread, SIGUSR1 and
+            # crashing trainers at once; the bundle list must not lose
+            # entries to a torn append
+            self.dumps.append(bundle)
 
         # memory LAST, time-bounded, AFTER the manifest landed: on a wedged
         # remote backend device.memory_stats() is an RPC that can block
@@ -377,6 +381,10 @@ def install_sigusr1(recorder: FlightRecorder) -> bool:
             return False
         previous = signal.getsignal(signal.SIGUSR1)
 
+        # tpusync: disable=signal-unsafe-handler — dump-on-SIGUSR1 IS the
+        # feature (last-resort diagnostics on a wedged process); the ring
+        # lock is an RLock and the bundle write accepts the async-signal
+        # risk in exchange for evidence
         def _handler(signum, frame):
             rec = _ACTIVE_RECORDER
             if rec is not None:

@@ -154,11 +154,15 @@ class HangWatchdog:
             bundle = self.recorder.dump(reason="hang",
                                         stalled_span=stalled_span,
                                         extra=extra)
-        self.last_fire = {"stalled_span": stalled_span,
-                          "waited_s": waited, "deadline_s": deadline,
-                          "bundle": bundle}
-        self.fired += 1   # last: observers polling `fired` see a complete
-        #   last_fire (the threaded end-to-end test races exactly this)
+        with self._lock:
+            # both under the lock, last_fire first: observers polling
+            # `fired` see a complete last_fire (the threaded end-to-end
+            # test races exactly this); the dump above stays outside the
+            # lock so heartbeats never stall behind bundle IO
+            self.last_fire = {"stalled_span": stalled_span,
+                              "waited_s": waited, "deadline_s": deadline,
+                              "bundle": bundle}
+            self.fired += 1
         if self.registry is not None:
             self.registry.counter(
                 "hang/watchdog_fired",

@@ -826,6 +826,49 @@ def summarize_sharding(records: List[Dict[str, Any]]) -> str:
     return "\n".join(lines)
 
 
+def summarize_sync(records: List[Dict[str, Any]]) -> str:
+    """``== sync ==`` — the host-concurrency audit tpusync publishes:
+    thread-root census (how many functions run on main vs each spawned
+    thread / signal handler / executor), the whole-program lock graph
+    size, and findings by rule."""
+    latest: Dict[Tuple[str, str], Dict[str, Any]] = {}
+    for r in records:
+        name = str(r.get("name", ""))
+        if name.startswith("tpusync/"):
+            latest[(name, _label_str(r.get("labels", {})))] = r
+    if not latest:
+        return ""
+
+    def gauge(name: str) -> Any:
+        r = latest.get((name, "-"))
+        return r["value"] if r else None
+
+    lines = ["== sync =="]
+    fns = gauge("tpusync/functions_total")
+    locks = gauge("tpusync/lock_graph_locks")
+    edges = gauge("tpusync/lock_graph_edges")
+    if fns is not None:
+        lines.append(f"  functions analyzed = {fns:.0f}, locks = "
+                     f"{locks or 0:.0f}, lock-order edges = {edges or 0:.0f}")
+    roots = [(lbl.split("=", 1)[1], r["value"])
+             for (name, lbl), r in latest.items()
+             if name == "tpusync/root_functions" and lbl.startswith("root=")]
+    if roots:
+        rows = [[root, f"{n:.0f}"]
+                for root, n in sorted(roots, key=lambda kv: -kv[1])]
+        lines.append(_fmt_table(["thread root", "functions"], rows))
+    findings = {lbl: r["value"] for (name, lbl), r in latest.items()
+                if name == "tpusync/findings"
+                and r.get("type") == "counter"}
+    total = sum(findings.values())
+    if total:
+        for lbl, n in sorted(findings.items(), key=lambda kv: -kv[1]):
+            lines.append(f"  {lbl}: {n:.0f}")
+        lines.append(f"  !! {total:.0f} concurrency finding(s) — run "
+                     "python -m tools.tpusync for the details")
+    return "\n".join(lines)
+
+
 def summarize_recompiles(records: List[Dict[str, Any]]) -> str:
     compiles = [r for r in records
                 if r.get("type") == "counter" and r.get("name") == "xla/compiles"]
@@ -880,6 +923,7 @@ def report(paths: List[str]) -> str:
                             summarize_rlhf(records),
                             summarize_cost(records),
                             summarize_sharding(records),
+                            summarize_sync(records),
                             summarize_serving(records),
                             summarize_serve_goodput(records),
                             summarize_reqtrace(records),

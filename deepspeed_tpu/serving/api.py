@@ -638,6 +638,11 @@ class ServingEngine:
                 progress |= bool(admitted)
                 if admitted:
                     self._trace_admitted(admitted)
+                # tpusync: disable=lock-order-inversion — the SE->FR edge
+                # (prefill-complete handoff) and the FR->SE edge (router
+                # submit/step) are both RLock re-entries on the one thread
+                # that drives a fleet: engines under a router are stepped
+                # only from FleetRouter.step, which already holds FR
                 progress |= self._step_prefill()
                 progress |= (self._step_verify()
                              if self._drafter is not None
@@ -811,7 +816,10 @@ class ServingEngine:
             if (self.on_prefill_complete is not None
                     and req.state == DECODE):
                 # still DECODE: a max_new_tokens=1 request already finished
-                # in _emit above and has nothing left to hand off
+                # in _emit above and has nothing left to hand off.
+                # tpusync: disable=callback-under-lock — router-bound seam,
+                # not user code; the handoff must see the request frozen at
+                # prefill completion, so it runs under the engine lock
                 self.on_prefill_complete(req)
         return True
 

@@ -139,6 +139,10 @@ class ArenaHandoff(KVHandoff):
                     if rt is not None:
                         import jax
 
+                        # tpusync: disable=blocking-under-lock — tracing
+                        # mode only; the sync buys stage-honest export/
+                        # import timings and the handoff must be atomic
+                        # with arena state anyway
                         jax.block_until_ready(buf_k)   # stage-honest split
                 if rt is not None:
                     t1 = clock()
@@ -155,6 +159,10 @@ class ArenaHandoff(KVHandoff):
                                               dst_pad)
                 import jax
 
+                # tpusync: disable=blocking-under-lock — the import must
+                # commit before the request rebinds to the decode replica;
+                # a torn arena is worse than a stalled lock, and the copy
+                # is bounded (one request's blocks, layer-chunked)
                 jax.block_until_ready(dst._arena["k"])   # honest latency
                 if rt is not None:
                     rt.interval(trace, "handoff", t2, clock(),

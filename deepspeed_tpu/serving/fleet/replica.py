@@ -33,7 +33,10 @@ from __future__ import annotations
 import collections
 import dataclasses
 import statistics
-from typing import List, Optional
+from typing import TYPE_CHECKING, List, Optional
+
+if TYPE_CHECKING:
+    from ..api import ServingEngine
 
 __all__ = ["Replica", "ReplicaHealth", "ReplicaDead", "ReplicaRetired",
            "ROLE_MIXED", "ROLE_PREFILL", "ROLE_DECODE", "build_replicas"]
@@ -98,8 +101,8 @@ class Replica:
     """One fleet member. ``role`` partitions the fleet for prefill/decode
     disaggregation (``ROLE_MIXED`` replicas serve both phases)."""
 
-    def __init__(self, engine, index: int, role: str = ROLE_MIXED,
-                 health_window: int = 8):
+    def __init__(self, engine: "ServingEngine", index: int,
+                 role: str = ROLE_MIXED, health_window: int = 8):
         if role not in (ROLE_MIXED, ROLE_PREFILL, ROLE_DECODE):
             raise ValueError(f"unknown replica role '{role}'")
         self.engine = engine

@@ -1266,28 +1266,36 @@ class FleetRouter:
         decode pool — falls back to decoding in place. Destination blocks
         of a failed transfer are freed inside the transport; source
         blocks are released exactly once, on success only."""
-        fid = self._by_engine.get((src.index, req.rid))
-        fr = self._requests.get(fid) if fid is not None else None
-        if fr is None or fr.done:
-            return
-        cands = sorted((r for r in self.decode_pool
-                        if self._routable(r) and r.engine is not src.engine),
-                       key=lambda r: r.health().load_key)
-        t0 = self.clock()
-        obs = get_session()
-        # arm the injected transfer failure ONCE for this handoff event;
-        # the finally disarms an armament the seam never reached (every
-        # candidate pool dry), or it would leak into a later, unplanned
-        # handoff and break the deterministic-plan contract
-        injected = (self._injector is not None
-                    and self._injector.take_handoff_fail(self._iterations))
-        if injected:
-            self.handoff.inject_fail_next += 1
-        try:
-            self._handoff_attempts(src, req, fr, cands, t0, obs)
-        finally:
-            if injected and self.handoff.inject_fail_next > 0:
-                self.handoff.inject_fail_next -= 1
+        # Re-enter the router lock explicitly (RLock: free on the normal
+        # path, where step() already holds it). The handoff mutates router
+        # state — bind(), handoff tallies, probation credit — and must not
+        # rely on every engine step being driven from under step()'s lock.
+        with self._lock:
+            fid = self._by_engine.get((src.index, req.rid))
+            fr = self._requests.get(fid) if fid is not None else None
+            if fr is None or fr.done:
+                return
+            cands = sorted(
+                (r for r in self.decode_pool
+                 if self._routable(r) and r.engine is not src.engine),
+                key=lambda r: r.health().load_key)
+            t0 = self.clock()
+            obs = get_session()
+            # arm the injected transfer failure ONCE for this handoff
+            # event; the finally disarms an armament the seam never
+            # reached (every candidate pool dry), or it would leak into a
+            # later, unplanned handoff and break the deterministic-plan
+            # contract
+            injected = (self._injector is not None
+                        and self._injector.take_handoff_fail(
+                            self._iterations))
+            if injected:
+                self.handoff.inject_fail_next += 1
+            try:
+                self._handoff_attempts(src, req, fr, cands, t0, obs)
+            finally:
+                if injected and self.handoff.inject_fail_next > 0:
+                    self.handoff.inject_fail_next -= 1
 
     def _handoff_attempts(self, src: Replica, req, fr: _FleetRequest,
                           cands: List[Replica], t0: float, obs) -> None:

@@ -128,6 +128,9 @@ class BlockAllocator:
                     f"incref of block {b} which is not allocated")
         for b in ids:
             self._refs[b] += 1
+        # tpusync: disable=unguarded-shared-write — engine-owned: every
+        # runtime path holds ServingEngine._lock; the allocator itself is
+        # documented single-owner and takes no lock of its own
         self.peak_shared = max(self.peak_shared, self.blocks_shared)
 
     def free(self, ids: List[int]) -> None:
@@ -142,6 +145,8 @@ class BlockAllocator:
             self._refs[b] -= 1
             if self._refs[b] == 0:
                 del self._refs[b]
+                # tpusync: disable=unguarded-shared-write — engine-owned,
+                # synchronized under ServingEngine._lock (see incref)
                 self._free.append(b)
 
 
@@ -269,6 +274,8 @@ class PrefixCache:
             return False
         self.alloc.incref([block_id])
         self._entries[key] = block_id
+        # tpusync: disable=unguarded-shared-write — engine-owned cache,
+        # synchronized under ServingEngine._lock like its allocator
         self.inserts += 1
         return True
 

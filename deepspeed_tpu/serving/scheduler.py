@@ -257,6 +257,9 @@ class Scheduler:
             req.blocks = []
         self._admit_index.pop(req.rid, None)
         if self.on_release is not None:
+            # tpusync: disable=callback-under-lock — engine-bound seam
+            # (prefix-cache/drafter cleanup), not user code; block release
+            # and its observers must be atomic
             self.on_release(req)
 
     def _note_terminal(self, req: Request) -> None:
@@ -511,6 +514,9 @@ class Scheduler:
         req.state = QUEUED
         self.queued.append(req)
         if self.on_preempt is not None:
+            # tpusync: disable=callback-under-lock — engine-bound seam
+            # (drafter/KV bookkeeping), not user code; the requeue and its
+            # observers must see one consistent preemption
             self.on_preempt(req)
 
     # -- iteration planning ------------------------------------------------

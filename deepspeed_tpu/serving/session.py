@@ -17,11 +17,14 @@ called between steps).
 from __future__ import annotations
 
 import threading
-from typing import Iterator, List, Optional
+from typing import TYPE_CHECKING, Iterator, List, Optional
 
 import numpy as np
 
 from .scheduler import CANCELLED, DEADLINE_EXCEEDED, FINISHED, Request
+
+if TYPE_CHECKING:  # circular at runtime: api.py imports this module
+    from .api import ServingEngine
 
 __all__ = ["RequestHandle", "RequestCancelled", "DeadlineExceeded"]
 
@@ -84,7 +87,7 @@ def drive_stream(cond: threading.Condition, tokens: List[int], is_done,
 class RequestHandle:
     """Incremental, thread-safe view of one request's generated tokens."""
 
-    def __init__(self, engine, req: Request):
+    def __init__(self, engine: "ServingEngine", req: Request):
         self._engine = engine
         self._req = req
         self._cond = threading.Condition()

@@ -340,6 +340,9 @@ class DraftModelDrafter(Drafter):
         for st in self._state.values():
             if st.blocks:
                 self.alloc.free(st.blocks)
+        # tpusync: disable=unguarded-shared-write — shutdown-ordered:
+        # close() runs after ServingEngine.close() stopped the driver
+        # thread, so no release() can race it
         self._state.clear()
 
 

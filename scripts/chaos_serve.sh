@@ -20,7 +20,9 @@
 #
 # CPU-only and sleep-free: injected slowness rides the health data-plane,
 # faults are pinned to router iterations — a chaos run is exactly
-# reproducible.
+# reproducible. The stress pass reruns the threaded variant with
+# deterministic seeded GIL-yield points at every lock boundary
+# (LockPerturber, pytest --stress): same seed, same interleaving pressure.
 #
 # Usage: scripts/chaos_serve.sh [extra pytest args...]
 set -euo pipefail
@@ -34,3 +36,11 @@ JAX_PLATFORMS=cpu python -m pytest \
     "tests/unit/test_fleet.py::TestHandoffFaultTolerance" \
     "tests/unit/test_fleet.py::TestParkedResubmission" \
     -q -p no:cacheprovider "$@"
+
+for seed in 1234 7; do
+    JAX_PLATFORMS=cpu python -m pytest \
+        "tests/unit/test_fleet_chaos.py::TestThreadedChaos" \
+        "tests/unit/test_sync_regressions.py" \
+        --stress --stress-seed "$seed" \
+        -q -p no:cacheprovider "$@"
+done

@@ -1,13 +1,15 @@
 #!/usr/bin/env bash
 # The unified static-analysis driver: lint (source) + audit (program
 # semantics) + cost (program cost) + shard (program layout: every
-# parameter/output placement vs the logical-axis rule registry) + parity
+# parameter/output placement vs the logical-axis rule registry) + sync
+# (host concurrency: thread-root reachability, guarded-by discipline,
+# lock-order cycles over the serving/observability orchestration) + parity
 # (serving kernel-path tests, tier-1 marker set) + chaos (training
 # fault-injection recovery smoke) + chaos_serve (serving-fleet self-healing
 # smoke) + rlhf (hybrid-engine-v2 post-training smoke: flip-no-recompile +
 # replay-bit-exact) in one run, one exit code for CI.
 #
-# The four analyzers share the same gate semantics (committed baseline,
+# The five analyzers share the same gate semantics (committed baseline,
 # stale-entry rot detection, the render_report tail in
 # tools/tpulint/baseline.py), so this script is just sequencing: every gate
 # runs even when an earlier one fails, and the exit code is the OR of
@@ -22,7 +24,7 @@ cd "$(dirname "$0")/.."
 
 selected=("$@")
 fail=0
-for gate in lint audit cost shard parity chaos chaos_serve rlhf; do
+for gate in lint audit cost shard sync parity chaos chaos_serve rlhf; do
     if [ "${#selected[@]}" -gt 0 ]; then
         case " ${selected[*]} " in
             *" $gate "*) ;;

@@ -1,0 +1,13 @@
+#!/usr/bin/env bash
+# Repo concurrency gate: tpusync over the host-orchestration scope
+# (serving/, serving/fleet/, observability/, launcher/, runtime/session.py,
+# runtime/checkpoint.py) against the committed baseline. Exits non-zero on
+# any new finding — unguarded shared write, lock-order inversion, blocking
+# call under a lock, signal-unsafe handler, callback under a lock — or a
+# stale baseline entry. Usage: scripts/sync.sh [extra tpusync args...]
+set -euo pipefail
+
+cd "$(dirname "$0")/.."
+
+JAX_PLATFORMS=cpu python -m tools.tpusync \
+    --baseline .tpusync-baseline.json "$@"

@@ -29,6 +29,29 @@ def pytest_configure(config):
     config.addinivalue_line("markers", "slow: long-running end-to-end tests")
 
 
+def pytest_addoption(parser):
+    parser.addoption(
+        "--stress", action="store_true", default=False,
+        help="wrap serving/fleet locks in a seeded LockPerturber: "
+             "deterministic GIL-yield points at lock boundaries widen "
+             "race windows in the threaded chaos tests")
+    parser.addoption(
+        "--stress-seed", type=int, default=1234,
+        help="LCG seed for --stress yield-point placement")
+
+
+@pytest.fixture
+def stress_perturber(request):
+    """A seeded LockPerturber under ``--stress``, else None. Tests that
+    accept it instrument their engines/routers when present — the same
+    test body runs plain in tier-1 and perturbed in the chaos gate."""
+    if not request.config.getoption("--stress"):
+        return None
+    from deepspeed_tpu.observability.faultinject import LockPerturber
+
+    return LockPerturber(seed=request.config.getoption("--stress-seed"))
+
+
 @pytest.fixture(autouse=True)
 def _reset_global_mesh():
     yield

@@ -196,3 +196,54 @@ class TestServingChaosGate:
             assert_no_leaked_blocks(replicas)
         finally:
             router.close()
+
+
+class TestThreadedChaos:
+    """The threaded variant: a ``dstpu-fleet`` driver thread steps the
+    fleet while clients submit and block on handle condvars from the main
+    thread — the two-thread topology tpusync's whole-program graph models.
+    Under ``pytest --stress`` the ``stress_perturber`` fixture wraps the
+    router's and every engine's lock in a seeded
+    :class:`~deepspeed_tpu.observability.faultinject.LockPerturber`:
+    deterministic GIL-yield points at each lock boundary widen exactly the
+    race windows the analyzer reasons about, with zero wall-clock waits.
+    ``scripts/chaos_serve.sh`` runs this class both plain and stressed.
+    """
+
+    def test_threaded_kill_mid_stream_bit_exact(self, tiny_engine,
+                                                stress_perturber):
+        prompts = mk_prompts(N_REQ)
+        want = oracle_outputs(tiny_engine, prompts,
+                              seeds=list(range(N_REQ)))
+        replicas = build_replicas(tiny_engine, ServingConfig(**SCFG), 3)
+        router = FleetRouter(
+            replicas, FleetConfig(**CHAOS_FLEET),
+            fault_plan=[{"kind": "replica_kill", "step": 5, "replica": 1}])
+        if stress_perturber is not None:
+            stress_perturber.instrument(
+                router, *[r.engine for r in replicas])
+        router.start()
+        try:
+            handles = [router.submit(p, max_new_tokens=N_NEW, seed=i,
+                                     temperature=TEMP)
+                       for i, p in enumerate(prompts)]
+            outs = [h.result(timeout_s=120.0) for h in handles]
+            # the fault fired on the driver thread while clients waited
+            assert replicas[1].deaths == 1
+            for i, (o, exp) in enumerate(zip(outs, want)):
+                np.testing.assert_array_equal(
+                    o, exp,
+                    err_msg=f"request {i} diverged from the single "
+                            f"engine (threaded driver)")
+            assert router.submitted_count == (
+                router.finished_count + router.cancelled_count
+                + router.shed_count_total
+                + router.deadline_exceeded_count)
+            assert router.cancelled_count == 0
+        finally:
+            router.close()
+        assert_no_leaked_blocks(replicas)
+        if stress_perturber is not None:
+            # the perturber actually exercised the lock boundaries
+            assert stress_perturber.acquires > 0
+            assert stress_perturber.yields > 0

@@ -1,0 +1,554 @@
+"""tpusync unit tests: per-rule positive/negative/suppression fixtures,
+thread-root graph + lock-order-cycle synthesis on miniature modules, and
+the repo-wide gate (the analyzer run over the host-orchestration scope
+with the committed zero-debt baseline must be clean — this test is what
+makes tier-1 enforce concurrency analysis)."""
+
+import subprocess
+import sys
+from pathlib import Path
+
+from tools.tpusync import analyze_source, build_program
+from tools.tpusync.core import DEFAULT_SCOPE, RULES, SyncModule
+from tools.tpusync.threadgraph import LockId
+
+REPO = Path(__file__).resolve().parents[2]
+
+
+def rules_of(source, **kw):
+    return sorted({f.rule for f in analyze_source(source, **kw)})
+
+
+def findings_of(source, rule, **kw):
+    return [f for f in analyze_source(source, **kw) if f.rule == rule]
+
+
+# ---------------------------------------------------------------------------
+# rule fixtures
+
+
+SHARED_WRITE = (
+    "import threading\n"
+    "class Worker:\n"
+    "    def __init__(self):\n"
+    "        self._lock = threading.Lock()\n"
+    "        self.count = 0\n"
+    "    def run_loop(self):\n"
+    "        self.count += 1\n"
+    "    def bump(self):\n"
+    "        {write}\n"
+    "    def launch(self):\n"
+    "        t = threading.Thread(target=self.run_loop, name='w')\n"
+    "        t.start()\n")
+
+
+class TestUnguardedSharedWrite:
+    def test_positive_two_roots_no_lock(self):
+        src = SHARED_WRITE.format(write="self.count += 1")
+        hits = findings_of(src, "unguarded-shared-write")
+        assert len(hits) == 1
+        msg = hits[0].message
+        # actionable: names the attribute, the roots, and a candidate lock
+        assert "Worker.count" in msg
+        assert "thread:w" in msg and "main" in msg
+        assert "Worker._lock" in msg
+
+    def test_negative_common_lock(self):
+        src = (
+            "import threading\n"
+            "class Worker:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self.count = 0\n"
+            "    def run_loop(self):\n"
+            "        with self._lock:\n"
+            "            self.count += 1\n"
+            "    def bump(self):\n"
+            "        with self._lock:\n"
+            "            self.count += 1\n"
+            "    def launch(self):\n"
+            "        t = threading.Thread(target=self.run_loop)\n"
+            "        t.start()\n")
+        assert rules_of(src) == []
+
+    def test_negative_single_root(self):
+        src = (
+            "class Tally:\n"
+            "    def __init__(self):\n"
+            "        self.n = 0\n"
+            "    def bump(self):\n"
+            "        self.n += 1\n")
+        assert rules_of(src) == []
+
+    def test_init_writes_exempt(self):
+        # construction happens-before publication: __init__ writes never
+        # count as racing sites (were they counted, __init__'s main root
+        # would race the spawn-only _run_loop below)
+        src = (
+            "import threading\n"
+            "class Worker:\n"
+            "    def __init__(self):\n"
+            "        self.count = 0\n"
+            "    def _run_loop(self):\n"
+            "        self.count += 1\n"
+            "    def launch(self):\n"
+            "        t = threading.Thread(target=self._run_loop, name='w')\n"
+            "        t.start()\n")
+        assert rules_of(src) == []
+
+    def test_guarded_by_annotation_enforced(self):
+        src = (
+            "import threading\n"
+            "class Worker:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self.count = 0  # tpusync: guarded-by=_lock\n"
+            "    def bump(self):\n"
+            "        self.count += 1\n")
+        hits = findings_of(src, "unguarded-shared-write")
+        # single-root, but the declared guard makes EVERY bare write a
+        # finding — and the message names the missing lock
+        assert len(hits) == 1
+        assert "_lock" in hits[0].message
+        assert "Worker.bump" in hits[0].message
+
+    def test_guarded_by_annotation_satisfied(self):
+        src = (
+            "import threading\n"
+            "class Worker:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self.count = 0  # tpusync: guarded-by=_lock\n"
+            "    def bump(self):\n"
+            "        with self._lock:\n"
+            "            self.count += 1\n")
+        assert rules_of(src) == []
+
+    def test_suppression(self):
+        # suppressing the thread-side write removes that site from the
+        # race set; the lone remaining main-root site is then clean
+        src = (
+            "import threading\n"
+            "class Worker:\n"
+            "    def __init__(self):\n"
+            "        self.count = 0\n"
+            "    def run_loop(self):\n"
+            "        self.count += 1  "
+            "# tpusync: disable=unguarded-shared-write\n"
+            "    def bump(self):\n"
+            "        self.count += 1\n"
+            "    def launch(self):\n"
+            "        t = threading.Thread(target=self.run_loop, name='w')\n"
+            "        t.start()\n")
+        assert rules_of(src) == []
+
+    def test_multiline_comment_suppression(self):
+        # a comment-only disable line covers the next CODE line, however
+        # many why-comment lines sit in between
+        src = (
+            "import threading\n"
+            "class Worker:\n"
+            "    def __init__(self):\n"
+            "        self.count = 0\n"
+            "    def run_loop(self):\n"
+            "        # tpusync: disable=unguarded-shared-write — safe:\n"
+            "        # publication is fenced by the queue join\n"
+            "        self.count += 1\n"
+            "    def bump(self):\n"
+            "        self.count += 1\n"
+            "    def launch(self):\n"
+            "        t = threading.Thread(target=self.run_loop, name='w')\n"
+            "        t.start()\n")
+        assert rules_of(src) == []
+
+
+class TestLockOrderInversion:
+    def test_positive_two_lock_cycle(self):
+        src = (
+            "import threading\n"
+            "a = threading.Lock()\n"
+            "b = threading.Lock()\n"
+            "def f():\n"
+            "    with a:\n"
+            "        with b:\n"
+            "            pass\n"
+            "def g():\n"
+            "    with b:\n"
+            "        with a:\n"
+            "            pass\n")
+        hits = findings_of(src, "lock-order-inversion")
+        assert len(hits) == 1
+        assert "a -> b" in hits[0].message or "b -> a" in hits[0].message
+        assert "deadlock" in hits[0].message
+
+    def test_negative_consistent_order(self):
+        src = (
+            "import threading\n"
+            "a = threading.Lock()\n"
+            "b = threading.Lock()\n"
+            "def f():\n"
+            "    with a:\n"
+            "        with b:\n"
+            "            pass\n"
+            "def g():\n"
+            "    with a:\n"
+            "        with b:\n"
+            "            pass\n")
+        assert rules_of(src) == []
+
+    def test_positive_nonreentrant_reacquire(self):
+        src = (
+            "import threading\n"
+            "class C:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "    def outer(self):\n"
+            "        with self._lock:\n"
+            "            self.inner()\n"
+            "    def inner(self):\n"
+            "        with self._lock:\n"
+            "            pass\n")
+        hits = findings_of(src, "lock-order-inversion")
+        assert len(hits) == 1
+        assert "self-deadlock" in hits[0].message
+
+    def test_negative_rlock_reacquire(self):
+        src = (
+            "import threading\n"
+            "class C:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.RLock()\n"
+            "    def outer(self):\n"
+            "        with self._lock:\n"
+            "            self.inner()\n"
+            "    def inner(self):\n"
+            "        with self._lock:\n"
+            "            pass\n")
+        assert findings_of(src, "lock-order-inversion") == []
+
+    def test_three_lock_cycle_across_modules(self):
+        # A→B in one module, B→C and C→A in another: one cycle, found on
+        # the whole-program graph, with every hop named
+        m1 = SyncModule("pkg/m1.py", (
+            "import threading\n"
+            "A = threading.Lock()\n"
+            "B = threading.Lock()\n"
+            "C = threading.Lock()\n"
+            "def ab():\n"
+            "    with A:\n"
+            "        with B:\n"
+            "            pass\n"))
+        m2 = SyncModule("pkg/m2.py", (
+            "from pkg.m1 import A, B, C\n"
+            "def bc():\n"
+            "    with B:\n"
+            "        with C:\n"
+            "            pass\n"
+            "def ca():\n"
+            "    with C:\n"
+            "        with A:\n"
+            "            pass\n"))
+        program = build_program([m1, m2])
+        cycles = program.lock_cycles()
+        assert len(cycles) == 1
+        assert len(cycles[0]) == 3
+
+
+class TestBlockingUnderLock:
+    def test_positive_sleep_under_lock(self):
+        src = (
+            "import threading, time\n"
+            "class C:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "    def f(self):\n"
+            "        with self._lock:\n"
+            "            time.sleep(1)\n")
+        hits = findings_of(src, "blocking-under-lock")
+        assert len(hits) == 1
+        assert "time.sleep" in hits[0].message
+        assert "C._lock" in hits[0].message
+
+    def test_negative_sleep_outside(self):
+        src = (
+            "import threading, time\n"
+            "class C:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "    def f(self):\n"
+            "        with self._lock:\n"
+            "            pass\n"
+            "        time.sleep(1)\n")
+        assert findings_of(src, "blocking-under-lock") == []
+
+    def test_positive_unbounded_queue_get(self):
+        src = (
+            "import threading, queue\n"
+            "class C:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self.q = queue.Queue()\n"
+            "    def f(self):\n"
+            "        with self._lock:\n"
+            "            return self.q.get()\n")
+        assert len(findings_of(src, "blocking-under-lock")) == 1
+
+    def test_negative_cond_wait_idiom(self):
+        # `with cond: cond.wait()` releases the lock while waiting — the
+        # condition-variable idiom is not a blocking-under-lock
+        src = (
+            "import threading\n"
+            "class C:\n"
+            "    def __init__(self):\n"
+            "        self._cond = threading.Condition()\n"
+            "    def f(self):\n"
+            "        with self._cond:\n"
+            "            self._cond.wait()\n")
+        assert findings_of(src, "blocking-under-lock") == []
+
+    def test_suppression(self):
+        src = (
+            "import threading, time\n"
+            "class C:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "    def f(self):\n"
+            "        with self._lock:\n"
+            "            time.sleep(1)  # tpusync: disable=blocking-under-lock\n")
+        assert findings_of(src, "blocking-under-lock") == []
+
+
+class TestSignalUnsafeHandler:
+    def test_positive_lock_in_handler(self):
+        src = (
+            "import signal, threading\n"
+            "_lock = threading.Lock()\n"
+            "def handler(signum, frame):\n"
+            "    with _lock:\n"
+            "        pass\n"
+            "signal.signal(signal.SIGTERM, handler)\n")
+        hits = findings_of(src, "signal-unsafe-handler")
+        assert len(hits) == 1
+        assert "SIGTERM" in hits[0].message
+        assert "handler" in hits[0].message
+
+    def test_positive_io_through_helper(self):
+        # transitive: the handler's call closure does the IO
+        src = (
+            "import signal\n"
+            "def dump():\n"
+            "    with open('/tmp/x', 'w') as fh:\n"
+            "        fh.write('x')\n"
+            "def handler(signum, frame):\n"
+            "    dump()\n"
+            "signal.signal(signal.SIGUSR1, handler)\n")
+        hits = findings_of(src, "signal-unsafe-handler")
+        assert len(hits) == 1
+        assert "open()" in hits[0].message
+
+    def test_negative_flag_set_only(self):
+        src = (
+            "import signal\n"
+            "STOP = False\n"
+            "def handler(signum, frame):\n"
+            "    global STOP\n"
+            "    STOP = True\n"
+            "signal.signal(signal.SIGTERM, handler)\n")
+        assert findings_of(src, "signal-unsafe-handler") == []
+
+    def test_thread_root_annotation_creates_handler(self):
+        # the annotation declares a root the AST can't see (C callback,
+        # RPC dispatch) — signal:* roots get handler checking too
+        src = (
+            "import threading\n"
+            "_lock = threading.Lock()\n"
+            "# tpusync: thread-root=signal:SIGPROF\n"
+            "def on_prof_tick():\n"
+            "    with _lock:\n"
+            "        pass\n")
+        hits = findings_of(src, "signal-unsafe-handler")
+        assert len(hits) == 1
+        assert "SIGPROF" in hits[0].message
+
+
+class TestCallbackUnderLock:
+    def test_positive(self):
+        src = (
+            "import threading\n"
+            "class C:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self.on_done = None\n"
+            "    def f(self):\n"
+            "        with self._lock:\n"
+            "            self.on_done()\n")
+        hits = findings_of(src, "callback-under-lock")
+        assert len(hits) == 1
+        assert "on_done" in hits[0].message
+        assert "C._lock" in hits[0].message
+
+    def test_negative_outside_lock(self):
+        src = (
+            "import threading\n"
+            "class C:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self.on_done = None\n"
+            "    def f(self):\n"
+            "        with self._lock:\n"
+            "            pass\n"
+            "        self.on_done()\n")
+        assert findings_of(src, "callback-under-lock") == []
+
+    def test_suppression(self):
+        src = (
+            "import threading\n"
+            "class C:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self.on_done = None\n"
+            "    def f(self):\n"
+            "        with self._lock:\n"
+            "            self.on_done()  # tpusync: disable=callback-under-lock\n")
+        assert findings_of(src, "callback-under-lock") == []
+
+
+# ---------------------------------------------------------------------------
+# the thread-root graph on a miniature program
+
+
+class TestThreadRootGraph:
+    def mini(self):
+        main_mod = SyncModule("app/main.py", (
+            "import threading\n"
+            "from app.work import Pump\n"
+            "def run():\n"
+            "    p = Pump()\n"
+            "    t = threading.Thread(target=p.loop, name='pump')\n"
+            "    t.start()\n"))
+        work_mod = SyncModule("app/work.py", (
+            "class Pump:\n"
+            "    def loop(self):\n"
+            "        while True:\n"
+            "            self._tick()\n"
+            "    def _tick(self):\n"
+            "        pass\n"))
+        return build_program([main_mod, work_mod])
+
+    def fn(self, program, qualname):
+        return next(f for f in program.functions if f.qualname == qualname)
+
+    def test_spawn_target_gets_thread_root(self):
+        program = self.mini()
+        assert "thread:pump" in self.fn(program, "Pump.loop").roots
+
+    def test_roots_propagate_to_callees(self):
+        program = self.mini()
+        # _tick is private and only called from the spawned loop: it runs
+        # on the pump thread (plus main, since loop is a public method)
+        assert "thread:pump" in self.fn(program, "Pump._tick").roots
+
+    def test_public_defs_rooted_at_main(self):
+        program = self.mini()
+        assert "main" in self.fn(program, "run").roots
+
+    def test_root_census(self):
+        census = self.mini().root_census()
+        assert census["thread:pump"] == 2      # loop + _tick
+        assert census["main"] >= 2
+
+    def test_lock_registry(self):
+        m = SyncModule("m.py", (
+            "import threading\n"
+            "G = threading.RLock()\n"
+            "class C:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"))
+        program = build_program([m])
+        kinds = {lid.display: kind for lid, kind in program.locks.items()}
+        assert kinds == {"G": "RLock", "C._lock": "Lock"}
+        assert LockId("cls", "m.py", "C", "_lock") in program.locks
+
+
+# ---------------------------------------------------------------------------
+# repo-wide gate
+
+
+class TestRepoGate:
+    def test_rule_registry_complete(self):
+        import tools.tpusync.rules  # noqa: F401
+
+        assert {r.name for r in RULES} == {
+            "unguarded-shared-write", "lock-order-inversion",
+            "blocking-under-lock", "signal-unsafe-handler",
+            "callback-under-lock"}
+
+    def test_seeded_race_detected(self, tmp_path):
+        """The injected-race fixture: a two-root unguarded write must exit
+        1 and the diagnostic must name the function, the candidate lock
+        and the racing thread roots."""
+        bad = tmp_path / "racy.py"
+        bad.write_text(
+            "import threading\n"
+            "class Pump:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self.total = 0\n"
+            "    def loop(self):\n"
+            "        self.total += 1\n"
+            "    def add(self, n):\n"
+            "        self.total += n\n"
+            "    def launch(self):\n"
+            "        t = threading.Thread(target=self.loop, name='pump')\n"
+            "        t.start()\n")
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.tpusync", str(bad),
+             "--baseline", ".tpusync-baseline.json",
+             "--root", str(tmp_path)],
+            cwd=REPO, capture_output=True, text=True, timeout=600)
+        assert proc.returncode == 1
+        assert "unguarded-shared-write" in proc.stdout
+        assert "Pump.total" in proc.stdout          # the attribute
+        assert "Pump.loop" in proc.stdout           # a racing function
+        assert "thread:pump" in proc.stdout         # the spawned root
+        assert "main" in proc.stdout                # ... racing main
+        assert "Pump._lock" in proc.stdout          # the candidate guard
+
+    def test_stale_baseline_rots(self, tmp_path):
+        """Baseline rot parity with the other gates: an entry for a file
+        that no longer produces findings fails the gate until pruned.
+        Runs on a tiny synthetic scope — rot semantics live in the shared
+        baseline machinery, so a one-file tree exercises them fully."""
+        import json
+
+        (tmp_path / "clean.py").write_text("def ok():\n    return 1\n")
+        stale = tmp_path / "stale.json"
+        stale.write_text(json.dumps({
+            "version": 1, "tool": "tpusync",
+            "counts": {"clean.py::blocking-under-lock": 3}}))
+
+        def run(*extra):
+            return subprocess.run(
+                [sys.executable, "-m", "tools.tpusync",
+                 str(tmp_path / "clean.py"), "--root", str(tmp_path),
+                 "--baseline", str(stale), *extra],
+                cwd=REPO, capture_output=True, text=True, timeout=600)
+
+        proc = run()
+        assert proc.returncode == 1
+        assert "stale" in proc.stdout
+        # --prune-baseline ratchets it away, then the gate is green
+        assert run("--prune-baseline").returncode == 0
+        assert run().returncode == 0
+
+    def test_sync_script_gate(self):
+        """scripts/sync.sh — the CI entry point — must pass on the tree:
+        the committed host-orchestration scope + committed zero-debt
+        baseline analyze clean. A new unguarded write / lock cycle /
+        blocking call under a lock fails this test (and therefore
+        tier-1)."""
+        proc = subprocess.run(
+            ["bash", "scripts/sync.sh"],
+            cwd=REPO, capture_output=True, text=True, timeout=600)
+        assert proc.returncode == 0, \
+            f"scripts/sync.sh failed:\n{proc.stdout}\n{proc.stderr}"

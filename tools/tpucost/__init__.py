@@ -1,6 +1,6 @@
 """tpucost — static program-cost analyzer and CI perf-regression gate.
 
-The third analyzer in the lint/audit/cost/shard quartet. tpulint reads SOURCE,
+The third analyzer in the lint/audit/cost/shard/sync quintet. tpulint reads SOURCE,
 tpuaudit reads the PROGRAM's semantics (collectives, donation, dtypes);
 tpucost reads the program's COST: it AOT-compiles every entry in the
 tpuaudit registry host-side and extracts XLA's own cost and memory analysis

@@ -1,0 +1,18 @@
+"""tpusync — host-concurrency static analysis (the fifth gate).
+
+The quartet of static gates reasons about *programs* (tpulint: source,
+tpuaudit: semantics, tpucost: cost, tpushard: layout); tpusync reasons about
+*threads*: which functions run on which thread roots (main, spawned driver
+threads, signal handlers, executor submits), which locks guard which shared
+attributes, and where the hand-rolled host orchestration — the code
+DeepSpeed delegates to torch.distributed's battle-tested plumbing — can
+race or deadlock.
+
+See ``docs/tpusync.md`` for the annotation vocabulary and rule semantics.
+"""
+
+from .core import (Finding, RULES, SyncModule, analyze_paths, analyze_source,
+                   build_program)
+
+__all__ = ["Finding", "RULES", "SyncModule", "analyze_paths",
+           "analyze_source", "build_program"]

@@ -1,0 +1,188 @@
+"""tpusync CLI — the host-concurrency gate.
+
+Usage::
+
+    # gate run (what scripts/sync.sh does): default scope vs the committed
+    # baseline
+    python -m tools.tpusync --baseline .tpusync-baseline.json
+
+    python -m tools.tpusync deepspeed_tpu/serving --format json
+    python -m tools.tpusync --baseline b.json --write-baseline
+    python -m tools.tpusync --baseline b.json --prune-baseline
+
+Same gate semantics as the other four analyzers (shared driver in
+``tools/tpulint/baseline.py``): exit 0 clean or fully baselined, 1 new
+findings or stale baseline entries, 2 usage error. ``--baseline`` defaults
+to the committed ``.tpusync-baseline.json`` when it exists, so the bare
+command is the gate.
+
+Every run publishes ``tpusync/*`` metrics (findings by rule, per-root
+function census, lock-graph size) into the process MetricsRegistry;
+``--metrics-jsonl`` dumps them for the ``report`` CLI's ``== sync ==``
+section.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import List, Optional
+
+from tools.tpulint import baseline as baseline_mod
+from tools.tpulint.core import iter_python_files
+
+from .core import (DEFAULT_SCOPE, RULES, SyncModule, analyze_paths,
+                   build_program)
+
+DEFAULT_BASELINE = ".tpusync-baseline.json"
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="tpusync",
+        description="Host-concurrency static analysis: thread-root "
+                    "reachability, guarded-by discipline, lock-order "
+                    "cycles, blocking/callbacks under locks, signal-handler "
+                    "safety.")
+    parser.add_argument("paths", nargs="*", default=None,
+                        help="files or directories to analyze (default: the "
+                             "host orchestration scope — serving/, "
+                             "observability/, launcher/, runtime "
+                             "session+checkpoint)")
+    parser.add_argument("--format", choices=("text", "json"), default="text")
+    parser.add_argument("--baseline", metavar="FILE", default=None,
+                        help=f"JSON baseline of accepted findings (default: "
+                             f"{DEFAULT_BASELINE} when it exists)")
+    parser.add_argument("--write-baseline", action="store_true",
+                        help="write the current findings to --baseline and "
+                             "exit 0")
+    parser.add_argument("--prune-baseline", action="store_true",
+                        help="drop stale baseline entries and ratchet "
+                             "budgets down to current counts, then exit 0")
+    parser.add_argument("--select", metavar="RULES", default=None,
+                        help="comma-separated rule names to run "
+                             "(default: all)")
+    parser.add_argument("--root", metavar="DIR", default=None,
+                        help="directory finding paths are made relative to "
+                             "(default: cwd)")
+    parser.add_argument("--metrics-jsonl", metavar="FILE", default=None,
+                        help="also dump the tpusync/* metrics to a JSONL "
+                             "(readable by 'observability report')")
+    parser.add_argument("--list-rules", action="store_true",
+                        help="print the rule registry and exit")
+    return parser
+
+
+def publish_metrics(program, findings) -> None:
+    """tpusync/* metrics into the process registry. Import-guarded: the
+    analyzer must run in a container with nothing but the stdlib."""
+    try:
+        from deepspeed_tpu.observability import get_registry
+    except ImportError:
+        return
+    reg = get_registry()
+    counter = reg.counter("tpusync/findings",
+                          "concurrency findings by rule")
+    for f in findings:
+        counter.inc(1, rule=f.rule)
+    reg.gauge("tpusync/functions_total",
+              "functions in the thread-root graph").set(
+        len(program.functions))
+    root_gauge = reg.gauge("tpusync/root_functions",
+                           "functions reachable per thread root")
+    for root, n in sorted(program.root_census().items()):
+        root_gauge.set(n, root=root)
+    reg.gauge("tpusync/lock_graph_locks",
+              "declared locks in the whole-program model").set(
+        len(program.locks))
+    reg.gauge("tpusync/lock_graph_edges",
+              "lock-order edges (A held while acquiring B)").set(
+        len(program.order_edges))
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+
+    if args.list_rules:
+        from . import rules as _rules  # noqa: F401
+
+        for rule in RULES:
+            print(f"{rule.name}: {rule.description}")
+        return 0
+
+    select = None
+    if args.select:
+        from . import rules as _rules  # noqa: F401
+
+        select = {r.strip() for r in args.select.split(",") if r.strip()}
+        known = {r.name for r in RULES}
+        unknown = select - known
+        if unknown:
+            print(f"tpusync: unknown rule(s): {', '.join(sorted(unknown))}",
+                  file=sys.stderr)
+            return 2
+
+    paths = args.paths or [p for p in DEFAULT_SCOPE if os.path.exists(p)]
+    missing = [p for p in (args.paths or []) if not os.path.exists(p)]
+    if missing:
+        print(f"tpusync: no such path: {', '.join(missing)}",
+              file=sys.stderr)
+        return 2
+    if not paths:
+        print("tpusync: nothing to analyze", file=sys.stderr)
+        return 2
+
+    findings = analyze_paths(paths, root=args.root, select=select)
+
+    # the census/metrics view wants the model, not just the diagnostics
+    root = args.root or os.getcwd()
+    modules = []
+    for fpath in iter_python_files(paths):
+        rel = os.path.relpath(fpath, root).replace(os.sep, "/")
+        try:
+            with open(fpath, "r", encoding="utf-8") as fh:
+                modules.append(SyncModule(rel, fh.read()))
+        except (SyntaxError, UnicodeDecodeError, OSError):
+            continue
+    program = build_program(modules)
+    publish_metrics(program, findings)
+
+    if args.metrics_jsonl:
+        from deepspeed_tpu.observability import get_registry
+
+        get_registry().dump_jsonl(args.metrics_jsonl,
+                                  extra={"tool": "tpusync"})
+
+    baseline_path = args.baseline
+    if baseline_path is None and not (args.write_baseline
+                                      or args.prune_baseline):
+        if os.path.exists(DEFAULT_BASELINE):
+            baseline_path = DEFAULT_BASELINE
+
+    # Stale detection judges only keys this run could have produced (same
+    # contract as tpulint): files under analyzed dirs count even when
+    # deleted — a removed module is the most common source of rot.
+    analyzed = {os.path.relpath(p, root).replace(os.sep, "/")
+                for p in iter_python_files(paths)}
+    dir_prefixes: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            rel = os.path.relpath(p, root).replace(os.sep, "/")
+            dir_prefixes.append("" if rel == "." else rel.rstrip("/") + "/")
+
+    def in_scope(key: str) -> bool:
+        path, _, rule = key.rpartition("::")
+        if select is not None and rule not in select:
+            return False
+        return path in analyzed or any(path.startswith(pref)
+                                       for pref in dir_prefixes)
+
+    return baseline_mod.gate_and_report(
+        findings, tool="tpusync", fmt=args.format,
+        baseline_path=baseline_path, write_baseline=args.write_baseline,
+        prune_baseline=args.prune_baseline, in_scope=in_scope)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,284 @@
+"""tpusync core — module model, annotations, rule registry, driver.
+
+Same skeleton as ``tools/tpulint/core.py`` (stdlib-only, Finding keyed by
+``path::rule``, inline suppressions, shared baseline gate), but the unit of
+analysis is the **whole program**, not one module: races and deadlocks live
+in the composition of modules (a router thread calling into an engine, a
+signal handler re-entering the recorder), so the rules run once over a
+cross-module :class:`~tools.tpusync.threadgraph.Program`.
+
+Annotation vocabulary (all comments, all optional):
+
+* ``# tpusync: disable=<rule>[,<rule>...]`` — suppress findings on this
+  line (a comment-only line also covers the next line, tpulint semantics);
+* ``# tpusync: guarded-by=<lock>`` on an attribute assignment — declare
+  that ``self.<attr>`` must only be written while holding ``self.<lock>``;
+  every write site is then checked, even single-root ones;
+* ``# tpusync: thread-root=<name>`` on a ``def`` — declare an entry point
+  the AST cannot see (RPC dispatch, C callback), adding root ``<name>``.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+from tools.tpulint.core import iter_python_files
+
+__all__ = [
+    "Finding", "SyncModule", "Rule", "RULES", "register",
+    "analyze_source", "analyze_paths", "build_program", "DEFAULT_SCOPE",
+]
+
+# the host-orchestration surface the gate runs over (scripts/sync.sh)
+DEFAULT_SCOPE = (
+    "deepspeed_tpu/serving",
+    "deepspeed_tpu/observability",
+    "deepspeed_tpu/launcher",
+    "deepspeed_tpu/runtime/session.py",
+    "deepspeed_tpu/runtime/checkpoint.py",
+)
+
+_SUPPRESS_RE = re.compile(
+    r"#.*?tpusync:\s*disable="
+    r"([A-Za-z0-9_\-]+(?:\s*,\s*[A-Za-z0-9_\-]+)*)")
+_GUARDED_RE = re.compile(r"#.*?tpusync:\s*guarded-by=([A-Za-z0-9_.]+)")
+_ROOT_RE = re.compile(r"#.*?tpusync:\s*thread-root=([A-Za-z0-9_\-:.]+)")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One diagnostic. ``key`` (path::rule) is the baseline bucket —
+    identical to tpulint's so ``tools/tpulint/baseline.py`` drives the
+    gate unchanged."""
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+
+    @property
+    def key(self) -> str:
+        return f"{self.path}::{self.rule}"
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule}: {self.message}"
+
+    def to_json(self) -> Dict[str, object]:
+        return dataclasses.asdict(self)
+
+
+class SyncModule:
+    """Parsed module plus the annotation/lookup surface the program model
+    and the rules need."""
+
+    def __init__(self, path: str, source: str):
+        self.path = path
+        self.source = source
+        self.tree = ast.parse(source, filename=path)
+        self.lines = source.splitlines()
+        self.aliases = self._collect_aliases(self.tree)
+        self.suppressions = self._collect_suppressions(self.lines)
+        self.parents: Dict[ast.AST, ast.AST] = {}
+        for node in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(node):
+                self.parents[child] = node
+        self._guard_lines = self._annotation_lines(_GUARDED_RE)
+        self._root_lines = self._annotation_lines(_ROOT_RE)
+        # (class or "", attr) -> declared guarding lock attribute
+        self.guarded_by: Dict[Tuple[str, str], str] = {}
+        self._collect_guards()
+        # def lineno -> declared root label
+        self.thread_root_annotations: Dict[int, str] = {}
+        self._collect_root_decls()
+
+    # -- parsing helpers ---------------------------------------------------
+    @staticmethod
+    def _collect_aliases(tree: ast.Module) -> Dict[str, str]:
+        aliases: Dict[str, str] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    aliases[a.asname or a.name.split(".")[0]] = (
+                        a.name if a.asname else a.name.split(".")[0])
+            elif isinstance(node, ast.ImportFrom) and node.module \
+                    and node.level == 0:
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+        return aliases
+
+    @staticmethod
+    def _collect_suppressions(lines: Sequence[str]) -> Dict[int, Set[str]]:
+        out: Dict[int, Set[str]] = {}
+        for i, text in enumerate(lines, start=1):
+            m = _SUPPRESS_RE.search(text)
+            if not m:
+                continue
+            rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+            out.setdefault(i, set()).update(rules)
+            if text.lstrip().startswith("#"):
+                # a comment-only suppression covers the next CODE line —
+                # the why-comment it opens may run several lines
+                j = i + 1
+                while j <= len(lines) and \
+                        lines[j - 1].lstrip().startswith("#"):
+                    j += 1
+                out.setdefault(j, set()).update(rules)
+        return out
+
+    def _annotation_lines(self, rx: re.Pattern) -> Dict[int, str]:
+        """line -> annotation value; a comment-only line also annotates the
+        next line (mirrors suppression placement rules)."""
+        out: Dict[int, str] = {}
+        for i, text in enumerate(self.lines, start=1):
+            m = rx.search(text)
+            if not m:
+                continue
+            out[i] = m.group(1)
+            if text.lstrip().startswith("#"):
+                out.setdefault(i + 1, m.group(1))
+        return out
+
+    def _collect_guards(self) -> None:
+        for node in ast.walk(self.tree):
+            if not isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                continue
+            lock = self._guard_lines.get(node.lineno)
+            if lock is None:
+                continue
+            targets = node.targets if isinstance(node, ast.Assign) else \
+                [node.target]
+            for tgt in targets:
+                if isinstance(tgt, ast.Attribute) and \
+                        isinstance(tgt.value, ast.Name) and \
+                        tgt.value.id == "self":
+                    cls = self.enclosing_class(tgt) or ""
+                    self.guarded_by[(cls, tgt.attr)] = lock
+                elif isinstance(tgt, ast.Name):
+                    cls = self.enclosing_class(tgt) or ""
+                    self.guarded_by[(cls, tgt.id)] = lock
+
+    def _collect_root_decls(self) -> None:
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                label = self._root_lines.get(node.lineno)
+                if label is not None:
+                    self.thread_root_annotations[node.lineno] = label
+
+    # -- lookups -----------------------------------------------------------
+    def dotted(self, node: ast.AST) -> Optional[str]:
+        parts: List[str] = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if not isinstance(node, ast.Name):
+            return None
+        parts.append(self.aliases.get(node.id, node.id))
+        return ".".join(reversed(parts))
+
+    def enclosing_class(self, node: ast.AST) -> Optional[str]:
+        """Name of the innermost class the node sits in (crossing function
+        scopes — ``self.x`` inside a method belongs to the class)."""
+        cur = self.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, ast.ClassDef):
+                return cur.name
+            cur = self.parents.get(cur)
+        return None
+
+    def enclosing_function(self, node: ast.AST) -> Optional[ast.AST]:
+        cur = self.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)):
+                return cur
+            cur = self.parents.get(cur)
+        return None
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        rules = self.suppressions.get(line, ())
+        return rule in rules or "all" in rules
+
+
+class Rule:
+    """Subclasses set ``name``/``description`` and implement
+    ``check(program) -> Iterator[Finding]`` over the whole program."""
+
+    name: str = ""
+    description: str = ""
+
+    def check(self, program) -> Iterator[Finding]:
+        raise NotImplementedError
+
+
+RULES: List[Rule] = []
+
+
+def register(cls):
+    RULES.append(cls())
+    return cls
+
+
+def build_program(modules: List[SyncModule]):
+    """Cross-module thread/lock model. (Import deferred: threadgraph
+    imports nothing from here, but keeping the seam explicit.)"""
+    from .threadgraph import Program
+    return Program(modules)
+
+
+def _run_rules(modules: List[SyncModule],
+               select: Optional[Set[str]] = None) -> List[Finding]:
+    from . import rules as _rules  # noqa: F401  (registers RULES)
+
+    program = build_program(modules)
+    by_path = {m.path: m for m in modules}
+    findings: List[Finding] = []
+    for rule in RULES:
+        if select and rule.name not in select:
+            continue
+        for f in rule.check(program):
+            mod = by_path.get(f.path)
+            if mod is None or not mod.suppressed(f.rule, f.line):
+                findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+def analyze_source(source: str, path: str = "<string>",
+                   select: Optional[Set[str]] = None) -> List[Finding]:
+    """Single-module entry point (fixture tests). The 'program' is just
+    this module."""
+    try:
+        module = SyncModule(path, source)
+    except SyntaxError as e:
+        return [Finding("syntax-error", path, e.lineno or 0, e.offset or 0,
+                        f"could not parse: {e.msg}")]
+    return _run_rules([module], select)
+
+
+def analyze_paths(paths: Sequence[str], root: Optional[str] = None,
+                  select: Optional[Set[str]] = None) -> List[Finding]:
+    """Whole-program run: parse every file under ``paths`` into ONE model,
+    then apply the rules once. ``root`` makes finding paths relative
+    (stable baseline keys)."""
+    root = root or os.getcwd()
+    modules: List[SyncModule] = []
+    findings: List[Finding] = []
+    for fpath in iter_python_files(paths):
+        rel = os.path.relpath(fpath, root).replace(os.sep, "/")
+        try:
+            with open(fpath, "r", encoding="utf-8") as fh:
+                modules.append(SyncModule(rel, fh.read()))
+        except (SyntaxError, UnicodeDecodeError, OSError) as e:
+            msg = getattr(e, "msg", None) or str(e)
+            findings.append(Finding(
+                "syntax-error", rel, getattr(e, "lineno", 0) or 0,
+                getattr(e, "offset", 0) or 0, f"could not parse: {msg}"))
+    findings.extend(_run_rules(modules, select))
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings

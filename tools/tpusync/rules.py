@@ -1,0 +1,402 @@
+"""tpusync rules — the five concurrency checks.
+
+Each rule sees the whole :class:`~tools.tpusync.threadgraph.Program` and
+yields Findings whose messages always name the **function**, the **lock**
+(held, missing, or cycling) and the **thread roots** involved — a finding
+you cannot act on without re-deriving the interleaving is a finding that
+gets baselined instead of fixed.
+
+False-positive posture: every heuristic here errs conservative (flag), and
+the escape hatch is an inline ``# tpusync: disable=<rule>`` with a comment
+saying *why* the pattern is safe — the suppression then documents the
+invariant the type system can't."""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from .core import Finding, Rule, register
+from .threadgraph import FuncInfo, LockId, Program, _NONREENTRANT
+
+_MUTATORS = {"append", "extend", "add", "remove", "discard", "pop",
+             "popleft", "appendleft", "clear", "update", "insert",
+             "setdefault", "rotate"}
+_INIT_FUNCS = {"__init__", "__post_init__", "__new__"}
+_BLOCKING_DOTTED_PREFIX = ("shutil.", "subprocess.")
+_BLOCKING_DOTTED = {"time.sleep", "os.makedirs", "os.replace", "os.rename",
+                    "os.remove", "os.fsync", "jax.block_until_ready"}
+_CALLBACK_SUFFIX = ("_callback", "_hook")
+
+
+def _roots_str(roots: Set[str]) -> str:
+    return ", ".join(sorted(roots)) or "∅"
+
+
+def _held_str(held) -> str:
+    return ", ".join(sorted(l.display for l in held)) or "nothing"
+
+
+@register
+class UnguardedSharedWrite(Rule):
+    name = "unguarded-shared-write"
+    description = ("attribute written from ≥2 thread roots with no common "
+                   "lock (or without its declared guarded-by lock)")
+
+    def check(self, program: Program) -> Iterator[Finding]:
+        # (module path, owner class, attr) -> write sites
+        writes: Dict[Tuple[str, str, str],
+                     List[Tuple[FuncInfo, int, int, frozenset]]] = {}
+        for fi in program.functions:
+            in_init = fi.name in _INIT_FUNCS
+            globs = _global_decls(fi.node)
+            for node, held, _ in program.held_regions(fi):
+                for owner, attr, line, col in _write_targets(
+                        fi, node, globs):
+                    if in_init and owner:    # construction happens-before
+                        continue
+                    # a suppressed site leaves the race set entirely —
+                    # the remaining sites are judged on their own
+                    if fi.module.suppressed(self.name, line):
+                        continue
+                    key = (fi.module.path, owner, attr)
+                    writes.setdefault(key, []).append(
+                        (fi, line, col, held))
+        for (path, owner, attr), sites in sorted(writes.items()):
+            mod = next(m for m in program.modules if m.path == path)
+            display = f"{owner}.{attr}" if owner else attr
+            guard = mod.guarded_by.get((owner, attr))
+            if guard is not None:
+                required = _resolve_guard(program, mod, owner, guard)
+                for fi, line, col, held in sites:
+                    if required is not None and required in held:
+                        continue
+                    yield Finding(
+                        self.name, path, line, col,
+                        f"write to '{display}' in {fi.qualname} (roots: "
+                        f"{_roots_str(fi.roots)}) without its declared "
+                        f"guard '{guard}' (# tpusync: guarded-by); holds: "
+                        f"{_held_str(held)}")
+                continue
+            roots: Set[str] = set()
+            for fi, _, _, _ in sites:
+                roots |= fi.roots
+            if len(roots) < 2:
+                continue
+            common = None
+            for _, _, _, held in sites:
+                common = held if common is None else (common & held)
+            if common:
+                continue
+            # anchor on the least-guarded, earliest site; list the rest
+            anchor = min(sites, key=lambda s: (len(s[3]), s[1]))
+            detail = "; ".join(
+                f"{fi.qualname} ({p}:{ln}, roots: {_roots_str(fi.roots)}, "
+                f"holds: {_held_str(held)})"
+                for fi, ln, _, held in sites[:4]
+                for p in (fi.module.path,))
+            if len(sites) > 4:
+                detail += f"; +{len(sites) - 4} more"
+            candidates = sorted(
+                l.display for l in program.locks
+                if l.scope == "cls" and l.owner == owner
+                and l.module == path) if owner else []
+            hint = (f"; candidate guard(s): {', '.join(candidates)}"
+                    if candidates else "")
+            yield Finding(
+                self.name, path, anchor[1], anchor[2],
+                f"shared attribute '{display}' written from "
+                f"{len(roots)} roots ({_roots_str(roots)}) with no common "
+                f"lock — sites: {detail}{hint}")
+
+
+@register
+class LockOrderInversion(Rule):
+    name = "lock-order-inversion"
+    description = ("cycle in the whole-program lock-acquisition graph "
+                   "(potential deadlock), incl. non-reentrant re-acquire")
+
+    def check(self, program: Program) -> Iterator[Finding]:
+        for cycle in program.lock_cycles():
+            a, b = cycle[0]
+            path, line, via = program.order_edges[(a, b)]
+            if a == b:
+                kind = program.lock_kind(a)
+                yield Finding(
+                    self.name, path, line, 0,
+                    f"non-reentrant {kind} '{a.display}' may be "
+                    f"re-acquired while already held (via {via} at "
+                    f"{path}:{line}) — self-deadlock on the same thread")
+                continue
+            hops = []
+            for (x, y) in cycle:
+                p, ln, v = program.order_edges.get((x, y), (path, line, via))
+                hops.append(f"{x.display} -> {y.display} "
+                            f"({p}:{ln} via {v})")
+            yield Finding(
+                self.name, path, line, 0,
+                f"lock-order cycle: {'; '.join(hops)} — two threads "
+                f"taking these locks in opposite order deadlock")
+
+
+@register
+class BlockingUnderLock(Rule):
+    name = "blocking-under-lock"
+    description = ("sleep / join / block_until_ready / file IO / unbounded "
+                   "queue.get while holding a lock")
+
+    def check(self, program: Program) -> Iterator[Finding]:
+        for fi in program.functions:
+            for node, held, _ in program.held_regions(fi):
+                if not held or not isinstance(node, ast.Call):
+                    continue
+                what = self._blocking_kind(program, fi, node, held)
+                if what is None:
+                    continue
+                yield Finding(
+                    self.name, fi.module.path, node.lineno, node.col_offset,
+                    f"{what} in {fi.qualname} (roots: "
+                    f"{_roots_str(fi.roots)}) while holding "
+                    f"{_held_str(held)} — every thread contending for the "
+                    f"lock stalls behind it")
+
+    def _blocking_kind(self, program: Program, fi: FuncInfo,
+                       node: ast.Call, held) -> Optional[str]:
+        mod = fi.module
+        dotted = mod.dotted(node.func) or ""
+        if dotted in _BLOCKING_DOTTED or \
+                dotted.startswith(_BLOCKING_DOTTED_PREFIX):
+            return f"blocking call {dotted}()"
+        if dotted == "open":
+            return "file IO open()"
+        if not isinstance(node.func, ast.Attribute):
+            return None
+        leaf = node.func.attr
+        if leaf == "block_until_ready":
+            return "device sync .block_until_ready()"
+        if leaf == "join" and _thread_like(mod, node.func.value, fi):
+            return "thread .join()"
+        if leaf == "get" and _unbounded_get(node):
+            return "unbounded queue .get()"
+        if leaf == "wait" and not _has_timeout(node):
+            recv = program.resolve_lock(mod, node.func.value, fi)
+            others = set(held) - ({recv} if recv is not None else set())
+            if recv is not None and not others:
+                return None        # with cond: cond.wait() — the idiom
+            if others:
+                return (f"unbounded .wait() while also holding "
+                        f"{_held_str(others)}")
+            return "unbounded .wait()"
+        return None
+
+
+@register
+class SignalUnsafeHandler(Rule):
+    name = "signal-unsafe-handler"
+    description = ("signal handler (or its call closure) acquiring "
+                   "non-reentrant locks or doing IO/allocation")
+
+    def check(self, program: Program) -> Iterator[Finding]:
+        handlers: Dict[FuncInfo, str] = {}
+        for label, fi, _site in program.spawns:
+            if label.startswith("signal:"):
+                handlers.setdefault(fi, label)
+        for fi in program.functions:
+            label = fi.module.thread_root_annotations.get(fi.node.lineno)
+            if label and label.startswith("signal:"):
+                handlers.setdefault(fi, label)
+        for handler, label in sorted(handlers.items(),
+                                     key=lambda kv: (kv[0].module.path,
+                                                     kv[0].line)):
+            closure = _call_closure(program, handler)
+            lock_hits: List[str] = []
+            io_hits: List[str] = []
+            for g in closure:
+                for lid in sorted(program._own_with_locks(g),
+                                  key=lambda l: l.key):
+                    kind = program.lock_kind(lid)
+                    if kind in _NONREENTRANT or kind == "Semaphore":
+                        lock_hits.append(
+                            f"{kind} '{lid.display}' in {g.qualname} "
+                            f"({g.module.path}:{g.line})")
+                for node in _own_calls(g):
+                    why = _alloc_io_kind(g.module, node)
+                    if why is not None:
+                        io_hits.append(f"{why} in {g.qualname} "
+                                       f"({g.module.path}:{node.lineno})")
+            path, line = handler.module.path, handler.line
+            fn = handler.qualname
+            for hit in lock_hits:
+                yield Finding(
+                    self.name, path, line, 0,
+                    f"signal handler {fn} ({label}) reaches {hit} — if the "
+                    f"interrupted main-thread frame already holds it, the "
+                    f"handler deadlocks")
+            if io_hits:
+                sample = "; ".join(io_hits[:3])
+                more = f"; +{len(io_hits) - 3} more" if len(io_hits) > 3 \
+                    else ""
+                yield Finding(
+                    self.name, path, line, 0,
+                    f"signal handler {fn} ({label}) allocates/does IO "
+                    f"({sample}{more}) — handlers run atop an arbitrary "
+                    f"interrupted frame; keep them to flag-sets and "
+                    f"reentrant state")
+
+
+@register
+class CallbackUnderLock(Rule):
+    name = "callback-under-lock"
+    description = ("user/exporter callback invoked while holding an "
+                   "internal lock")
+
+    def check(self, program: Program) -> Iterator[Finding]:
+        for fi in program.functions:
+            for node, held, _ in program.held_regions(fi):
+                if not held or not isinstance(node, ast.Call):
+                    continue
+                leaf = None
+                if isinstance(node.func, ast.Attribute):
+                    leaf = node.func.attr
+                elif isinstance(node.func, ast.Name):
+                    leaf = node.func.id
+                if leaf is None or not _callback_name(leaf):
+                    continue
+                yield Finding(
+                    self.name, fi.module.path, node.lineno,
+                    node.col_offset,
+                    f"callback '{leaf}' invoked in {fi.qualname} (roots: "
+                    f"{_roots_str(fi.roots)}) while holding "
+                    f"{_held_str(held)} — foreign code under an internal "
+                    f"lock can re-enter or block it")
+
+
+# -- shared helpers --------------------------------------------------------
+def _callback_name(leaf: str) -> bool:
+    return (leaf.startswith("on_") or leaf.endswith(_CALLBACK_SUFFIX)
+            or leaf == "write_events")
+
+
+def _global_decls(fn: ast.AST) -> Set[str]:
+    out: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Global):
+            out.update(node.names)
+    return out
+
+
+def _write_targets(fi: FuncInfo, node: ast.AST, globs: Set[str]
+                   ) -> Iterator[Tuple[str, str, int, int]]:
+    """(owner class or "", attr, line, col) for mutations in this stmt."""
+    if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+        targets = node.targets if isinstance(node, ast.Assign) \
+            else [node.target]
+        for tgt in targets:
+            if isinstance(tgt, ast.Attribute) and \
+                    isinstance(tgt.value, ast.Name) and \
+                    tgt.value.id == "self" and fi.class_name:
+                yield fi.class_name, tgt.attr, tgt.lineno, tgt.col_offset
+            elif isinstance(tgt, ast.Name) and tgt.id in globs:
+                yield "", tgt.id, tgt.lineno, tgt.col_offset
+    elif isinstance(node, ast.Call) and \
+            isinstance(node.func, ast.Attribute) and \
+            node.func.attr in _MUTATORS:
+        recv = node.func.value
+        if isinstance(recv, ast.Attribute) and \
+                isinstance(recv.value, ast.Name) and \
+                recv.value.id == "self" and fi.class_name:
+            yield fi.class_name, recv.attr, node.lineno, node.col_offset
+        elif isinstance(recv, ast.Name) and recv.id in globs:
+            yield "", recv.id, node.lineno, node.col_offset
+
+
+def _resolve_guard(program: Program, mod, owner: str,
+                   guard: str) -> Optional[LockId]:
+    name = guard.rpartition(".")[2]
+    lid = LockId("cls", mod.path, owner, name)
+    if lid in program.locks:
+        return lid
+    lid = LockId("mod", mod.path, "", name)
+    if lid in program.locks:
+        return lid
+    matches = [l for l in program.locks if l.name == name]
+    return matches[0] if len(matches) == 1 else None
+
+
+def _thread_like(mod, recv: ast.AST, fi: FuncInfo) -> bool:
+    text = mod.dotted(recv) or ""
+    leaf = text.rpartition(".")[2].lower()
+    if "thread" in leaf or leaf in ("_t", "worker", "_drain"):
+        return True
+    fn = fi.node
+    for n in ast.walk(fn):
+        if isinstance(n, ast.Assign) and isinstance(n.value, ast.Call) \
+                and (mod.dotted(n.value.func) or "") in (
+                    "threading.Thread", "Thread"):
+            for t in n.targets:
+                if isinstance(t, ast.Name) and t.id == text:
+                    return True
+    return False
+
+
+def _has_timeout(node: ast.Call) -> bool:
+    if any(kw.arg == "timeout" for kw in node.keywords):
+        return True
+    return len(node.args) >= 1 and not (
+        isinstance(node.args[0], ast.Constant)
+        and node.args[0].value is True)
+
+
+def _unbounded_get(node: ast.Call) -> bool:
+    """queue.get() with blocking semantics and no timeout. Zero-argument
+    ``.get()`` is unambiguous (dict.get needs a key); ``get(True)`` /
+    ``get(block=True)`` without a timeout also counts."""
+    if any(kw.arg == "timeout" for kw in node.keywords):
+        return False
+    if not node.args and not node.keywords:
+        return True
+    if node.args and isinstance(node.args[0], ast.Constant) and \
+            node.args[0].value is True and len(node.args) == 1:
+        return True
+    return any(kw.arg == "block" and isinstance(kw.value, ast.Constant)
+               and kw.value.value is True for kw in node.keywords)
+
+
+def _call_closure(program: Program, start: FuncInfo) -> List[FuncInfo]:
+    seen = {start}
+    order = [start]
+    frontier = [start]
+    while frontier:
+        cur = frontier.pop()
+        for callee in program.call_edges.get(cur, ()):
+            if callee not in seen:
+                seen.add(callee)
+                order.append(callee)
+                frontier.append(callee)
+    return order
+
+
+def _own_calls(fi: FuncInfo) -> Iterator[ast.Call]:
+    from .threadgraph import _own_nodes
+    for node in _own_nodes(fi.node):
+        if isinstance(node, ast.Call):
+            yield node
+
+
+def _alloc_io_kind(mod, node: ast.Call) -> Optional[str]:
+    dotted = mod.dotted(node.func) or ""
+    if dotted == "open":
+        return "open()"
+    if dotted.startswith(("os.makedirs", "os.replace", "os.rename",
+                          "shutil.")):
+        return f"{dotted}()"
+    if dotted == "print":
+        return "print()"
+    if dotted.startswith("logging.") or \
+            (isinstance(node.func, ast.Attribute)
+             and (mod.dotted(node.func.value) or "").rpartition(".")[2]
+             in ("logger", "log")):
+        return f"logging call {dotted or node.func.attr}()"
+    if dotted in ("threading.Thread", "Thread"):
+        return "thread spawn"
+    return None

@@ -1,0 +1,872 @@
+"""Whole-program thread-root reachability + lock model.
+
+The concurrency mirror of tpulint's jit-reachability graph: instead of
+asking "which functions are traced under jit", tpusync asks "which functions
+execute on which *thread roots*" and "which locks are held where".
+
+**Roots** are the places a new flow of control enters Python:
+
+* ``main`` — the importing/caller thread. Seeded onto module top-level code,
+  public (non-underscore) functions and dunder methods (anything a client
+  can call), then propagated through calls;
+* ``thread:<name>`` — ``threading.Thread(target=f, name="<name>")`` spawns
+  (the router driver, hang watchdog, async-save publisher, ...);
+* ``signal:<SIG>`` — ``signal.signal(SIG, handler)`` handlers, which run
+  *on top of* whatever the main thread was doing;
+* ``executor:<fn>`` — ``ThreadPoolExecutor.submit/map`` operands;
+* ``# tpusync: thread-root=<name>`` — annotation for entry points the AST
+  cannot see (RPC dispatch, C callbacks).
+
+Reachability closes over calls resolved by simple name: bare names within
+the module (import aliases followed across analyzed modules), attribute
+calls (``self.step()``, ``r.engine.submit()``) against every same-named
+def in the program, and callback *bindings* (``obj.on_fire = f`` makes a
+later ``x.on_fire()`` call resolve to ``f``). Deliberately name-based and
+over-approximate — wrong only in the conservative direction, with inline
+suppressions as the escape hatch (same contract as tpulint).
+
+**Locks** are identified by declaration site: ``self._lock =
+threading.Lock()`` in class ``C`` is the node ``C._lock`` (per module), a
+module-level ``L = threading.Lock()`` is ``L``. ``with`` regions feed three
+derived facts used by the rules:
+
+* ``held_at(stmt)`` — the with-stack inside the function plus the
+  *entry-held* set: locks held at EVERY call site of the function
+  (intersection, to fixpoint);
+* ``acquires(fn)`` — locks a function may take, closed over callees;
+* the **lock-order graph** — edge ``A -> B`` when ``B`` is acquired
+  (directly or via a call) inside a ``with A:`` region. A cycle is a
+  potential deadlock; a self-edge is flagged only for non-reentrant kinds
+  (``Lock``/``Condition`` — re-entering an ``RLock`` is its purpose).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, FrozenSet, Iterator, List, Optional, Set, Tuple
+
+FunctionNode = ast.AST  # FunctionDef | AsyncFunctionDef | Lambda
+
+_THREAD_CTORS = {"threading.Thread", "Thread"}
+_EXECUTOR_CTORS = {"concurrent.futures.ThreadPoolExecutor",
+                   "ThreadPoolExecutor",
+                   "concurrent.futures.ProcessPoolExecutor",
+                   "ProcessPoolExecutor"}
+_LOCK_CTORS = {
+    "threading.Lock": "Lock", "threading.RLock": "RLock",
+    "threading.Condition": "Condition", "Lock": "Lock", "RLock": "RLock",
+    "Condition": "Condition", "threading.Semaphore": "Semaphore",
+    "threading.BoundedSemaphore": "Semaphore",
+}
+_NONREENTRANT = {"Lock", "Condition"}
+_STDLIB_ROOTS = {"subprocess", "threading", "queue", "socket", "io", "os",
+                 "collections", "tempfile", "multiprocessing", "selectors"}
+# Method names so generic (dicts, files, stdlib containers) that resolving
+# them to same-named program defs manufactures false call edges — excluded
+# from the global by-name fallback (typed receivers still resolve).
+_GENERIC_METHODS = {"get", "set", "close", "flush", "update", "pop", "put",
+                    "copy", "clear", "read", "write", "items", "keys",
+                    "values", "add", "remove"}
+
+
+@dataclasses.dataclass(frozen=True)
+class LockId:
+    """One lock node. ``scope`` is 'cls' (class attribute), 'mod'
+    (module-level name) or 'loc' (function-local)."""
+    scope: str
+    module: str
+    owner: str      # class name / "" / function qualname
+    name: str       # attribute or variable name
+
+    @property
+    def display(self) -> str:
+        if self.scope == "cls":
+            return f"{self.owner}.{self.name}"
+        return self.name
+
+    @property
+    def key(self) -> str:
+        return f"{self.module}::{self.owner}::{self.name}"
+
+
+@dataclasses.dataclass(eq=False)
+class FuncInfo:
+    """One function/lambda in the program."""
+    module: "object"            # SyncModule (untyped to avoid the cycle)
+    node: FunctionNode
+    name: str                   # simple name ("" for lambdas)
+    qualname: str               # Class.name or name
+    class_name: Optional[str]
+    line: int
+    roots: Set[str] = dataclasses.field(default_factory=set)
+    # entry-held fixpoint state: None = not yet constrained (universe)
+    entry_held: Optional[FrozenSet[LockId]] = None
+    spawn_only: bool = False    # registered as a spawn/signal/executor
+    #   target (main is NOT implied by having no callers)
+
+
+@dataclasses.dataclass
+class WriteSite:
+    """One shared-state mutation (assignment or mutating method call)."""
+    func: FuncInfo
+    attr: str                   # attribute / global name
+    owner: str                  # class name or "" for module globals
+    module: "object"
+    line: int
+    col: int
+    held: FrozenSet[LockId]
+    in_init: bool
+
+
+class Program:
+    """The cross-module model one tpusync run reasons over."""
+
+    def __init__(self, modules: List["object"]):
+        self.modules = modules
+        self.functions: List[FuncInfo] = []
+        self.by_node: Dict[FunctionNode, FuncInfo] = {}
+        self.defs_by_name: Dict[str, List[FuncInfo]] = {}
+        # callback bindings: attr name -> FuncInfos assigned to `<x>.attr`
+        self.attr_bindings: Dict[str, List[FuncInfo]] = {}
+        self.locks: Dict[LockId, str] = {}           # -> kind (Lock/RLock/..)
+        self.lock_decl_site: Dict[LockId, Tuple[str, int]] = {}
+        self.call_edges: Dict[FuncInfo, Set[FuncInfo]] = {}
+        # spawn/signal/executor registrations: (root label, target, site)
+    # spawn sites double as the gate-report's per-root census
+        self.spawns: List[Tuple[str, FuncInfo, Tuple[str, int]]] = []
+        # lock-order edges: (A, B) -> example (path, line, via) site
+        self.order_edges: Dict[Tuple[LockId, LockId], Tuple[str, int, str]] = {}
+        # resolve_call memo: everything it reads (aliases, attr_classes,
+        # defs) is frozen before the first resolution, and the fixpoint
+        # passes re-resolve the same call nodes many times over
+        self._resolve_cache: Dict[int, List[FuncInfo]] = {}
+        self._collect_functions()
+        # class names with at least one def — the "known types" universe
+        # every precision layer checks against (hoisted: it was rebuilt
+        # per resolved call)
+        self._known_classes = {fi.class_name for fi in self.functions
+                               if fi.class_name}
+        self._collect_locks()
+        self._collect_bindings()
+        self._collect_attr_classes()
+        self._collect_spawns()
+        self._build_call_edges()
+        self._propagate_roots()
+        self._compute_entry_held()
+        self._compute_acquires()
+        self._build_order_edges()
+
+    # -- gathering ---------------------------------------------------------
+    def _collect_functions(self) -> None:
+        for mod in self.modules:
+            for node, class_name in _walk_defs(mod.tree):
+                name = getattr(node, "name", "")
+                qual = f"{class_name}.{name}" if class_name else (
+                    name or f"<lambda:{node.lineno}>")
+                fi = FuncInfo(module=mod, node=node, name=name,
+                              qualname=qual, class_name=class_name,
+                              line=node.lineno)
+                self.functions.append(fi)
+                self.by_node[node] = fi
+                if name:
+                    self.defs_by_name.setdefault(name, []).append(fi)
+            # explicit thread-root annotations
+            for fi in self.functions:
+                if fi.module is mod:
+                    label = mod.thread_root_annotations.get(fi.node.lineno)
+                    if label:
+                        fi.roots.add(label)
+                        fi.spawn_only = True
+
+    def _collect_locks(self) -> None:
+        for mod in self.modules:
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, ast.Assign) or \
+                        not isinstance(node.value, ast.Call):
+                    continue
+                ctor = mod.dotted(node.value.func)
+                kind = _LOCK_CTORS.get(ctor or "")
+                if kind is None:
+                    continue
+                for tgt in node.targets:
+                    lid = self._lock_target_id(mod, tgt)
+                    if lid is not None:
+                        self.locks[lid] = kind
+                        self.lock_decl_site.setdefault(
+                            lid, (mod.path, node.lineno))
+
+    def _lock_target_id(self, mod, tgt: ast.AST) -> Optional[LockId]:
+        if isinstance(tgt, ast.Attribute) and \
+                isinstance(tgt.value, ast.Name) and tgt.value.id == "self":
+            cls = mod.enclosing_class(tgt)
+            if cls:
+                return LockId("cls", mod.path, cls, tgt.attr)
+        elif isinstance(tgt, ast.Name):
+            fn = mod.enclosing_function(tgt)
+            if fn is None:
+                return LockId("mod", mod.path, "", tgt.id)
+            fi = self.by_node.get(fn)
+            return LockId("loc", mod.path,
+                          fi.qualname if fi else "?", tgt.id)
+        return None
+
+    def _collect_bindings(self) -> None:
+        """``<expr>.attr = <func|lambda>`` — callback seams the attr-call
+        resolver follows (``on_prefill_complete``, ``context_fn``, ...)."""
+        for mod in self.modules:
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, ast.Assign):
+                    continue
+                target_fi = self._operand_funcs(mod, node.value)
+                if not target_fi:
+                    continue
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Attribute):
+                        self.attr_bindings.setdefault(
+                            tgt.attr, []).extend(target_fi)
+
+    def _operand_funcs(self, mod, expr: ast.AST) -> List[FuncInfo]:
+        """FuncInfos an expression may evaluate to (name / self-attr /
+        lambda)."""
+        if isinstance(expr, ast.Lambda):
+            fi = self.by_node.get(expr)
+            return [fi] if fi else []
+        if isinstance(expr, ast.Name):
+            return [fi for fi in self.defs_by_name.get(expr.id, ())
+                    if fi.module is mod]
+        if isinstance(expr, ast.Attribute):
+            # self._drive / obj.method — match by simple name, module first
+            cands = self.defs_by_name.get(expr.attr, [])
+            local = [fi for fi in cands if fi.module is mod]
+            return local or cands
+        return []
+
+    def _collect_attr_classes(self) -> None:
+        """name -> classes it is constructed as (``self.sched =
+        Scheduler(...)``, ``mon = FleetHealthMonitor(...)``) — a light type
+        layer that keeps receiver-qualified calls inside the right class."""
+        self.attr_classes: Dict[str, Set[str]] = {}
+        known = self._known_classes
+        for mod in self.modules:
+            for node in ast.walk(mod.tree):
+                if isinstance(node, ast.Assign) and \
+                        isinstance(node.value, ast.Call):
+                    ctor = (mod.dotted(node.value.func) or
+                            "").rpartition(".")[2]
+                    if ctor not in known:
+                        continue
+                    for tgt in node.targets:
+                        name = tgt.attr if isinstance(tgt, ast.Attribute) \
+                            else (tgt.id if isinstance(tgt, ast.Name)
+                                  else None)
+                        if name is not None:
+                            self.attr_classes.setdefault(
+                                name, set()).add(ctor)
+                elif isinstance(node, ast.Assign) and \
+                        isinstance(node.value, ast.Name):
+                    # `self._engine = engine` where the enclosing function
+                    # annotates the parameter: param types flow onto attrs
+                    fn = mod.enclosing_function(node)
+                    leaf = _param_type(mod, fn, node.value.id) \
+                        if fn is not None else None
+                    if leaf in known:
+                        for tgt in node.targets:
+                            if isinstance(tgt, ast.Attribute):
+                                self.attr_classes.setdefault(
+                                    tgt.attr, set()).add(leaf)
+                elif isinstance(node, ast.AnnAssign):
+                    # dataclass fields / annotated attrs: `engine:
+                    # ServingEngine` (string annotations included)
+                    ann = node.annotation
+                    if isinstance(ann, ast.Constant) and \
+                            isinstance(ann.value, str):
+                        leaf = ann.value.rpartition(".")[2].strip("'\" ")
+                    else:
+                        leaf = (mod.dotted(ann) or "").rpartition(".")[2]
+                    tgt = node.target
+                    name = tgt.attr if isinstance(tgt, ast.Attribute) else (
+                        tgt.id if isinstance(tgt, ast.Name) else None)
+                    if leaf in known and name is not None:
+                        self.attr_classes.setdefault(name, set()).add(leaf)
+
+    def _collect_spawns(self) -> None:
+        for mod in self.modules:
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                dotted = mod.dotted(node.func) or ""
+                site = (mod.path, node.lineno)
+                if dotted in _THREAD_CTORS:
+                    target = _kwarg(node, "target") or (
+                        node.args[0] if node.args else None)
+                    label = None
+                    name_kw = _kwarg(node, "name")
+                    if isinstance(name_kw, ast.Constant) and \
+                            isinstance(name_kw.value, str):
+                        label = f"thread:{name_kw.value}"
+                    for fi in self._operand_funcs(mod, target) \
+                            if target is not None else []:
+                        self._register_root(
+                            fi, label or f"thread:{fi.name or 'lambda'}",
+                            site)
+                elif dotted == "signal.signal" and len(node.args) >= 2:
+                    sig = mod.dotted(node.args[0]) or "?"
+                    signame = sig.rpartition(".")[2]
+                    for fi in self._operand_funcs(mod, node.args[1]):
+                        self._register_root(fi, f"signal:{signame}", site)
+                elif isinstance(node.func, ast.Attribute) and \
+                        node.func.attr in ("submit", "map") and node.args:
+                    if self._is_executor(mod, node.func.value):
+                        for fi in self._operand_funcs(mod, node.args[0]):
+                            self._register_root(
+                                fi, f"executor:{fi.name or 'lambda'}", site)
+
+    def _local_types(self, mod, name_node: ast.Name) -> Set[str]:
+        """Classes a local variable may hold, from assignments in its
+        enclosing function: ctor calls and typed-attribute loads (``rt =
+        obs.reqtrace`` picks up ``reqtrace``'s construction-site type)."""
+        fn = mod.enclosing_function(name_node)
+        if fn is None:
+            return set()
+        known = self._known_classes
+        out: Set[str] = set()
+        resolved_all = True
+        for n in ast.walk(fn):
+            if isinstance(n, (ast.For, ast.AsyncFor)) and \
+                    isinstance(n.target, ast.Name) and \
+                    n.target.id == name_node.id:
+                elems = self._iter_elem_types(mod, fn, n.iter)
+                if elems:
+                    out.update(elems)
+                else:
+                    resolved_all = False
+                continue
+            if not isinstance(n, ast.Assign):
+                continue
+            if not any(isinstance(t, ast.Name) and t.id == name_node.id
+                       for t in n.targets):
+                continue
+            if isinstance(n.value, ast.Call):
+                dotted = mod.dotted(n.value.func) or ""
+                ctor = dotted.rpartition(".")[2]
+                if ctor in known:
+                    out.add(ctor)
+                elif dotted.split(".")[0] in _STDLIB_ROOTS:
+                    # stdlib object (Popen, socket, deque...): its methods
+                    # never resolve to program defs
+                    out.add("<external>")
+                else:
+                    resolved_all = False
+            elif isinstance(n.value, ast.Attribute):
+                types = self.attr_classes.get(n.value.attr)
+                if types:
+                    out.update(types)
+                else:
+                    resolved_all = False
+            else:
+                resolved_all = False
+        # a binding we could not type may hold anything: don't narrow
+        return out if resolved_all and out else set()
+
+    def _iter_elem_types(self, mod, fn: ast.AST,
+                         iter_expr: ast.AST) -> Set[str]:
+        """Element classes of a ``for x in <iter>`` loop, from the
+        iterable's AnnAssign annotation (``procs: List[subprocess.Popen]``
+        types every loop variable drawn from it)."""
+        ann = None
+        names, attrs = self._annassign_index(mod)
+        if isinstance(iter_expr, ast.Name):
+            # closures read enclosing-scope names: search the function,
+            # then the whole module
+            for n in ast.walk(fn):
+                if isinstance(n, ast.AnnAssign) and \
+                        isinstance(n.target, ast.Name) and \
+                        n.target.id == iter_expr.id:
+                    ann = n.annotation
+            if ann is None:
+                ann = names.get(iter_expr.id)
+        elif isinstance(iter_expr, ast.Attribute) and \
+                isinstance(iter_expr.value, ast.Name) and \
+                iter_expr.value.id == "self":
+            ann = attrs.get(iter_expr.attr)
+        if not isinstance(ann, ast.Subscript):
+            return set()
+        elem = ann.slice
+        if isinstance(elem, ast.Tuple) and elem.elts:   # Dict[K, V] → V
+            elem = elem.elts[-1]
+        dotted = mod.dotted(elem) or ""
+        if not dotted:
+            return set()
+        if dotted.split(".")[0] in _STDLIB_ROOTS:
+            return {"<external>"}
+        leaf = dotted.rpartition(".")[2]
+        known = self._known_classes
+        return {leaf} if leaf in known else set()
+
+    def _annassign_index(self, mod) -> Tuple[Dict[str, ast.AST],
+                                             Dict[str, ast.AST]]:
+        """One walk per module: AnnAssign annotations by plain name and by
+        ``self.<attr>`` (last declaration wins, matching the linear-scan
+        semantics this replaces)."""
+        idx = getattr(mod, "_tpusync_ann_idx", None)
+        if idx is None:
+            names: Dict[str, ast.AST] = {}
+            attrs: Dict[str, ast.AST] = {}
+            for n in ast.walk(mod.tree):
+                if not isinstance(n, ast.AnnAssign):
+                    continue
+                if isinstance(n.target, ast.Name):
+                    names[n.target.id] = n.annotation
+                elif isinstance(n.target, ast.Attribute) and \
+                        isinstance(n.target.value, ast.Name) and \
+                        n.target.value.id == "self":
+                    attrs[n.target.attr] = n.annotation
+            idx = (names, attrs)
+            mod._tpusync_ann_idx = idx
+        return idx
+
+    def _is_executor(self, mod, recv: ast.AST) -> bool:
+        """Does this receiver look like a futures executor? (``submit`` is
+        also the serving API's verb — only spelled receivers count.)"""
+        text = mod.dotted(recv) or ""
+        leaf = text.rpartition(".")[2].lower()
+        if "pool" in leaf or "executor" in leaf:
+            return True
+        # local name assigned (or with-bound) from an executor ctor
+        fn = mod.enclosing_function(recv)
+        scope = fn if fn is not None else mod.tree
+        for n in ast.walk(scope):
+            if isinstance(n, ast.Assign) and isinstance(n.value, ast.Call) \
+                    and mod.dotted(n.value.func) in _EXECUTOR_CTORS:
+                for t in n.targets:
+                    if isinstance(t, ast.Name) and t.id == text:
+                        return True
+            if isinstance(n, ast.withitem) and \
+                    isinstance(n.context_expr, ast.Call) and \
+                    mod.dotted(n.context_expr.func) in _EXECUTOR_CTORS and \
+                    isinstance(n.optional_vars, ast.Name) and \
+                    n.optional_vars.id == text:
+                return True
+        return False
+
+    def _register_root(self, fi: FuncInfo, label: str,
+                       site: Tuple[str, int]) -> None:
+        fi.roots.add(label)
+        fi.spawn_only = True
+        self.spawns.append((label, fi, site))
+
+    # -- call graph + root propagation -------------------------------------
+    def _build_call_edges(self) -> None:
+        for fi in self.functions:
+            edges: Set[FuncInfo] = set()
+            for node in _own_nodes(fi.node):
+                if isinstance(node, ast.Call):
+                    edges.update(self.resolve_call(fi.module, node))
+            self.call_edges[fi] = edges
+
+    def resolve_call(self, mod, call: ast.Call) -> List[FuncInfo]:
+        cached = self._resolve_cache.get(id(call))
+        if cached is None:
+            cached = self._resolve_call_uncached(mod, call)
+            self._resolve_cache[id(call)] = cached
+        return cached
+
+    def _resolve_call_uncached(self, mod, call: ast.Call) -> List[FuncInfo]:
+        fn = call.func
+        if isinstance(fn, ast.Name):
+            # bare name: module-local defs, else alias-followed cross-module
+            local = [fi for fi in self.defs_by_name.get(fn.id, ())
+                     if fi.module is mod]
+            if local:
+                return local
+            dotted = mod.aliases.get(fn.id)
+            if dotted:
+                leaf = dotted.rpartition(".")[2]
+                return [fi for fi in self.defs_by_name.get(leaf, ())
+                        if fi.module is not mod]
+            return []
+        if isinstance(fn, ast.Attribute):
+            # stdlib Thread/lock methods on thread-like receivers must not
+            # resolve to same-named program defs (Thread.start vs
+            # Router.start) — spawn targets are modeled explicitly
+            if fn.attr in ("start", "join", "run", "is_alive", "acquire",
+                           "release", "cancel_join_thread") and \
+                    _thread_like_recv(mod, fn.value):
+                return []
+            out: List[FuncInfo] = []
+            cands = self.defs_by_name.get(fn.attr, [])
+            if isinstance(fn.value, ast.Name) and fn.value.id == "self":
+                cls = mod.enclosing_class(fn)
+                same_cls = [fi for fi in cands if fi.module is mod
+                            and fi.class_name == cls]
+                if same_cls:
+                    return same_cls
+            # receiver typed by construction site (`self.sched =
+            # Scheduler(...)` makes `self.sched.X()` resolve only inside
+            # Scheduler)
+            recv_leaf = None
+            if isinstance(fn.value, ast.Attribute):
+                recv_leaf = fn.value.attr
+            elif isinstance(fn.value, ast.Name) and fn.value.id != "self":
+                recv_leaf = fn.value.id
+                local = self._local_types(mod, fn.value)
+                if local:
+                    return [fi for fi in cands if fi.class_name in local]
+                # imported-module receiver (``os.kill``, ``time.sleep``,
+                # ``reqtrace.get_tracer``): resolve against that module's
+                # top-level defs only — never method candidates
+                target = mod.aliases.get(fn.value.id)
+                if target is not None:
+                    mpath = target.lstrip(".").replace(".", "/") + ".py"
+                    return [fi for fi in cands
+                            if not fi.class_name
+                            and fi.module.path.endswith(mpath)]
+            if recv_leaf is not None:
+                types = self.attr_classes.get(recv_leaf)
+                if types:
+                    return [fi for fi in cands if fi.class_name in types]
+            if fn.attr in _GENERIC_METHODS:
+                return []
+            out.extend(cands)
+            out.extend(self.attr_bindings.get(fn.attr, []))
+            return out
+        return []
+
+    def _propagate_roots(self) -> None:
+        # seed main: public defs, dunders, and module-top-level callees
+        for fi in self.functions:
+            if fi.name and (not fi.name.startswith("_")
+                            or (fi.name.startswith("__")
+                                and fi.name.endswith("__"))):
+                fi.roots.add("main")
+        for mod in self.modules:
+            for node in ast.iter_child_nodes(mod.tree):
+                for call in ast.walk(node):
+                    if isinstance(call, ast.Call) and not isinstance(
+                            node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                   ast.ClassDef)):
+                        for fi in self.resolve_call(mod, call):
+                            fi.roots.add("main")
+        # private defs nobody spawns and nobody calls are still client
+        # entry points (helpers imported elsewhere): give them main
+        called: Set[FuncInfo] = set()
+        for edges in self.call_edges.values():
+            called.update(edges)
+        for fi in self.functions:
+            if not fi.roots and fi not in called and not fi.spawn_only:
+                fi.roots.add("main")
+        # fixpoint: roots flow caller -> callee
+        changed = True
+        while changed:
+            changed = False
+            for fi, edges in self.call_edges.items():
+                for callee in edges:
+                    missing = fi.roots - callee.roots
+                    if missing:
+                        callee.roots |= missing
+                        changed = True
+
+    # -- lock facts --------------------------------------------------------
+    def resolve_lock(self, mod, expr: ast.AST,
+                     fi: Optional[FuncInfo]) -> Optional[LockId]:
+        """LockId for a ``with <expr>:`` context (or a wait/acquire
+        receiver). Unknown expressions resolve to a declared lock when the
+        attribute name is unambiguous across the program."""
+        if isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Name) and expr.value.id == "self":
+            cls = mod.enclosing_class(expr)
+            if cls:
+                lid = LockId("cls", mod.path, cls, expr.attr)
+                if lid in self.locks:
+                    return lid
+                # inherited / mixin attr: fall through to unique-name match
+        if isinstance(expr, ast.Name):
+            for scope, owner in (("mod", ""),):
+                lid = LockId(scope, mod.path, owner, expr.id)
+                if lid in self.locks:
+                    return lid
+            if fi is not None:
+                lid = LockId("loc", mod.path, fi.qualname, expr.id)
+                if lid in self.locks:
+                    return lid
+        # unique attribute-name match anywhere in the program
+        leaf = expr.attr if isinstance(expr, ast.Attribute) else (
+            expr.id if isinstance(expr, ast.Name) else None)
+        if leaf:
+            matches = [lid for lid in self.locks if lid.name == leaf]
+            if len(matches) == 1:
+                return matches[0]
+        return None
+
+    def lock_kind(self, lid: LockId) -> str:
+        return self.locks.get(lid, "?")
+
+    def held_regions(self, fi: FuncInfo) -> Iterator[
+            Tuple[ast.AST, FrozenSet[LockId], Optional[LockId]]]:
+        """(statement, held locks incl. entry-held, innermost lock) for
+        every node in the function body. The with-stack part is static per
+        function, so it is computed once and cached; only the entry-held
+        union varies (the fixpoint passes re-walk every function)."""
+        cache = getattr(self, "_region_cache", None)
+        if cache is None:
+            cache = self._region_cache = {}
+        regions = cache.get(fi)
+        if regions is None:
+            regions = cache[fi] = list(self._walk_regions(fi))
+        entry = fi.entry_held or frozenset()
+        if not entry:
+            yield from regions
+            return
+        for node, held, inner in regions:
+            yield node, entry | held, inner
+
+    def _walk_regions(self, fi: FuncInfo) -> Iterator[
+            Tuple[ast.AST, FrozenSet[LockId], Optional[LockId]]]:
+        stack: List[Tuple[ast.AST, Tuple[LockId, ...]]] = \
+            [(fi.node, ())]
+        while stack:
+            node, held = stack.pop()
+            if node is not fi.node and isinstance(
+                    node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.Lambda)):
+                continue    # nested scope: analyzed via its own entry-held
+            if isinstance(node, ast.With):
+                # yield the With itself under the OUTER held set — the
+                # order-edge builder reads `inner` here to record direct
+                # `with A: with B:` nesting
+                yield node, frozenset(held), (held[-1] if held else None)
+                new = list(held)
+                for item in node.items:
+                    lid = self.resolve_lock(fi.module, item.context_expr, fi)
+                    if lid is not None:
+                        new.append(lid)
+                for child in node.body:
+                    stack.append((child, tuple(new)))
+                for item in node.items:
+                    stack.append((item.context_expr, held))
+                continue
+            yield node, frozenset(held), (held[-1] if held else None)
+            for child in ast.iter_child_nodes(node):
+                stack.append((child, held))
+
+    def _own_with_locks(self, fi: FuncInfo) -> Set[LockId]:
+        out: Set[LockId] = set()
+        for node in _own_nodes(fi.node):
+            if isinstance(node, ast.With):
+                for item in node.items:
+                    lid = self.resolve_lock(fi.module, item.context_expr, fi)
+                    if lid is not None:
+                        out.add(lid)
+        return out
+
+    def _compute_entry_held(self) -> None:
+        """entry_held(f) = intersection over call sites of the locks held
+        there. Only true entry points — spawn/signal/executor targets and
+        functions with NO in-program callers — are seeded with the empty
+        set; a public method whose every call site holds the engine lock
+        is (for gating purposes) guarded by it, which is exactly the
+        layered engine->scheduler->allocator design this tree uses."""
+        called: Set[FuncInfo] = set()
+        for edges in self.call_edges.values():
+            called.update(edges)
+        for fi in self.functions:
+            if fi.spawn_only or fi not in called:
+                fi.entry_held = frozenset()
+        for _ in range(len(self.functions)):
+            changed = False
+            for caller in self.functions:
+                entry = caller.entry_held
+                if entry is None:
+                    continue
+                for node, held, _inner in self.held_regions(caller):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    for callee in self.resolve_call(caller.module, node):
+                        new = frozenset(held)
+                        cur = callee.entry_held
+                        nxt = new if cur is None else (cur & new)
+                        if nxt != cur:
+                            callee.entry_held = nxt
+                            changed = True
+            if not changed:
+                break
+        for fi in self.functions:
+            if fi.entry_held is None:
+                fi.entry_held = frozenset()
+
+    def _compute_acquires(self) -> None:
+        self.acquires: Dict[FuncInfo, Set[LockId]] = {
+            fi: self._own_with_locks(fi) for fi in self.functions}
+        changed = True
+        while changed:
+            changed = False
+            for fi, edges in self.call_edges.items():
+                for callee in edges:
+                    extra = self.acquires[callee] - self.acquires[fi]
+                    if extra:
+                        self.acquires[fi] |= extra
+                        changed = True
+
+    def _build_order_edges(self) -> None:
+        for fi in self.functions:
+            for node, held, inner in self.held_regions(fi):
+                if inner is None:
+                    continue
+                if isinstance(node, ast.With):
+                    continue
+                if isinstance(node, ast.Call):
+                    for callee in self.resolve_call(fi.module, node):
+                        for lid in self.acquires[callee]:
+                            if lid not in held:
+                                self.order_edges.setdefault(
+                                    (inner, lid),
+                                    (fi.module.path, node.lineno,
+                                     callee.qualname))
+                            elif lid == inner:
+                                # re-acquisition of the held lock via a call
+                                self.order_edges.setdefault(
+                                    (inner, inner),
+                                    (fi.module.path, node.lineno,
+                                     callee.qualname))
+            # direct nesting: with A: ... with B:
+            for node, held, inner in self.held_regions(fi):
+                if isinstance(node, ast.With) and inner is not None:
+                    for item in node.items:
+                        lid = self.resolve_lock(fi.module, item.context_expr,
+                                                fi)
+                        if lid is not None and lid != inner:
+                            self.order_edges.setdefault(
+                                (inner, lid),
+                                (fi.module.path, node.lineno, "with"))
+
+    def lock_cycles(self) -> List[List[Tuple[LockId, LockId]]]:
+        """Elementary cycles in the lock-order graph. Self-edges count only
+        for non-reentrant kinds. Deduplicated by node set."""
+        graph: Dict[LockId, Set[LockId]] = {}
+        for (a, b) in self.order_edges:
+            if a == b:
+                continue
+            graph.setdefault(a, set()).add(b)
+        cycles: List[List[Tuple[LockId, LockId]]] = []
+        seen: Set[FrozenSet[LockId]] = set()
+        for (a, b) in sorted(self.order_edges,
+                             key=lambda e: (e[0].key, e[1].key)):
+            if a == b:
+                if self.lock_kind(a) in _NONREENTRANT or \
+                        self.lock_kind(a) == "?":
+                    if frozenset((a,)) not in seen:
+                        seen.add(frozenset((a,)))
+                        cycles.append([(a, a)])
+                continue
+            path = self._find_path(graph, b, a)
+            if path is not None:
+                nodes = frozenset([a] + path)
+                if nodes not in seen:
+                    seen.add(nodes)
+                    edges = [(a, b)]
+                    cur = b
+                    for nxt in path[1:]:
+                        edges.append((cur, nxt))
+                        cur = nxt
+                    cycles.append(edges)
+        return cycles
+
+    @staticmethod
+    def _find_path(graph: Dict[LockId, Set[LockId]], src: LockId,
+                   dst: LockId) -> Optional[List[LockId]]:
+        stack = [(src, [src])]
+        visited = {src}
+        while stack:
+            node, path = stack.pop()
+            if node == dst:
+                return path
+            for nxt in sorted(graph.get(node, ()), key=lambda l: l.key):
+                if nxt not in visited:
+                    visited.add(nxt)
+                    stack.append((nxt, path + [nxt]))
+        return None
+
+    # -- census (the report CLI + metrics read these) ----------------------
+    def root_census(self) -> Dict[str, int]:
+        """root label -> number of functions reachable on it."""
+        out: Dict[str, int] = {}
+        for fi in self.functions:
+            for r in fi.roots:
+                out[r] = out.get(r, 0) + 1
+        return out
+
+
+def _walk_defs(tree: ast.Module) -> Iterator[Tuple[FunctionNode,
+                                                   Optional[str]]]:
+    """(def node, enclosing class name) for every function/lambda."""
+    stack: List[Tuple[ast.AST, Optional[str]]] = [(tree, None)]
+    while stack:
+        node, cls = stack.pop()
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                stack.append((child, child.name))
+            elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                    ast.Lambda)):
+                yield child, cls
+                stack.append((child, cls))
+            else:
+                stack.append((child, cls))
+
+
+def _own_nodes(fn: ast.AST) -> Iterator[ast.AST]:
+    """Walk a function body without descending into nested defs/lambdas."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _ann_leaf(mod, ann: Optional[ast.AST]) -> Optional[str]:
+    """Class-name leaf of a type annotation (handles string annotations)."""
+    if ann is None:
+        return None
+    if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+        return ann.value.rpartition(".")[2].strip("'\" ")
+    return (mod.dotted(ann) or "").rpartition(".")[2] or None
+
+
+def _param_type(mod, fn: ast.AST, name: str) -> Optional[str]:
+    args = getattr(fn, "args", None)
+    if args is None:
+        return None
+    for a in list(args.args) + list(args.kwonlyargs):
+        if a.arg == name:
+            return _ann_leaf(mod, a.annotation)
+    return None
+
+
+def _thread_like_recv(mod, recv: ast.AST) -> bool:
+    """Receiver spelled like a Thread/lock handle (``self._thread``, a
+    local assigned from ``threading.Thread``)."""
+    text = mod.dotted(recv) or ""
+    leaf = text.rpartition(".")[2].lower()
+    if "thread" in leaf or "lock" in leaf or "_cond" in leaf or \
+            leaf in ("_t", "watchdog_t", "timer"):
+        return True
+    fn = mod.enclosing_function(recv)
+    if fn is None or "." in text:
+        return False
+    for n in ast.walk(fn):
+        if isinstance(n, ast.Assign) and isinstance(n.value, ast.Call) \
+                and (mod.dotted(n.value.func) or "") in _THREAD_CTORS:
+            for t in n.targets:
+                if isinstance(t, ast.Name) and t.id == text:
+                    return True
+    return False
+
+
+def _kwarg(call: ast.Call, name: str) -> Optional[ast.AST]:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
