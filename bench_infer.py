@@ -260,10 +260,20 @@ def _serve_load(srv, prompts, arrivals, n_new, deadline_s=None):
     return handles, wall, sheds
 
 
-def _configure_bench_obs():
-    from deepspeed_tpu.config.config import ObservabilityConfig
+def _configure_bench_obs(tune=False, ttft_slo_ms=0.0, tpot_slo_ms=0.0):
+    from deepspeed_tpu.config.config import ObservabilityConfig, TuneConfig
     from deepspeed_tpu.observability import configure_observability
 
+    tune_cfg = TuneConfig()
+    if tune:
+        # the tuned A/B arm: store + controller on, cadence short enough
+        # to act within a bench-scale trace
+        tune_cfg = TuneConfig(
+            enabled=True, controller=True,
+            interval_iterations=int(
+                os.environ.get("BENCH_SERVE_TUNE_INTERVAL", 8)),
+            hold_iterations=int(
+                os.environ.get("BENCH_SERVE_TUNE_HOLD", 16)))
     configure_observability(ObservabilityConfig(
         enabled=True,
         output_dir=os.environ.get("BENCH_OBS_DIR",
@@ -273,7 +283,12 @@ def _configure_bench_obs():
         request_tracing=os.environ.get("BENCH_TRACE", "1") == "1",
         # per-iteration serving wall-time buckets; the arm records carry
         # the bucket shares and the gauges land in the metrics JSONL
-        serve_goodput=True))
+        serve_goodput=True,
+        # nonzero only for the autotune A/B: burn rates are its outcome
+        # metric AND the live tuner's input signal
+        serve_ttft_slo_ms=ttft_slo_ms,
+        serve_tpot_slo_ms=tpot_slo_ms,
+        tune=tune_cfg))
 
 
 def _arm_observability_stats(stats, tag, accts):
@@ -421,6 +436,87 @@ def _serve_one_mode(engine, scfg_kwargs, paged_kernel, prompts, arrivals,
             [("0", srv._serve_acct)])
     srv.close()
     return stats
+
+
+def _serve_autotune_arm(engine, scfg_kwargs, paged_kernel, prompts,
+                        arrivals, n_new, block, fleet_n, tuned,
+                        ttft_slo_ms=50.0, tpot_slo_ms=3.0,
+                        deadline_s=None):
+    """One closed-loop A/B arm: the SAME engine config and Poisson trace
+    (with its mid-trace load shift) either static (``tuned=False``) or
+    with the live tuner walking knobs against measured burn. Both arms
+    own an observability session (burn is the measured outcome); only the
+    tuned arm's session carries the time-series store + controller, and it
+    runs LAST so the exported metrics JSONL describes the tuned fleet.
+    Returns ``(stats, token_streams)`` — the streams feed the bit-exactness
+    check (data-only knobs must not change a single sampled token)."""
+    from deepspeed_tpu.serving import ServingConfig, ServingEngine
+
+    scfg = ServingConfig(paged_kernel=paged_kernel, **scfg_kwargs)
+    if fleet_n:
+        from deepspeed_tpu.config.config import FleetConfig
+        from deepspeed_tpu.serving.fleet import FleetRouter, build_replicas
+
+        replicas = build_replicas(engine, scfg, fleet_n)
+        srv = FleetRouter(replicas, FleetConfig(policy="kv_occupancy"))
+        engines = [r.engine for r in replicas]
+    else:
+        srv = ServingEngine(engine, scfg)
+        engines = [srv]
+    # warmup: compile off the clock, BEFORE the observability session —
+    # the tuner must never see (or cause) a compile
+    srv.submit(prompts[0][: max(block, 8)], max_new_tokens=2).result()
+    _configure_bench_obs(tune=tuned, ttft_slo_ms=ttft_slo_ms,
+                         tpot_slo_ms=tpot_slo_ms)
+    srv.reset_latency_stats()
+
+    handles, wall, sheds = _serve_load(srv, prompts, arrivals, n_new,
+                                       deadline_s=deadline_s)
+    stats = _load_stats(handles, wall)
+    streams = [list(map(int, h.tokens)) for h in handles]
+    # measured outcome: worst-replica burn + mean goodput fraction from
+    # the serve_goodput accountants (the same signals the tuner read)
+    accts = [e._serve_acct for e in engines if e._serve_acct is not None]
+    totals = [a.totals() for a in accts]
+    if totals:
+        # burn keys are absent until a request finished in the window
+        stats["slo_burn"] = {
+            "ttft": round(max(t.get("ttft_slo_burn_rate", 0.0)
+                              for t in totals), 4),
+            "tpot": round(max(t.get("tpot_slo_burn_rate", 0.0)
+                              for t in totals), 4),
+            "goodput_fraction": round(
+                sum(t["goodput_fraction"] for t in totals) / len(totals),
+                4),
+        }
+    if sheds:
+        stats["admission_sheds"] = sheds
+    tuner = srv._tuner
+    if tuned and tuner is not None:
+        rep = tuner.report()
+        stats["autotune"] = {
+            "moves": rep["moves"],
+            "rollbacks": rep["rollbacks"],
+            "knobs_final": rep["knobs"],
+            "objective": {"initial": rep["objective_initial"],
+                          "last": rep["objective_last"]},
+            # the knob trajectory, decision by decision
+            "trajectory": [
+                {"iteration": d["iteration"], "kind": d["kind"],
+                 "knob": d["knob"], "action": d["action"],
+                 "reason": d["reason"], "from": d["from"], "to": d["to"]}
+                for d in rep["decisions"]],
+        }
+        from deepspeed_tpu.observability import get_session
+
+        obs = get_session()
+        if obs.enabled:
+            stats["autotune"]["recommendations_file"] = (
+                tuner.export_recommendations(os.path.join(
+                    obs.output_dir,
+                    obs.config.tune.recommendations_file)))
+    srv.close()
+    return stats, streams
 
 
 def _serve_fleet_arm(engine, scfg_kwargs, paged_kernel, n, policy, disagg,
@@ -641,6 +737,74 @@ def serving_main() -> None:
         raise
 
     obs_wanted = os.environ.get("BENCH_OBS", "1") == "1"
+    autotune_flag = os.environ.get("BENCH_SERVE_AUTOTUNE", "off")
+    if autotune_flag == "on":
+        # closed-loop A/B: static arm vs live-tuner arm over the SAME
+        # trace, re-timed with a mid-trace load shift (arrival rate
+        # triples halfway) so the tuner has a regime change to react to
+        if spec_flag != "off" or chaos_plan is not None:
+            raise SystemExit("--autotune is its own A/B — run --spec / "
+                             "--chaos in separate invocations")
+        shift_rng = np.random.RandomState(7)
+        n_half = n_requests // 2
+        gaps = np.concatenate([
+            shift_rng.exponential(1.0 / rate, size=n_half),
+            shift_rng.exponential(1.0 / (3.0 * rate),
+                                  size=n_requests - n_half)])
+        shift_arrivals = np.cumsum(gaps)
+        primary_mode = modes[-1]
+        metric = (f"{model_name}_{dtype_name}_autotune"
+                  f"{f'_fleet{fleet_n}' if fleet_n else ''}"
+                  "_serving_p50_ttft_ms")
+        # both arms measure burn against the SAME SLOs (or the deltas
+        # mean nothing); defaults target a CPU-scale tiny-model trace
+        ttft_slo = float(os.environ.get("BENCH_SERVE_TTFT_SLO_MS", 50.0))
+        tpot_slo = float(os.environ.get("BENCH_SERVE_TPOT_SLO_MS", 3.0))
+        static, static_streams = _serve_autotune_arm(
+            engine, scfg_kwargs, primary_mode, prompts, shift_arrivals,
+            n_new, block, fleet_n, tuned=False, ttft_slo_ms=ttft_slo,
+            tpot_slo_ms=tpot_slo, deadline_s=deadline_s)
+        from deepspeed_tpu.observability import get_session
+
+        # close the static arm's session BEFORE the tuned arm's warmup:
+        # its compile must not trip the live session's recompile watchdog
+        if get_session().enabled:
+            get_session().close(export=False)
+        tuned, tuned_streams = _serve_autotune_arm(
+            engine, scfg_kwargs, primary_mode, prompts, shift_arrivals,
+            n_new, block, fleet_n, tuned=True, ttft_slo_ms=ttft_slo,
+            tpot_slo_ms=tpot_slo, deadline_s=deadline_s)
+        obs = get_session()
+        if obs.enabled:
+            obs.dump_metrics(path=os.environ.get("BENCH_METRICS_JSONL",
+                                                 "BENCH_metrics_serve"
+                                                 ".jsonl"),
+                             metric=metric)
+            obs.close(export=False)
+        sb, tb = static.get("slo_burn", {}), tuned.get("slo_burn", {})
+        record = {
+            "metric": metric,
+            "value": tuned["p50_ttft_ms"],
+            "unit": "ms",
+            "vs_baseline": None,
+            "autotune_ab": {
+                "static": static,
+                "tuned": tuned,
+                # the headline: burn and goodput deltas (tuned - static;
+                # negative burn delta = the tuner bought SLO health)
+                "ttft_burn_delta": (round(tb["ttft"] - sb["ttft"], 4)
+                                    if sb and tb else None),
+                "tpot_burn_delta": (round(tb["tpot"] - sb["tpot"], 4)
+                                    if sb and tb else None),
+                "goodput_delta": (round(tb["goodput_fraction"]
+                                        - sb["goodput_fraction"], 4)
+                                  if sb and tb else None),
+                # data-only knobs: every sampled token identical
+                "streams_match": static_streams == tuned_streams,
+            },
+        }
+        print(json.dumps(record))
+        return
     if fleet_n:
         # fleet mode: single-engine baseline, then the routing-policy A/B
         # (round-robin vs occupancy-aware) over the SAME trace; the
@@ -825,12 +989,20 @@ if __name__ == "__main__":
             os.environ["BENCH_SERVE_DEADLINE"] = argv[i + 1]
         elif a.startswith("--deadline="):
             os.environ["BENCH_SERVE_DEADLINE"] = a.split("=", 1)[1]
+        # --autotune on runs the closed-loop A/B: live tuner vs static
+        # config over the same mid-trace-load-shift Poisson trace
+        elif a == "--autotune" and i + 1 < len(argv):
+            os.environ["BENCH_SERVE_AUTOTUNE"] = argv[i + 1]
+        elif a.startswith("--autotune="):
+            os.environ["BENCH_SERVE_AUTOTUNE"] = a.split("=", 1)[1]
     if os.environ.get("BENCH_SERVE_PAGED_KERNEL", "") not in ("", "on",
                                                               "off"):
         raise SystemExit("--paged-kernel must be 'on' or 'off'")
     if os.environ.get("BENCH_SERVE_SPEC", "off") not in ("off", "ngram",
                                                          "draft"):
         raise SystemExit("--spec must be 'off', 'ngram' or 'draft'")
+    if os.environ.get("BENCH_SERVE_AUTOTUNE", "off") not in ("off", "on"):
+        raise SystemExit("--autotune must be 'on' or 'off'")
     if os.environ.get("BENCH_PREDICT") == "1":
         predict_main()
     elif os.environ.get("BENCH_CHILD") == "1":

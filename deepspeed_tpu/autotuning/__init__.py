@@ -7,3 +7,4 @@ metric."""
 from .autotuner import (Autotuner, ResourceManager, generate_experiments,
                         grid_space, random_space)  # noqa: F401
 from .cost_model import TpuCostModel  # noqa: F401
+from .livetuner import LiveTuner, maybe_make_tuner  # noqa: F401

@@ -118,6 +118,7 @@ class Autotuner:
         self.runner = runner or self._subprocess_runner
         self.results: Dict[str, Optional[float]] = {}
         self.cost_backend: Optional[str] = None   # set per tune() sweep
+        self.live_calibration: Optional[Dict[str, float]] = None
 
     def _subprocess_runner(self, name: str, config: Dict) -> Optional[float]:
         exp_dir = os.path.join(self.results_dir, name)
@@ -158,18 +159,51 @@ class Autotuner:
         except Exception:                           # noqa: BLE001
             return None
 
+    @staticmethod
+    def _extract_live_signals(live_signals: Any) -> Dict[str, float]:
+        """Scalars from the observability substrate — either a plain dict
+        (``{"mfu": 0.41}``) or a
+        :class:`~..observability.timeseries.TimeSeriesStore`, from which
+        the EWMA of the measured utilization series is taken (train
+        ``goodput/mfu``, serving ``serve_goodput/goodput_fraction``) —
+        smoothed evidence, not one noisy window."""
+        if live_signals is None:
+            return {}
+        if hasattr(live_signals, "stats_matching"):
+            out: Dict[str, float] = {}
+            for key, pattern in (("mfu", "goodput/mfu*"),
+                                 ("goodput_fraction",
+                                  "serve_goodput/goodput_fraction*"),
+                                 ("tokens_per_sec",
+                                  "serve_goodput/tokens_per_sec*")):
+                sts = live_signals.stats_matching(pattern)
+                vals = [s["ewma"] for s in sts.values() if s.get("n")]
+                if vals:
+                    out[key] = float(sum(vals) / len(vals))
+            return out
+        return {k: float(v) for k, v in dict(live_signals).items()
+                if v is not None}
+
     def tune(self, space: Optional[Dict[str, Sequence[Any]]] = None,
              tuner_type: str = "gridsearch", num_trials: int = 50,
              model_info: Optional[Dict[str, Any]] = None,
              max_parallel: int = 1,
              cost_vector: Any = None,
+             live_signals: Any = None,
              **model_kwargs) -> Tuple[Optional[str], Optional[float]]:
         """Run the sweep. ``model_based``: rank the grid with the analytic
         cost model, measure only the top ``num_trials`` feasible configs
         (reference ModelBasedTuner's surrogate-guided selection).
         ``cost_vector``: an explicit ``tools.tpucost.CostVector`` to
         calibrate the model on; by default one is discovered from the
-        in-process tpucost/tpuaudit registry (entry ``train/step``)."""
+        in-process tpucost/tpuaudit registry (entry ``train/step``).
+        ``live_signals``: measured-utilization scalars (a dict or a
+        :class:`TimeSeriesStore`) — the closed-loop path: the cost model's
+        assumed MFU is replaced with the MEASURED one, the same way
+        ``calibrate_from_vector`` replaces table flops with XLA-counted
+        ones, so the ranking reflects what this model on this machine
+        actually achieves."""
+        self.live_calibration = None
         if tuner_type == "model_based":
             if model_info is None:
                 model_info = (self.base_config.get("autotuning", {})
@@ -193,6 +227,16 @@ class Autotuner:
                     "static-tables (no tpucost vector available — register "
                     "the engine's audit entries to calibrate on the real "
                     "program)")
+            live = self._extract_live_signals(live_signals)
+            measured = live.get("mfu", live.get("goodput_fraction"))
+            if measured is not None and measured > 0:
+                model.mfu = min(max(float(measured), 0.01), 1.0)
+                model.backend += "+live"
+                self.live_calibration = dict(live, applied_mfu=model.mfu)
+                logger.info(
+                    f"autotuning(model_based): MFU recalibrated from live "
+                    f"signals ({model.mfu:.3f} measured vs the static "
+                    "assumption)")
             self.cost_backend = model.backend
             all_exps = generate_experiments(self.base_config, space,
                                             "gridsearch", num_trials)
@@ -226,7 +270,9 @@ class Autotuner:
             json.dump({"best": best_name, "metric": self.metric,
                        "results": self.results,
                        "predictions": self.predictions,
-                       "cost_backend": self.cost_backend}, fh, indent=1)
+                       "cost_backend": self.cost_backend,
+                       "live_calibration": self.live_calibration},
+                      fh, indent=1)
         return best_name, best_val
 
 

@@ -1,0 +1,641 @@
+"""Live-signal serving autotuner — telemetry that closes the loop.
+
+The static :class:`~.autotuner.Autotuner` walks a cost model BETWEEN runs;
+this module tunes the RUNNING system: an online controller on router/engine
+cadence that reads measured SLO burn rates and goodput bucket shares from
+the metric time-series store (:mod:`deepspeed_tpu.observability.timeseries`)
+and walks serving knobs against them.
+
+**Jit-cache discipline is the contract.** Every knob the controller touches
+is DATA-ONLY — it changes scheduling or host-side policy, never a compiled
+program's shape, so a tuned fleet runs zero extra compiles and its token
+streams stay bit-identical to the untuned oracle (sampling draws depend
+only on (engine seed, request seed, token index), never on how the
+scheduler batched or routed the work):
+
+* ``spec``          — suspend/resume speculative decoding (the same
+  bit-exact flip the degraded ladder's rung 1 uses; the tuner COMPOSES
+  with the ladder — an engine speculates only when neither objects);
+* ``chunk_budget``  — prefill chunks per scheduler iteration: extra chunks
+  pull TTFT forward under prefill backlog at some TPOT cost (the dispatch
+  itself is the same compiled program either way);
+* ``role_ratio``    — disaggregated fleets only: promote a decode replica
+  to ``mixed`` (it serves whole requests locally — more prefill capacity,
+  no handoff rewiring) and demote it back;
+* ``deadline_pad``  — admission-control estimate pad: shed
+  deadline-infeasible work earlier (protecting the burn rate of admitted
+  requests) or relax back;
+* ``overload_threshold`` — the degraded ladder's occupancy trip point,
+  read live by the router each iteration.
+
+Shape knobs (speculative K, block/pool size, prefill chunk width, mesh) are
+explicitly OUT of the online loop: changing one means a recompile, so the
+tuner only ever emits them as a between-session **recommendations
+artifact** (``tune_recommendations.json``) with the measured evidence
+attached; ``Autotuner.tune(live_signals=...)`` recalibrates its static
+tables from the same signals.
+
+The controller is a guarded one-knob-at-a-time hill-climb: pick the knob
+the dominant pressure names, move it one notch, HOLD for
+``hold_iterations`` while the store accumulates after-evidence, then judge
+— a move whose objective (goodput fraction under the SLO-burn constraint)
+regressed beyond ``hysteresis`` is rolled back and that (knob, direction)
+cools down. Every decision is a ``tune/*`` metric event with before/after
+evidence in the store and the flight-recorder ring.
+
+All host-side: a decision tick is dict reads and attribute writes — never
+a device dispatch. Gated by ``ObservabilityConfig.tune.controller``; the
+disabled path constructs nothing.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import threading
+from typing import Any, Dict, List, Optional
+
+from ..utils.logging import logger
+
+__all__ = ["LiveTuner", "maybe_make_tuner", "RECOMMENDATIONS_FORMAT"]
+
+RECOMMENDATIONS_FORMAT = 1
+
+# metric names scalarized into the store at each decision tick (publish is
+# name-filtered, so an idle fleet's tick costs a handful of dict walks)
+SAMPLE_METRICS = (
+    "serve_goodput/goodput_fraction", "serve_goodput/ttft_slo_burn_rate",
+    "serve_goodput/tpot_slo_burn_rate", "serve_goodput/tokens_per_sec",
+    "serve_goodput/seconds", "serve_goodput/wall_seconds",
+    "serving/queue_depth", "serving/arena_occupancy",
+    "fleet_serving/queue_depth", "fleet_serving/arena_occupancy",
+    "fleet_serving/degraded_mode", "fleet_serving/replicas_alive",
+    "tune/objective", "tune/knob_value", "tune/decisions", "tune/rollbacks",
+    "timeseries/series", "timeseries/points_total",
+)
+
+
+def maybe_make_tuner(target: Any, obs: Any = None) -> Optional["LiveTuner"]:
+    """A controller for ``target`` (ServingEngine or FleetRouter) when the
+    current observability session carries the ``tune.controller`` gate —
+    None otherwise (the call sites re-check lazily, like the serve-goodput
+    accountant, because benches enable observability after warmup)."""
+    if obs is None:
+        from ..observability import get_session
+
+        obs = get_session()
+    if not obs.enabled:
+        return None
+    tc = getattr(obs.config, "tune", None)
+    if tc is None or not (getattr(tc, "enabled", False)
+                          and getattr(tc, "controller", False)):
+        return None
+    if obs.timeseries is None:
+        return None
+    return LiveTuner(target, store=obs.timeseries, config=tc,
+                     registry=obs.registry, session=obs)
+
+
+# ---------------------------------------------------------------------------
+# knobs — each one data-only; "up" favors TTFT/overload protection, "down"
+# favors TPOT/throughput and relaxes toward the untuned default
+# ---------------------------------------------------------------------------
+
+
+class _Knob:
+    name = "knob"
+
+    def available(self, tu: "LiveTuner") -> bool:
+        return True
+
+    def value(self, tu: "LiveTuner") -> float:
+        raise NotImplementedError
+
+    def default(self, tu: "LiveTuner") -> float:
+        return 0.0
+
+    def candidate(self, tu: "LiveTuner", action: str) -> Optional[float]:
+        """The next value one notch in ``action`` ('up'|'down'), or None at
+        a bound."""
+        raise NotImplementedError
+
+    def apply(self, tu: "LiveTuner", value: float) -> None:
+        raise NotImplementedError
+
+
+class _SpecKnob(_Knob):
+    """1.0 = tuner wants speculation suspended. Composes with the degraded
+    ladder through :meth:`LiveTuner._reapply` — the engine flag is the OR
+    of both owners, so neither steals the other's suspension."""
+
+    name = "spec"
+
+    def available(self, tu):
+        return any(e._drafter is not None for e in tu._alive_engines())
+
+    def value(self, tu):
+        return 1.0 if tu._spec_suspended else 0.0
+
+    def candidate(self, tu, action):
+        if action == "up":
+            return None if tu._spec_suspended else 1.0
+        return 0.0 if tu._spec_suspended else None
+
+    def apply(self, tu, value):
+        tu._spec_suspended = bool(value)
+        tu._reapply()
+
+
+class _ChunkBudgetKnob(_Knob):
+    """Prefill chunks per scheduler iteration (``ServingEngine.
+    prefill_chunks_per_iter``) — scheduling-only; the per-chunk dispatch is
+    the same compiled program at every setting."""
+
+    name = "chunk_budget"
+    MAX = 4
+
+    def value(self, tu):
+        return float(tu._chunk_budget)
+
+    def default(self, tu):
+        return 1.0
+
+    def candidate(self, tu, action):
+        if action == "up":
+            return tu._chunk_budget + 1 if tu._chunk_budget < self.MAX \
+                else None
+        return tu._chunk_budget - 1 if tu._chunk_budget > 1 else None
+
+    def apply(self, tu, value):
+        tu._chunk_budget = max(1, int(value))
+        tu._reapply()
+
+
+class _RoleRatioKnob(_Knob):
+    """Disagg fleets: tuner-promoted decode→mixed replicas (count)."""
+
+    name = "role_ratio"
+
+    def available(self, tu):
+        r = tu._router
+        return r is not None and r.disagg
+
+    def value(self, tu):
+        return float(len(tu._promoted))
+
+    def candidate(self, tu, action):
+        from ..serving.fleet.replica import ROLE_DECODE
+
+        r = tu._router
+        if action == "up":
+            cands = [x for x in r.replicas
+                     if x.alive and not x.retired and x.role == ROLE_DECODE]
+            # always leave one PURE decode replica: handoffs need a
+            # destination that is not also prefilling
+            return len(tu._promoted) + 1 if len(cands) >= 2 else None
+        return len(tu._promoted) - 1 if tu._promoted else None
+
+    def apply(self, tu, value):
+        from ..serving.fleet.replica import ROLE_DECODE, ROLE_MIXED
+
+        r = tu._router
+        want = max(0, int(value))
+        try:
+            while len(tu._promoted) > want:
+                idx = tu._promoted.pop()
+                r.set_replica_role(idx, ROLE_DECODE)
+            while len(tu._promoted) < want:
+                cands = [x for x in r.replicas
+                         if x.alive and not x.retired
+                         and x.role == ROLE_DECODE
+                         and x.index not in tu._promoted]
+                if len(cands) < 2:
+                    break
+                idx = cands[0].index
+                r.set_replica_role(idx, ROLE_MIXED)
+                tu._promoted.append(idx)
+        except ValueError:
+            # pool-invariant refusal (fleet shrank under us) — keep state
+            # consistent with reality
+            pass
+
+
+class _DeadlinePadKnob(_Knob):
+    """Admission-control estimate pad (``FleetRouter.admission_pad``):
+    pad > 0 inflates the completion estimate, shedding infeasible work
+    earlier — the admitted population's burn rate improves at the cost of
+    more sheds."""
+
+    name = "deadline_pad"
+    STEP, MAX = 0.25, 1.0
+
+    def available(self, tu):
+        return tu._router is not None
+
+    def value(self, tu):
+        return float(tu._router.admission_pad)
+
+    def candidate(self, tu, action):
+        v = tu._router.admission_pad
+        if action == "up":
+            return round(v + self.STEP, 4) if v < self.MAX - 1e-9 else None
+        return round(max(v - self.STEP, 0.0), 4) if v > 1e-9 else None
+
+    def apply(self, tu, value):
+        tu._router.admission_pad = float(value)
+
+
+class _OverloadThresholdKnob(_Knob):
+    """The degraded ladder's occupancy trip point
+    (``FleetConfig.overload_occupancy``, read live each router iteration):
+    walking it DOWN degrades earlier under sustained burn."""
+
+    name = "overload_threshold"
+    STEP, MIN = 0.08, 0.5
+
+    def available(self, tu):
+        return tu._router is not None
+
+    def value(self, tu):
+        return float(tu._router.config.overload_occupancy)
+
+    def default(self, tu):
+        return tu._overload_default
+
+    def candidate(self, tu, action):
+        v = tu._router.config.overload_occupancy
+        if action == "up":        # protective: degrade earlier
+            nv = round(v - self.STEP, 4)
+            return nv if nv >= self.MIN else None
+        nv = round(v + self.STEP, 4)
+        return nv if nv <= tu._overload_default + 1e-9 else None
+
+    def apply(self, tu, value):
+        tu._router.config.overload_occupancy = float(value)
+
+
+_KNOBS = {k.name: k for k in (_SpecKnob(), _ChunkBudgetKnob(),
+                              _RoleRatioKnob(), _DeadlinePadKnob(),
+                              _OverloadThresholdKnob())}
+
+# proposal preference per pressure regime (knob, action) — first available,
+# in-bounds, not-cooling candidate wins; one knob moves at a time
+_TTFT_ORDER = (("chunk_budget", "up"), ("spec", "up"), ("role_ratio", "up"),
+               ("overload_threshold", "up"), ("deadline_pad", "up"))
+_TPOT_ORDER = (("chunk_budget", "down"), ("spec", "down"),
+               ("role_ratio", "down"), ("deadline_pad", "up"))
+_RELAX_ORDER = (("deadline_pad", "down"), ("overload_threshold", "down"),
+                ("spec", "down"), ("chunk_budget", "down"),
+                ("role_ratio", "down"))
+
+
+class LiveTuner:
+    """Online serving controller (see module docstring). One per
+    ``FleetRouter`` (or standalone ``ServingEngine``), created lazily at
+    step cadence by :func:`maybe_make_tuner`; ``on_iteration`` is the only
+    hot-path entry and returns immediately off-cadence."""
+
+    def __init__(self, target: Any, store: Any, config: Any, registry: Any,
+                 session: Any = None):
+        self.target = target
+        self.store = store
+        self.config = config
+        self.registry = registry
+        self.session = session
+        self._router = target if hasattr(target, "replicas") else None
+        self._lock = threading.RLock()
+        # -- knob state the tuner owns --
+        self._spec_suspended = False
+        self._chunk_budget = 1
+        self._promoted: List[int] = []     # tuner-promoted replica indices
+        self._overload_default = (
+            float(self._router.config.overload_occupancy)
+            if self._router is not None else 0.0)
+        # -- controller state --
+        self._next_tick = int(config.interval_iterations)
+        self._pending: Optional[Dict[str, Any]] = None
+        self._cooldown: Dict[tuple, int] = {}   # (knob, action) -> until it
+        self._moves = 0
+        self._rollbacks = 0
+        self.decisions: "collections.deque" = collections.deque(maxlen=512)
+        self._initial_objective: Optional[float] = None
+        self._last_objective: Optional[float] = None
+        self._last_iteration = 0
+        knobs = list(getattr(config, "knobs", ())) or list(_KNOBS)
+        self._knobs = {n: _KNOBS[n] for n in knobs if n in _KNOBS}
+
+    # -- target plumbing ---------------------------------------------------
+    def _alive_engines(self) -> List[Any]:
+        if self._router is not None:
+            return [r.engine for r in self._router.replicas if r.alive]
+        return [self.target]
+
+    def _reapply(self) -> None:
+        """Push tuner-owned engine knobs onto every ALIVE engine — covers
+        revived incarnations (fresh engines default to untuned) and
+        composes the spec flag with the degraded ladder (OR of both
+        owners: the ladder's ``_set_degraded`` writes the same attribute
+        fleet-wide)."""
+        ladder = False
+        if self._router is not None:
+            from ..serving.fleet.router import DEGRADED_NO_SPEC
+
+            ladder = self._router._degraded >= DEGRADED_NO_SPEC
+        for eng in self._alive_engines():
+            eng.spec_suspended = self._spec_suspended or ladder
+            eng.prefill_chunks_per_iter = self._chunk_budget
+
+    def _sample(self, iteration: int) -> None:
+        """Refresh the gauges the objective reads (accountant publish is
+        host-side) and scalarize them into the store through the
+        registry's publish hook — one ingest path for everything."""
+        for eng in self._alive_engines():
+            acct = getattr(eng, "_serve_acct", None)
+            if acct is not None:
+                acct.publish()
+        self.registry.publish(iteration, names=SAMPLE_METRICS)
+
+    # -- signals / objective ----------------------------------------------
+    def _agg(self, pattern: str, how: str = "mean", stat: str = "ewma",
+             window: int = 8) -> Optional[float]:
+        sts = self.store.stats_matching(pattern, window=window)
+        vals = [s[stat] for s in sts.values() if s.get("n")]
+        if not vals:
+            return None
+        if how == "max":
+            return max(vals)
+        if how == "sum":
+            return float(sum(vals))
+        return float(sum(vals) / len(vals))
+
+    def read_signals(self, window: int = 8) -> Dict[str, float]:
+        """The controller's inputs, from the store's rolling windows (the
+        worst replica's burn is the fleet's burn)."""
+        return {
+            "ttft_burn": self._agg("serve_goodput/ttft_slo_burn_rate*",
+                                   "max", window=window) or 0.0,
+            "tpot_burn": self._agg("serve_goodput/tpot_slo_burn_rate*",
+                                   "max", window=window) or 0.0,
+            "goodput": self._agg("serve_goodput/goodput_fraction*",
+                                 window=window) or 0.0,
+            "occupancy": self._agg("*arena_occupancy*", "max",
+                                   window=window) or 0.0,
+            "queue_depth": self._agg("*queue_depth*", "sum", stat="last",
+                                     window=window) or 0.0,
+        }
+
+    def objective(self, signals: Dict[str, float]) -> float:
+        """Goodput fraction under the SLO-burn constraint: burn over the
+        ceiling is a weighted penalty, so the climb never trades SLO
+        health for device utilization."""
+        ceil = self.config.burn_ceiling
+        w = self.config.burn_weight
+        over = (max(0.0, signals["ttft_burn"] - ceil)
+                + max(0.0, signals["tpot_burn"] - ceil))
+        return signals["goodput"] - w * over
+
+    # -- the decision tick -------------------------------------------------
+    def on_iteration(self, iteration: Optional[int] = None) -> None:
+        """Router/engine cadence hook — returns immediately off-cadence
+        (one compare). Host-only; never dispatches."""
+        with self._lock:
+            it = (iteration if iteration is not None
+                  else self._last_iteration + 1)
+            self._last_iteration = it
+            if it < self._next_tick:
+                return
+            self._next_tick = it + int(self.config.interval_iterations)
+            self._sample(it)
+            signals = self.read_signals()
+            obj = self.objective(signals)
+            self._last_objective = obj
+            if self._initial_objective is None:
+                self._initial_objective = obj
+            self.registry.gauge(
+                "tune/objective",
+                help="goodput fraction minus weighted SLO-burn overshoot "
+                     "(the live tuner's climb target)").set(obj)
+            if self._pending is not None:
+                if it >= self._pending["judge_at"]:
+                    self._judge(it, signals, obj)
+                self._reapply()
+                return
+            self._propose(it, signals, obj)
+            self._reapply()
+
+    def _cooling(self, knob: str, action: str, it: int) -> bool:
+        return self._cooldown.get((knob, action), 0) > it
+
+    def _propose(self, it: int, signals: Dict[str, float],
+                 obj: float) -> None:
+        ceil = self.config.burn_ceiling
+        if self.config.max_moves and self._moves >= self.config.max_moves:
+            return
+        ttft_over = signals["ttft_burn"] - ceil
+        tpot_over = signals["tpot_burn"] - ceil
+        if ttft_over > 0 and ttft_over >= tpot_over:
+            order, reason = _TTFT_ORDER, "ttft_burn"
+        elif tpot_over > 0:
+            order, reason = _TPOT_ORDER, "tpot_burn"
+        elif (max(signals["ttft_burn"], signals["tpot_burn"])
+                < 0.8 * ceil):
+            order, reason = _RELAX_ORDER, "relax"
+        else:
+            return      # inside the hysteresis band around the ceiling
+        for name, action in order:
+            knob = self._knobs.get(name)
+            if knob is None or not knob.available(self) \
+                    or self._cooling(name, action, it):
+                continue
+            cur = knob.value(self)
+            if reason == "relax" and cur == knob.default(self):
+                continue
+            new = knob.candidate(self, action)
+            if new is None or new == cur:
+                continue
+            knob.apply(self, new)
+            self._moves += 1
+            self._pending = {
+                "knob": name, "action": action, "reason": reason,
+                "from": cur, "to": new, "iteration": it,
+                "judge_at": it + int(self.config.hold_iterations),
+                "objective_before": obj, "signals_before": dict(signals),
+            }
+            self._note_decision("move", self._pending)
+            return
+
+    def _judge(self, it: int, signals: Dict[str, float],
+               obj: float) -> None:
+        p = self._pending
+        self._pending = None
+        before = p["objective_before"]
+        # relative hysteresis: deltas inside the band are noise, and a
+        # kept move needs the evidence, not the benefit of the doubt
+        band = self.config.hysteresis * max(abs(before), 1e-3)
+        delta = obj - before
+        self.registry.gauge(
+            "tune/objective_delta",
+            help="objective after the hold window minus before the "
+                 "move").set(delta)
+        p.update(objective_after=obj, objective_delta=delta,
+                 signals_after=dict(signals), judged_at=it)
+        if delta < -band:
+            knob = self._knobs[p["knob"]]
+            knob.apply(self, p["from"])
+            self._rollbacks += 1
+            self._cooldown[(p["knob"], p["action"])] = (
+                it + 4 * int(self.config.hold_iterations))
+            self.registry.counter(
+                "tune/rollbacks",
+                help="knob moves reverted after the hold window's "
+                     "objective regressed").inc(knob=p["knob"])
+            p["outcome"] = "rolled_back"
+            self._note_decision("rollback", p)
+        else:
+            p["outcome"] = "kept"
+            self._note_decision("keep", p)
+
+    def _note_decision(self, kind: str, p: Dict[str, Any]) -> None:
+        self.decisions.append(dict(p, kind=kind))
+        knob = self._knobs[p["knob"]]
+        value = knob.value(self)
+        reg = self.registry
+        reg.counter(
+            "tune/decisions",
+            help="live-tuner knob decisions by knob/action/reason").inc(
+                knob=p["knob"], action=p["action"], reason=p["reason"])
+        reg.gauge(
+            "tune/knob_value",
+            help="current live-tuner knob settings (numeric "
+                 "encoding)").set(value, knob=p["knob"])
+        if self.session is not None:
+            # before/after evidence rides the flight-recorder ring too —
+            # a crash bundle names what the tuner last did
+            self.session.flight_event(
+                "tune_decision", decision=kind, knob=p["knob"],
+                action=p["action"], reason=p["reason"],
+                value_from=p["from"], value_to=p["to"],
+                objective_before=round(p["objective_before"], 6),
+                objective_after=round(p.get("objective_after", 0.0), 6)
+                if "objective_after" in p else None)
+        logger.info(
+            f"live tuner: {kind} {p['knob']} {p['action']} "
+            f"({p['from']} -> {p['to']}, reason={p['reason']})")
+
+    # -- between-session output -------------------------------------------
+    def recommendations(self) -> List[Dict[str, Any]]:
+        """Shape-knob advice (speculative K, block pool, prefill chunk
+        width) from measured evidence — NEVER applied online; changing any
+        of these recompiles, so they ship as an artifact for the next
+        engine construction."""
+        recs: List[Dict[str, Any]] = []
+        engines = [e for e in self._alive_engines()
+                   if hasattr(e, "config")]
+        # speculative K vs measured acceptance
+        for eng in engines:
+            if getattr(eng, "_drafter", None) is None:
+                continue
+            k = int(eng.config.speculative.num_draft_tokens)
+            proposed = max(eng._spec_proposed, 0)
+            if proposed < 64:          # not enough evidence
+                break
+            accept = eng._spec_accepted / proposed
+            if accept < 0.4 and k > 1:
+                recs.append({
+                    "knob": "speculative.num_draft_tokens", "kind": "shape",
+                    "current": k, "recommended": k - 1,
+                    "reason": "low draft acceptance — verify width is "
+                              "wasted work",
+                    "evidence": {"acceptance_rate": round(accept, 4),
+                                 "proposed": proposed}})
+            elif accept > 0.9:
+                recs.append({
+                    "knob": "speculative.num_draft_tokens", "kind": "shape",
+                    "current": k, "recommended": k + 1,
+                    "reason": "near-unity draft acceptance — a wider "
+                              "verify would emit more per dispatch",
+                    "evidence": {"acceptance_rate": round(accept, 4),
+                                 "proposed": proposed}})
+            break                      # fleet replicas share the config
+        # block pool vs measured occupancy
+        occ = self.store.stats_matching("*arena_occupancy*", window=64)
+        p99s = [s["p99"] for s in occ.values() if s.get("n")]
+        if p99s and engines:
+            p99 = max(p99s)
+            pool = engines[0].config.pool_blocks()
+            if p99 > 0.9:
+                recs.append({
+                    "knob": "serving.num_blocks", "kind": "shape",
+                    "current": pool, "recommended": int(pool * 1.25),
+                    "reason": "arena occupancy p99 near saturation — "
+                              "preemption pressure",
+                    "evidence": {"occupancy_p99": round(p99, 4)}})
+            elif p99 < 0.25:
+                recs.append({
+                    "knob": "serving.num_blocks", "kind": "shape",
+                    "current": pool,
+                    "recommended": max(int(pool * 0.75),
+                                       engines[0].blocks_per_seq),
+                    "reason": "arena occupancy p99 low — HBM is "
+                              "over-provisioned for this load",
+                    "evidence": {"occupancy_p99": round(p99, 4)}})
+        # prefill chunk width vs the settled online chunk budget
+        if self._chunk_budget > 1 and engines:
+            c = int(engines[0].config.prefill_chunk)
+            recs.append({
+                "knob": "serving.prefill_chunk", "kind": "shape",
+                "current": c,
+                "recommended": c * self._chunk_budget,
+                "reason": "the online loop settled on "
+                          f"{self._chunk_budget} chunks/iteration — one "
+                          "wider dispatch beats N narrow ones",
+                "evidence": {"chunks_per_iteration": self._chunk_budget}})
+        return recs
+
+    def report(self) -> Dict[str, Any]:
+        """Controller summary for benches and the recommendations file."""
+        with self._lock:
+            decs = list(self.decisions)
+            return {
+                "iterations": self._last_iteration,
+                "moves": self._moves,
+                "rollbacks": self._rollbacks,
+                "objective_initial": self._initial_objective,
+                "objective_last": self._last_objective,
+                "knobs": {name: k.value(self)
+                          for name, k in self._knobs.items()
+                          if k.available(self)},
+                "decisions": decs,
+            }
+
+    def export_recommendations(self, path: str) -> str:
+        rep = self.report()
+        out = {
+            "format": RECOMMENDATIONS_FORMAT,
+            "generated_at_iteration": rep["iterations"],
+            "moves": rep["moves"],
+            "rollbacks": rep["rollbacks"],
+            "objective": {"initial": rep["objective_initial"],
+                          "last": rep["objective_last"]},
+            "knobs": rep["knobs"],
+            "signals": self.read_signals(window=32),
+            "recommendations": self.recommendations(),
+        }
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+        with open(path, "w") as fh:
+            json.dump(out, fh, indent=1)
+        return path
+
+    def finalize(self) -> None:
+        """Close-time hook (router/engine ``close``): final tune gauges +
+        the recommendations artifact into the session's output dir. Never
+        raises — tuning output must not take a teardown down."""
+        try:
+            obs = self.session
+            if obs is not None and obs.enabled and obs.output_dir:
+                self.export_recommendations(os.path.join(
+                    obs.output_dir, self.config.recommendations_file))
+        except Exception:
+            logger.warning("live tuner finalize failed", exc_info=True)

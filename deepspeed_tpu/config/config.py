@@ -264,6 +264,72 @@ class MonitorConfig(ConfigModel):
 
 
 @dataclass
+class TuneConfig(ConfigModel):
+    """Closed-loop telemetry (``observability/timeseries.py`` +
+    ``autotuning/livetuner.py``): the metric time-series store and the
+    live-signal serving controller that walks DATA-ONLY knobs against
+    measured SLO burn. Off by default — the disabled path allocates no
+    store and wires no controller (zero extra dispatches, zero compiles,
+    watchdog-asserted in tests)."""
+
+    enabled: bool = False              # master gate: the time-series store
+    store_capacity: int = 512          # retained points per series ring
+    store_max_series: int = 4096       # series cap (overflow counted)
+    store_ewma_alpha: float = 0.2      # EWMA smoothing for derived stats
+    timeseries_file: str = "timeseries.jsonl"  # close-time ring export
+    # -- the online controller (needs enabled=True too) --
+    controller: bool = False           # walk serving knobs on router cadence
+    interval_iterations: int = 32      # decision cadence (router iterations)
+    hold_iterations: int = 64          # post-move hold before judging
+    hysteresis: float = 0.05           # |relative objective delta| ignored
+    burn_ceiling: float = 1.0          # SLO burn-rate constraint (SRE
+    #   convention: 1.0 = spending the error budget exactly on schedule)
+    burn_weight: float = 1.0           # objective penalty per unit of burn
+    #   over the ceiling
+    max_moves: int = 0                 # total knob moves allowed (0 = no cap)
+    knobs: List[str] = field(default_factory=lambda: [
+        "spec", "chunk_budget", "role_ratio", "deadline_pad",
+        "overload_threshold"])
+    recommendations_file: str = "tune_recommendations.json"  # shape-knob
+    #   (speculative K, block size, mesh) advice — between-session only,
+    #   NEVER walked online (jit-cache discipline)
+
+    KNOWN_KNOBS = ("spec", "chunk_budget", "role_ratio", "deadline_pad",
+                   "overload_threshold")
+
+    def validate(self) -> None:
+        if self.store_capacity < 2:
+            raise ConfigError("observability.tune.store_capacity must be "
+                              ">= 2 (a trend needs two points)")
+        if self.store_max_series < 1:
+            raise ConfigError(
+                "observability.tune.store_max_series must be >= 1")
+        if not 0.0 < self.store_ewma_alpha <= 1.0:
+            raise ConfigError(
+                "observability.tune.store_ewma_alpha must be in (0, 1]")
+        if self.interval_iterations < 1:
+            raise ConfigError(
+                "observability.tune.interval_iterations must be >= 1")
+        if self.hold_iterations < 1:
+            raise ConfigError(
+                "observability.tune.hold_iterations must be >= 1")
+        if self.hysteresis < 0:
+            raise ConfigError("observability.tune.hysteresis must be >= 0")
+        if self.burn_ceiling <= 0:
+            raise ConfigError("observability.tune.burn_ceiling must be > 0")
+        if self.burn_weight < 0:
+            raise ConfigError("observability.tune.burn_weight must be >= 0")
+        if self.max_moves < 0:
+            raise ConfigError("observability.tune.max_moves must be >= 0 "
+                              "(0 = uncapped)")
+        for k in self.knobs:
+            if k not in self.KNOWN_KNOBS:
+                raise ConfigError(
+                    f"observability.tune.knobs: unknown knob '{k}' "
+                    f"(known: {list(self.KNOWN_KNOBS)})")
+
+
+@dataclass
 class ObservabilityConfig(ConfigModel):
     """Gate for ``deepspeed_tpu.observability`` — span tracer, metrics
     registry file output, recompile watchdog, memory gauges. Off by default:
@@ -347,8 +413,18 @@ class ObservabilityConfig(ConfigModel):
     serve_tpot_slo_ms: float = 0.0
     serve_slo_budget: float = 0.01     # allowed breach fraction: burn rate
     #   = observed breach fraction / this (1.0 = spending on budget)
+    # closed-loop telemetry (observability/timeseries.py +
+    # autotuning/livetuner.py): metric time-series store + live-signal
+    # serving controller — docs/observability.md "Closed loop"
+    tune: TuneConfig = field(default_factory=TuneConfig)
 
     def validate(self) -> None:
+        if isinstance(self.tune, dict):
+            # direct-constructor convenience (same pattern as
+            # ServingConfig.speculative): from_dict coerces nested
+            # configs, the plain dataclass constructor does not
+            self.tune = TuneConfig.from_dict(self.tune)
+        self.tune.validate()
         if self.max_spans < 1:
             raise ConfigError("observability.max_spans must be >= 1")
         if self.memory_poll_steps < 1:

@@ -68,6 +68,7 @@ from .reqtrace import ReqTrace, RequestTracer
 from .servegoodput import ServeGoodput
 from .servegoodput import note_compile_current as _sg_note_compile
 from .spans import Span, SpanTracer, noop_tracer, write_chrome_trace
+from .timeseries import TimeSeriesStore
 
 __all__ = [
     "Observability", "configure_observability", "get_session", "reset_session",
@@ -81,6 +82,7 @@ __all__ = [
     "NumericsSentinel", "NumericsState", "NumericsTrip",
     "Fault", "FaultInjector",
     "ReqTrace", "RequestTracer", "ServeGoodput", "write_chrome_trace",
+    "TimeSeriesStore",
 ]
 
 
@@ -172,6 +174,30 @@ class Observability:
                 # request was doing (the in-flight trace tail)
                 self.recorder.context_providers["request_traces"] = \
                     self.reqtrace.inflight_summary
+        # metric time-series store (observability/timeseries.py): rolling
+        # per-series history over the registry's publish stream — the
+        # measurement half of the closed tune loop. Gated by
+        # ``config.tune.enabled``; the disabled path allocates nothing.
+        self.timeseries: Optional[TimeSeriesStore] = None
+        tune_cfg = getattr(config, "tune", None)
+        if isinstance(tune_cfg, dict):
+            # direct-constructor convenience: a dict reaches here only when
+            # nobody called config.validate() (which coerces); a silently
+            # ignored tune gate would be a store that never materializes
+            from ..config.config import TuneConfig
+
+            tune_cfg = config.tune = TuneConfig.from_dict(tune_cfg)
+            tune_cfg.validate()
+        if self.enabled and tune_cfg is not None \
+                and getattr(tune_cfg, "enabled", False):
+            self.timeseries = TimeSeriesStore(
+                capacity=tune_cfg.store_capacity,
+                max_series=tune_cfg.store_max_series,
+                ewma_alpha=tune_cfg.store_ewma_alpha)
+            if self.recorder is not None:
+                # a crash bundle carries every series' recent trajectory
+                self.recorder.context_providers["timeseries"] = \
+                    self.timeseries.summary
         if self.recorder is not None or self.hang is not None \
                 or self.goodput is not None or self.fleet is not None:
             self.tracer.on_event = self._span_event
@@ -198,10 +224,10 @@ class Observability:
         ``make_current=False`` must not steal the live session's crash
         evidence, so this runs from ``configure_observability``, not from
         construction."""
-        if self.recorder is not None:
+        if self.recorder is not None or self.timeseries is not None:
             self.registry.on_publish = self._on_publish
-            if self.config.flight_sigusr1:
-                install_sigusr1(self.recorder)
+        if self.recorder is not None and self.config.flight_sigusr1:
+            install_sigusr1(self.recorder)
 
     # -- event dispatch (span stream -> recorder/hang/goodput) ------------
     def _span_event(self, phase: str, span: Span) -> None:
@@ -226,6 +252,10 @@ class Observability:
                 self.goodput.on_span(phase, span.name, t, dur_s=dur)
 
     def _on_publish(self, step: int, events) -> None:
+        if self.timeseries is not None:
+            self.timeseries.ingest(step, events)
+            # the store's own health is itself a series next publish
+            self.timeseries.publish_self(self.registry)
         if self.recorder is not None:
             self.recorder.record("metric_publish", step=step,
                                  events=len(events))
@@ -348,6 +378,9 @@ class Observability:
                 if self.reqtrace is not None and self.reqtrace.retained:
                     self.reqtrace.export_chrome_trace(os.path.join(
                         self.output_dir, self.config.reqtrace_chrome_file))
+                if self.timeseries is not None:
+                    self.timeseries.export_jsonl(os.path.join(
+                        self.output_dir, self.config.tune.timeseries_file))
             except Exception:  # telemetry must never take the job down
                 from ..utils.logging import logger
 
@@ -357,13 +390,14 @@ class Observability:
         self.tracer.close()
         if self.reqtrace is not None:
             self.reqtrace.close()
+        # the registry is a process singleton: only clear the publish hook
+        # if it is still OURS — a replacement session installed its own
+        # before closing us (configure_observability ordering). Outside the
+        # recorder branch: a store-only session owns the hook too.
+        if self.registry.on_publish == self._on_publish:
+            self.registry.on_publish = None
         if self.recorder is not None:
             self.recorder.detach_logging()
-            # the registry is a process singleton: only clear the publish
-            # hook if it is still OURS — a replacement session installed its
-            # own before closing us (configure_observability ordering)
-            if self.registry.on_publish == self._on_publish:
-                self.registry.on_publish = None
             from .flightrecorder import _ACTIVE_RECORDER
 
             if _ACTIVE_RECORDER is self.recorder:
@@ -397,6 +431,13 @@ def configure_observability(config: Optional[Any] = None,
     session = Observability(config, process_index=process_index)
     if make_current:
         if _SESSION is not None and _SESSION is not session:
+            if (session.timeseries is not None
+                    and _SESSION.timeseries is not None):
+                # engine rebuilds (training soft-restart remediation,
+                # fleet revival) reconfigure the session — the rolling
+                # windows must carry over, or the tuner/fleet-health
+                # medians re-warm from zero after every recovery
+                session.timeseries.adopt(_SESSION.timeseries)
             # close (without exporting) the session being replaced: left
             # open, its LIFO atexit hook would run LAST and overwrite the
             # live run's exports with stale data, and its JSONL handle

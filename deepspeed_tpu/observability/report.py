@@ -424,6 +424,60 @@ def summarize_serve_goodput(records: List[Dict[str, Any]]) -> str:
     return "\n".join(lines)
 
 
+def summarize_autotune(records: List[Dict[str, Any]]) -> str:
+    """``== autotune ==`` — the live tuner's trail: knob settings at close,
+    decisions by knob/action/reason, rollbacks, and the objective
+    before/after, from the ``tune/*`` metrics plus the time-series store's
+    self-telemetry (``timeseries/*``)."""
+    recs = [r for r in records
+            if str(r.get("name", "")).startswith(("tune/", "timeseries/"))]
+    if not recs:
+        return ""
+    latest: Dict[Tuple[str, str], Dict[str, Any]] = {}
+    for r in recs:
+        latest[(r["name"], _label_str(r.get("labels", {})))] = r
+    lines = ["== autotune =="]
+    knobs = [(r.get("labels", {}).get("knob", "?"), r["value"])
+             for (n, _), r in latest.items() if n == "tune/knob_value"]
+    if knobs:
+        lines.append("  knobs at close: " + "  ".join(
+            f"{k}={v:g}" for k, v in sorted(knobs)))
+    decisions = [(r.get("labels", {}), r["value"])
+                 for (n, _), r in latest.items() if n == "tune/decisions"]
+    if decisions:
+        rows = [[str(lbl.get("knob", "?")), str(lbl.get("action", "?")),
+                 str(lbl.get("reason", "?")), f"{v:.0f}"]
+                for lbl, v in sorted(decisions, key=lambda kv: -kv[1])]
+        lines.append(_fmt_table(["knob", "action", "reason", "count"], rows))
+    rollbacks = [(r.get("labels", {}).get("knob", "?"), r["value"])
+                 for (n, _), r in latest.items() if n == "tune/rollbacks"]
+    if rollbacks:
+        lines.append("  rollbacks: " + "  ".join(
+            f"{k}={v:.0f}" for k, v in sorted(rollbacks)))
+
+    def gauge(name: str) -> Any:
+        r = latest.get((name, "-"))
+        return r["value"] if r is not None else None
+
+    obj = gauge("tune/objective")
+    delta = gauge("tune/objective_delta")
+    if obj is not None:
+        part = f"  objective (goodput - burn penalty): last={obj:.4f}"
+        if delta is not None:
+            part += f"  last-judged move delta={delta:+.4f}"
+        lines.append(part)
+    n_series = gauge("timeseries/series")
+    if n_series is not None:
+        pts = gauge("timeseries/points_total") or 0
+        dropped = gauge("timeseries/dropped_series")
+        part = (f"  time-series store: {n_series:.0f} series, "
+                f"{pts:.0f} points")
+        if dropped:
+            part += f"  !! {dropped:.0f} series dropped at the cap"
+        lines.append(part)
+    return "\n".join(lines)
+
+
 def summarize_fleet_serving(records: List[Dict[str, Any]]) -> str:
     """``== fleet serving ==`` — the serving-fleet router's view: per-replica
     occupancy/queue table, routing decisions by policy reason, prefill→decode
@@ -927,6 +981,7 @@ def report(paths: List[str]) -> str:
                             summarize_serving(records),
                             summarize_serve_goodput(records),
                             summarize_reqtrace(records),
+                            summarize_autotune(records),
                             summarize_fleet_serving(records),
                             summarize_fleet(records),
                             summarize_recompiles(records)) if s]

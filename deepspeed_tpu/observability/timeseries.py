@@ -1,0 +1,268 @@
+"""Metric time-series store — rolling history for every published series.
+
+The registry (:mod:`.metrics`) keeps only the CURRENT value of each series;
+everything older evaporates into per-session JSONL that nothing in-process
+can read back. This module closes that gap: a bounded in-process ring of
+``(step, value)`` points per flattened series name, fed by
+``MetricsRegistry.publish`` through the observability session's
+``on_publish`` hook, so any component can ask "what has
+``serve_goodput/ttft_slo_burn_rate/replica=2`` done over the last N
+windows" instead of re-deriving it.
+
+Design constraints (same discipline as the registry):
+
+* **Host-only, O(1) ingest.** One deque append per published scalar, under
+  one lock. Nothing here ever touches a device.
+* **Bounded.** ``capacity`` points per series, ``max_series`` series total
+  — a long-running server's store stays constant-size; overflow is counted
+  (``dropped_series``), never silent.
+* **Derived stats on demand** — :meth:`TimeSeriesStore.stats` computes
+  last / mean / p50 / p99 / EWMA / windowed least-squares slope over the
+  retained window at query time, so the ingest path stays an append.
+* **Queryable by pattern** — ``query("serve_goodput/*burn*")`` (fnmatch
+  over flattened names, so labels match too: the registry flattens
+  ``{replica=2}`` into ``.../replica=2/...`` segments).
+* **Crash-evidence** — :meth:`summary` is registered as a flight-recorder
+  context provider, so a crash bundle's MANIFEST carries every series'
+  recent trajectory; :meth:`export_jsonl` writes the full rings for the
+  bench/report tooling.
+
+The store is the measurement half of the closed tune loop
+(docs/observability.md "Closed loop"): the live tuner
+(:mod:`deepspeed_tpu.autotuning.livetuner`) reads burn rates and bucket
+shares from here and walks serving knobs against them. Gated by
+``ObservabilityConfig.tune.enabled`` — the disabled path allocates
+nothing.
+"""
+
+from __future__ import annotations
+
+import collections
+import fnmatch
+import json
+import math
+import os
+import threading
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+__all__ = ["TimeSeriesStore", "series_stats"]
+
+
+def _percentile(sorted_xs: List[float], q: float) -> float:
+    """Nearest-rank percentile over an already-sorted sample."""
+    if not sorted_xs:
+        return 0.0
+    idx = min(len(sorted_xs) - 1, max(0, int(math.ceil(q * len(sorted_xs))) - 1))
+    return sorted_xs[idx]
+
+
+def series_stats(points: Iterable[Tuple[float, float]],
+                 ewma_alpha: float = 0.2,
+                 window: Optional[int] = None) -> Dict[str, float]:
+    """Rolling stats over ``(step, value)`` points (newest last). ``window``
+    restricts to the most recent N points. The slope is the least-squares
+    fit of value against sample INDEX (not step), so irregular publish
+    cadences still yield a per-window trend; callers that need per-step
+    slope can divide by their cadence."""
+    pts = list(points)
+    if window is not None and window > 0:
+        pts = pts[-window:]
+    if not pts:
+        return {"n": 0}
+    vals = [v for _, v in pts]
+    n = len(vals)
+    mean = sum(vals) / n
+    ewma = vals[0]
+    for v in vals[1:]:
+        ewma = ewma_alpha * v + (1.0 - ewma_alpha) * ewma
+    # least-squares slope over sample index
+    if n >= 2:
+        xbar = (n - 1) / 2.0
+        num = sum((i - xbar) * (v - mean) for i, v in enumerate(vals))
+        den = sum((i - xbar) ** 2 for i in range(n))
+        slope = num / den if den else 0.0
+    else:
+        slope = 0.0
+    s = sorted(vals)
+    return {
+        "n": n, "last": vals[-1], "mean": mean,
+        "min": s[0], "max": s[-1],
+        "p50": _percentile(s, 0.50), "p99": _percentile(s, 0.99),
+        "ewma": ewma, "slope": slope,
+        "first_step": pts[0][0], "last_step": pts[-1][0],
+    }
+
+
+class TimeSeriesStore:
+    """Bounded per-series ring buffers over the registry's publish stream
+    (see module docstring). Thread-safe; one per enabled observability
+    session with the ``tune`` gate on, carried ACROSS session replacements
+    (``configure_observability`` adopts the predecessor's store) so engine
+    rebuilds — fleet revivals, training soft-restarts — never re-warm the
+    rolling windows from zero."""
+
+    def __init__(self, capacity: int = 512, max_series: int = 4096,
+                 ewma_alpha: float = 0.2):
+        self.capacity = max(int(capacity), 1)
+        self.max_series = max(int(max_series), 1)
+        self.ewma_alpha = float(ewma_alpha)
+        self._lock = threading.RLock()
+        self._series: "collections.OrderedDict[str, collections.deque]" = \
+            collections.OrderedDict()
+        self.ingests = 0          # publish batches seen
+        self.points_total = 0     # points appended (ring drops not deducted)
+        self.dropped_series = 0   # appends refused at the max_series cap
+
+    # -- ingest ------------------------------------------------------------
+    def observe(self, name: str, value: float, step: int = 0) -> None:
+        """Append one point. New series past ``max_series`` are dropped
+        (counted) — a label explosion must degrade, not OOM."""
+        with self._lock:
+            ring = self._series.get(name)
+            if ring is None:
+                if len(self._series) >= self.max_series:
+                    self.dropped_series += 1
+                    return
+                ring = self._series[name] = collections.deque(
+                    maxlen=self.capacity)
+            ring.append((int(step), float(value)))
+            self.points_total += 1
+
+    def ingest(self, step: int, events: Iterable[Tuple[str, float, int]]) -> None:
+        """Feed one registry ``publish`` batch: ``(name, value, step)``
+        triples, already flattened (labels are path segments)."""
+        with self._lock:
+            self.ingests += 1
+        for name, value, ev_step in events:
+            self.observe(name, value, ev_step if ev_step is not None else step)
+
+    def adopt(self, other: "TimeSeriesStore") -> None:
+        """Take over a predecessor store's rings (session replacement — the
+        soft-restart survival path). Points beyond THIS store's capacity
+        are dropped oldest-first; counters carry over so the trajectory's
+        bookkeeping stays monotonic across rebuilds."""
+        if other is self:
+            return
+        with other._lock:
+            series = [(k, list(v)) for k, v in other._series.items()]
+            ingests, points = other.ingests, other.points_total
+            dropped = other.dropped_series
+        with self._lock:
+            for name, pts in series:
+                ring = self._series.get(name)
+                if ring is None:
+                    if len(self._series) >= self.max_series:
+                        self.dropped_series += 1
+                        continue
+                    ring = self._series[name] = collections.deque(
+                        maxlen=self.capacity)
+                # adopted history goes BEFORE anything this store observed
+                mine = list(ring)
+                ring.clear()
+                ring.extend(pts)
+                ring.extend(mine)
+            self.ingests += ingests
+            self.points_total += points
+            self.dropped_series += dropped
+
+    # -- query -------------------------------------------------------------
+    def names(self) -> List[str]:
+        with self._lock:
+            return list(self._series.keys())
+
+    def query(self, pattern: str = "*") -> Dict[str, List[Tuple[int, float]]]:
+        """Series matching an fnmatch pattern over flattened names →
+        list of ``(step, value)`` points, oldest first. Labels are path
+        segments in the flattened name (``serve_goodput/ttft_slo_burn_rate/
+        replica=2``), so ``*replica=2*`` selects one replica's series."""
+        with self._lock:
+            return {name: list(ring)
+                    for name, ring in self._series.items()
+                    if fnmatch.fnmatchcase(name, pattern)}
+
+    def window(self, name: str, n: Optional[int] = None
+               ) -> List[Tuple[int, float]]:
+        """The most recent ``n`` points of one series (all when None)."""
+        with self._lock:
+            ring = self._series.get(name)
+            pts = list(ring) if ring is not None else []
+        return pts[-n:] if n else pts
+
+    def latest(self, name: str) -> Optional[float]:
+        with self._lock:
+            ring = self._series.get(name)
+            return ring[-1][1] if ring else None
+
+    def stats(self, name: str, window: Optional[int] = None
+              ) -> Dict[str, float]:
+        """Rolling stats (last/mean/p50/p99/ewma/slope) over one series'
+        retained window — see :func:`series_stats`."""
+        return series_stats(self.window(name), ewma_alpha=self.ewma_alpha,
+                            window=window)
+
+    def stats_matching(self, pattern: str, window: Optional[int] = None
+                       ) -> Dict[str, Dict[str, float]]:
+        return {name: series_stats(pts, ewma_alpha=self.ewma_alpha,
+                                   window=window)
+                for name, pts in self.query(pattern).items()}
+
+    # -- export ------------------------------------------------------------
+    def summary(self, window: int = 32, limit: int = 256) -> Dict[str, Any]:
+        """Bounded per-series trajectory digest — the crash-bundle context
+        provider (a MANIFEST field must stay readable, so rings are
+        digested to stats + the last few points, and the series count is
+        capped)."""
+        with self._lock:
+            items = list(self._series.items())[:limit]
+            truncated = max(len(self._series) - limit, 0)
+            counters = {"ingests": self.ingests,
+                        "points_total": self.points_total,
+                        "dropped_series": self.dropped_series,
+                        "series": len(self._series)}
+        out: Dict[str, Any] = dict(counters)
+        out["truncated_series"] = truncated
+        digest = {}
+        for name, ring in items:
+            pts = list(ring)
+            st = series_stats(pts, ewma_alpha=self.ewma_alpha, window=window)
+            st["tail"] = [[s, round(v, 6)] for s, v in pts[-4:]]
+            digest[name] = st
+        out["series_stats"] = digest
+        return out
+
+    def export_jsonl(self, path: str) -> str:
+        """One record per series (full retained ring) + a header record —
+        same file discipline as ``MetricsRegistry.dump_jsonl`` (truncates:
+        the file is a snapshot)."""
+        with self._lock:
+            series = [(k, list(v)) for k, v in self._series.items()]
+            header = {"type": "timeseries_meta", "series": len(series),
+                      "capacity": self.capacity, "ingests": self.ingests,
+                      "points_total": self.points_total,
+                      "dropped_series": self.dropped_series}
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        with open(path, "w") as fh:
+            fh.write(json.dumps(header) + "\n")
+            for name, pts in series:
+                fh.write(json.dumps({
+                    "type": "timeseries", "name": name,
+                    "points": [[s, v] for s, v in pts]}) + "\n")
+        return path
+
+    def publish_self(self, registry: Any) -> None:
+        """Store self-telemetry (``timeseries/*`` gauges) into the
+        registry — called from the session's publish hook at ingest
+        cadence, so the store's own health is itself a series."""
+        with self._lock:
+            n_series, n_points = len(self._series), self.points_total
+            dropped = self.dropped_series
+        registry.gauge("timeseries/series",
+                       help="live series in the time-series store").set(
+                           n_series)
+        registry.gauge("timeseries/points_total",
+                       help="points appended to the store (ring drops not "
+                            "deducted)").set(n_points)
+        if dropped:
+            registry.gauge("timeseries/dropped_series",
+                           help="series refused at the max_series cap").set(
+                               dropped)

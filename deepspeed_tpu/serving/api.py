@@ -133,6 +133,20 @@ class ServingEngine:
         # path with zero proposals IS the plain decode, so flipping this
         # mid-stream is bit-exact by construction)
         self.spec_suspended = False
+        # prefill chunks per scheduler iteration — the live tuner's
+        # chunked-prefill budget knob. Scheduling-only: N > 1 runs the
+        # SAME compiled chunk program N times before the decode phase,
+        # pulling TTFT forward under prefill backlog at some TPOT cost;
+        # streams stay bit-exact at any setting
+        self.prefill_chunks_per_iter = 1
+        # set by FleetRouter: replicas are tuned fleet-wide, never solo
+        self._fleet_managed = False
+        # lazy live-tuner hook (single-engine deployments; see
+        # FleetRouter._maybe_tuner for the fleet path); latched per
+        # OBSERVABILITY SESSION, not once — benches replace the session
+        # after warmup
+        self._tuner = None
+        self._tuner_obs = None
         self._drafter = make_drafter(self.config, engine, self.alloc,
                                      self.blocks_per_seq,
                                      draft_engine=draft_engine,
@@ -354,6 +368,27 @@ class ServingEngine:
                     tpot_slo_ms=obs.config.serve_tpot_slo_ms,
                     slo_budget=obs.config.serve_slo_budget)
         return acct
+
+    def _maybe_tuner(self):
+        """Lazy live-tuner lookup for SINGLE-engine deployments — fleet
+        replicas return None unconditionally (the router owns the fleet's
+        controller). Same discipline as :meth:`_accountant`: the disabled
+        path is one cached-bool check, nothing allocated."""
+        if self._fleet_managed:
+            return None
+        if self._tuner is None:
+            obs = get_session()
+            if obs is not self._tuner_obs:
+                # probe once per session object: configure_observability
+                # always builds a new session, so identity tracks
+                # enable/replace without re-probing every iteration
+                with self._lock:
+                    self._tuner_obs = obs
+                    if obs.enabled:
+                        from ..autotuning.livetuner import maybe_make_tuner
+
+                        self._tuner = maybe_make_tuner(self, obs)
+        return self._tuner
 
     def _trace_start(self, req: Request, parent_trace=None) -> None:
         rt = get_session().reqtrace
@@ -638,19 +673,24 @@ class ServingEngine:
                 progress |= bool(admitted)
                 if admitted:
                     self._trace_admitted(admitted)
-                # tpusync: disable=lock-order-inversion — the SE->FR edge
-                # (prefill-complete handoff) and the FR->SE edge (router
-                # submit/step) are both RLock re-entries on the one thread
-                # that drives a fleet: engines under a router are stepped
-                # only from FleetRouter.step, which already holds FR
-                progress |= self._step_prefill()
+                for _ in range(max(int(self.prefill_chunks_per_iter), 1)):
+                    # tpusync: disable=lock-order-inversion — the SE->FR
+                    # edge (prefill-complete handoff) and the FR->SE edge
+                    # (router submit/step) are both RLock re-entries on the
+                    # one thread that drives a fleet: engines under a
+                    # router are stepped only from FleetRouter.step, which
+                    # already holds FR
+                    ran_chunk = self._step_prefill()
+                    progress |= ran_chunk
+                    if not ran_chunk:
+                        break
                 progress |= (self._step_verify()
                              if self._drafter is not None
                              and not self.spec_suspended
                              else self._step_decode())
                 self._publish_iteration()
+                it = self._iterations
                 self._iterations += 1
-                return progress
             finally:
                 if acct is not None:
                     acct.iteration_end(self.clock())
@@ -661,6 +701,14 @@ class ServingEngine:
                     # publishes the final snapshot.
                     if acct.iterations % 16 == 1:
                         acct.publish()
+        # the live tuner's decision tick runs OUTSIDE the engine lock: the
+        # controller is foreign code with its own lock, and its knob writes
+        # are plain scheduling attributes — keeping it out of the critical
+        # section keeps the lock graph acyclic (tools/tpusync)
+        tuner = self._maybe_tuner()
+        if tuner is not None:
+            tuner.on_iteration(it)
+        return progress
 
     def _expire_deadlines(self) -> bool:
         """Deadline enforcement at decode time: a request whose absolute
@@ -1340,6 +1388,8 @@ class ServingEngine:
             return
         self._closed = True
         self.stop()
+        if self._tuner is not None:
+            self._tuner.finalize()     # recommendations artifact
         if self._drafter is not None:
             self._drafter.close()
         if self._serve_acct is not None:

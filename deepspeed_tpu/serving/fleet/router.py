@@ -276,6 +276,9 @@ class FleetRouter:
             r.warmup_left = self.config.health_warmup_steps
             # request traces + serve_goodput gauges carry the replica index
             r.engine.trace_tag = str(r.index)
+            # the router owns the fleet's live tuner; replica engines must
+            # not each grow their own
+            r.engine._fleet_managed = True
         roles = {r.role for r in self.replicas}
         self.disagg = roles != {ROLE_MIXED}
         self.prefill_pool = [r for r in self.replicas
@@ -346,6 +349,17 @@ class FleetRouter:
         self._replaced_engines: List[Any] = []
         # -- overload control state --
         self._degraded = DEGRADED_NONE
+        # admission-estimate pad: the live tuner's deadline knob.
+        # _estimate_completion_s scales by (1 + pad), so pad > 0 sheds
+        # deadline-infeasible work earlier. Data-only: admission policy,
+        # never a dispatch shape.
+        self.admission_pad = 0.0
+        # lazy live-tuner hook (autotuning.livetuner), consulted at step
+        # cadence like the engines' goodput accountant: benches enable
+        # observability after construction, and the disabled path must
+        # wire nothing
+        self._tuner = None
+        self._tuner_obs = None
         self._pressure_streak = 0
         self._calm_streak = 0
         self._shed_count = 0
@@ -556,8 +570,39 @@ class FleetRouter:
                     self._settle(fr)
             self._update_overload()
             self._publish()
+            it = self._iterations
             self._iterations += 1
-            return progress
+        # the live tuner's decision tick runs OUTSIDE the router lock: the
+        # controller takes its own lock and may re-enter router APIs
+        # (set_replica_role), so in-lock invocation would knot the lock
+        # graph (tools/tpusync). Still after _update_overload — the tuner
+        # recomposes the spec flag on top of this iteration's ladder
+        # verdict.
+        tuner = self._maybe_tuner()
+        if tuner is not None:
+            tuner.on_iteration(it)
+        return progress
+
+    def _maybe_tuner(self):
+        """The live tuner, created lazily once the observability session
+        carries the ``tune.controller`` gate (benches enable it after
+        warmup). Disabled path: one cached-bool check per iteration —
+        nothing allocated, nothing dispatched."""
+        if self._tuner is None:
+            from ...observability import get_session
+
+            obs = get_session()
+            if obs is not self._tuner_obs:
+                # probe once per session object: configure_observability
+                # always builds a new session, so identity tracks
+                # enable/replace without re-probing every iteration
+                with self._lock:
+                    self._tuner_obs = obs
+                    if obs.enabled:
+                        from ...autotuning.livetuner import maybe_make_tuner
+
+                        self._tuner = maybe_make_tuner(self, obs)
+        return self._tuner
 
     def reset_latency_stats(self) -> None:
         """Drop the router's handoff/decision/resubmit tallies AND every
@@ -668,6 +713,9 @@ class FleetRouter:
             r.revive(engine, self.config.probation_requests)
             engine.trace_tag = str(r.index)   # the incarnation keeps the
             #   replica's identity on traces and serve_goodput gauges
+            engine._fleet_managed = True
+            # a fresh incarnation boots untuned; the live tuner's next
+            # decision tick re-pushes its owned knobs fleet-wide
             # conservative: even with grafted programs, the incarnation's
             # first measured steps are not representative
             r.warmup_left = self.config.health_warmup_steps
@@ -845,7 +893,41 @@ class FleetRouter:
         h = replica.health()
         avg_mnt = (statistics.fmean(self._mnt_obs)
                    if self._mnt_obs else float(max_new_tokens))
-        return tpot * (max_new_tokens + h.queue_depth * avg_mnt)
+        return ((1.0 + self.admission_pad)
+                * tpot * (max_new_tokens + h.queue_depth * avg_mnt))
+
+    def set_replica_role(self, index: int, role: str) -> None:
+        """Reassign a replica's pool membership at runtime — the live
+        tuner's prefill:decode ratio knob. Data-plane only: roles gate
+        which pool ``_pick`` routes NEW work to; in-flight requests finish
+        where they sit. Pure-prefill handoff wiring is fixed at
+        construction, so runtime moves are restricted to the
+        DECODE <-> MIXED edge (a mixed replica decodes its own prefills in
+        place — no handoff seam to rewire), and the fleet must keep at
+        least one prefill-capable and one decode-capable replica."""
+        allowed = (ROLE_DECODE, ROLE_MIXED)
+        with self._lock:
+            r = self.replicas[index]
+            if role == r.role:
+                return
+            if r.role not in allowed or role not in allowed:
+                raise ValueError(
+                    f"set_replica_role({index}, {role!r}): runtime role "
+                    "moves are decode<->mixed only (prefill handoff "
+                    "wiring is fixed at construction)")
+            prev = r.role
+            r.role = role
+            pp = [x for x in self.replicas
+                  if x.role in (ROLE_PREFILL, ROLE_MIXED)]
+            dp = [x for x in self.replicas
+                  if x.role in (ROLE_DECODE, ROLE_MIXED)]
+            if not pp or not dp:
+                r.role = prev
+                raise ValueError(
+                    f"set_replica_role({index}, {role!r}) would leave the "
+                    "fleet without a prefill- or decode-capable replica")
+            self.prefill_pool, self.decode_pool = pp, dp
+            log_dist(f"fleet replica {index} role: {prev} -> {role}")
 
     def _affinity_key(self, prompt: np.ndarray) -> Optional[bytes]:
         if int(prompt.size) < self._block_size:
@@ -1520,6 +1602,8 @@ class FleetRouter:
             return
         self._closed = True
         self.stop()
+        if self._tuner is not None:
+            self._tuner.finalize()     # recommendations artifact
         self.publish_latency_gauges()
         # pool the replicas' latency reservoirs BEFORE their close()
         # publishes: each ServingEngine.close() sets the same unlabeled

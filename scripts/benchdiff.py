@@ -1,0 +1,201 @@
+#!/usr/bin/env python
+"""Run-over-run bench artifact comparison — the trajectory, diffable.
+
+Compares two bench runs' artifacts and prints metric deltas with
+regression flags, so "did r06 get slower than r05" is a command instead of
+an eyeball pass over JSON:
+
+    python scripts/benchdiff.py bench_results/r05 bench_results/r06
+    python scripts/benchdiff.py old_metrics.jsonl new_metrics.jsonl
+    python scripts/benchdiff.py --strict --threshold 10 r05/ r06/
+
+* Directory args: each ``<name>.json`` written by ``run_bench_suite.py``
+  (``{"name", "result": {...}}``) is flattened to dotted numeric paths
+  (``result.value``, ``result.autotune_ab.tuned.p50_ttft_ms``) and diffed
+  against the same path in the other run. Skipped benches diff as absent.
+* ``.jsonl`` args: metrics JSONL (``BENCH_metrics_*.jsonl`` /
+  ``timeseries.jsonl``) — the LAST value per (name, labels) series is
+  diffed.
+
+A delta is flagged as a REGRESSION when the metric's better-direction is
+known from its name (``*_ms``/``ttft``/``tpot``/``burn``/latency → lower
+is better; ``tokens_per_sec``/``goodput``/``mfu``/throughput → higher) and
+the change moves the wrong way by more than ``--threshold`` percent
+(default 5). Unknown-direction metrics are printed but never flagged.
+``--strict`` exits 1 when any regression was flagged (CI wiring).
+
+Stdlib only — runs anywhere the artifacts do.
+"""
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, Iterable, Optional, Tuple
+
+LOWER_IS_BETTER = ("_ms", "ttft", "tpot", "burn", "latency", "wall_s",
+                   "wall_seconds", "preemptions", "sheds", "dropped",
+                   "rollbacks", "deaths", "failures", "recompile")
+HIGHER_IS_BETTER = ("tokens_per_sec", "goodput", "mfu", "throughput",
+                    "requests_per_sec", "acceptance_rate", "hit_rate",
+                    "roofline_frac", "fraction")
+
+
+def direction(path: str) -> Optional[int]:
+    """-1 lower-is-better, +1 higher-is-better, None unknown. Checked
+    most-specific token first so ``goodput_fraction`` beats ``_ms``-style
+    substring accidents."""
+    p = path.lower()
+    for tok in HIGHER_IS_BETTER:
+        if tok in p:
+            return 1
+    for tok in LOWER_IS_BETTER:
+        if tok in p:
+            return -1
+    return None
+
+
+def flatten(obj: Any, prefix: str = "") -> Dict[str, float]:
+    """Dotted-path -> numeric leaf (bools excluded: a True/False flip is
+    reported separately, not as 1.0 vs 0.0)."""
+    out: Dict[str, float] = {}
+    if isinstance(obj, dict):
+        for k, v in obj.items():
+            out.update(flatten(v, f"{prefix}.{k}" if prefix else str(k)))
+    elif isinstance(obj, bool):
+        pass
+    elif isinstance(obj, (int, float)):
+        out[prefix] = float(obj)
+    return out
+
+
+def load_run_dir(path: str) -> Dict[str, Dict[str, float]]:
+    """bench name -> flattened numeric metrics from <name>.json files."""
+    out: Dict[str, Dict[str, float]] = {}
+    for fn in sorted(os.listdir(path)):
+        if not fn.endswith(".json"):
+            continue
+        try:
+            with open(os.path.join(path, fn)) as fh:
+                rec = json.load(fh)
+        except (OSError, ValueError) as exc:
+            print(f"  !! unreadable {fn}: {exc}", file=sys.stderr)
+            continue
+        name = rec.get("name", fn[:-5]) if isinstance(rec, dict) else fn[:-5]
+        if isinstance(rec, dict) and rec.get("skipped"):
+            continue
+        result = rec.get("result", rec) if isinstance(rec, dict) else rec
+        flat = flatten(result)
+        # Bench results name their headline scalar via a sibling "metric"
+        # string; fold it into the path so direction() can classify it.
+        if isinstance(result, dict) and "value" in flat \
+                and isinstance(result.get("metric"), str):
+            flat[f"value[{result['metric']}]"] = flat.pop("value")
+        out[name] = flat
+    return out
+
+
+def load_metrics_jsonl(path: str) -> Dict[str, Dict[str, float]]:
+    """One pseudo-bench ("metrics") -> last value per (name, labels)."""
+    series: Dict[str, float] = {}
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if not isinstance(rec, dict) or "name" not in rec:
+                continue
+            if "value" not in rec or isinstance(rec["value"], (dict, list)):
+                continue
+            labels = rec.get("labels") or {}
+            key = rec["name"] + "".join(
+                f"{{{k}={labels[k]}}}" for k in sorted(labels))
+            try:
+                series[key] = float(rec["value"])
+            except (TypeError, ValueError):
+                continue
+    return {"metrics": series}
+
+
+def load(path: str) -> Dict[str, Dict[str, float]]:
+    if os.path.isdir(path):
+        return load_run_dir(path)
+    if path.endswith(".jsonl"):
+        return load_metrics_jsonl(path)
+    raise SystemExit(f"benchdiff: {path} is neither a run directory nor a "
+                     ".jsonl metrics file")
+
+
+def diff(old: Dict[str, Dict[str, float]],
+         new: Dict[str, Dict[str, float]],
+         threshold_pct: float) -> Iterable[Tuple[str, str, Optional[float],
+                                                 Optional[float], str]]:
+    """(bench, metric, old, new, flag) rows; flag in
+    {'', 'REGRESSION', 'improved', 'added', 'removed'}."""
+    for bench in sorted(set(old) | set(new)):
+        o, n = old.get(bench), new.get(bench)
+        if o is None or n is None:
+            yield (bench, "*", None, None,
+                   "added" if o is None else "removed")
+            continue
+        for path in sorted(set(o) | set(n)):
+            ov, nv = o.get(path), n.get(path)
+            if ov is None or nv is None:
+                yield (bench, path, ov, nv,
+                       "added" if ov is None else "removed")
+                continue
+            if ov == nv:
+                continue
+            pct = (100.0 * (nv - ov) / abs(ov)) if ov else float("inf")
+            d = direction(path)
+            flag = ""
+            if d is not None and abs(pct) >= threshold_pct:
+                worse = (pct > 0) if d < 0 else (pct < 0)
+                flag = "REGRESSION" if worse else "improved"
+            yield (bench, path, ov, nv, flag)
+
+
+def main(argv) -> int:
+    ap = argparse.ArgumentParser(
+        description="diff two bench runs (directories of <name>.json or "
+                    "metrics .jsonl files)")
+    ap.add_argument("old")
+    ap.add_argument("new")
+    ap.add_argument("--threshold", type=float, default=5.0,
+                    help="percent change to flag (default 5)")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit 1 when any REGRESSION was flagged")
+    ap.add_argument("--all", action="store_true",
+                    help="print unchanged-direction/small deltas too")
+    args = ap.parse_args(argv)
+
+    rows = list(diff(load(args.old), load(args.new), args.threshold))
+    regressions = 0
+    printed = 0
+    print(f"benchdiff: {args.old} -> {args.new} "
+          f"(threshold {args.threshold:g}%)")
+    for bench, path, ov, nv, flag in rows:
+        if flag == "REGRESSION":
+            regressions += 1
+        elif not args.all and flag not in ("improved", "added", "removed"):
+            continue
+        if ov is None or nv is None:
+            print(f"  [{flag:>10}] {bench}: {path}")
+        else:
+            pct = (100.0 * (nv - ov) / abs(ov)) if ov else float("inf")
+            mark = flag or "changed"
+            print(f"  [{mark:>10}] {bench}: {path}  "
+                  f"{ov:g} -> {nv:g} ({pct:+.1f}%)")
+        printed += 1
+    if not printed:
+        print("  no flagged deltas")
+    print(f"benchdiff: {regressions} regression(s) flagged")
+    return 1 if (args.strict and regressions) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -7,7 +7,9 @@
 # (serving kernel-path tests, tier-1 marker set) + chaos (training
 # fault-injection recovery smoke) + chaos_serve (serving-fleet self-healing
 # smoke) + rlhf (hybrid-engine-v2 post-training smoke: flip-no-recompile +
-# replay-bit-exact) in one run, one exit code for CI.
+# replay-bit-exact) + tune (closed-loop telemetry: time-series store +
+# live-tuner state machine + tuner-on bit-exactness) in one run, one exit
+# code for CI.
 #
 # The five analyzers share the same gate semantics (committed baseline,
 # stale-entry rot detection, the render_report tail in
@@ -24,7 +26,7 @@ cd "$(dirname "$0")/.."
 
 selected=("$@")
 fail=0
-for gate in lint audit cost shard sync parity chaos chaos_serve rlhf; do
+for gate in lint audit cost shard sync parity chaos chaos_serve rlhf tune; do
     if [ "${#selected[@]}" -gt 0 ]; then
         case " ${selected[*]} " in
             *" $gate "*) ;;

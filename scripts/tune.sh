@@ -1,0 +1,31 @@
+#!/usr/bin/env bash
+# Closed-loop telemetry gate: the metric time-series store + the live
+# serving autotuner (docs/observability.md "Closed loop",
+# docs/autotuning.md).
+#
+# Runs the fake-clock controller suite and the end-to-end contract on the
+# tiny model, asserting:
+#   * the store's rings stay bounded, stats/query/adoption behave, and a
+#     session replacement carries the rolling windows over;
+#   * the controller's full state machine under synthetic burn — propose
+#     one notch, hold, judge, keep/rollback, cooldown, relax to defaults;
+#   * the jit-cache discipline: a fleet serving with the tuner ON walking
+#     knobs mid-trace produces token streams bit-identical to the untuned
+#     solo oracle, with zero steady-state recompiles;
+#   * the disabled path wires nothing — no store allocation, no
+#     controller on either ServingEngine or FleetRouter;
+#   * the recommendations artifact (tune_recommendations.json) exists at
+#     close and carries the versioned schema (format, knobs, evidence).
+#
+# CPU-only, wall-clock-free (the controller runs on iteration counts, the
+# synthetic signals on a fake clock) — a tune gate run is exactly
+# reproducible.
+#
+# Usage: scripts/tune.sh [extra pytest args...]
+set -euo pipefail
+
+cd "$(dirname "$0")/.."
+
+JAX_PLATFORMS=cpu python -m pytest \
+    "tests/unit/test_livetuner.py" \
+    -q -p no:cacheprovider "$@"

@@ -1,0 +1,457 @@
+"""Closed-loop telemetry tests — the metric time-series store
+(``observability/timeseries.py``) and the live-signal serving autotuner
+(``autotuning/livetuner.py``).
+
+Three layers, matching the subsystem's own:
+
+* the store in isolation — bounded rings, derived stats, pattern queries,
+  predecessor adoption (the soft-restart survival path), JSONL export;
+* the controller on a FAKE clock — synthetic burn signals drive the full
+  state machine (propose → hold → judge → keep/rollback → cooldown →
+  relax) with no engine, no device, no wall time;
+* the contract end-to-end on the tiny model — a fleet serving with the
+  tuner ON produces token streams bit-identical to the untuned solo
+  oracle (the jit-cache discipline: every online knob is data-only) with
+  zero steady-state recompiles, and a disabled session wires nothing —
+  no store, no controller.
+"""
+
+import json
+import os
+import types
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from deepspeed_tpu.autotuning.livetuner import (LiveTuner,
+                                                RECOMMENDATIONS_FORMAT,
+                                                maybe_make_tuner)
+from deepspeed_tpu.config.config import (ConfigError, FleetConfig,
+                                         ObservabilityConfig, ServingConfig,
+                                         TuneConfig)
+from deepspeed_tpu.observability import (configure_observability,
+                                         get_registry, get_session,
+                                         reset_session)
+from deepspeed_tpu.observability.metrics import MetricsRegistry
+from deepspeed_tpu.observability.timeseries import (TimeSeriesStore,
+                                                    series_stats)
+
+
+@pytest.fixture(autouse=True)
+def _obs_isolation():
+    reset_session()
+    get_registry().reset()
+    yield
+    reset_session()
+    get_registry().reset()
+
+
+# ---------------------------------------------------------------------------
+# the store
+# ---------------------------------------------------------------------------
+
+
+class TestTimeSeriesStore:
+    def test_ring_bounded_per_series(self):
+        st = TimeSeriesStore(capacity=4)
+        for i in range(10):
+            st.observe("a", float(i), step=i)
+        assert st.window("a") == [(6, 6.0), (7, 7.0), (8, 8.0), (9, 9.0)]
+        assert st.points_total == 10     # appends counted, drops not deducted
+
+    def test_max_series_cap_counts_overflow(self):
+        st = TimeSeriesStore(max_series=2)
+        st.observe("a", 1.0)
+        st.observe("b", 1.0)
+        st.observe("c", 1.0)             # refused, counted
+        st.observe("a", 2.0)             # existing series still ingests
+        assert sorted(st.names()) == ["a", "b"]
+        assert st.dropped_series == 1
+        assert st.latest("a") == 2.0
+
+    def test_series_stats(self):
+        pts = [(i, float(v)) for i, v in enumerate([1, 2, 3, 4])]
+        s = series_stats(pts, ewma_alpha=0.5)
+        assert s["n"] == 4 and s["last"] == 4.0 and s["mean"] == 2.5
+        assert s["min"] == 1.0 and s["max"] == 4.0
+        assert s["slope"] == pytest.approx(1.0)      # perfectly linear
+        assert s["first_step"] == 0 and s["last_step"] == 3
+        assert series_stats([]) == {"n": 0}
+        # window restricts to the newest points
+        assert series_stats(pts, window=2)["mean"] == 3.5
+
+    def test_query_patterns_match_flattened_labels(self):
+        st = TimeSeriesStore()
+        st.observe("serve_goodput/ttft_slo_burn_rate/replica=0", 1.0)
+        st.observe("serve_goodput/ttft_slo_burn_rate/replica=1", 2.0)
+        st.observe("serving/queue_depth", 3.0)
+        assert len(st.query("serve_goodput/ttft_slo_burn_rate*")) == 2
+        assert list(st.query("*replica=1*")) == [
+            "serve_goodput/ttft_slo_burn_rate/replica=1"]
+        sts = st.stats_matching("*burn*")
+        assert {s["last"] for s in sts.values()} == {1.0, 2.0}
+
+    def test_ingest_batch_uses_event_step(self):
+        st = TimeSeriesStore()
+        st.ingest(7, [("a", 1.0, 5), ("b", 2.0, None)])
+        assert st.window("a") == [(5, 1.0)]
+        assert st.window("b") == [(7, 2.0)]      # falls back to batch step
+        assert st.ingests == 1
+
+    def test_adopt_prepends_history_and_carries_counters(self):
+        old = TimeSeriesStore(capacity=8)
+        for i in range(3):
+            old.observe("a", float(i), step=i)
+        new = TimeSeriesStore(capacity=8)
+        new.observe("a", 99.0, step=10)
+        new.adopt(old)
+        pts = new.window("a")
+        assert pts == [(0, 0.0), (1, 1.0), (2, 2.0), (10, 99.0)]
+        assert new.points_total == 4     # 3 adopted + 1 own
+
+    def test_export_jsonl_round_trip(self, tmp_path):
+        st = TimeSeriesStore()
+        st.observe("a", 1.5, step=2)
+        path = st.export_jsonl(str(tmp_path / "ts.jsonl"))
+        with open(path) as fh:
+            recs = [json.loads(l) for l in fh if l.strip()]
+        assert recs[0]["type"] == "timeseries_meta" and recs[0]["series"] == 1
+        assert recs[1] == {"type": "timeseries", "name": "a",
+                           "points": [[2, 1.5]]}
+
+    def test_publish_self_gauges(self):
+        st = TimeSeriesStore()
+        st.observe("a", 1.0)
+        reg = MetricsRegistry()
+        st.publish_self(reg)
+        snap = {name: v for name, v, _ in reg.publish(0)}
+        assert snap["timeseries/series"] == 1
+        assert snap["timeseries/points_total"] == 1
+        assert "timeseries/dropped_series" not in snap   # only when nonzero
+
+    def test_config_validation(self):
+        with pytest.raises(ConfigError):
+            TuneConfig(store_capacity=1).validate()
+        with pytest.raises(ConfigError):
+            TuneConfig(knobs=["bogus"]).validate()
+        TuneConfig().validate()
+
+
+# ---------------------------------------------------------------------------
+# the controller, fake clock
+# ---------------------------------------------------------------------------
+
+
+class FakeEngine:
+    """The attribute surface the tuner touches on a ServingEngine — no
+    device, no scheduler."""
+
+    def __init__(self, drafter=None):
+        self._drafter = drafter
+        self.spec_suspended = False
+        self.prefill_chunks_per_iter = 1
+        self._serve_acct = None
+
+
+TC = dict(enabled=True, controller=True, interval_iterations=4,
+          hold_iterations=8)
+
+
+def mk_tuner(target=None, **over):
+    cfg = TuneConfig(**dict(TC, **over))
+    cfg.validate()
+    store = TimeSeriesStore()
+    eng = target if target is not None else FakeEngine()
+    tu = LiveTuner(eng, store=store, config=cfg,
+                   registry=MetricsRegistry())
+    return tu, eng, store
+
+
+def feed(store, step, ttft=0.0, tpot=0.0, goodput=1.0):
+    store.observe("serve_goodput/ttft_slo_burn_rate", ttft, step)
+    store.observe("serve_goodput/tpot_slo_burn_rate", tpot, step)
+    store.observe("serve_goodput/goodput_fraction", goodput, step)
+
+
+def run(tu, store, n, start, **sig):
+    """Advance the fake clock n iterations, feeding one signal point per
+    iteration (so EWMA windows track the regime change)."""
+    for it in range(start, start + n):
+        feed(store, it, **sig)
+        tu.on_iteration(it)
+    return start + n
+
+
+class TestControllerFakeClock:
+    def test_off_cadence_is_a_noop(self):
+        tu, _, store = mk_tuner()
+        for it in range(1, 4):           # below interval_iterations=4
+            feed(store, it, ttft=5.0)
+            tu.on_iteration(it)
+        assert tu._last_objective is None
+        assert tu._pending is None and not tu.decisions
+
+    def test_ttft_pressure_walks_chunk_budget_to_max(self):
+        tu, eng, store = mk_tuner()
+        run(tu, store, 120, 1, ttft=2.0, goodput=0.5)
+        assert eng.prefill_chunks_per_iter == 4      # _ChunkBudgetKnob.MAX
+        rep = tu.report()
+        assert rep["moves"] >= 3 and rep["rollbacks"] == 0
+        moves = [d for d in tu.decisions if d["kind"] == "move"]
+        assert moves[0]["knob"] == "chunk_budget" \
+            and moves[0]["action"] == "up" \
+            and moves[0]["reason"] == "ttft_burn"
+        # hold window respected: consecutive moves at least hold apart
+        for a, b in zip(moves, moves[1:]):
+            assert b["iteration"] - a["iteration"] >= TC["hold_iterations"]
+        # every kept move judged with the evidence attached
+        keep = next(d for d in tu.decisions if d["kind"] == "keep")
+        assert "objective_after" in keep and keep["outcome"] == "kept"
+
+    def test_spec_suspend_after_chunk_budget_exhausts(self):
+        tu, eng, store = mk_tuner(FakeEngine(drafter=object()))
+        run(tu, store, 200, 1, ttft=2.0, goodput=0.5)
+        assert eng.prefill_chunks_per_iter == 4
+        assert eng.spec_suspended is True
+        assert ("spec", "up") in {(d["knob"], d["action"])
+                                  for d in tu.decisions}
+
+    def test_rollback_on_objective_regression_then_cooldown(self):
+        tu, eng, store = mk_tuner()
+        # pressure until exactly one move is pending
+        it = 1
+        while tu._pending is None:
+            it = run(tu, store, 1, it, ttft=2.0, goodput=0.5)
+        # the held move's after-evidence: goodput collapses
+        while tu._rollbacks == 0:
+            it = run(tu, store, 1, it, ttft=2.0, goodput=0.05)
+            assert it < 200
+        assert eng.prefill_chunks_per_iter == 1      # reverted
+        roll = next(d for d in tu.decisions if d["kind"] == "rollback")
+        assert roll["outcome"] == "rolled_back"
+        assert roll["objective_delta"] < 0
+        # (knob, action) cools down — sustained pressure proposes nothing
+        # (the fake engine has no drafter/router, so no fallback knob)
+        moves_before = tu._moves
+        it = run(tu, store, 2 * TC["hold_iterations"], it,
+                 ttft=2.0, goodput=0.05)
+        assert tu._moves == moves_before and tu._pending is None
+        # ...and re-proposes once the cooldown expires
+        it = run(tu, store, 4 * TC["hold_iterations"], it,
+                 ttft=2.0, goodput=0.5)
+        assert tu._moves > moves_before
+
+    def test_calm_signals_relax_back_to_defaults(self):
+        tu, eng, store = mk_tuner()
+        it = run(tu, store, 120, 1, ttft=2.0, goodput=0.5)
+        assert eng.prefill_chunks_per_iter > 1
+        it = run(tu, store, 200, it, ttft=0.0, tpot=0.0, goodput=0.9)
+        assert eng.prefill_chunks_per_iter == 1
+        relaxed = [d for d in tu.decisions if d["reason"] == "relax"]
+        assert relaxed and all(d["knob"] == "chunk_budget" for d in relaxed)
+        # settled at defaults: further calm ticks propose nothing
+        moves = tu._moves
+        run(tu, store, 40, it, goodput=0.9)
+        assert tu._moves == moves
+
+    def test_tpot_pressure_prefers_budget_down(self):
+        tu, eng, store = mk_tuner()
+        it = run(tu, store, 120, 1, ttft=2.0, goodput=0.5)
+        assert eng.prefill_chunks_per_iter == 4
+        run(tu, store, 60, it, ttft=0.0, tpot=2.0, goodput=0.5)
+        down = [d for d in tu.decisions if d["reason"] == "tpot_burn"]
+        assert down and down[0]["knob"] == "chunk_budget" \
+            and down[0]["action"] == "down"
+        assert eng.prefill_chunks_per_iter < 4
+
+    def test_max_moves_caps_the_walk(self):
+        tu, eng, store = mk_tuner(max_moves=1)
+        run(tu, store, 200, 1, ttft=2.0, goodput=0.5)
+        assert tu._moves == 1 and eng.prefill_chunks_per_iter == 2
+
+    def test_router_knobs_walk_and_relax(self):
+        """deadline_pad / overload_threshold against a fake router: the
+        protective walk degrades earlier + sheds sooner, and calm relaxes
+        both back to their untuned defaults."""
+        router = types.SimpleNamespace(
+            replicas=[], disagg=False, _degraded=0, admission_pad=0.0,
+            config=types.SimpleNamespace(overload_occupancy=0.9))
+        tu, _, store = mk_tuner(router,
+                                knobs=["deadline_pad", "overload_threshold"])
+        assert tu._router is router
+        it = run(tu, store, 400, 1, ttft=2.0, goodput=0.5)
+        assert router.config.overload_occupancy == pytest.approx(0.5)
+        assert router.admission_pad == pytest.approx(1.0)
+        run(tu, store, 600, it, ttft=0.0, goodput=0.9)
+        assert router.admission_pad == pytest.approx(0.0)
+        assert router.config.overload_occupancy == pytest.approx(0.9)
+
+    def test_objective_penalizes_burn_over_ceiling_only(self):
+        tu, _, _ = mk_tuner(burn_ceiling=1.0, burn_weight=2.0)
+        base = dict(ttft_burn=0.0, tpot_burn=0.0, goodput=0.8,
+                    occupancy=0.0, queue_depth=0.0)
+        assert tu.objective(dict(base)) == pytest.approx(0.8)
+        assert tu.objective(dict(base, ttft_burn=0.9)) == pytest.approx(0.8)
+        assert tu.objective(dict(base, ttft_burn=1.5)) == pytest.approx(
+            0.8 - 2.0 * 0.5)
+
+    def test_export_recommendations_artifact_schema(self, tmp_path):
+        tu, _, store = mk_tuner()
+        run(tu, store, 120, 1, ttft=2.0, goodput=0.5)
+        path = tu.export_recommendations(str(tmp_path / "rec.json"))
+        with open(path) as fh:
+            out = json.load(fh)
+        assert out["format"] == RECOMMENDATIONS_FORMAT
+        assert out["moves"] >= 1 and "objective" in out
+        assert out["knobs"]["chunk_budget"] == 4.0
+        assert isinstance(out["recommendations"], list)
+        # the settled >1 chunk budget turns into shape-knob advice only for
+        # real engines (FakeEngine has no .config) — never applied online
+        assert all(r["kind"] == "shape" for r in out["recommendations"])
+
+
+# ---------------------------------------------------------------------------
+# gating — the disabled path constructs nothing
+# ---------------------------------------------------------------------------
+
+
+def _fake_obs(enabled=True, tune=None, store="auto"):
+    return types.SimpleNamespace(
+        enabled=enabled,
+        config=types.SimpleNamespace(tune=tune),
+        timeseries=TimeSeriesStore() if store == "auto" else store,
+        registry=MetricsRegistry())
+
+
+class TestGating:
+    def test_maybe_make_tuner_requires_every_gate(self):
+        on = TuneConfig(enabled=True, controller=True)
+        assert maybe_make_tuner(FakeEngine(), _fake_obs(enabled=False,
+                                                        tune=on)) is None
+        assert maybe_make_tuner(FakeEngine(), _fake_obs(tune=None)) is None
+        assert maybe_make_tuner(
+            FakeEngine(), _fake_obs(tune=TuneConfig(enabled=True))) is None
+        assert maybe_make_tuner(FakeEngine(),
+                                _fake_obs(tune=on, store=None)) is None
+        tu = maybe_make_tuner(FakeEngine(), _fake_obs(tune=on))
+        assert isinstance(tu, LiveTuner)
+
+    def test_store_allocation_gated_on_tune_enabled(self, tmp_path):
+        configure_observability(ObservabilityConfig(
+            enabled=True, output_dir=str(tmp_path / "a")))
+        assert get_session().timeseries is None
+        configure_observability(ObservabilityConfig(
+            enabled=True, output_dir=str(tmp_path / "b"),
+            tune={"enabled": True, "store_capacity": 16}))
+        st = get_session().timeseries
+        assert isinstance(st, TimeSeriesStore) and st.capacity == 16
+
+    def test_session_replacement_adopts_store(self, tmp_path):
+        configure_observability(ObservabilityConfig(
+            enabled=True, output_dir=str(tmp_path / "a"),
+            tune={"enabled": True}))
+        get_session().timeseries.observe("a", 1.0, step=3)
+        configure_observability(ObservabilityConfig(
+            enabled=True, output_dir=str(tmp_path / "b"),
+            tune={"enabled": True}))
+        # the soft-restart survival path: rolling windows carry over
+        assert get_session().timeseries.window("a") == [(3, 1.0)]
+
+
+# ---------------------------------------------------------------------------
+# end to end — tiny model: bit-exactness with the tuner ON, and the
+# disabled path wires nothing on real engines
+# ---------------------------------------------------------------------------
+
+SCFG = dict(block_size=16, num_blocks=32, max_seqs=4, max_model_len=128,
+            prefill_chunk=16, max_queue=64)
+N_NEW = 10
+TEMP = 0.7
+
+
+@pytest.fixture(scope="module")
+def tiny_engine():
+    from deepspeed_tpu.inference import init_inference
+
+    return init_inference("tiny", dtype=jnp.float32, max_out_tokens=128)
+
+
+def mk_prompts(n, seed=23):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(1, 50, size=rng.randint(4, 48)).astype(np.int32)
+            for _ in range(n)]
+
+
+class TestTunerEndToEnd:
+    def test_disabled_session_wires_no_tuner_no_store(self, tiny_engine):
+        from deepspeed_tpu.serving import ServingEngine
+
+        assert not get_session().enabled
+        srv = ServingEngine(tiny_engine, ServingConfig(**SCFG))
+        try:
+            h = srv.submit(mk_prompts(1)[0], max_new_tokens=4, seed=0)
+            h.result()
+        finally:
+            srv.close()
+        assert srv._tuner is None
+        assert get_session().timeseries is None
+
+    def test_fleet_with_tuner_on_is_bit_exact_vs_oracle(self, tiny_engine,
+                                                        tmp_path):
+        from deepspeed_tpu.serving import ServingEngine
+        from deepspeed_tpu.serving.fleet import FleetRouter, build_replicas
+
+        prompts = mk_prompts(10)
+        # oracle: solo engine, observability disabled, no tuner
+        solo = ServingEngine(tiny_engine, ServingConfig(**SCFG))
+        try:
+            want = [solo.submit(p, max_new_tokens=N_NEW, seed=i,
+                                temperature=TEMP).result()
+                    for i, p in enumerate(prompts)]
+        finally:
+            solo.close()
+
+        # a 1ms TTFT SLO every request breaches: sustained burn makes the
+        # controller actually walk knobs mid-trace
+        configure_observability(ObservabilityConfig(
+            enabled=True, output_dir=str(tmp_path / "obs"),
+            serve_goodput=True,
+            serve_ttft_slo_ms=0.001, serve_tpot_slo_ms=1000.0,
+            tune={"enabled": True, "controller": True,
+                  "interval_iterations": 2, "hold_iterations": 4}))
+        replicas = build_replicas(tiny_engine, ServingConfig(**SCFG), 2)
+        router = FleetRouter(replicas, FleetConfig(policy="kv_occupancy"))
+        try:
+            handles, i, it = [], 0, 0
+            while i < len(prompts) or router.in_flight():
+                if i < len(prompts) and it % 2 == 0:
+                    handles.append(router.submit(
+                        prompts[i], max_new_tokens=N_NEW, seed=i,
+                        temperature=TEMP))
+                    i += 1
+                router.step()
+                it += 1
+                assert it < 10_000, "fleet made no progress"
+            got = [h.result() for h in handles]
+            tuner = router._tuner
+            assert tuner is not None, "tune gate on but no controller wired"
+            assert tuner._last_iteration > 0
+            assert tuner._moves >= 1, "sustained burn yet the tuner sat still"
+        finally:
+            router.close()
+
+        # the contract: scheduling-only knobs — streams bit-identical
+        for w, g in zip(want, got):
+            np.testing.assert_array_equal(np.asarray(w), np.asarray(g))
+        sess = get_session()
+        if sess.watchdog is not None:
+            assert sess.watchdog.steady_state_compiles == 0, (
+                "live tuning must never recompile a hot function")
+        # close() exported the shape-knob recommendations artifact
+        rec_path = os.path.join(str(tmp_path / "obs"),
+                                "tune_recommendations.json")
+        assert os.path.exists(rec_path)
+        with open(rec_path) as fh:
+            assert json.load(fh)["format"] == RECOMMENDATIONS_FORMAT
