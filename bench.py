@@ -134,6 +134,17 @@ def main() -> None:
             "fleet_cadence_steps": int(os.environ.get(
                 "BENCH_FLEET_CADENCE",
                 2 + int(os.environ.get("BENCH_STEPS", 30)))),
+            # BENCH_PROFILE=1: deep-profiler capture windows mid-bench —
+            # a scheduled window every BENCH_PROFILE_EVERY steps, parsed
+            # into profile_summary.json (measured vs tpucost-predicted
+            # step time for train/step) next to the metrics JSONL
+            "profiling": {
+                "enabled": os.environ.get("BENCH_PROFILE", "0") == "1",
+                "profile_every_steps": int(os.environ.get(
+                    "BENCH_PROFILE_EVERY", 10)),
+                "window_iterations": int(os.environ.get(
+                    "BENCH_PROFILE_WINDOW", 4)),
+            },
         },
     }
     engine, *_ = deepspeed_tpu.initialize(model=model, config=cfg)

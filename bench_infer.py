@@ -261,7 +261,8 @@ def _serve_load(srv, prompts, arrivals, n_new, deadline_s=None):
 
 
 def _configure_bench_obs(tune=False, ttft_slo_ms=0.0, tpot_slo_ms=0.0):
-    from deepspeed_tpu.config.config import ObservabilityConfig, TuneConfig
+    from deepspeed_tpu.config.config import (ObservabilityConfig,
+                                             ProfilingConfig, TuneConfig)
     from deepspeed_tpu.observability import configure_observability
 
     tune_cfg = TuneConfig()
@@ -274,6 +275,18 @@ def _configure_bench_obs(tune=False, ttft_slo_ms=0.0, tpot_slo_ms=0.0):
                 os.environ.get("BENCH_SERVE_TUNE_INTERVAL", 8)),
             hold_iterations=int(
                 os.environ.get("BENCH_SERVE_TUNE_HOLD", 16)))
+    # BENCH_PROFILE=1: deep-profiler capture windows during the serving
+    # trace — scheduled every BENCH_PROFILE_EVERY iterations (plus any
+    # telemetry triggers), with profile_summary.json's measured-vs-
+    # predicted rows landing next to the bench record
+    prof_cfg = ProfilingConfig()
+    if os.environ.get("BENCH_PROFILE", "0") == "1":
+        prof_cfg = ProfilingConfig(
+            enabled=True,
+            profile_every_steps=int(
+                os.environ.get("BENCH_PROFILE_EVERY", 64)),
+            window_iterations=int(
+                os.environ.get("BENCH_PROFILE_WINDOW", 8)))
     configure_observability(ObservabilityConfig(
         enabled=True,
         output_dir=os.environ.get("BENCH_OBS_DIR",
@@ -288,7 +301,7 @@ def _configure_bench_obs(tune=False, ttft_slo_ms=0.0, tpot_slo_ms=0.0):
         # metric AND the live tuner's input signal
         serve_ttft_slo_ms=ttft_slo_ms,
         serve_tpot_slo_ms=tpot_slo_ms,
-        tune=tune_cfg))
+        tune=tune_cfg, profiling=prof_cfg))
 
 
 def _arm_observability_stats(stats, tag, accts):

@@ -58,7 +58,9 @@ from typing import Any, Dict, List, Optional
 
 from ..utils.logging import logger
 
-__all__ = ["LiveTuner", "maybe_make_tuner", "RECOMMENDATIONS_FORMAT"]
+__all__ = ["LiveTuner", "maybe_make_tuner", "RECOMMENDATIONS_FORMAT",
+           "load_recommendations", "discover_recommendations",
+           "apply_recommendations"]
 
 RECOMMENDATIONS_FORMAT = 1
 
@@ -639,3 +641,138 @@ class LiveTuner:
                     obs.output_dir, self.config.recommendations_file))
         except Exception:
             logger.warning("live tuner finalize failed", exc_info=True)
+
+
+# ---------------------------------------------------------------------------
+# acting on the artifact — the next session's boot path
+# (``init_serving(recommendations=...)``) applies the shape knobs the last
+# run could only recommend, closing the between-session half of the loop.
+# ---------------------------------------------------------------------------
+
+
+def load_recommendations(path: str) -> Dict[str, Any]:
+    """Read a ``tune_recommendations.json``. Raises ``ValueError`` with a
+    named reason on a missing/undecodable file or a format-version
+    mismatch — an artifact from a different tuner generation must be
+    refused loudly, never half-applied."""
+    try:
+        with open(path) as fh:
+            artifact = json.load(fh)
+    except OSError as e:
+        raise ValueError(f"unreadable: {e}") from e
+    except json.JSONDecodeError as e:
+        raise ValueError(f"undecodable: {e}") from e
+    if not isinstance(artifact, dict):
+        raise ValueError("malformed: artifact is not an object")
+    fmt = artifact.get("format")
+    if fmt != RECOMMENDATIONS_FORMAT:
+        raise ValueError(f"format_version: artifact format {fmt!r} != "
+                         f"supported {RECOMMENDATIONS_FORMAT}")
+    artifact.setdefault("recommendations", [])
+    artifact["_path"] = path
+    return artifact
+
+
+def discover_recommendations(search_dir: Optional[str] = None,
+                             filename: str = "tune_recommendations.json"
+                             ) -> Optional[str]:
+    """Newest recommendations artifact under ``search_dir`` (default: the
+    current session's output dir, else ``./dstpu_obs``), by mtime. None
+    when nothing is there — auto-discovery is best-effort by design."""
+    if search_dir is None:
+        from ..observability import get_session
+
+        obs = get_session()
+        search_dir = obs.output_dir or "./dstpu_obs"
+    import glob as _glob
+
+    found = _glob.glob(os.path.join(search_dir, "**", filename),
+                       recursive=True)
+    found += _glob.glob(os.path.join(search_dir, filename))
+    if not found:
+        return None
+    return max(set(found), key=os.path.getmtime)
+
+
+# per-knob evidence floors: a recommendation below its floor was produced
+# from too little traffic to act on at boot (the tuner itself uses the same
+# thresholds when EMITTING — these guard artifacts edited by hand or
+# generated by an older/looser run)
+_EVIDENCE_FLOORS = {
+    "speculative.num_draft_tokens": ("proposed", 64),
+    "serving.prefill_chunk": ("chunks_per_iteration", 2),
+    "serving.num_blocks": ("occupancy_p99", None),   # present at all
+}
+
+
+def apply_recommendations(scfg: Any, artifact: Dict[str, Any]
+                          ) -> "tuple[List[dict], List[dict]]":
+    """Apply an artifact's shape recommendations to a ``ServingConfig``
+    IN PLACE, before engine construction (these knobs change compiled
+    program shapes — boot is the only safe time). Returns ``(applied,
+    refused)`` provenance lists; every refused entry carries a named
+    ``reason``. Never raises: an un-appliable recommendation is a refusal
+    row, not a boot failure."""
+    applied: List[dict] = []
+    refused: List[dict] = []
+    for rec in artifact.get("recommendations", []):
+        knob = rec.get("knob", "?")
+        recommended = rec.get("recommended")
+        evidence = rec.get("evidence") or {}
+        row = {"knob": knob, "current": rec.get("current"),
+               "recommended": recommended, "evidence": evidence,
+               "why": rec.get("reason", "")}
+
+        def refuse(reason: str) -> None:
+            refused.append(dict(row, reason=reason))
+
+        if rec.get("kind") != "shape":
+            refuse("not_a_shape_knob")
+            continue
+        floor = _EVIDENCE_FLOORS.get(knob)
+        if floor is None:
+            refuse("unknown_knob")
+            continue
+        key, minimum = floor
+        if key not in evidence:
+            refuse(f"insufficient_evidence:{key}_missing")
+            continue
+        if minimum is not None and evidence[key] < minimum:
+            refuse(f"insufficient_evidence:{key}={evidence[key]}"
+                   f"<{minimum}")
+            continue
+        if not isinstance(recommended, int) or recommended < 1:
+            refuse("invalid_value")
+            continue
+        if knob == "speculative.num_draft_tokens":
+            # pre-validate configs still carry the raw dict form
+            spec = scfg.speculative
+            mode = (spec.get("mode", "off") if isinstance(spec, dict)
+                    else spec.mode)
+            if mode == "off":
+                refuse("speculative_off")
+                continue
+            if isinstance(spec, dict):
+                spec["num_draft_tokens"] = recommended
+            else:
+                spec.num_draft_tokens = recommended
+        elif knob == "serving.num_blocks":
+            if recommended < scfg.blocks_per_seq():
+                refuse("below_blocks_per_seq")
+                continue
+            scfg.num_blocks = recommended
+        elif knob == "serving.prefill_chunk":
+            if recommended % scfg.block_size != 0:
+                refuse("not_block_multiple")
+                continue
+            scfg.prefill_chunk = recommended
+        applied.append(row)
+        logger.info(
+            f"tune recommendations: applied {knob} "
+            f"{rec.get('current')} -> {recommended} "
+            f"({rec.get('reason', '')})")
+    for r in refused:
+        logger.warning(
+            f"tune recommendations: REFUSED {r['knob']} "
+            f"-> {r['recommended']}: {r['reason']}")
+    return applied, refused

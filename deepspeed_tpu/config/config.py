@@ -330,6 +330,76 @@ class TuneConfig(ConfigModel):
 
 
 @dataclass
+class ProfilingConfig(ConfigModel):
+    """Triggered deep profiling (``observability/profiler.py``): bounded
+    ``jax.profiler`` capture windows opened on demand (SIGUSR2 / engine
+    ``start_profile``), on a step schedule, or by telemetry the session
+    already collects (SLO-burn over ceiling, goodput-slope collapse,
+    steady-state recompile, hang-watchdog pre-fire) — parsed into
+    per-entry device/host seconds and paired against the tpucost roofline
+    (``profile_summary.json``). Off by default: the disabled path wires no
+    hooks and never touches ``jax.profiler`` (zero extra dispatches or
+    compiles, watchdog-asserted in tests)."""
+
+    enabled: bool = False
+    trace_dir: str = ""                # "" => <output_dir>/profile
+    window_iterations: int = 8         # engine iterations/steps per window
+    window_wall_s: float = 120.0       # hard wall ceiling on an open window
+    profile_every_steps: int = 0       # scheduled windows (0 = off)
+    capture_budget: int = 8            # total captures per session — a
+    #   flapping trigger can never fill the disk
+    keep_last: int = 4                 # on-disk capture dirs retained
+    cooldown_iterations: int = 256     # per-trigger re-arm delay
+    check_interval_iterations: int = 16  # telemetry-trigger poll cadence
+    trigger_burn: bool = True          # TTFT/TPOT SLO burn over ceiling
+    burn_ceiling: float = 2.0          # EWMA burn rate that opens a window
+    trigger_goodput_slope: bool = True  # goodput EWMA slope collapse
+    slope_floor: float = -0.01         # goodput_fraction slope per step
+    trigger_recompile: bool = True     # steady-state recompile observed
+    trigger_hang: bool = True          # hang-watchdog pre-fire capture
+    hang_prefire_fraction: float = 0.5  # open at this fraction of deadline
+    sigusr2: bool = True               # SIGUSR2 => on-demand window
+    summary_file: str = "profile_summary.json"   # measured-vs-predicted
+    hotspot_top_k: int = 5             # HLO-op hotspots kept per entry
+
+    def validate(self) -> None:
+        if self.window_iterations < 1:
+            raise ConfigError(
+                "observability.profiling.window_iterations must be >= 1")
+        if self.window_wall_s <= 0:
+            raise ConfigError(
+                "observability.profiling.window_wall_s must be > 0")
+        if self.profile_every_steps < 0:
+            raise ConfigError(
+                "observability.profiling.profile_every_steps must be >= 0 "
+                "(0 = no schedule)")
+        if self.capture_budget < 1:
+            raise ConfigError(
+                "observability.profiling.capture_budget must be >= 1")
+        if self.keep_last < 1:
+            raise ConfigError(
+                "observability.profiling.keep_last must be >= 1")
+        if self.cooldown_iterations < 0:
+            raise ConfigError(
+                "observability.profiling.cooldown_iterations must be >= 0")
+        if self.check_interval_iterations < 1:
+            raise ConfigError(
+                "observability.profiling.check_interval_iterations must "
+                "be >= 1")
+        if self.burn_ceiling <= 0:
+            raise ConfigError(
+                "observability.profiling.burn_ceiling must be > 0")
+        if not 0.0 < self.hang_prefire_fraction < 1.0:
+            raise ConfigError(
+                "observability.profiling.hang_prefire_fraction must be in "
+                "(0, 1) — 1.0 would capture after the watchdog already "
+                "fired")
+        if self.hotspot_top_k < 1:
+            raise ConfigError(
+                "observability.profiling.hotspot_top_k must be >= 1")
+
+
+@dataclass
 class ObservabilityConfig(ConfigModel):
     """Gate for ``deepspeed_tpu.observability`` — span tracer, metrics
     registry file output, recompile watchdog, memory gauges. Off by default:
@@ -417,6 +487,10 @@ class ObservabilityConfig(ConfigModel):
     # autotuning/livetuner.py): metric time-series store + live-signal
     # serving controller — docs/observability.md "Closed loop"
     tune: TuneConfig = field(default_factory=TuneConfig)
+    # triggered deep profiling (observability/profiler.py): telemetry-
+    # triggered jax.profiler capture windows + per-entry device-time
+    # attribution — docs/observability.md "Deep profiling"
+    profiling: ProfilingConfig = field(default_factory=ProfilingConfig)
 
     def validate(self) -> None:
         if isinstance(self.tune, dict):
@@ -425,6 +499,9 @@ class ObservabilityConfig(ConfigModel):
             # configs, the plain dataclass constructor does not
             self.tune = TuneConfig.from_dict(self.tune)
         self.tune.validate()
+        if isinstance(self.profiling, dict):
+            self.profiling = ProfilingConfig.from_dict(self.profiling)
+        self.profiling.validate()
         if self.max_spans < 1:
             raise ConfigError("observability.max_spans must be >= 1")
         if self.memory_poll_steps < 1:

@@ -61,6 +61,8 @@ from .hangdetect import HangWatchdog
 from .memory import record_memory
 from .metrics import Counter, Gauge, Histogram, MetricsRegistry, get_registry
 from .numerics import NumericsSentinel, NumericsState, NumericsTrip
+from .profiler import (DeepProfiler, install_sigusr2, parse_trace_dir,
+                       uninstall_sigusr2)
 from .recompile import RecompileWatchdog, get_watchdog
 from .recompile import install as install_watchdog
 from .recompile import uninstall as uninstall_watchdog
@@ -83,6 +85,8 @@ __all__ = [
     "Fault", "FaultInjector",
     "ReqTrace", "RequestTracer", "ServeGoodput", "write_chrome_trace",
     "TimeSeriesStore",
+    "DeepProfiler", "parse_trace_dir", "install_sigusr2",
+    "uninstall_sigusr2",
 ]
 
 
@@ -198,6 +202,32 @@ class Observability:
                 # a crash bundle carries every series' recent trajectory
                 self.recorder.context_providers["timeseries"] = \
                     self.timeseries.summary
+        # triggered deep profiling (observability/profiler.py): capture
+        # windows + measured-vs-predicted attribution. Gated by
+        # ``config.profiling.enabled``; the disabled path wires nothing —
+        # no engine tick, no SIGUSR2, no hang pre-fire hook.
+        self.profiler: Optional[DeepProfiler] = None
+        prof_cfg = getattr(config, "profiling", None)
+        if isinstance(prof_cfg, dict):
+            from ..config.config import ProfilingConfig
+
+            prof_cfg = config.profiling = ProfilingConfig.from_dict(prof_cfg)
+            prof_cfg.validate()
+        if self.enabled and prof_cfg is not None \
+                and getattr(prof_cfg, "enabled", False):
+            self.profiler = DeepProfiler(
+                prof_cfg, registry=self.registry,
+                timeseries=self.timeseries, recorder=self.recorder,
+                output_dir=self.output_dir)
+            if self.recorder is not None:
+                # crash bundles carry the latest measured-vs-predicted
+                # summary; a hang-prefire window still open at dump time is
+                # closed first so its trace flushes into the bundle
+                self.recorder.context_providers["profile_summary"] = \
+                    self.profiler.bundle_context
+            if self.hang is not None and prof_cfg.trigger_hang:
+                self.hang.prefire_fraction = prof_cfg.hang_prefire_fraction
+                self.hang.on_prefire = self._on_hang_prefire
         if self.recorder is not None or self.hang is not None \
                 or self.goodput is not None or self.fleet is not None:
             self.tracer.on_event = self._span_event
@@ -228,6 +258,8 @@ class Observability:
             self.registry.on_publish = self._on_publish
         if self.recorder is not None and self.config.flight_sigusr1:
             install_sigusr1(self.recorder)
+        if self.profiler is not None and self.config.profiling.sigusr2:
+            install_sigusr2(self.profiler)
 
     # -- event dispatch (span stream -> recorder/hang/goodput) ------------
     def _span_event(self, phase: str, span: Span) -> None:
@@ -273,6 +305,15 @@ class Observability:
         # serving goodput: routed to whichever replica accountant is
         # mid-iteration on this thread (a threadlocal read when none is)
         _sg_note_compile(secs)
+        if self.profiler is not None:
+            # steady-state recompile => capture trigger (pending; opened at
+            # the next engine tick)
+            self.profiler.on_compile(secs, where, steady)
+
+    def _on_hang_prefire(self, stalled_span: str, waited: float,
+                         deadline: float) -> None:
+        if self.profiler is not None:
+            self.profiler.on_hang_prefire(stalled_span, waited, deadline)
 
     def _on_hang_fire(self, stalled_span: str, waited: float,
                       deadline: float, bundle: str) -> None:
@@ -311,6 +352,10 @@ class Observability:
                                   extra=extra or None) or None
 
     def note_step(self, global_step: int) -> None:
+        # NO profiler tick here: the serving engine calls note_step while
+        # holding its lock, and the profiler tick may dispatch
+        # (start_trace). Engines tick the profiler explicitly, outside
+        # their locks — ServingEngine.step and TpuEngine's step sites.
         if self.watchdog is not None:
             self.watchdog.note_step(global_step)
         if self.goodput is not None:
@@ -369,6 +414,15 @@ class Observability:
             # final-window flush: a trip after the last cadence check must
             # not exit silently (never raises; abort downgrades to log)
             self.numerics.flush()
+        if self.profiler is not None:
+            try:
+                # before dump_metrics: a window still open flushes, and its
+                # summary gauges make the final JSONL snapshot
+                self.profiler.close()
+            except Exception:
+                from ..utils.logging import logger
+
+                logger.warning("profiler close failed", exc_info=True)
         if self.enabled and export:
             try:
                 if self.goodput is not None:
@@ -402,6 +456,11 @@ class Observability:
 
             if _ACTIVE_RECORDER is self.recorder:
                 uninstall_sigusr1()
+        if self.profiler is not None:
+            from .profiler import _ACTIVE_PROFILER
+
+            if _ACTIVE_PROFILER is self.profiler:
+                uninstall_sigusr2()
         if self.watchdog is not None and get_watchdog() is self.watchdog:
             uninstall_watchdog()
 

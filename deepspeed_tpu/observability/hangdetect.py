@@ -75,6 +75,14 @@ class HangWatchdog:
         # ladder sets this to hang_soft_restarts + 1.
         self.abort_after_fires = 1
         self.on_fire = on_fire
+        # optional early hook, called ONCE per stall when the silence passes
+        # `prefire_fraction × deadline` — the deep profiler opens a capture
+        # window here so the eventual crash bundle carries a trace of the
+        # stall forming, not just its aftermath. None (default) costs one
+        # attribute check per check().
+        self.on_prefire: Optional[Callable[..., None]] = None
+        self.prefire_fraction = 0.5
+        self._prefired_beat: Optional[float] = None
         # optional () -> dict merged into the fire dump's extra — the fleet
         # monitor uses it to say "blocked in the step-N gather, rank R never
         # arrived"; None (default) costs one attribute check per fire
@@ -127,6 +135,23 @@ class HangWatchdog:
         now = self._clock() if now is None else now
         waited = now - beat_t
         deadline = self.deadline_s()
+        if self.on_prefire is not None \
+                and waited > self.prefire_fraction * deadline:
+            with self._lock:
+                # once per stall: the latch is the beat timestamp, so a
+                # heartbeat (new stall) re-arms it
+                prefire = (self._armed and self._last_beat is not None
+                           and self._last_beat[0] == beat_t
+                           and self._prefired_beat != beat_t)
+                if prefire:
+                    self._prefired_beat = beat_t
+            if prefire:
+                try:
+                    self.on_prefire(stalled_span=beat_name, waited=waited,
+                                    deadline=deadline)
+                except Exception:
+                    logger.warning("hang watchdog on_prefire hook failed",
+                                   exc_info=True)
         if waited <= deadline:
             return False
         with self._lock:

@@ -475,6 +475,86 @@ def summarize_autotune(records: List[Dict[str, Any]]) -> str:
         if dropped:
             part += f"  !! {dropped:.0f} series dropped at the cap"
         lines.append(part)
+    # boot-time provenance: which offline shape recommendations the last
+    # engine construction applied / refused (init_serving(recommendations=))
+    for name, verb in (("tune/recommendations_applied", "applied"),
+                       ("tune/recommendations_refused", "REFUSED")):
+        hits = [(r.get("labels", {}), r["value"])
+                for (n, _), r in latest.items() if n == name]
+        if hits:
+            lines.append(f"  recommendations {verb} at boot: " + "  ".join(
+                f"{lbl.get('knob', '?')}"
+                + (f" ({lbl['reason']})" if lbl.get("reason") else "")
+                + (f" x{v:.0f}" if v != 1 else "")
+                for lbl, v in sorted(hits, key=lambda kv: str(kv[0]))))
+    return "\n".join(lines)
+
+
+def summarize_profiling(records: List[Dict[str, Any]]) -> str:
+    """``== profiling ==`` — the deep profiler's trail: capture ledger
+    (windows by trigger, budget headroom, wall cost) and the per-entry
+    measured-vs-predicted table from the ``profile/*`` metrics
+    (``observability/profiler.py``). model_error is measured/predicted
+    step time — 1.0 means the tpucost roofline is exact."""
+    recs = [r for r in records
+            if str(r.get("name", "")).startswith("profile/")]
+    if not recs:
+        return ""
+    latest: Dict[Tuple[str, str], Dict[str, Any]] = {}
+    for r in recs:
+        latest[(r["name"], _label_str(r.get("labels", {})))] = r
+    lines = ["== profiling =="]
+    captures = [(r.get("labels", {}).get("trigger", "?"), r["value"])
+                for (n, _), r in latest.items() if n == "profile/captures"]
+    if captures:
+        total = sum(v for _, v in captures)
+        lines.append(f"  capture windows: {total:.0f} (" + "  ".join(
+            f"{t}={v:.0f}" for t, v in sorted(captures)) + ")")
+    budget = latest.get(("profile/budget_remaining", "-"))
+    if budget is not None:
+        lines.append(f"  capture budget remaining: {budget['value']:.0f}")
+    wall = next((r for (n, _), r in latest.items()
+                 if n == "profile/capture_wall_seconds"), None)
+    if wall is not None and wall.get("count"):
+        lines.append(
+            f"  window wall cost: mean={wall.get('mean', 0):.2f}s "
+            f"max={wall.get('max', 0):.2f}s over {wall['count']:.0f} "
+            "window(s)")
+    entries: Dict[str, Dict[str, Dict[str, Any]]] = {}
+    for (n, _), r in latest.items():
+        entry = r.get("labels", {}).get("entry")
+        if entry:
+            entries.setdefault(entry, {})[n.split("/", 1)[1]] = r
+    if entries:
+        rows = []
+        for entry in sorted(entries):
+            m = entries[entry]
+
+            def val(name: str, fmt: str = ".4f") -> str:
+                r = m.get(name)
+                return format(r["value"], fmt) if r else "-"
+
+            pred = m.get("predicted_step_ms")
+            rows.append([
+                entry,
+                val("device_seconds", ".4f"),
+                val("host_seconds", ".4f"),
+                val("measured_step_ms"),
+                val("predicted_step_ms"),
+                val("model_error", ".2f"),
+                val("measured_mfu", ".4f"),
+                (pred or {}).get("labels", {}).get("bound", "-"),
+            ])
+        lines.append(_fmt_table(
+            ["entry", "device_s", "host_s", "meas_ms", "pred_ms",
+             "err_x", "meas_mfu", "bound"], rows))
+        bad = [e for e, m in entries.items()
+               if m.get("model_error", {}).get("value", 0) > 3.0]
+        if bad:
+            lines.append("  !! measured > 3x predicted for: "
+                         + ", ".join(sorted(bad))
+                         + " — the cost model is missing something these "
+                           "programs do")
     return "\n".join(lines)
 
 
@@ -982,6 +1062,7 @@ def report(paths: List[str]) -> str:
                             summarize_serve_goodput(records),
                             summarize_reqtrace(records),
                             summarize_autotune(records),
+                            summarize_profiling(records),
                             summarize_fleet_serving(records),
                             summarize_fleet(records),
                             summarize_recompiles(records)) if s]
@@ -1096,6 +1177,53 @@ def crash_report(bundle_dir: str, last_steps: int = 5,
                 f"replicas {'>'.join(tr.get('replicas', [])) or '-'} "
                 f"age {tr.get('age_s', 0):.1f}s — last: {doing}"
                 + (f" ({breakdown})" if breakdown else ""))
+
+    # PR-18 staple, surfaced here for the first time: the time-series
+    # store's trajectory digest — what every key series was doing in the
+    # steps leading up to the dump
+    ts = man.get("timeseries") or {}
+    series_stats = ts.get("series_stats") or {}
+    if ts:
+        lines.append(
+            f"\n== metric trajectories ==  ({ts.get('series', 0)} series, "
+            f"{ts.get('points_total', 0)} points in store"
+            + (f", {ts['dropped_series']} dropped at cap"
+               if ts.get("dropped_series") else "") + ")")
+        # most-volatile first: |slope| ranks "what was moving" above noise
+        ranked = sorted(series_stats.items(),
+                        key=lambda kv: -abs(kv[1].get("slope", 0.0)))
+        for name, st in ranked[:12]:
+            tail = " ".join(f"{v:.4g}" for _, v in (st.get("tail") or []))
+            lines.append(
+                f"  {name}: last={st.get('last', 0):.6g} "
+                f"ewma={st.get('ewma', 0):.6g} "
+                f"slope={st.get('slope', 0):+.4g} n={st.get('n', 0)}"
+                + (f"  tail[{tail}]" if tail else ""))
+        if len(ranked) > 12:
+            lines.append(f"  ... {len(ranked) - 12} more series in "
+                         "MANIFEST.json")
+    prof = man.get("profile_summary") or {}
+    if prof:
+        cap = prof.get("capture") or {}
+        lines.append("\n== profiling staple ==")
+        if cap:
+            lines.append(
+                f"  latest capture: #{cap.get('seq', '?')} "
+                f"trigger={cap.get('trigger', '?')} "
+                f"status={cap.get('status', '?')} "
+                f"wall={cap.get('wall_s', 0):.2f}s")
+        for c in (prof.get("captures") or [])[:8]:
+            lines.append(
+                f"    window #{c.get('seq', '?')} {c.get('trigger', '?')} "
+                f"@iter {c.get('opened_iteration', '?')} "
+                f"-> {c.get('status', '?')}")
+        for entry, row in sorted((prof.get("entries") or {}).items()):
+            part = (f"  {entry}: device={row.get('device_s', 0):.4f}s "
+                    f"meas={row.get('measured_step_ms', '-')}ms")
+            if row.get("predicted_step_ms") is not None:
+                part += (f" pred={row['predicted_step_ms']}ms "
+                         f"err={row.get('model_error', '-')}x")
+            lines.append(part)
 
     steps = [e for e in events
              if e.get("kind") == "span_end" and e.get("name") == "train_batch"]

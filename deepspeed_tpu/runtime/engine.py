@@ -1063,6 +1063,8 @@ class TrainEngine:
         if obs.enabled:
             obs.note_step(self.global_steps)
             obs.maybe_record_memory(self.global_steps)
+            if obs.profiler is not None:
+                obs.profiler.on_step(self.global_steps)
         # cadence-gated flag materialisation (the sentinel's ONE host sync);
         # between cadence steps this is a single modulo. Raises NumericsTrip
         # under action='abort' — after dumping the bundle.
@@ -1337,6 +1339,8 @@ class TrainEngine:
         if self._obs.enabled:
             self._obs.note_step(self.global_steps)
             self._obs.maybe_record_memory(self.global_steps)
+            if self._obs.profiler is not None:
+                self._obs.profiler.on_step(self.global_steps)
         self._last_lr = float(stats.lr)
         if self.lr_scheduler is not None and hasattr(self.lr_scheduler, "step"):
             self.lr_scheduler.step()
@@ -1536,7 +1540,10 @@ class TrainEngine:
                       # (all gas microbatches) — tpucost's roofline turns
                       # it into a predicted tokens/sec bound
                       "tokens_per_step": _batch_tokens(stacked_batch),
-                      "shard": self._shard_tag(group=prefix)})
+                      "shard": self._shard_tag(group=prefix),
+                      # lowered module name ("jit_train_step") — the deep
+                      # profiler's attribution key back to this entry
+                      "program": "train_step"})
             return name
         except Exception:  # registration must never take training down
             logger.warning("tpuaudit step registration failed", exc_info=True)
@@ -1637,6 +1644,22 @@ class TrainEngine:
             raise RuntimeError(
                 "start_profile() called while a profiler trace is already "
                 "active — call stop_profile() first")
+        prof = getattr(self._obs, "profiler", None)
+        if log_dir is None and prof is not None:
+            # deep profiler present: the manual window rides its ledger —
+            # capture dir management, parse + measured-vs-predicted summary
+            # on stop, profile/* metrics (an explicit log_dir keeps the raw
+            # path: the operator asked for a specific directory)
+            cap = prof.open_window("manual")
+            if cap is None:
+                raise RuntimeError(
+                    "start_profile(): a triggered capture window is "
+                    "already open — it closes at its iteration/wall bound")
+            self._profiling = True
+            self._profile_capture = cap
+            self._profile_span = self._obs.span(
+                "profile", category="profiler", dir=cap.dir).begin()
+            return
         log_dir = log_dir or self.config.observability.profile_dir
         jax.profiler.start_trace(log_dir)
         self._profiling = True
@@ -1648,7 +1671,13 @@ class TrainEngine:
             logger.warning("stop_profile() called with no active profiler "
                            "trace — ignoring")
             return
-        jax.profiler.stop_trace()
+        if getattr(self, "_profile_capture", None) is not None:
+            prof = getattr(self._obs, "profiler", None)
+            if prof is not None:
+                prof.close_window()
+            self._profile_capture = None
+        else:
+            jax.profiler.stop_trace()
         self._profiling = False
         if self._profile_span is not None:
             self._profile_span.end()

@@ -708,6 +708,11 @@ class ServingEngine:
         tuner = self._maybe_tuner()
         if tuner is not None:
             tuner.on_iteration(it)
+        # deep-profiler tick, same discipline: trigger polling and window
+        # open/close do their own locking and may dispatch (start_trace)
+        prof = get_session().profiler
+        if prof is not None:
+            prof.on_iteration(it)
         return progress
 
     def _expire_deadlines(self) -> bool:
@@ -1532,14 +1537,19 @@ class ServingEngine:
                 tags={"engine": "ServingEngine", "chunk": C,
                       "max_blocks": MAXB, "paged_impl": self._paged_impl,
                       # one chunked-prefill run ingests C prompt tokens
-                      "tokens_per_step": C, "shard": shard})
+                      "tokens_per_step": C, "shard": shard,
+                      # lowered module name ("jit_<program>") — the deep
+                      # profiler keys measured device time back to this
+                      # entry through it
+                      "program": "prefill_chunk"})
             register_entry_point(
                 "serving/decode", build=build_decode, donate_argnums=(1,),
                 expected_collectives=expected, mesh=self.engine.mesh,
                 tags={"engine": "ServingEngine", "rows": R,
                       "max_blocks": MAXB, "paged_impl": self._paged_impl,
                       # one decode iteration emits one token per row
-                      "tokens_per_step": R, "shard": shard})
+                      "tokens_per_step": R, "shard": shard,
+                      "program": "decode"})
 
             def build_cow():
                 eng = wself()
@@ -1557,7 +1567,8 @@ class ServingEngine:
                 "serving/cow_copy", build=build_cow, donate_argnums=(0,),
                 expected_collectives=(), mesh=self.engine.mesh,
                 tags={"engine": "ServingEngine",
-                      "block_size": self.config.block_size})
+                      "block_size": self.config.block_size,
+                      "program": "cow_copy"})
             def build_score():
                 eng = wself()
                 if eng is None:
@@ -1581,7 +1592,8 @@ class ServingEngine:
                 tags={"engine": "ServingEngine", "chunk": C,
                       "max_blocks": MAXB, "paged_impl": self._paged_impl,
                       # one scoring chunk ingests C sequence tokens
-                      "tokens_per_step": C, "shard": shard})
+                      "tokens_per_step": C, "shard": shard,
+                      "program": "score_chunk"})
             names = ["serving/prefill_chunk", "serving/decode",
                      "serving/cow_copy", "serving/score_chunk"]
             if self._drafter is not None:
@@ -1628,7 +1640,8 @@ class ServingEngine:
                   # conservative floor: one verify dispatch emits AT LEAST
                   # one token per row (acceptance only adds to this)
                   "tokens_per_step": R,
-                  "shard": self.engine._shard_tag()})
+                  "shard": self.engine._shard_tag(),
+                  "program": "verify"})
         names = ["serving/verify"]
         drafter = self._drafter
         if not hasattr(drafter, "_decode"):    # host-side drafter: no
@@ -1688,30 +1701,97 @@ class ServingEngine:
             mesh=drafter.engine.mesh,
             tags={"engine": "ServingEngine", "rows": R,
                   "draft_model": True, "tokens_per_step": R,
-                  "shard": dshard})
+                  # the drafter's decode lowers to the same jit_decode
+                  # module name as the target's — the profiler attributes
+                  # the program to the target entry and marks it shared
+                  "shard": dshard, "program": "decode"})
         register_entry_point(
             "serving/draft_prefill", build=build_draft_prefill,
             donate_argnums=(1,), expected_collectives=dexp,
             mesh=drafter.engine.mesh,
             tags={"engine": "ServingEngine", "chunk": C,
                   "draft_model": True, "tokens_per_step": C,
-                  "shard": dshard})
+                  "shard": dshard, "program": "prefill_chunk"})
         return names + ["serving/draft_decode", "serving/draft_prefill"]
+
+
+def _apply_boot_recommendations(scfg: ServingConfig,
+                                recommendations: Any) -> "tuple":
+    """Resolve + apply a ``tune_recommendations.json`` to the serving
+    config before engine construction (boot is the only recompile-safe
+    moment for shape knobs). ``recommendations``: a path, an already-loaded
+    artifact dict, or ``"auto"`` (newest artifact in the run dir). Returns
+    ``(applied, refused)`` provenance lists and publishes
+    ``tune/recommendations_{applied,refused}`` counters; a bad artifact is
+    refused with a named reason, never a boot failure."""
+    from ..autotuning.livetuner import (apply_recommendations,
+                                        discover_recommendations,
+                                        load_recommendations)
+    from ..observability import get_registry
+
+    applied: List[dict] = []
+    refused: List[dict] = []
+    artifact: Optional[dict] = None
+    if isinstance(recommendations, dict):
+        artifact = recommendations
+    else:
+        path = recommendations
+        if path == "auto":
+            path = discover_recommendations()
+            if path is None:
+                logger.info("tune recommendations: auto-discovery found "
+                            "no artifact; booting with configured shapes")
+                return applied, refused
+        try:
+            artifact = load_recommendations(str(path))
+        except ValueError as e:
+            refused.append({"knob": "*", "reason": str(e),
+                            "path": str(path)})
+            logger.warning(
+                f"tune recommendations: REFUSED artifact {path}: {e}")
+    if artifact is not None:
+        applied, refused2 = apply_recommendations(scfg, artifact)
+        refused += refused2
+    reg = get_registry()
+    for row in applied:
+        reg.counter(
+            "tune/recommendations_applied",
+            help="offline shape recommendations applied at engine "
+                 "boot").inc(knob=row["knob"])
+    for row in refused:
+        reg.counter(
+            "tune/recommendations_refused",
+            help="offline shape recommendations refused at boot (named "
+                 "reason)").inc(knob=row["knob"],
+                                reason=row["reason"].split(":", 1)[0])
+    return applied, refused
 
 
 def init_serving(model=None, serving_config: Optional[Any] = None,
                  clock: Callable[[], float] = time.monotonic,
-                 draft_model=None, **init_inference_kwargs) -> ServingEngine:
+                 draft_model=None, recommendations: Optional[Any] = None,
+                 **init_inference_kwargs) -> ServingEngine:
     """Build an ``InferenceEngine`` (same surface as ``init_inference``) and
     wrap it in a ``ServingEngine``. ``serving_config``: a ``ServingConfig``
     or plain dict. ``draft_model`` (for ``speculative.mode='draft'``): a
     model name/instance for the drafter — built on the same dtype so its
-    paged arena shares the serving block pool cleanly."""
+    paged arena shares the serving block pool cleanly. ``recommendations``:
+    a ``tune_recommendations.json`` path, loaded artifact dict, or
+    ``"auto"`` — the previous run's offline shape advice (speculative K,
+    block pool, chunk width) applied to the config at boot with provenance
+    (``engine.recommendations_applied``)."""
     from ..inference import init_inference
 
     if isinstance(serving_config, dict):
         serving_config = ServingConfig.from_dict(serving_config)
     scfg = serving_config or ServingConfig()
+    rec_applied: List[dict] = []
+    rec_refused: List[dict] = []
+    if recommendations is not None:
+        rec_applied, rec_refused = _apply_boot_recommendations(
+            scfg, recommendations)
+        if rec_applied:
+            scfg.validate()   # applied shapes must still be a legal config
     # the offline arena is unused by serving, but a shared engine may still
     # serve generate() calls — keep its budget at least the serving budget
     init_inference_kwargs.setdefault("max_out_tokens", scfg.max_model_len)
@@ -1721,5 +1801,8 @@ def init_serving(model=None, serving_config: Optional[Any] = None,
         draft_engine = init_inference(
             model=draft_model, dtype=engine.config.dtype,
             max_out_tokens=scfg.max_model_len)
-    return ServingEngine(engine, scfg, clock=clock,
-                         draft_engine=draft_engine)
+    serving = ServingEngine(engine, scfg, clock=clock,
+                            draft_engine=draft_engine)
+    serving.recommendations_applied = rec_applied
+    serving.recommendations_refused = rec_refused
+    return serving

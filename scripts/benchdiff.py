@@ -16,6 +16,10 @@ an eyeball pass over JSON:
 * ``.jsonl`` args: metrics JSONL (``BENCH_metrics_*.jsonl`` /
   ``timeseries.jsonl``) — the LAST value per (name, labels) series is
   diffed.
+* ``profile_summary.json`` args (the deep profiler's measured-vs-predicted
+  artifact): each entry's measured/predicted step ms, model_error and
+  measured MFU are diffed per entry — a widening model_error run-over-run
+  flags as a REGRESSION (the cost model is drifting from the chip).
 
 A delta is flagged as a REGRESSION when the metric's better-direction is
 known from its name (``*_ms``/``ttft``/``tpot``/``burn``/latency → lower
@@ -35,7 +39,8 @@ from typing import Any, Dict, Iterable, Optional, Tuple
 
 LOWER_IS_BETTER = ("_ms", "ttft", "tpot", "burn", "latency", "wall_s",
                    "wall_seconds", "preemptions", "sheds", "dropped",
-                   "rollbacks", "deaths", "failures", "recompile")
+                   "rollbacks", "deaths", "failures", "recompile",
+                   "model_error", "device_s", "host_s")
 HIGHER_IS_BETTER = ("tokens_per_sec", "goodput", "mfu", "throughput",
                     "requests_per_sec", "acceptance_rate", "hit_rate",
                     "roofline_frac", "fraction")
@@ -121,13 +126,38 @@ def load_metrics_jsonl(path: str) -> Dict[str, Dict[str, float]]:
     return {"metrics": series}
 
 
+def load_profile_summary(path: str) -> Dict[str, Dict[str, float]]:
+    """One pseudo-bench ("profile_summary") -> per-entry measured vs
+    predicted columns from the deep profiler's artifact
+    (``observability/profiler.py``). Column paths carry the entry name
+    (``serving/decode.model_error``) so direction() classifies them and
+    run-over-run deltas stay per-entry."""
+    with open(path) as fh:
+        doc = json.load(fh)
+    if not isinstance(doc, dict) or "entries" not in doc:
+        raise SystemExit(f"benchdiff: {path} is not a profile_summary.json "
+                         "(no 'entries' key)")
+    flat: Dict[str, float] = {}
+    for entry, row in sorted(doc.get("entries", {}).items()):
+        if not isinstance(row, dict):
+            continue
+        for col in ("measured_step_ms", "predicted_step_ms", "model_error",
+                    "measured_mfu", "device_s", "host_s", "invocations"):
+            v = row.get(col)
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                flat[f"{entry}.{col}"] = float(v)
+    return {"profile_summary": flat}
+
+
 def load(path: str) -> Dict[str, Dict[str, float]]:
     if os.path.isdir(path):
         return load_run_dir(path)
     if path.endswith(".jsonl"):
         return load_metrics_jsonl(path)
-    raise SystemExit(f"benchdiff: {path} is neither a run directory nor a "
-                     ".jsonl metrics file")
+    if path.endswith(".json"):
+        return load_profile_summary(path)
+    raise SystemExit(f"benchdiff: {path} is neither a run directory, a "
+                     ".jsonl metrics file, nor a profile_summary.json")
 
 
 def diff(old: Dict[str, Dict[str, float]],

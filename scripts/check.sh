@@ -8,8 +8,9 @@
 # fault-injection recovery smoke) + chaos_serve (serving-fleet self-healing
 # smoke) + rlhf (hybrid-engine-v2 post-training smoke: flip-no-recompile +
 # replay-bit-exact) + tune (closed-loop telemetry: time-series store +
-# live-tuner state machine + tuner-on bit-exactness) in one run, one exit
-# code for CI.
+# live-tuner state machine + tuner-on bit-exactness) + profile (triggered
+# deep-profiling: capture-window state machine + trace attribution + the
+# measured-vs-predicted join) in one run, one exit code for CI.
 #
 # The five analyzers share the same gate semantics (committed baseline,
 # stale-entry rot detection, the render_report tail in
@@ -26,7 +27,7 @@ cd "$(dirname "$0")/.."
 
 selected=("$@")
 fail=0
-for gate in lint audit cost shard sync parity chaos chaos_serve rlhf tune; do
+for gate in lint audit cost shard sync parity chaos chaos_serve rlhf tune profile; do
     if [ "${#selected[@]}" -gt 0 ]; then
         case " ${selected[*]} " in
             *" $gate "*) ;;

@@ -1,0 +1,9 @@
+#!/usr/bin/env bash
+# Deep-profiling gate: the triggered-capture state machine (fake clock, no
+# wall time), trace-artifact attribution on the committed fixture, a live
+# CPU capture smoke joining measured seconds against the tpucost
+# prediction, and the boot-recommendations apply/refuse matrix.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+JAX_PLATFORMS=cpu python -m pytest "tests/unit/test_profiler.py" -q \
+    -p no:cacheprovider "$@"

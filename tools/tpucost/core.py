@@ -28,7 +28,7 @@ from . import extract
 from .roofline import roofline
 
 __all__ = ["CostVector", "cost_entry", "run_cost", "registry_cost_vector",
-           "publish_vectors"]
+           "measured_join", "publish_vectors"]
 
 
 @dataclasses.dataclass
@@ -155,6 +155,41 @@ def registry_cost_vector(name: str, **kwargs) -> Optional[CostVector]:
         return cost_entry(ep, **kwargs)
     except Exception:                               # noqa: BLE001
         return None
+
+
+def measured_join(entry: str, measured_step_s: float,
+                  device_kind: Optional[str] = None) -> Optional[dict]:
+    """Pair ONE measured per-invocation device time (seconds, from a
+    profiler capture window) with this entry's roofline prediction — the
+    join half of the measured-vs-predicted loop. Returns the comparison
+    columns (``predicted_step_ms``, ``mfu_ceiling``, ``bound``,
+    ``model_error`` = measured/predicted, and ``measured_mfu`` when the
+    vector has flops) or None when the entry can't be costed — the
+    profiler treats that as a missing column, never an error."""
+    if measured_step_s <= 0:
+        return None
+    v = registry_cost_vector(entry, device_kind=device_kind)
+    if v is None:
+        return None
+    out: Dict[str, Any] = {
+        "predicted_step_ms": round(v.predicted_step_s * 1e3, 4),
+        "mfu_ceiling": round(v.mfu_ceiling, 4),
+        "bound": v.bound,
+    }
+    if v.predicted_step_s > 0:
+        out["model_error"] = round(measured_step_s / v.predicted_step_s, 4)
+    flops = v.metrics.get("flops", 0.0)
+    if flops > 0:
+        try:
+            from deepspeed_tpu.autotuning.cost_model import peak_flops_for
+
+            peak = peak_flops_for(device_kind)
+        except Exception:                           # noqa: BLE001
+            peak = 0.0
+        if peak > 0:
+            out["measured_mfu"] = round(
+                flops / (measured_step_s * peak), 6)
+    return out
 
 
 # gauges published per entry (the report CLI's == cost == section reads
